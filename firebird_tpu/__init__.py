@@ -1,0 +1,38 @@
+"""firebird_tpu — a TPU-native LCMAP CCDC framework.
+
+A ground-up re-design of the capabilities of USGS-EROS/lcmap-firebird
+(reference: /root/reference, a PySpark 2.3 / Mesos / Cassandra driver for
+per-pixel CCDC change detection + RandomForest land-cover classification)
+for TPU hardware with JAX/XLA.
+
+Architecture (vs. reference ccdc/ layering, see SURVEY.md §1):
+
+- ``grid``      — pure-numpy Albers grid geometry (replaces merlin.geometry +
+                  Chipmunk /grid /snap /near HTTP calls; ref ccdc/grid.py).
+- ``ingest``    — chip sources + dense device packing (replaces merlin.create
+                  + ccdc/timeseries.py per-pixel RDD fan-out).
+- ``ccd``       — the CCDC science kernel in JAX (replaces the external
+                  lcmap-pyccd package driven by ccdc/pyccd.py). NumPy float64
+                  oracle + jit/vmap TPU kernel, scan-over-time design.
+- ``rf``        — RandomForest training + JAX inference (replaces
+                  ccdc/randomforest.py + features.py + udfs.py on Spark ML).
+- ``store``     — keyed idempotent sinks: sqlite/parquet/memory backends with
+                  the reference's four logical tables (replaces
+                  ccdc/cassandra.py + chip/pixel/segment/tile modules).
+- ``parallel``  — device mesh / sharding helpers (replaces Spark partitioning,
+                  shuffle and Mesos scheduling with jax.sharding over ICI/DCN).
+- ``driver``    — host orchestration: tile -> chunks -> prefetch -> device ->
+                  drain (replaces ccdc/core.py).
+- ``cli``       — `firebird changedetection|classification` (ref ccdc/cli.py).
+- ``ops``       — Pallas TPU kernels for hot inner ops.
+- ``utils``     — dates, functional helpers.
+
+Unlike the reference (env vars read at import time, ccdc/__init__.py:11-26),
+configuration here is explicit: build a :class:`firebird_tpu.config.Config`
+and pass it down.
+"""
+
+from firebird_tpu.__about__ import __version__
+from firebird_tpu.config import Config
+
+__all__ = ["Config", "__version__"]

@@ -1,0 +1,60 @@
+"""Command line interface (reference: ccdc/cli.py).
+
+Commands mirror the reference's click group: `changedetection` and
+`classification` with the same option names (cli.py:25-74).  The driver
+wiring lands with the end-to-end slice; until then the commands surface a
+clear error rather than silently doing nothing.
+"""
+
+from __future__ import annotations
+
+import click
+
+from firebird_tpu.utils import dates
+
+
+def context_settings():
+    """Normalized (lower-cased) tokens, as the reference (cli.py:9-16)."""
+    return dict(token_normalize_func=lambda x: x.lower())
+
+
+@click.group(context_settings=context_settings())
+def entrypoint():
+    """firebird_tpu — TPU-native LCMAP CCDC."""
+
+
+@entrypoint.command()
+@click.option("--x", "-x", required=True, type=float)
+@click.option("--y", "-y", required=True, type=float)
+@click.option("--acquired", "-a", required=False, default=None)
+@click.option("--number", "-n", required=False, default=2500, type=int)
+@click.option("--chunk_size", "-c", required=False, default=2500, type=int)
+def changedetection(x, y, acquired, number, chunk_size):
+    """Run change detection for a tile and save results to the store."""
+    from firebird_tpu.driver import core
+
+    return core.changedetection(
+        x=x, y=y,
+        acquired=acquired or dates.default_acquired(),
+        number=number, chunk_size=chunk_size,
+    )
+
+
+@entrypoint.command()
+@click.option("--x", "-x", required=True, type=float)
+@click.option("--y", "-y", required=True, type=float)
+@click.option("--msday", "-s", required=True, type=int)
+@click.option("--meday", "-e", required=True, type=int)
+@click.option("--acquired", "-a", required=False, default=None)
+def classification(x, y, msday, meday, acquired):
+    """Train on the 3x3 tile neighborhood and classify the tile."""
+    from firebird_tpu.driver import core
+
+    return core.classification(
+        x=x, y=y, msday=msday, meday=meday,
+        acquired=acquired or dates.default_acquired(),
+    )
+
+
+if __name__ == "__main__":
+    entrypoint()

@@ -1,0 +1,101 @@
+"""Configuration for firebird_tpu.
+
+The reference reads env vars at import time into module constants
+(ccdc/__init__.py:11-26: ARD_CHIPMUNK, AUX_CHIPMUNK, CASSANDRA_*,
+INPUT_PARTITIONS, PRODUCT_PARTITIONS) and derives a Cassandra keyspace from
+the ARD/AUX URL paths + version.txt (ccdc/__init__.py:29-44).
+
+Here configuration is an explicit, immutable dataclass constructed from env
+(:meth:`Config.from_env`) or keyword arguments, passed down the stack.  The
+same three tiers exist: deploy-time env, per-run CLI options, and derived
+config (``keyspace``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from urllib.parse import urlparse
+
+from firebird_tpu.__about__ import __version__ as _VERSION
+
+
+def _cqlstr(s: str) -> str:
+    """Sanitize a string for use as a store namespace (keyspace).
+
+    Mirrors merlin.functions.cqlstr semantics used by the reference keyspace
+    derivation (ccdc/__init__.py:44): strip non-alphanumeric to underscores.
+    """
+    return re.sub(r"[^a-zA-Z0-9_]", "_", s)
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Deploy-time configuration.
+
+    Attributes mirror the reference's env contract where one exists; TPU/JAX
+    specific knobs replace the Spark/Cassandra tuning.
+    """
+
+    # Data sources (reference: ARD_CHIPMUNK / AUX_CHIPMUNK urls)
+    ard_url: str = "http://localhost:5656"
+    aux_url: str = "http://localhost:5656"
+
+    # Results store. backend: 'sqlite' | 'parquet' | 'memory' | 'cassandra'
+    store_backend: str = "sqlite"
+    store_path: str = "firebird.db"
+
+    # Host-side ingest parallelism (reference: INPUT_PARTITIONS, default 1,
+    # "controls parallel requests to chipmunk")
+    input_parallelism: int = 1
+
+    # Device batching: chips fitted per device dispatch (replaces
+    # PRODUCT_PARTITIONS; sizing is per-device batch, not partition count).
+    chips_per_batch: int = 8
+
+    # Max observations capacity per pixel time series (padded/bucketed).
+    max_obs: int = 512
+
+    # Time-bucket granularity for padding (ingest pads T up to a multiple).
+    obs_bucket: int = 64
+
+    # JAX compute dtype for the CCD kernel ('float32' or 'float64').
+    dtype: str = "float32"
+
+    # Framework version (reference: version.txt read in keyspace()).
+    version: str = _VERSION
+
+    @classmethod
+    def from_env(cls, env: dict | None = None, **overrides) -> "Config":
+        """Build a Config from environment variables (explicitly, not at
+        import time).  Recognized vars mirror the reference where possible:
+        ARD_CHIPMUNK, AUX_CHIPMUNK, INPUT_PARTITIONS, plus
+        FIREBIRD_STORE_BACKEND, FIREBIRD_STORE_PATH, FIREBIRD_CHIPS_PER_BATCH,
+        FIREBIRD_MAX_OBS, FIREBIRD_DTYPE.
+        """
+        e = os.environ if env is None else env
+        kw = dict(
+            ard_url=e.get("ARD_CHIPMUNK", cls.ard_url),
+            aux_url=e.get("AUX_CHIPMUNK", cls.aux_url),
+            store_backend=e.get("FIREBIRD_STORE_BACKEND", cls.store_backend),
+            store_path=e.get("FIREBIRD_STORE_PATH", cls.store_path),
+            input_parallelism=int(e.get("INPUT_PARTITIONS", cls.input_parallelism)),
+            chips_per_batch=int(e.get("FIREBIRD_CHIPS_PER_BATCH", cls.chips_per_batch)),
+            max_obs=int(e.get("FIREBIRD_MAX_OBS", cls.max_obs)),
+            dtype=e.get("FIREBIRD_DTYPE", cls.dtype),
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+    def keyspace(self) -> str:
+        """Derive the store namespace from ARD/AUX URL paths + version.
+
+        Mirrors ccdc/__init__.py:29-44: results are namespaced by input
+        source and code version so reruns against different inputs or code
+        never collide.
+        """
+        ard = urlparse(self.ard_url).path.replace("/", "")
+        aux = urlparse(self.aux_url).path.replace("/", "")
+        ks = _cqlstr(f"{ard}_{aux}_ccdc_{self.version}").strip().lower().lstrip("_")
+        return ks

@@ -1,0 +1,102 @@
+"""Observability: logging, counters, and timers.
+
+The reference logs exclusively through JVM log4j over the py4j bridge
+(ccdc/__init__.py:60-76 "the jvm is what is actually doing all the logging"),
+with per-subsystem categories configured in resources/log4j.properties:48-53
+(`ids`, `change-detection`, `random-forest-training`,
+`random-forest-classification`, `timeseries`, `pyccd`).
+
+Here there is no JVM: plain Python logging with the same category names, an
+ISO8601 stderr format mirroring log4j.properties:20-24, plus the metrics the
+reference lacks (SURVEY.md §5): chip/pixel/segment throughput counters.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+import time
+
+# Per-subsystem categories, mirroring resources/log4j.properties:48-53.
+CATEGORIES = (
+    "ids",
+    "change-detection",
+    "random-forest-training",
+    "random-forest-classification",
+    "timeseries",
+    "pyccd",
+)
+
+_configured = False
+_lock = threading.Lock()
+
+
+def configure(level: int = logging.INFO) -> None:
+    """Install the ISO8601 stderr handler once (idempotent)."""
+    global _configured
+    with _lock:
+        if _configured:
+            return
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter(
+                fmt="%(asctime)s %(levelname)s %(name)s: %(message)s",
+                datefmt="%Y-%m-%dT%H:%M:%S",
+            )
+        )
+        root = logging.getLogger("firebird")
+        root.addHandler(handler)
+        root.setLevel(level)
+        root.propagate = False
+        _configured = True
+
+
+def logger(name: str) -> logging.Logger:
+    """Get a per-subsystem logger (replaces ccdc.logger(ctx, name))."""
+    configure()
+    return logging.getLogger(f"firebird.{name}")
+
+
+class Counters:
+    """Thread-safe throughput counters.
+
+    The reference has no metrics system (SURVEY.md §5); these close that gap.
+    Typical keys: chips, pixels, segments, bytes_in, bytes_out.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+        self._t0 = time.monotonic()
+
+    def add(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + n
+
+    def get(self, key: str) -> int:
+        with self._lock:
+            return self._counts.get(key, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            elapsed = time.monotonic() - self._t0
+            out = dict(self._counts)
+        out["elapsed_sec"] = elapsed
+        for k in list(out):
+            if k != "elapsed_sec" and elapsed > 0:
+                out[f"{k}_per_sec"] = out[k] / elapsed
+        return out
+
+
+class timer:
+    """Context manager measuring wall time in seconds (``.elapsed``)."""
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        self.elapsed = 0.0
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.monotonic() - self._t0
+        return False

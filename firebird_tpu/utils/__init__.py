@@ -1,0 +1,4 @@
+from firebird_tpu.utils.fn import first, second, flatten, partition_all, take
+from firebird_tpu.utils import dates
+
+__all__ = ["first", "second", "flatten", "partition_all", "take", "dates"]

@@ -1,0 +1,26 @@
+"""Test harness configuration.
+
+Tests run on CPU with a virtual 8-device platform so multi-chip sharding is
+exercised without TPU hardware (mirrors the driver's dryrun_multichip
+validation).  Env must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# The CCD oracle is float64; enable x64 so the JAX kernel can be tested at
+# both precisions.
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

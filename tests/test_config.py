@@ -1,0 +1,32 @@
+from firebird_tpu.config import Config
+
+
+def test_defaults():
+    cfg = Config()
+    assert cfg.input_parallelism == 1  # mirrors INPUT_PARTITIONS default
+    assert cfg.max_obs == 512
+
+
+def test_from_env():
+    cfg = Config.from_env(env={"ARD_CHIPMUNK": "http://h:1/ard_x",
+                               "AUX_CHIPMUNK": "http://h:1/aux_y",
+                               "INPUT_PARTITIONS": "4"})
+    assert cfg.ard_url.endswith("/ard_x")
+    assert cfg.input_parallelism == 4
+
+
+def test_keyspace_derivation():
+    # Mirrors ccdc/__init__.py:29-44: keyspace = f(ard path, aux path, version)
+    cfg = Config(ard_url="http://host/ard-c01-v01", aux_url="http://host/aux-c01-v01",
+                 version="1.0")
+    ks = cfg.keyspace()
+    assert ks == "ard_c01_v01_aux_c01_v01_ccdc_1_0"
+    # namespaced differently for different inputs
+    cfg2 = Config(ard_url="http://host/ard-c01-v02", aux_url="http://host/aux-c01-v01",
+                  version="1.0")
+    assert cfg2.keyspace() != ks
+
+
+def test_overrides():
+    cfg = Config.from_env(env={}, chips_per_batch=16)
+    assert cfg.chips_per_batch == 16

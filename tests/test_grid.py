@@ -1,0 +1,102 @@
+"""Grid geometry tests.
+
+Golden values come from the reference's recorded Chipmunk responses
+(test/data/{grid,snap,near}_response.json): the tile grid maps proj
+(-615585, 2414805) <-> grid (13, 6) and the chip grid maps
+(-543585, 2378805) <-> (674, 312).
+"""
+
+import numpy as np
+
+from firebird_tpu import grid
+
+
+def test_definition_shape():
+    defn = grid.CONUS.definition()
+    assert {d["name"] for d in defn} == {"tile", "chip"}
+    assert set(defn[0].keys()) == {"proj", "tx", "sy", "ty", "ry", "rx", "sx", "name"}
+    tiledef = next(d for d in defn if d["name"] == "tile")
+    assert tiledef["sx"] == 150000.0 and tiledef["tx"] == 2565585.0
+
+
+def test_grid_pt_proj_pt_roundtrip_tile():
+    # Golden pair from snap_response.json
+    assert grid.grid_pt(-615585.0, 2414805.0, grid.CONUS_TILE) == (13, 6)
+    assert grid.proj_pt(13, 6, grid.CONUS_TILE) == (-615585.0, 2414805.0)
+
+
+def test_grid_pt_proj_pt_roundtrip_chip():
+    assert grid.grid_pt(-543585.0, 2378805.0, grid.CONUS_CHIP) == (674, 312)
+    assert grid.proj_pt(674, 312, grid.CONUS_CHIP) == (-543585.0, 2378805.0)
+
+
+def test_snap_interior_point():
+    # Any point interior to chip (674, 312) snaps to its UL corner.
+    s = grid.snap(-543585.0 + 1500.0, 2378805.0 - 1500.0)
+    assert s["chip"]["proj-pt"] == (-543585.0, 2378805.0)
+    assert s["chip"]["grid-pt"] == (674, 312)
+    # ... and to the containing tile (13, 6).
+    assert s["tile"]["proj-pt"] == (-615585.0, 2414805.0)
+    assert s["tile"]["grid-pt"] == (13, 6)
+
+
+def test_tile_record():
+    t = grid.tile(100, 200)
+    assert set(t.keys()) == {"x", "y", "h", "v", "ulx", "uly", "lrx", "lry", "chips"}
+    # 100, 200 falls in tile h=17, v=20 region? Verify self-consistency.
+    assert t["ulx"] == t["x"] and t["uly"] == t["y"]
+    assert t["lrx"] - t["ulx"] == 150000.0
+    assert t["uly"] - t["lry"] == 150000.0
+    assert t["ulx"] <= 100 < t["lrx"]
+    assert t["lry"] < 200 <= t["uly"]
+    # A tile contains exactly 50x50 = 2500 chips (SURVEY.md §0).
+    assert t["chips"].shape == (2500, 2)
+    # First chip is the tile's UL corner; chips step by 3000 m.
+    assert tuple(t["chips"][0]) == (t["ulx"], t["uly"])
+    assert tuple(t["chips"][1]) == (t["ulx"] + 3000, t["uly"])
+    assert tuple(t["chips"][50]) == (t["ulx"], t["uly"] - 3000)
+    # All chips are inside the tile extents.
+    assert t["chips"][:, 0].min() == t["ulx"]
+    assert t["chips"][:, 0].max() == t["lrx"] - 3000
+    assert t["chips"][:, 1].max() == t["uly"]
+    assert t["chips"][:, 1].min() == t["lry"] + 3000
+
+
+def test_chips_ints():
+    cs = grid.chips(grid.tile(-543585.0, 2378805.0))
+    assert len(cs) == 2500
+    assert all(isinstance(c[0], int) and isinstance(c[1], int) for c in cs)
+    assert (-543585, 2378805) in cs
+
+
+def test_near_is_3x3():
+    n = grid.near(-543585.0, 2378805.0)
+    assert len(n["tile"]) == 9
+    assert len(n["chip"]) == 9
+    hs = sorted({gp["grid-pt"][0] for gp in n["tile"]})
+    vs = sorted({gp["grid-pt"][1] for gp in n["tile"]})
+    assert hs == [12, 13, 14]
+    assert vs == [5, 6, 7]
+    # Ordering matches the reference fixture: h ascending outer, proj-y
+    # ascending inner (near_response.json).
+    assert n["tile"][0]["grid-pt"] == (12, 7)
+    assert n["tile"][1]["grid-pt"] == (12, 6)
+    assert n["tile"][-1]["grid-pt"] == (14, 5)
+
+
+def test_training_is_nine_tiles():
+    # ref test/test_grid.py:18-20 asserts 9 tiles worth of chips.
+    cids = grid.training(-543585.0, 2378805.0)
+    assert len(cids) == 9 * 2500
+    assert len(set(cids)) == 9 * 2500
+
+
+def test_classification_is_one_tile():
+    cids = grid.classification(-543585.0, 2378805.0)
+    assert len(cids) == 2500
+    assert (-543585, 2378805) in cids
+
+
+def test_coordinates_dtype():
+    t = grid.tile(0, 0)
+    assert t["chips"].dtype == np.int64
