@@ -1,0 +1,25 @@
+"""CCDC science kernel.
+
+Replaces the external lcmap-pyccd package (the reference's hot path:
+``ccd.detect(dates, blues, ..., qas)`` called per pixel inside a Spark
+flatMap, ccdc/pyccd.py:151-183).  Two implementations of one spec:
+
+- :mod:`firebird_tpu.ccd.reference` — NumPy float64 oracle.  Readable,
+  per-pixel, defines the algorithm.  Used as the golden standard in tests
+  and for CPU fallback.
+- :mod:`firebird_tpu.ccd.kernel` — the TPU implementation: jit + vmap over
+  all 10,000 pixels of a chip, scan-over-time state machine, batched linear
+  algebra on the MXU.
+
+Both read their constants from :mod:`firebird_tpu.ccd.params`.
+
+The result contract mirrors pyccd's exactly (ccdc/pyccd.py:106-148 and the
+golden fixture test/test_pyccd.py:37-126): a dict with ``change_models``
+(list of segments with per-band {magnitude, rmse, coefficients, intercept})
+and ``processing_mask`` aligned to the input observation order.
+"""
+
+from firebird_tpu.ccd import params
+from firebird_tpu.ccd.reference import detect
+
+__all__ = ["params", "detect"]

@@ -1,0 +1,143 @@
+"""Behavioral tests for the CCDC NumPy oracle.
+
+The reference repo has no algorithm-accuracy tests (the algorithm lived in
+the external pyccd package); these pin the behavior of our spec on series
+with known ground truth.
+"""
+
+import numpy as np
+import pytest
+
+from firebird_tpu.ccd import detect, params, synthetic
+from firebird_tpu.utils import dates as dt
+
+
+@pytest.fixture(scope="module")
+def t():
+    return synthetic.acquisition_dates("1995-01-01", "2015-01-01", 16)
+
+
+def test_stable_series_one_segment(t, rng=None):
+    rng = np.random.default_rng(7)
+    Y = synthetic.harmonic_series(t, rng)
+    res = detect(**synthetic.pixel(t, Y))
+    assert res["procedure"] == "standard"
+    assert len(res["change_models"]) == 1
+    m = res["change_models"][0]
+    assert m["curve_qa"] == params.CURVE_QA_START | params.CURVE_QA_END
+    assert m["start_day"] == int(t[0])
+    assert m["end_day"] == int(t[-1])
+    assert m["change_probability"] <= 1 / params.PEEK_SIZE
+    # Model recovered: nir mean ~2500, annual cos amplitude ~400.
+    nir = m["nir"]
+    fitted_mean = nir["intercept"] + nir["coefficients"][0] * (t[0] + t[-1]) / 2
+    assert abs(fitted_mean - 2500) < 100
+    assert abs(nir["coefficients"][1] - 400) < 60
+    assert nir["rmse"] < 60
+    # Essentially every clear obs participates.
+    assert m["observation_count"] >= t.shape[0] - 2
+
+
+def test_step_change_two_segments(t):
+    rng = np.random.default_rng(8)
+    Y = synthetic.harmonic_series(t, rng)
+    Y = synthetic.with_step_change(Y, t, "2005-06-01", delta=800.0)
+    res = detect(**synthetic.pixel(t, Y))
+    assert len(res["change_models"]) == 2
+    first, second = res["change_models"]
+    change_ord = dt.to_ordinal("2005-06-01")
+    # Break lands on the first obs at/after the change date.
+    expected_break = int(t[t >= change_ord][0])
+    assert first["break_day"] == expected_break
+    assert first["change_probability"] == 1.0
+    assert first["curve_qa"] == params.CURVE_QA_START
+    assert second["curve_qa"] == params.CURVE_QA_END
+    assert second["start_day"] == expected_break
+    # Magnitude reflects the step (nir residual ~ +800).
+    assert abs(first["nir"]["magnitude"] - 800) < 150
+    # Second segment fits the shifted level.
+    m2 = second["nir"]
+    mid2 = (second["start_day"] + second["end_day"]) / 2
+    assert abs(m2["intercept"] + m2["coefficients"][0] * mid2 - 3300) < 120
+
+
+def test_single_outlier_is_masked_not_break(t):
+    rng = np.random.default_rng(9)
+    Y = synthetic.harmonic_series(t, rng)
+    spike = t.shape[0] // 2
+    Y[:, spike] += 4000.0  # a cloud-like spike in every band
+    res = detect(**synthetic.pixel(t, Y))
+    assert len(res["change_models"]) == 1
+    assert res["processing_mask"][spike] == 0
+
+
+def test_all_fill_no_models():
+    t = np.array([723868, 724404, 731205, 734973])
+    Y = np.full((7, 4), params.FILL_VALUE, dtype=np.float64)
+    qa = np.full(4, synthetic.QA_FILL, dtype=np.uint16)
+    res = detect(**synthetic.pixel(t, Y, qa))
+    assert res["change_models"] == []
+    assert res["processing_mask"] == [0, 0, 0, 0]
+    assert res["procedure"] == "no-data"
+
+
+def test_reference_fixture_element_shape():
+    """The reference's canonical smoke element: 4 obs, all fill values,
+    qas=1 (fill bit) — test/__init__.py:37-46."""
+    res = detect(
+        dates=[734973, 731205, 724404, 723868],
+        blues=np.full(4, -9999, np.int16), greens=np.full(4, -9999, np.int16),
+        reds=np.full(4, -9999, np.int16), nirs=np.full(4, -9999, np.int16),
+        swir1s=np.full(4, -9999, np.int16), swir2s=np.full(4, -9999, np.int16),
+        thermals=np.full(4, -9999, np.int16),
+        qas=np.array([1, 1, 1, 1], np.uint16))
+    assert res["change_models"] == []
+    assert len(res["processing_mask"]) == 4
+
+
+def test_snow_procedure(t):
+    rng = np.random.default_rng(10)
+    Y = synthetic.harmonic_series(t, rng)
+    qa = np.full(t.shape[0], synthetic.QA_SNOW, dtype=np.uint16)
+    qa[: t.shape[0] // 10] = synthetic.QA_CLEAR  # <25% clear, >75% snow
+    res = detect(**synthetic.pixel(t, Y, qa))
+    assert res["procedure"] == "permanent-snow"
+    assert len(res["change_models"]) == 1
+    assert res["change_models"][0]["curve_qa"] == params.CURVE_QA_PERSIST_SNOW
+    assert res["change_models"][0]["change_probability"] == 0.0
+
+
+def test_insufficient_clear_procedure(t):
+    rng = np.random.default_rng(11)
+    Y = synthetic.harmonic_series(t, rng)
+    qa = np.full(t.shape[0], synthetic.QA_CLOUD, dtype=np.uint16)
+    res = detect(**synthetic.pixel(t, Y, qa))
+    assert res["procedure"] == "insufficient-clear"
+    assert len(res["change_models"]) == 1
+    assert res["change_models"][0]["curve_qa"] == params.CURVE_QA_INSUF_CLEAR
+
+
+def test_input_order_invariance(t):
+    """The data plane delivers newest-first (ccdc/timeseries.py:104-115);
+    results must not depend on input order and the mask must align to the
+    input order."""
+    rng = np.random.default_rng(12)
+    Y = synthetic.harmonic_series(t, rng)
+    spike = t.shape[0] // 2
+    Y[:, spike] += 4000.0
+    fwd = detect(**synthetic.pixel(t, Y))
+    rev = detect(**synthetic.pixel(t[::-1], Y[:, ::-1]))
+    assert fwd["change_models"] == rev["change_models"]
+    assert rev["processing_mask"] == fwd["processing_mask"][::-1]
+
+
+def test_segment_record_contract(t):
+    """Fields consumed by the format layer (ccdc/pyccd.py:106-148)."""
+    rng = np.random.default_rng(13)
+    Y = synthetic.harmonic_series(t, rng)
+    m = detect(**synthetic.pixel(t, Y))["change_models"][0]
+    assert {"start_day", "end_day", "break_day", "observation_count",
+            "change_probability", "curve_qa"} <= set(m.keys())
+    for band in params.BAND_NAMES:
+        assert {"magnitude", "rmse", "coefficients", "intercept"} == set(m[band].keys())
+        assert len(m[band]["coefficients"]) == 7
