@@ -25,6 +25,10 @@ from scipy import stats
 # ---------------------------------------------------------------------------
 NUM_BANDS = 7
 BAND_NAMES = ("blue", "green", "red", "nir", "swir1", "swir2", "thermal")
+# Plural forms are the data-plane keyword names (ccdc/timeseries.py:33-45)
+# and index the spectra axis everywhere.
+BAND_NAMES_PLURAL = ("blues", "greens", "reds", "nirs", "swir1s", "swir2s",
+                     "thermals")
 
 # Bands used for change scoring (green, red, nir, swir1, swir2).
 DETECTION_BANDS = (1, 2, 3, 4, 5)

@@ -64,7 +64,7 @@ def pixel(t: np.ndarray, Y: np.ndarray, qa: np.ndarray | None = None) -> dict:
     """Pack into the detect() keyword contract (ccdc/pyccd.py:161-168)."""
     if qa is None:
         qa = np.full(t.shape[0], QA_CLEAR, dtype=np.uint16)
-    names = ("blues", "greens", "reds", "nirs", "swir1s", "swir2s", "thermals")
+    names = params.BAND_NAMES_PLURAL
     d = {n: np.clip(Y[i], -32768, 32767).astype(np.int16)
          for i, n in enumerate(names)}
     d["dates"] = t.astype(np.int64)

@@ -1,0 +1,23 @@
+"""Ingest: chip sources and dense device packing.
+
+Replaces the reference's merlin/Chipmunk data plane (ccdc/timeseries.py +
+the external merlin package).  The reference fans one chip id out to 10,000
+per-pixel Python rows inside a Spark flatMap (timeseries.py:120-125) and
+repartitions them over the cluster; here a chip stays a dense array — the
+packer emits device-ready batches ``[chips, bands, pixels, time]`` and the
+TPU kernel vmaps over the pixel axis.  No shuffle exists because sharding is
+a static, even split of the chip batch (SURVEY.md §2.4).
+
+Sources are pluggable (the reference's test seam is merlin cfg function
+injection, test/conftest.py:20-37; ours is the :class:`ChipSource`
+protocol): synthetic (deterministic, for tests/bench), file-backed
+fixtures, and a Chipmunk HTTP client.
+"""
+
+from firebird_tpu.ingest.packer import ChipData, PackedChips, pack, pixel_timeseries
+from firebird_tpu.ingest.sources import SyntheticSource, FileSource, ChipmunkSource
+
+__all__ = [
+    "ChipData", "PackedChips", "pack", "pixel_timeseries",
+    "SyntheticSource", "FileSource", "ChipmunkSource",
+]

@@ -1,0 +1,139 @@
+"""Dense packing of chip time series for device dispatch.
+
+The unit of I/O is the chip: 100x100 pixels x 7 spectral bands + QA over T
+acquisitions (SURVEY.md §0).  A :class:`ChipData` holds one chip's aligned
+arrays; :func:`pack` batches several into a :class:`PackedChips` with the
+time axis padded to a bucket size so XLA sees few distinct shapes
+(SURVEY.md §7 "ragged time dimension -> padding/bucketing policy").
+
+Padding convention: padded observations carry QA = fill (bit 0 set) and
+spectra = FILL_VALUE, so the kernel's QA triage drops them with no special
+cases — padding is indistinguishable from fill data, which the algorithm
+already handles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from firebird_tpu.ccd import params
+
+CHIP_SIDE = 100          # pixels per chip side (registry data_shape [100,100])
+PIXELS = CHIP_SIDE * CHIP_SIDE
+PIXEL_SIZE_M = 30        # Landsat ARD pixel, meters
+
+QA_FILL_PACKED = np.uint16(1 << params.QA_FILL_BIT)
+
+
+@dataclasses.dataclass
+class ChipData:
+    """One chip's date-aligned time series.
+
+    dates:   [T] ordinal days, ascending.
+    spectra: [7, T, 100, 100] int16 (band order blue..thermal).
+    qas:     [T, 100, 100] uint16 bit-packed QA.
+    """
+
+    cx: int
+    cy: int
+    dates: np.ndarray
+    spectra: np.ndarray
+    qas: np.ndarray
+
+    def __post_init__(self):
+        T = self.dates.shape[0]
+        assert self.spectra.shape == (params.NUM_BANDS, T, CHIP_SIDE, CHIP_SIDE), \
+            self.spectra.shape
+        assert self.qas.shape == (T, CHIP_SIDE, CHIP_SIDE), self.qas.shape
+        assert T < 2 or bool(np.all(np.diff(self.dates) >= 0)), "dates must ascend"
+
+
+@dataclasses.dataclass
+class PackedChips:
+    """A device-ready batch of chips.
+
+    cids:    [C, 2] int64 chip ids (cx, cy).
+    dates:   [C, T] int32, ascending within the valid prefix, 0-padded.
+    spectra: [C, 7, P, T] int16, FILL_VALUE-padded.
+    qas:     [C, P, T] uint16, fill-bit padded.
+    n_obs:   [C] int32 valid observation count per chip.
+
+    P = 10000 pixels in row-major order: pixel index p = row*100 + col where
+    (row, col) counts from the chip's upper-left, so the pixel's projection
+    coordinate is (px, py) = (cx + col*30, cy - row*30).
+    """
+
+    cids: np.ndarray
+    dates: np.ndarray
+    spectra: np.ndarray
+    qas: np.ndarray
+    n_obs: np.ndarray
+
+    @property
+    def n_chips(self) -> int:
+        return self.cids.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.dates.shape[1]
+
+    def pixel_coords(self, c: int) -> np.ndarray:
+        """[P, 2] (px, py) projection coordinates of chip c's pixels."""
+        cx, cy = self.cids[c]
+        cols = np.arange(CHIP_SIDE) * PIXEL_SIZE_M
+        rows = np.arange(CHIP_SIDE) * PIXEL_SIZE_M
+        px = cx + np.tile(cols, CHIP_SIDE)
+        py = cy - np.repeat(rows, CHIP_SIDE)
+        return np.stack([px, py], axis=1).astype(np.int64)
+
+
+def bucket_capacity(T: int, bucket: int, max_obs: int) -> int:
+    """Round T up to a bucket multiple, capped at max_obs."""
+    cap = ((max(T, 1) + bucket - 1) // bucket) * bucket
+    return min(cap, max_obs) if max_obs else cap
+
+
+def pack(chips: list[ChipData], *, bucket: int = 64, max_obs: int = 0) -> PackedChips:
+    """Pack chips into one padded batch.
+
+    If a chip has more observations than max_obs (when nonzero), the oldest
+    are kept and the newest truncated — and a warning is the caller's job to
+    surface; truncation loses data and max_obs should be sized to the
+    archive (a 40-year Landsat series at 16-day cadence with two platforms
+    is ~1800 acquisitions).
+    """
+    assert chips, "cannot pack zero chips"
+    T_max = max(c.dates.shape[0] for c in chips)
+    cap = bucket_capacity(T_max, bucket, max_obs)
+
+    C = len(chips)
+    cids = np.zeros((C, 2), np.int64)
+    dates = np.zeros((C, cap), np.int32)
+    spectra = np.full((C, params.NUM_BANDS, PIXELS, cap), params.FILL_VALUE, np.int16)
+    qas = np.full((C, PIXELS, cap), QA_FILL_PACKED, np.uint16)
+    n_obs = np.zeros(C, np.int32)
+
+    for i, c in enumerate(chips):
+        T = min(c.dates.shape[0], cap)
+        cids[i] = (c.cx, c.cy)
+        dates[i, :T] = c.dates[:T]
+        # [7, T, 100, 100] -> [7, P, T]
+        spectra[i, :, :, :T] = (
+            c.spectra[:, :T].reshape(params.NUM_BANDS, T, PIXELS).transpose(0, 2, 1))
+        qas[i, :, :T] = c.qas[:T].reshape(T, PIXELS).T
+        n_obs[i] = T
+    return PackedChips(cids=cids, dates=dates, spectra=spectra, qas=qas, n_obs=n_obs)
+
+
+def pixel_timeseries(p: PackedChips, c: int, pix: int) -> dict:
+    """Extract one pixel as the detect() keyword contract — the bridge to
+    the per-pixel oracle and the reference's row shape
+    (ccdc/timeseries.py:104-115)."""
+    T = int(p.n_obs[c])
+    d = {n: p.spectra[c, b, pix, :T].copy()
+         for b, n in enumerate(params.BAND_NAMES_PLURAL)}
+    d["dates"] = p.dates[c, :T].astype(np.int64)
+    d["qas"] = p.qas[c, pix, :T].copy()
+    return d

@@ -1,0 +1,261 @@
+"""Chip sources: synthetic, file-backed, and Chipmunk HTTP.
+
+The reference's only source is the Chipmunk raster service reached through
+merlin (`merlin.create`, driven at ccdc/timeseries.py:120-123; chip payloads
+are base64 int16 rasters per (ubid, acquisition) — test/data/chip_response.json).
+Tests there inject canned responses by swapping the merlin cfg functions
+(test/conftest.py:20-37).  Here the seam is the source object itself.
+
+All sources produce :class:`~firebird_tpu.ingest.packer.ChipData` (ARD) and
+aux dicts (AUX layers: dem, trends, aspect, posidex, slope, mpw —
+ccdc/timeseries.py:46-56).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+
+from firebird_tpu.ccd import harmonic, params, synthetic
+from firebird_tpu.ingest.packer import CHIP_SIDE, ChipData
+from firebird_tpu.obs import logger
+from firebird_tpu.utils import dates as dt
+
+log = logger("timeseries")
+
+AUX_NAMES = ("dem", "trends", "aspect", "posidex", "slope", "mpw")
+
+
+# ---------------------------------------------------------------------------
+# Synthetic source (tests + bench; no reference analogue — closes the
+# "no numerical fixtures" gap, SURVEY.md §4)
+# ---------------------------------------------------------------------------
+
+class SyntheticSource:
+    """Deterministic synthetic ARD + AUX per chip id.
+
+    Each chip gets a harmonic landscape with per-pixel level offsets; a
+    rectangular patch of ``change_frac`` of the area undergoes a step change
+    at a chip-specific date.  QA marks a fraction of acquisitions cloudy.
+    Fully determined by (seed, cx, cy).
+    """
+
+    def __init__(self, seed: int = 0, *, start="1995-01-01", end="2005-01-01",
+                 cadence_days: int = 16, change_frac: float = 0.25,
+                 cloud_frac: float = 0.15):
+        self.seed = seed
+        self.start, self.end = start, end
+        self.cadence_days = cadence_days
+        self.change_frac = change_frac
+        self.cloud_frac = cloud_frac
+
+    def _rng(self, cx: int, cy: int, salt: int = 0) -> np.random.Generator:
+        return np.random.default_rng(
+            abs(hash((int(self.seed), int(cx), int(cy), salt))) % (2**63))
+
+    def chip(self, cx: int, cy: int, acquired: str | None = None) -> ChipData:
+        # Generate the full archive first, slice at the end: the same chip
+        # queried with different acquired windows must agree on overlapping
+        # dates (like FileSource slicing a fixed archive).
+        rng = self._rng(cx, cy)
+        t = synthetic.acquisition_dates(self.start, self.end, self.cadence_days)
+        T = t.shape[0]
+        ph = harmonic.day_phase(t).astype(np.float32)
+
+        means = synthetic.DEFAULT_MEANS.astype(np.float32)
+        amps = synthetic.DEFAULT_AMPS.astype(np.float32)
+        # Per-pixel level field (spatially smooth-ish random offsets).
+        level = rng.normal(0, 60, size=(CHIP_SIDE, CHIP_SIDE)).astype(np.float32)
+
+        spectra = np.empty((params.NUM_BANDS, T, CHIP_SIDE, CHIP_SIDE), np.int16)
+        noise_scale = 30.0
+        for b in range(params.NUM_BANDS):
+            base = (means[b] + amps[b] * np.cos(ph))[:, None, None]
+            series = base + level[None, :, :] + rng.normal(
+                0, noise_scale, size=(T, CHIP_SIDE, CHIP_SIDE)).astype(np.float32)
+            spectra[b] = np.clip(series, -32768, 32767).astype(np.int16)
+
+        # Step change in a patch, at a chip-specific date in the middle half.
+        if self.change_frac > 0:
+            side = max(1, int(CHIP_SIDE * np.sqrt(self.change_frac)))
+            r0 = int(rng.integers(0, CHIP_SIDE - side + 1))
+            c0 = int(rng.integers(0, CHIP_SIDE - side + 1))
+            k = int(rng.integers(T // 4, 3 * T // 4))
+            delta = rng.uniform(500, 1000)
+            sign = np.where(rng.random(params.NUM_BANDS) < 0.5, -1.0, 1.0)
+            for b in range(params.NUM_BANDS):
+                spectra[b, k:, r0:r0 + side, c0:c0 + side] = np.clip(
+                    spectra[b, k:, r0:r0 + side, c0:c0 + side]
+                    + np.int16(sign[b] * delta), -32768, 32767)
+
+        qas = np.full((T, CHIP_SIDE, CHIP_SIDE), synthetic.QA_CLEAR, np.uint16)
+        cloudy = rng.random(T) < self.cloud_frac
+        qas[cloudy] = synthetic.QA_CLOUD
+
+        if acquired:
+            lo, hi = dt.acquired_range(acquired)
+            keep = (t >= lo) & (t <= hi)
+            t, spectra, qas = t[keep], spectra[:, keep], qas[keep]
+        return ChipData(cx=int(cx), cy=int(cy), dates=t, spectra=spectra, qas=qas)
+
+    def aux(self, cx: int, cy: int, acquired: str | None = None) -> dict:
+        """AUX layers: [100,100] arrays per name + the single aux date."""
+        rng = self._rng(cx, cy, salt=1)
+        row = np.arange(CHIP_SIDE, dtype=np.float32)
+        grad = row[None, :] + row[:, None]
+        out = {
+            "dem": (300 + 5 * grad + rng.normal(0, 20, (CHIP_SIDE, CHIP_SIDE))).astype(np.float32),
+            "aspect": rng.integers(0, 360, (CHIP_SIDE, CHIP_SIDE)).astype(np.int16),
+            "posidex": rng.random((CHIP_SIDE, CHIP_SIDE)).astype(np.float32),
+            "slope": np.abs(rng.normal(5, 3, (CHIP_SIDE, CHIP_SIDE))).astype(np.float32),
+            "mpw": (rng.random((CHIP_SIDE, CHIP_SIDE)) < 0.1).astype(np.uint8),
+            # Land-cover training labels in blobs; 0 and 9 are the values the
+            # reference filters out of training (randomforest.py:63).
+            "trends": (1 + (grad // 50) % 8).astype(np.uint8),
+        }
+        return out
+
+
+# ---------------------------------------------------------------------------
+# File-backed fixture source
+# ---------------------------------------------------------------------------
+
+class FileSource:
+    """Chips stored as .npz files in a directory: chip_{cx}_{cy}.npz with
+    arrays dates/spectra/qas, aux_{cx}_{cy}.npz with the AUX names."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _path(self, prefix: str, cx: int, cy: int) -> str:
+        return f"{self.root}/{prefix}_{int(cx)}_{int(cy)}.npz"
+
+    def chip(self, cx: int, cy: int, acquired: str | None = None) -> ChipData:
+        z = np.load(self._path("chip", cx, cy))
+        t, spectra, qas = z["dates"], z["spectra"], z["qas"]
+        if acquired:
+            lo, hi = dt.acquired_range(acquired)
+            keep = (t >= lo) & (t <= hi)
+            t, spectra, qas = t[keep], spectra[:, keep], qas[keep]
+        return ChipData(cx=int(cx), cy=int(cy), dates=t, spectra=spectra, qas=qas)
+
+    def aux(self, cx: int, cy: int, acquired: str | None = None) -> dict:
+        z = np.load(self._path("aux", cx, cy))
+        return {k: z[k] for k in AUX_NAMES}
+
+    def save_chip(self, c: ChipData) -> None:
+        np.savez_compressed(self._path("chip", c.cx, c.cy),
+                            dates=c.dates, spectra=c.spectra, qas=c.qas)
+
+    def save_aux(self, cx: int, cy: int, aux: dict) -> None:
+        np.savez_compressed(self._path("aux", cx, cy), **aux)
+
+
+# ---------------------------------------------------------------------------
+# Chipmunk HTTP source
+# ---------------------------------------------------------------------------
+
+# LCMAP ARD Collection-01 ubid layout: logical band -> ubids across
+# platforms (merlin's chipmunk-ard profile; ubid example 'le07_srb1' in
+# test/data/chip_response.json).
+ARD_UBIDS = {
+    "blues":    ("lt04_srb1", "lt05_srb1", "le07_srb1", "lc08_srb2"),
+    "greens":   ("lt04_srb2", "lt05_srb2", "le07_srb2", "lc08_srb3"),
+    "reds":     ("lt04_srb3", "lt05_srb3", "le07_srb3", "lc08_srb4"),
+    "nirs":     ("lt04_srb4", "lt05_srb4", "le07_srb4", "lc08_srb5"),
+    "swir1s":   ("lt04_srb5", "lt05_srb5", "le07_srb5", "lc08_srb6"),
+    "swir2s":   ("lt04_srb7", "lt05_srb7", "le07_srb7", "lc08_srb7"),
+    "thermals": ("lt04_btb6", "lt05_btb6", "le07_btb6", "lc08_btb10"),
+    "qas":      ("lt04_pixelqa", "lt05_pixelqa", "le07_pixelqa", "lc08_pixelqa"),
+}
+BAND_ORDER = params.BAND_NAMES_PLURAL
+
+AUX_UBIDS = {
+    "dem": ("AUX_DEM",), "trends": ("AUX_TRENDS",), "aspect": ("AUX_ASPECT",),
+    "posidex": ("AUX_POSIDEX",), "slope": ("AUX_SLOPE",), "mpw": ("AUX_MPW",),
+}
+
+
+def decode_raster(rec: dict, dtype=np.int16) -> np.ndarray:
+    """Decode one chip record's base64 payload to a [100,100] array.
+
+    Payload is 20,000 bytes of little-endian int16 (or uint16 for QA) —
+    the wire format seen in test/data/chip_response.json.
+    """
+    raw = base64.b64decode(rec["data"])
+    a = np.frombuffer(raw, dtype=np.dtype(dtype).newbyteorder("<"))
+    return a.reshape(CHIP_SIDE, CHIP_SIDE).astype(dtype)
+
+
+def _default_http_get(url: str) -> list | dict:
+    with urllib.request.urlopen(url, timeout=60) as r:
+        return json.loads(r.read().decode())
+
+
+class ChipmunkSource:
+    """HTTP client for the Chipmunk raster service.
+
+    ``http_get`` is injectable (url -> parsed JSON) so tests run without a
+    network, mirroring the reference's function-injection seam.
+    """
+
+    def __init__(self, url: str, http_get=None):
+        self.url = url.rstrip("/")
+        self.http_get = http_get or _default_http_get
+
+    def _chips(self, ubid: str, x: int, y: int, acquired: str) -> list:
+        q = urllib.parse.urlencode(
+            {"ubid": ubid, "x": x, "y": y, "acquired": acquired})
+        return self.http_get(f"{self.url}/chips?{q}") or []
+
+    def _band_series(self, ubids, cx, cy, acquired, dtype) -> dict[int, np.ndarray]:
+        """{ordinal_date: raster} merged across a logical band's ubids."""
+        series: dict[int, np.ndarray] = {}
+        for ubid in ubids:
+            for rec in self._chips(ubid, cx, cy, acquired):
+                d = dt.to_ordinal(rec["acquired"][:10])
+                if d not in series:  # first writer wins; skip wasted decodes
+                    series[d] = decode_raster(rec, dtype)
+        return series
+
+    def chip(self, cx: int, cy: int, acquired: str | None = None) -> ChipData:
+        acquired = acquired or dt.default_acquired()
+        per_band = {}
+        for name in BAND_ORDER:
+            per_band[name] = self._band_series(ARD_UBIDS[name], cx, cy,
+                                               acquired, np.int16)
+        qa_series = self._band_series(ARD_UBIDS["qas"], cx, cy, acquired,
+                                      np.uint16)
+        # Date alignment: keep acquisitions present in every band + QA
+        # (merlin's alignment step, SURVEY.md §3.3).
+        common = set(qa_series)
+        for s in per_band.values():
+            common &= set(s)
+        t = np.array(sorted(common), dtype=np.int64)
+        T = t.shape[0]
+        spectra = np.empty((params.NUM_BANDS, T, CHIP_SIDE, CHIP_SIDE), np.int16)
+        for b, name in enumerate(BAND_ORDER):
+            for k, d in enumerate(t):
+                spectra[b, k] = per_band[name][int(d)]
+        qas = np.stack([qa_series[int(d)] for d in t]) if T else \
+            np.zeros((0, CHIP_SIDE, CHIP_SIDE), np.uint16)
+        log.debug("chipmunk chip (%s,%s): %d aligned acquisitions", cx, cy, T)
+        return ChipData(cx=int(cx), cy=int(cy), dates=t, spectra=spectra, qas=qas)
+
+    def aux(self, cx: int, cy: int, acquired: str | None = None) -> dict:
+        acquired = acquired or dt.default_acquired()
+        # Wire dtypes from the AUX registry (test/data/registry_response.json:
+        # ASPECT INT16, DEM/POSIDEX/SLOPE FLOAT32, MPW/TRENDS BYTE).
+        wire = {"dem": np.float32, "trends": np.uint8, "aspect": np.int16,
+                "posidex": np.float32, "slope": np.float32, "mpw": np.uint8}
+        out = {}
+        for name, ubids in AUX_UBIDS.items():
+            series = self._band_series(ubids, cx, cy, acquired, wire[name])
+            if not series:
+                raise LookupError(f"no AUX {name} at ({cx},{cy})")
+            out[name] = series[min(series)]
+        return out

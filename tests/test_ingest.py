@@ -1,0 +1,141 @@
+"""Ingest tests: sources, decoding, packing, oracle bridge."""
+
+import base64
+import datetime
+
+import numpy as np
+import pytest
+
+from firebird_tpu.ccd import detect, params
+from firebird_tpu.ingest import (ChipmunkSource, FileSource, SyntheticSource,
+                                 pack, pixel_timeseries)
+from firebird_tpu.ingest.packer import CHIP_SIDE, PIXELS, QA_FILL_PACKED, bucket_capacity
+from firebird_tpu.ingest.sources import ARD_UBIDS, decode_raster
+
+
+@pytest.fixture(scope="module")
+def source():
+    return SyntheticSource(seed=3, start="1995-01-01", end="2001-01-01")
+
+
+@pytest.fixture(scope="module")
+def chipdata(source):
+    return source.chip(-543585, 2378805)
+
+
+def test_synthetic_chip_shapes(chipdata):
+    T = chipdata.dates.shape[0]
+    assert T > 100
+    assert chipdata.spectra.shape == (7, T, 100, 100)
+    assert chipdata.qas.shape == (T, 100, 100)
+    assert np.all(np.diff(chipdata.dates) > 0)
+
+
+def test_synthetic_deterministic(source):
+    a = source.chip(100, 200)
+    b = SyntheticSource(seed=3, start="1995-01-01", end="2001-01-01").chip(100, 200)
+    assert np.array_equal(a.spectra, b.spectra)
+    assert np.array_equal(a.qas, b.qas)
+
+
+def test_acquired_range_filters(source):
+    c = source.chip(0, 0, acquired="1996-01-01/1998-01-01")
+    import datetime
+    lo = datetime.date(1996, 1, 1).toordinal()
+    hi = datetime.date(1998, 1, 1).toordinal()
+    assert c.dates.min() >= lo and c.dates.max() <= hi
+
+
+def test_pack_shapes_and_padding(chipdata, source):
+    other = source.chip(-540585, 2378805)
+    p = pack([chipdata, other], bucket=64)
+    assert p.n_chips == 2
+    cap = bucket_capacity(chipdata.dates.shape[0], 64, 0)
+    assert p.capacity == cap
+    assert p.spectra.shape == (2, 7, PIXELS, cap)
+    assert p.qas.shape == (2, PIXELS, cap)
+    # Padding is QA-fill + FILL_VALUE so the kernel treats it as fill data.
+    T = int(p.n_obs[0])
+    if cap > T:
+        assert np.all(p.qas[0, :, T:] == QA_FILL_PACKED)
+        assert np.all(p.spectra[0, :, :, T:] == params.FILL_VALUE)
+
+
+def test_pixel_coords(chipdata):
+    p = pack([chipdata])
+    xy = p.pixel_coords(0)
+    assert xy.shape == (PIXELS, 2)
+    assert tuple(xy[0]) == (-543585, 2378805)           # UL pixel
+    assert tuple(xy[1]) == (-543585 + 30, 2378805)      # one col east
+    assert tuple(xy[100]) == (-543585, 2378805 - 30)    # one row south
+    assert tuple(xy[-1]) == (-543585 + 99 * 30, 2378805 - 99 * 30)
+
+
+def test_pixel_timeseries_feeds_oracle(chipdata):
+    """The packed batch round-trips into the per-pixel detect() contract."""
+    p = pack([chipdata])
+    ts = pixel_timeseries(p, 0, 4242)
+    assert set(ts) == {"dates", "blues", "greens", "reds", "nirs", "swir1s",
+                       "swir2s", "thermals", "qas"}
+    res = detect(**ts)
+    assert res["procedure"] == "standard"
+    assert len(res["change_models"]) >= 1
+
+
+def test_file_source_roundtrip(tmp_path, chipdata, source):
+    fs = FileSource(str(tmp_path))
+    fs.save_chip(chipdata)
+    fs.save_aux(chipdata.cx, chipdata.cy, source.aux(chipdata.cx, chipdata.cy))
+    c2 = fs.chip(chipdata.cx, chipdata.cy)
+    assert np.array_equal(c2.spectra, chipdata.spectra)
+    aux = fs.aux(chipdata.cx, chipdata.cy)
+    assert aux["dem"].shape == (100, 100)
+    assert set(np.unique(aux["trends"])) <= set(range(1, 9))
+
+
+def test_chipmunk_source_decodes_and_aligns():
+    """Fake Chipmunk: every spectral band present on two dates, QA on three;
+    alignment keeps the two common dates.  Wire format matches
+    test/data/chip_response.json (base64 LE int16, 20000 bytes)."""
+    def raster_b64(value, dtype=np.int16):
+        a = np.full((100, 100), value, dtype=dtype)
+        return base64.b64encode(a.tobytes()).decode()
+
+    dates = ["1999-01-01", "1999-02-02", "1999-03-03"]
+
+    def fake_get(url):
+        assert "/chips?" in url
+        from urllib.parse import parse_qs, urlparse
+        q = parse_qs(urlparse(url).query)
+        ubid = q["ubid"][0]
+        if not ubid.startswith("le07"):
+            return []  # only one platform has data
+        if ubid == "le07_pixelqa":
+            return [{"x": -100, "y": 100, "acquired": f"{d}T00:00:00Z",
+                     "data": raster_b64(2, np.uint16), "ubid": ubid}
+                    for d in dates]
+        return [{"x": -100, "y": 100, "acquired": f"{d}T00:00:00Z",
+                 "data": raster_b64(777), "ubid": ubid}
+                for d in dates[:2]]
+
+    src = ChipmunkSource("http://chipmunk/ard", http_get=fake_get)
+    c = src.chip(-100, 100, "1999-01-01/2000-01-01")
+    assert c.dates.shape[0] == 2  # only dates where all bands aligned
+    assert c.dates[0] == datetime.date(1999, 1, 1).toordinal()
+    assert np.all(c.spectra == 777)
+    assert np.all(c.qas == 2)
+
+
+def test_decode_raster_wire_format():
+    a = (np.arange(10000, dtype=np.int16) - 5000).reshape(100, 100)
+    rec = {"data": base64.b64encode(a.astype("<i2").tobytes()).decode()}
+    out = decode_raster(rec)
+    assert np.array_equal(out, a)
+
+
+def test_ubid_coverage():
+    # 7 spectral bands + QA, 4 platforms each.
+    assert set(ARD_UBIDS) == {"blues", "greens", "reds", "nirs", "swir1s",
+                              "swir2s", "thermals", "qas"}
+    for v in ARD_UBIDS.values():
+        assert len(v) == 4
