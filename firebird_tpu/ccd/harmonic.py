@@ -78,7 +78,7 @@ def lasso_cd_gram(G: np.ndarray, c: np.ndarray,
     return b
 
 
-def fit_bands(t: np.ndarray, Y: np.ndarray, ncoef: int,
+def fit_bands(t: np.ndarray, Y: np.ndarray, ncoef: int, anchor: float,
               alpha: float = params.LASSO_ALPHA) -> tuple[np.ndarray, np.ndarray]:
     """Fit all bands at once.
 
@@ -86,12 +86,16 @@ def fit_bands(t: np.ndarray, Y: np.ndarray, ncoef: int,
         t: [n] ordinal days of the fit window.
         Y: [nbands, n] observations.
         ncoef: number of design columns (4, 6 or 8).
+        anchor: design anchor (ordinal day).  The spec anchors ALL fits of a
+            pixel at the series' first observation (a global anchor), so the
+            TPU kernel can precompute one design matrix per chip and the
+            Lasso operates on identical Gram matrices in both
+            implementations.
 
     Returns:
         (coefs [nbands, MAX_COEFS] zero-padded in the internal
         parametrization, rmse [nbands]).
     """
-    anchor = float(t[0])
     X = design_matrix(t, anchor, ncoef)
     nb = Y.shape[0]
     coefs = np.zeros((nb, params.MAX_COEFS), dtype=np.float64)

@@ -112,12 +112,16 @@ def variogram(t: np.ndarray, Y: np.ndarray) -> np.ndarray:
 
 
 class _Model:
-    """A fitted multi-band harmonic model over a window of observations."""
+    """A fitted multi-band harmonic model over a window of observations.
 
-    def __init__(self, t: np.ndarray, Y: np.ndarray, ncoef: int):
-        self.anchor = float(t[0])
+    ``anchor`` is the global series anchor (first observation of the whole
+    series), shared by every fit of a pixel — see harmonic.fit_bands.
+    """
+
+    def __init__(self, t: np.ndarray, Y: np.ndarray, ncoef: int, anchor: float):
+        self.anchor = anchor
         self.ncoef = ncoef
-        self.coefs, self.rmse = harmonic.fit_bands(t, Y, ncoef)
+        self.coefs, self.rmse = harmonic.fit_bands(t, Y, ncoef, anchor)
 
     def resid(self, t: np.ndarray, Y: np.ndarray) -> np.ndarray:
         """[7, n] residuals at times t."""
@@ -137,8 +141,9 @@ def change_score(model: _Model, vario: np.ndarray, t: np.ndarray, Y: np.ndarray)
 def tmask_outliers(t: np.ndarray, Y: np.ndarray, vario: np.ndarray) -> np.ndarray:
     """[n] True where an obs fails the robust Tmask screen on green/swir1."""
     # Tmask design has no trend column: build [1, yr, cos, sin, cos2, sin2]
-    # then drop the yr column (index 1) -> TMASK_COEFS columns.
-    X = harmonic.design_matrix(t, float(t[0]), params.TMASK_COEFS + 1)
+    # then drop the yr column (index 1) -> TMASK_COEFS columns.  With the
+    # trend gone the design is anchor-independent.
+    X = harmonic.design_matrix(t, 0.0, params.TMASK_COEFS + 1)
     X = np.concatenate([X[:, :1], X[:, 2:]], axis=1)
     bad = np.zeros(t.shape[0], dtype=bool)
     for b in params.TMASK_BANDS:
@@ -195,6 +200,9 @@ def _standard_procedure(t: np.ndarray, Y: np.ndarray, usable: np.ndarray):
     alive = usable.copy()
     idx_all = np.flatnonzero(usable)
     vario = variogram(t[idx_all], Y[:, idx_all])
+    # Global design anchor: the series' first observation — shared by all
+    # pixels of a chip, so the TPU kernel can precompute one design matrix.
+    anchor = float(t[0]) if t.shape[0] else 0.0
 
     segments: list[dict] = []
 
@@ -226,7 +234,7 @@ def _standard_procedure(t: np.ndarray, Y: np.ndarray, usable: np.ndarray):
             alive[window[bad]] = False
             continue  # re-derive the window from the same cursor
 
-        model = _Model(t[window], Y[:, window], params.MIN_COEFS)
+        model = _Model(t[window], Y[:, window], params.MIN_COEFS, anchor)
         r = model.resid(t[window], Y[:, window])
         span = float(t[window[-1]] - t[window[0]])
         stable = True
@@ -248,7 +256,8 @@ def _standard_procedure(t: np.ndarray, Y: np.ndarray, usable: np.ndarray):
         # -------------------------------------------------------- extension
         included = list(window)
         n_last_fit = len(included)
-        model = _Model(t[included], Y[:, included], num_coefs(len(included)))
+        model = _Model(t[included], Y[:, included], num_coefs(len(included)),
+                       anchor)
         cursor = window[-1] + 1
         closed = False
 
@@ -297,7 +306,7 @@ def _standard_procedure(t: np.ndarray, Y: np.ndarray, usable: np.ndarray):
                 included.append(peek[0])
                 if len(included) >= params.REFIT_FACTOR * n_last_fit:
                     model = _Model(t[included], Y[:, included],
-                                   num_coefs(len(included)))
+                                   num_coefs(len(included)), anchor)
                     n_last_fit = len(included)
                 cursor = peek[0] + 1
 
@@ -315,7 +324,8 @@ def _single_model_procedure(t, Y, usable, curve_qa):
     if idx.size < params.MEOW_SIZE:
         return [], np.zeros_like(usable)
     tw, Yw = t[idx], Y[:, idx]
-    model = _Model(tw, Yw, num_coefs(idx.size))
+    anchor = float(t[0])
+    model = _Model(tw, Yw, num_coefs(idx.size), anchor)
     rec = _segment_record(
         model,
         start_day=tw[0], end_day=tw[-1], break_day=tw[-1],

@@ -30,6 +30,15 @@ log = logger("timeseries")
 AUX_NAMES = ("dem", "trends", "aspect", "posidex", "slope", "mpw")
 
 
+def _slice_acquired(t, spectra, qas, acquired):
+    """Restrict a chip archive to an ISO8601 acquired range (inclusive)."""
+    if not acquired:
+        return t, spectra, qas
+    lo, hi = dt.acquired_range(acquired)
+    keep = (t >= lo) & (t <= hi)
+    return t[keep], spectra[:, keep], qas[keep]
+
+
 # ---------------------------------------------------------------------------
 # Synthetic source (tests + bench; no reference analogue — closes the
 # "no numerical fixtures" gap, SURVEY.md §4)
@@ -86,7 +95,14 @@ class SyntheticSource:
             c0 = int(rng.integers(0, CHIP_SIDE - side + 1))
             k = int(rng.integers(T // 4, 3 * T // 4))
             delta = rng.uniform(500, 1000)
+            # Keep shifted values inside the valid data ranges (params
+            # OPTICAL/THERMAL): a negative step would push a band whose
+            # seasonal low (mean - amplitude, minus level/noise spread)
+            # sits near delta below OPTICAL_MIN, and in_range() would then
+            # discard the whole post-change observation.
             sign = np.where(rng.random(params.NUM_BANDS) < 0.5, -1.0, 1.0)
+            seasonal_low = synthetic.DEFAULT_MEANS - synthetic.DEFAULT_AMPS
+            sign = np.where(seasonal_low < delta + 300, 1.0, sign)
             for b in range(params.NUM_BANDS):
                 spectra[b, k:, r0:r0 + side, c0:c0 + side] = np.clip(
                     spectra[b, k:, r0:r0 + side, c0:c0 + side]
@@ -96,14 +112,11 @@ class SyntheticSource:
         cloudy = rng.random(T) < self.cloud_frac
         qas[cloudy] = synthetic.QA_CLOUD
 
-        if acquired:
-            lo, hi = dt.acquired_range(acquired)
-            keep = (t >= lo) & (t <= hi)
-            t, spectra, qas = t[keep], spectra[:, keep], qas[keep]
+        t, spectra, qas = _slice_acquired(t, spectra, qas, acquired)
         return ChipData(cx=int(cx), cy=int(cy), dates=t, spectra=spectra, qas=qas)
 
     def aux(self, cx: int, cy: int, acquired: str | None = None) -> dict:
-        """AUX layers: [100,100] arrays per name + the single aux date."""
+        """AUX layers: one [100,100] array per AUX_NAMES entry."""
         rng = self._rng(cx, cy, salt=1)
         row = np.arange(CHIP_SIDE, dtype=np.float32)
         grad = row[None, :] + row[:, None]
@@ -136,11 +149,8 @@ class FileSource:
 
     def chip(self, cx: int, cy: int, acquired: str | None = None) -> ChipData:
         z = np.load(self._path("chip", cx, cy))
-        t, spectra, qas = z["dates"], z["spectra"], z["qas"]
-        if acquired:
-            lo, hi = dt.acquired_range(acquired)
-            keep = (t >= lo) & (t <= hi)
-            t, spectra, qas = t[keep], spectra[:, keep], qas[keep]
+        t, spectra, qas = _slice_acquired(z["dates"], z["spectra"], z["qas"],
+                                          acquired)
         return ChipData(cx=int(cx), cy=int(cy), dates=t, spectra=spectra, qas=qas)
 
     def aux(self, cx: int, cy: int, acquired: str | None = None) -> dict:

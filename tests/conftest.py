@@ -7,15 +7,21 @@ validation).  Env must be set before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon sitecustomize registers the TPU platform and pins
+# JAX_PLATFORMS=axon before any env var we set can win; override through
+# jax.config instead (must happen before first jax use).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 # The CCD oracle is float64; enable x64 so the JAX kernel can be tested at
 # both precisions.
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+jax.config.update("jax_enable_x64", True)
 
 import numpy as np
 import pytest

@@ -1,0 +1,162 @@
+"""Kernel-vs-oracle parity tests (CPU, float64).
+
+The kernel must reproduce the NumPy oracle decision-for-decision: same
+segment counts, same start/end/break days, same processing masks, and
+numerically close models.  Runs on small pixel/time slices so CI stays
+fast; full-chip parity is exercised by bench/verification runs.
+"""
+
+import dataclasses
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from firebird_tpu.ccd import detect, kernel, params, synthetic
+from firebird_tpu.ingest import SyntheticSource, pack, pixel_timeseries
+from firebird_tpu.ingest.packer import PackedChips
+
+
+def slice_pixels(p: PackedChips, pix: np.ndarray) -> PackedChips:
+    """A PackedChips restricted to selected pixels (keeps chip axis)."""
+    return PackedChips(cids=p.cids, dates=p.dates,
+                       spectra=p.spectra[:, :, pix, :],
+                       qas=p.qas[:, pix, :], n_obs=p.n_obs)
+
+
+@pytest.fixture(scope="module")
+def packed():
+    src = SyntheticSource(seed=5, start="1995-01-01", end="2001-01-01",
+                          cloud_frac=0.1)
+    p = pack([src.chip(100, 200)], bucket=64)
+    # 60 pixels: a stretch of the chip guaranteed to include change-patch
+    # and stable pixels (patch is a 50x50 block somewhere).
+    rng = np.random.default_rng(0)
+    pix = rng.choice(10000, size=60, replace=False)
+    return slice_pixels(p, pix), p, pix
+
+
+def fetch(seg: kernel.ChipSegments, chip: int = 0) -> kernel.ChipSegments:
+    return kernel.ChipSegments(*[np.asarray(getattr(seg, f.name)[chip])
+                                 for f in dataclasses.fields(seg)])
+
+
+def run_kernel(p: PackedChips) -> kernel.ChipSegments:
+    return fetch(kernel.detect_packed(p, dtype=jnp.float64))
+
+
+def test_structural_parity(packed):
+    small, full, pix = packed
+    seg = run_kernel(small)
+    dates = small.dates[0][: int(small.n_obs[0])]
+    n_two = 0
+    for i in range(len(pix)):
+        o = detect(**pixel_timeseries(small, 0, i))
+        k = kernel.segments_to_records(seg, dates, i)
+        assert len(o["change_models"]) == len(k["change_models"]), i
+        n_two += len(o["change_models"]) > 1
+        for om, km in zip(o["change_models"], k["change_models"]):
+            assert om["start_day"] == km["start_day"], i
+            assert om["end_day"] == km["end_day"], i
+            assert om["break_day"] == km["break_day"], i
+            assert om["curve_qa"] == km["curve_qa"], i
+            assert om["observation_count"] == km["observation_count"], i
+            assert om["change_probability"] == pytest.approx(
+                km["change_probability"], abs=1e-6), i
+        assert o["processing_mask"] == k["processing_mask"], i
+    # the sample actually exercises break detection
+    assert n_two >= 3
+
+
+def test_numeric_parity(packed):
+    small, _, pix = packed
+    seg = run_kernel(small)
+    dates = small.dates[0][: int(small.n_obs[0])]
+    for i in range(0, len(pix), 7):
+        o = detect(**pixel_timeseries(small, 0, i))
+        k = kernel.segments_to_records(seg, dates, i)
+        for om, km in zip(o["change_models"], k["change_models"]):
+            for band in params.BAND_NAMES:
+                assert km[band]["rmse"] == pytest.approx(om[band]["rmse"],
+                                                         rel=1e-6, abs=1e-6)
+                assert km[band]["intercept"] == pytest.approx(
+                    om[band]["intercept"], rel=1e-5, abs=1e-3)
+                assert km[band]["magnitude"] == pytest.approx(
+                    om[band]["magnitude"], rel=1e-6, abs=1e-6)
+                for a, b in zip(om[band]["coefficients"],
+                                km[band]["coefficients"]):
+                    assert b == pytest.approx(a, rel=1e-5, abs=1e-6)
+
+
+def _pack_pixels(t, Ys, qas):
+    """Pack a handful of hand-built pixels into a 1-chip batch."""
+    P = len(Ys)
+    T = t.shape[0]
+    spectra = np.stack([np.asarray(Y, np.int16) for Y in Ys])  # [P,7,T]
+    spectra = spectra.transpose(1, 0, 2)[None]                 # [1,7,P,T]
+    qa = np.stack([np.asarray(q, np.uint16) for q in qas])[None]
+    return PackedChips(cids=np.zeros((1, 2), np.int64),
+                       dates=t[None].astype(np.int32),
+                       spectra=spectra, qas=qa,
+                       n_obs=np.array([T], np.int32))
+
+
+def test_procedures_parity():
+    rng = np.random.default_rng(44)
+    t = synthetic.acquisition_dates("1995-01-01", "2000-01-01", 16)
+    T = t.shape[0]
+    Y = synthetic.harmonic_series(t, rng)
+    qa_clear = np.full(T, synthetic.QA_CLEAR, np.uint16)
+    qa_snow = np.full(T, synthetic.QA_SNOW, np.uint16)
+    qa_snow[: T // 10] = synthetic.QA_CLEAR
+    qa_cloud = np.full(T, synthetic.QA_CLOUD, np.uint16)
+    qa_fill = np.full(T, synthetic.QA_FILL, np.uint16)
+    Yf = np.full((7, T), params.FILL_VALUE, np.float64)
+
+    p = _pack_pixels(t, [Y, Y, Y, Yf], [qa_clear, qa_snow, qa_cloud, qa_fill])
+    seg = run_kernel(p)
+    dates = p.dates[0]
+    expected = ["standard", "permanent-snow", "insufficient-clear", "no-data"]
+    for i, proc in enumerate(expected):
+        o = detect(**pixel_timeseries(p, 0, i))
+        k = kernel.segments_to_records(seg, dates, i)
+        assert k["procedure"] == proc == o["procedure"]
+        assert len(k["change_models"]) == len(o["change_models"])
+        for om, km in zip(o["change_models"], k["change_models"]):
+            assert om["start_day"] == km["start_day"]
+            assert om["curve_qa"] == km["curve_qa"]
+        assert k["processing_mask"] == o["processing_mask"]
+
+
+def test_spike_outlier_parity():
+    rng = np.random.default_rng(45)
+    t = synthetic.acquisition_dates("1995-01-01", "2000-01-01", 16)
+    Y = synthetic.harmonic_series(t, rng)
+    Y[:, t.shape[0] // 2] += 3000.0
+    qa = np.full(t.shape[0], synthetic.QA_CLEAR, np.uint16)
+    p = _pack_pixels(t, [Y], [qa])
+    seg = run_kernel(p)
+    o = detect(**pixel_timeseries(p, 0, 0))
+    k = kernel.segments_to_records(seg, p.dates[0], 0)
+    assert o["processing_mask"] == k["processing_mask"]
+    assert k["processing_mask"][t.shape[0] // 2] == 0
+
+
+def test_padding_is_inert(packed):
+    """Extra padded capacity must not change results."""
+    small, _, pix = packed
+    T = small.dates.shape[1]
+    pad = 64
+    bigger = PackedChips(
+        cids=small.cids,
+        dates=np.pad(small.dates, ((0, 0), (0, pad))),
+        spectra=np.pad(small.spectra, ((0, 0), (0, 0), (0, 0), (0, pad)),
+                       constant_values=params.FILL_VALUE),
+        qas=np.pad(small.qas, ((0, 0), (0, 0), (0, pad)),
+                   constant_values=int(synthetic.QA_FILL)),
+        n_obs=small.n_obs)
+    a = run_kernel(small)
+    b = run_kernel(bigger)
+    np.testing.assert_array_equal(a.n_segments, b.n_segments)
+    np.testing.assert_allclose(a.seg_meta, b.seg_meta, rtol=1e-12)
+    np.testing.assert_array_equal(a.mask, b.mask[:, :T])
