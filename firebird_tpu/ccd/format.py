@@ -1,0 +1,155 @@
+"""Result formatting: kernel output -> the reference's row contracts.
+
+Mirrors ccdc/pyccd.py:99-148 (`default` sentinel + `format` flattening one
+pyccd result into 40-column rows with ISO dates, golden-tested by the
+reference at test/test_pyccd.py:37-126) — plus a vectorized chip-level path
+that goes straight from the kernel's ChipSegments arrays to the three table
+frames (chip / pixel / segment), skipping per-pixel Python entirely.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from firebird_tpu.ccd import harmonic, params
+from firebird_tpu.utils import dates as dt
+
+# Column prefixes in band order (ccdc/pyccd.py:118-145).
+BAND_PREFIX = ("bl", "gr", "re", "ni", "s1", "s2", "th")
+
+
+def default(change_models: list) -> list:
+    """Sentinel segment when ccd ran but found no models
+    (ccdc/pyccd.py:99-103)."""
+    return ([{"start_day": 1, "end_day": 1, "break_day": 1}]
+            if not change_models else change_models)
+
+
+def format_records(cx, cy, px, py, dates, ccdresult) -> list[dict]:
+    """Per-pixel result -> list of flat row dicts (ccdc/pyccd.py:106-148).
+
+    ``dates`` are ordinal days; emitted as ISO strings in input order, the
+    processing mask alongside.
+    """
+    def g(cm, *keys, default=None):
+        v = cm
+        for k in keys:
+            if not isinstance(v, dict) or k not in v:
+                return default
+            v = v[k]
+        return v
+
+    mask = ccdresult.get("processing_mask")
+    rows = []
+    for cm in default(ccdresult.get("change_models") or []):
+        row = {
+            "cx": int(cx), "cy": int(cy), "px": int(px), "py": int(py),
+            "sday": dt.to_iso(cm["start_day"]),
+            "eday": dt.to_iso(cm["end_day"]),
+            "bday": dt.to_iso(cm.get("break_day", cm["end_day"])),
+            "chprob": g(cm, "change_probability"),
+            "curqa": g(cm, "curve_qa"),
+        }
+        for b, name in enumerate(params.BAND_NAMES):
+            p = BAND_PREFIX[b]
+            row[f"{p}mag"] = g(cm, name, "magnitude")
+            row[f"{p}rmse"] = g(cm, name, "rmse")
+            row[f"{p}coef"] = g(cm, name, "coefficients")
+            row[f"{p}int"] = g(cm, name, "intercept")
+        row["dates"] = [dt.to_iso(int(o)) for o in dates]
+        row["mask"] = list(mask) if mask is not None else None
+        rows.append(row)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# Vectorized chip-level frames
+# ---------------------------------------------------------------------------
+
+def _int_or_none(vals: np.ndarray, real: np.ndarray) -> np.ndarray:
+    """Object column of ints, None on sentinel rows (NULL in the store)."""
+    col = np.empty(vals.shape[0], object)
+    col[:] = np.asarray(vals, np.int64).tolist()
+    col[~real] = None
+    return col
+
+
+def _iso_col(ordinals: np.ndarray) -> np.ndarray:
+    """Vector ordinal->ISO via a small unique-value table."""
+    ordinals = np.asarray(ordinals, np.int64)
+    uniq, inv = np.unique(ordinals, return_inverse=True)
+    table = np.array([dt.to_iso(int(o)) if o > 0 else "0001-01-01"
+                      for o in uniq], dtype=object)
+    return table[inv]
+
+
+def chip_frames(packed, chip: int, seg) -> dict[str, dict]:
+    """ChipSegments (host arrays, single chip) -> the three table frames.
+
+    Returns {'chip': {...}, 'pixel': {...}, 'segment': {...}} where each
+    value is a dict of column -> numpy array, matching the reference table
+    schemas (ccdc/chip.py:15-22, pixel.py:14-21, segment.py:16-56).
+    Pixels with no segments contribute the sentinel row (sday=eday=bday=
+    0001-01-01, ccdc/pyccd.py:99-103) so reruns stay idempotent.
+    """
+    cx, cy = (int(v) for v in packed.cids[chip])
+    T = int(packed.n_obs[chip])
+    dates_ord = packed.dates[chip][:T]
+    anchor = float(dates_ord[0]) if T else 0.0
+    dates_iso = [dt.to_iso(int(o)) for o in dates_ord]
+
+    P = seg.n_segments.shape[0]
+    coords = packed.pixel_coords(chip)                         # [P,2]
+
+    nseg = np.asarray(seg.n_segments, np.int64)
+    n_rows = np.maximum(nseg, 1)                               # sentinel rows
+    pix_of_row = np.repeat(np.arange(P), n_rows)
+    # per-row segment index; sentinel rows get -1
+    seg_idx = np.concatenate([
+        np.arange(n) if n else np.array([-1])
+        for n in nseg]).astype(np.int64)
+    real = seg_idx >= 0
+    si = np.maximum(seg_idx, 0)
+
+    meta = np.asarray(seg.seg_meta, np.float64)[pix_of_row, si]    # [R,6]
+    rmse = np.asarray(seg.seg_rmse, np.float64)[pix_of_row, si]    # [R,7]
+    mag = np.asarray(seg.seg_mag, np.float64)[pix_of_row, si]
+    coefs = np.asarray(seg.seg_coef, np.float64)[pix_of_row, si]   # [R,7,8]
+    coefs7, intercept = harmonic.to_pyccd_convention(coefs, anchor)
+
+    R = meta.shape[0]
+    segment = {
+        "cx": np.full(R, cx, np.int64), "cy": np.full(R, cy, np.int64),
+        "px": coords[pix_of_row, 0], "py": coords[pix_of_row, 1],
+        "sday": np.where(real, _iso_col(meta[:, 0]), "0001-01-01"),
+        "eday": np.where(real, _iso_col(meta[:, 1]), "0001-01-01"),
+        "bday": np.where(real, _iso_col(meta[:, 2]), "0001-01-01"),
+        "chprob": np.where(real, meta[:, 3], np.nan),
+        "curqa": _int_or_none(meta[:, 4], real),
+        "rfrawp": np.full(R, None, object),
+    }
+    for b in range(params.NUM_BANDS):
+        p = BAND_PREFIX[b]
+        segment[f"{p}mag"] = np.where(real, mag[:, b], np.nan)
+        segment[f"{p}rmse"] = np.where(real, rmse[:, b], np.nan)
+        segment[f"{p}int"] = np.where(real, intercept[:, b], np.nan)
+        col = np.empty(R, object)
+        col[:] = coefs7[:, b].tolist()      # one C-level conversion
+        col[~real] = None
+        segment[f"{p}coef"] = col
+
+    mask = np.asarray(seg.mask, np.int8)[:, :T]
+    mask_col = np.empty(P, object)
+    mask_col[:] = mask.tolist()             # one C-level conversion
+    dates_col = np.empty(1, object)
+    dates_col[0] = dates_iso
+    pixel = {
+        "cx": np.full(P, cx, np.int64), "cy": np.full(P, cy, np.int64),
+        "px": coords[:, 0], "py": coords[:, 1],
+        "mask": mask_col,
+    }
+    chip_frame = {
+        "cx": np.array([cx], np.int64), "cy": np.array([cy], np.int64),
+        "dates": dates_col,
+    }
+    return {"chip": chip_frame, "pixel": pixel, "segment": segment}

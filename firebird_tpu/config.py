@@ -42,9 +42,13 @@ class Config:
     ard_url: str = "http://localhost:5656"
     aux_url: str = "http://localhost:5656"
 
-    # Results store. backend: 'sqlite' | 'parquet' | 'memory' | 'cassandra'
+    # Results store. backend: 'sqlite' | 'parquet' | 'memory'
     store_backend: str = "sqlite"
     store_path: str = "firebird.db"
+
+    # Ingest source: 'chipmunk' (HTTP, ard_url/aux_url) | 'synthetic' | 'file'
+    source_backend: str = "chipmunk"
+    source_path: str = "."
 
     # Host-side ingest parallelism (reference: INPUT_PARTITIONS, default 1,
     # "controls parallel requests to chipmunk")
@@ -80,6 +84,8 @@ class Config:
             aux_url=e.get("AUX_CHIPMUNK", cls.aux_url),
             store_backend=e.get("FIREBIRD_STORE_BACKEND", cls.store_backend),
             store_path=e.get("FIREBIRD_STORE_PATH", cls.store_path),
+            source_backend=e.get("FIREBIRD_SOURCE", cls.source_backend),
+            source_path=e.get("FIREBIRD_SOURCE_PATH", cls.source_path),
             input_parallelism=int(e.get("INPUT_PARTITIONS", cls.input_parallelism)),
             chips_per_batch=int(e.get("FIREBIRD_CHIPS_PER_BATCH", cls.chips_per_batch)),
             max_obs=int(e.get("FIREBIRD_MAX_OBS", cls.max_obs)),

@@ -1,0 +1,3 @@
+from firebird_tpu.driver import core
+
+__all__ = ["core"]

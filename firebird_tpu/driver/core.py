@@ -1,0 +1,157 @@
+"""Driver orchestration: tile -> chunks -> prefetch -> device -> drain.
+
+Replaces ccdc/core.py.  The reference's shape is preserved — snap the point
+to a tile, enumerate its chips, `partition_all(chunk_size, take(number,
+chips))`, run each chunk with failure isolation, persist chip/pixel/segment
+(core.py:78-124) — but execution is host-orchestrated TPU dispatch instead
+of Spark jobs: chips are fetched by a host thread pool (INPUT_PARTITIONS
+semantics), packed into device batches, run through the CCD kernel, and
+drained to the store by an async writer so egress overlaps compute.
+
+A failed chunk is logged and skipped (core.py:115-124 prints the traceback);
+because store writes are keyed upserts, rerunning the same tile repairs any
+gap (SURVEY.md §5 durability model).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import traceback
+
+import jax.numpy as jnp
+import numpy as np
+
+from firebird_tpu import grid
+from firebird_tpu.ccd import format as ccdformat
+from firebird_tpu.ccd import kernel
+from firebird_tpu.config import Config
+from firebird_tpu.ingest import ChipmunkSource, FileSource, SyntheticSource, pack
+from firebird_tpu.obs import Counters, logger
+from firebird_tpu.store import AsyncWriter, open_store
+from firebird_tpu.utils import dates as dt
+from firebird_tpu.utils.fn import partition_all, take
+
+_DTYPES = {"float32": jnp.float32, "float64": jnp.float64,
+           "bfloat16": jnp.bfloat16}
+
+
+def make_source(cfg: Config, kind: str | None = None):
+    """Source factory (cfg.source_backend): chipmunk | synthetic | file."""
+    kind = kind or cfg.source_backend
+    if kind == "chipmunk":
+        return ChipmunkSource(cfg.ard_url)
+    if kind == "synthetic":
+        return SyntheticSource(seed=0)
+    if kind == "file":
+        return FileSource(cfg.source_path)
+    raise ValueError(f"unknown source backend: {kind!r}")
+
+
+def make_aux_source(cfg: Config, kind: str | None = None):
+    kind = kind or cfg.source_backend
+    if kind == "chipmunk":
+        return ChipmunkSource(cfg.aux_url)
+    return make_source(cfg, kind)
+
+
+def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
+    """Run change detection for one chunk of chip ids (ref core.detect,
+    core.py:53-75): ingest -> pack -> kernel -> chip/pixel/segment writes."""
+    log.info("finding ccd segments for %d chips", len(cids))
+    dtype = _DTYPES[cfg.dtype]
+
+    with cf.ThreadPoolExecutor(max_workers=max(cfg.input_parallelism, 1)) as ex:
+        for batch_ids in partition_all(cfg.chips_per_batch, cids):
+            chips = list(ex.map(
+                lambda xy: source.chip(xy[0], xy[1], acquired), batch_ids))
+            packed = pack(chips, bucket=cfg.obs_bucket, max_obs=cfg.max_obs)
+            seg = kernel.detect_packed(packed, dtype=dtype)
+            seg_host = kernel.ChipSegments(
+                *[np.asarray(getattr(seg, f)) for f in
+                  ("n_segments", "seg_meta", "seg_rmse", "seg_mag",
+                   "seg_coef", "mask", "procedure")])
+            for c in range(packed.n_chips):
+                one = kernel.ChipSegments(
+                    *[getattr(seg_host, f)[c] for f in
+                      ("n_segments", "seg_meta", "seg_rmse", "seg_mag",
+                       "seg_coef", "mask", "procedure")])
+                frames = ccdformat.chip_frames(packed, c, one)
+                for table in ("chip", "pixel", "segment"):
+                    writer.write(table, frames[table])
+                counters.add("chips")
+                counters.add("pixels", one.n_segments.shape[0])
+                counters.add("segments", int(one.n_segments.sum()))
+    return list(cids)
+
+
+def changedetection(x, y, acquired: str | None = None, number: int = 2500,
+                    chunk_size: int = 2500, cfg: Config | None = None,
+                    source=None, store=None):
+    """Run change detection for a tile and save results (ref
+    core.changedetection, core.py:78-124).
+
+    Args mirror the reference CLI: tile point (x, y), ISO8601 acquired
+    range, number of chips (testing), chunk size (failure-isolation
+    granularity).
+
+    Returns the tuple of chip ids processed successfully.
+    """
+    cfg = cfg or Config.from_env()
+    acquired = acquired or dt.default_acquired()
+    log = logger("change-detection")
+    counters = Counters()
+
+    source = source or make_source(cfg)
+    store = store or open_store(cfg.store_backend, cfg.store_path,
+                                cfg.keyspace())
+    writer = AsyncWriter(store)
+
+    tile = grid.tile(x=x, y=y)
+    cids = list(take(number, grid.chips(tile)))
+    chunks = list(partition_all(chunk_size, cids))
+    log.info("tile h=%s v=%s: %d chips in %d chunks (acquired %s)",
+             tile["h"], tile["v"], len(cids), len(chunks), acquired)
+
+    done: list = []
+    try:
+        for chunk in chunks:
+            try:
+                done.extend(detect_chunk(
+                    chunk, source=source, writer=writer, acquired=acquired,
+                    cfg=cfg, counters=counters, log=log))
+                writer.flush()
+            except Exception as e:
+                # Chunk-level failure isolation (core.py:115-124): log and
+                # move on; idempotent writes make the rerun cheap.
+                log.error("chunk failed (%d chips): %s", len(chunk), e)
+                traceback.print_exc()
+    finally:
+        writer.close()
+        snap = counters.snapshot()
+        log.info("change-detection complete: %s", snap)
+
+    return tuple(done)
+
+
+def classification(x, y, msday: int, meday: int, acquired: str | None = None,
+                   cfg: Config | None = None, source=None, aux_source=None,
+                   store=None):
+    """Train on the 3x3 tile neighborhood, classify the tile, persist
+    predictions + the trained model (ref core.classification, core.py:156-251
+    — including the predict/save path the reference left commented out)."""
+    try:
+        from firebird_tpu.rf import pipeline as rf_pipeline
+    except ImportError as e:
+        raise RuntimeError(
+            "classification requires the firebird_tpu.rf module, which is "
+            "not available in this build") from e
+
+    cfg = cfg or Config.from_env()
+    acquired = acquired or dt.default_acquired()
+    store = store or open_store(cfg.store_backend, cfg.store_path,
+                                cfg.keyspace())
+    return rf_pipeline.classify_tile(
+        x=x, y=y, msday=msday, meday=meday, acquired=acquired, cfg=cfg,
+        source=source or make_source(cfg),
+        aux_source=aux_source or make_aux_source(cfg),
+        store=store)

@@ -1,0 +1,24 @@
+"""Keyed, idempotent result sinks.
+
+Replaces the reference's Cassandra persistence (ccdc/cassandra.py + the
+chip/pixel/segment/tile table modules + resources/schema.cql) with the same
+four logical tables over pluggable backends.  The durability model is
+preserved: primary keys are the natural keys, writes are upserts, so any
+rerun of a tile/chunk overwrites the same rows (SURVEY.md §5
+"checkpoint/resume = idempotent append writes").
+
+Backends: sqlite (dev/default), parquet (bulk/analytics), memory (tests).
+A Cassandra adapter can implement the same Store interface where a cluster
+exists; nothing above this layer would change.
+
+Writes are drained by an AsyncWriter on a host thread so device compute
+overlaps egress (the reference instead tuned spark-cassandra concurrent
+writes, ccdc/__init__.py:20-22).
+"""
+
+from firebird_tpu.store.schema import TABLES, primary_key
+from firebird_tpu.store.backends import MemoryStore, SqliteStore, ParquetStore, open_store
+from firebird_tpu.store.writer import AsyncWriter
+
+__all__ = ["TABLES", "primary_key", "MemoryStore", "SqliteStore",
+           "ParquetStore", "open_store", "AsyncWriter"]

@@ -1,0 +1,231 @@
+"""Store backends: memory, sqlite, parquet.
+
+The Store interface: ``write(table, frame)`` upserts a dict-of-columns
+frame; ``read(table, where=None)`` returns a dict of columns (optionally
+filtered by exact-match key values).  Frames are dicts of equal-length numpy
+arrays / lists, as produced by firebird_tpu.ccd.format.chip_frames.
+
+Idempotence: rows are keyed by the table's primary key (schema.py);
+re-writing the same key replaces the row — the reference's rerun-upsert
+semantics (mode('append') onto Cassandra PKs, ccdc/cassandra.py:62-63,
+SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sqlite3
+import threading
+
+import numpy as np
+
+from firebird_tpu.store import schema
+
+
+def _normalize(v):
+    """Plain-Python cell values; NaN becomes None uniformly across backends
+    (the reference stores NULL for absent model fields, schema.cql)."""
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        v = float(v)
+    if isinstance(v, float) and math.isnan(v):
+        return None
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return v
+
+
+class MemoryStore:
+    """Dict-backed store for tests: {table: {key_tuple: row_dict}}."""
+
+    def __init__(self, keyspace: str = "default"):
+        self.keyspace = keyspace
+        self._tables: dict[str, dict] = {t: {} for t in schema.TABLES}
+        self._lock = threading.Lock()
+
+    def write(self, table: str, frame: dict) -> int:
+        key = schema.primary_key(table)
+        cols = list(frame.keys())
+        n = len(next(iter(frame.values())))
+        with self._lock:
+            for i in range(n):
+                row = {c: _normalize(frame[c][i]) for c in cols}
+                self._tables[table][tuple(row[k] for k in key)] = row
+        return n
+
+    def read(self, table: str, where: dict | None = None) -> dict:
+        with self._lock:
+            rows = [r for r in self._tables[table].values()
+                    if not where or all(r.get(k) == v for k, v in where.items())]
+        cols = schema.columns(table)
+        return {c: [r.get(c) for r in rows] for c in cols}
+
+    def count(self, table: str) -> int:
+        return len(self._tables[table])
+
+    def close(self):
+        pass
+
+
+class SqliteStore:
+    """Sqlite-backed store with INSERT OR REPLACE upserts.
+
+    One database file per keyspace (the reference namespaces by Cassandra
+    keyspace derived from inputs+version, ccdc/__init__.py:29-44; here the
+    keyspace is part of the filename).
+    """
+
+    def __init__(self, path: str, keyspace: str = "default"):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        root, ext = os.path.splitext(path)
+        self.path = f"{root}.{keyspace}{ext or '.db'}"
+        self.keyspace = keyspace
+        self._local = threading.local()
+        self._all_conns: list[sqlite3.Connection] = []
+        self._conns_lock = threading.Lock()
+        self._create()
+
+    def _conn(self) -> sqlite3.Connection:
+        if not hasattr(self._local, "conn"):
+            # check_same_thread=False so close() can shut every thread's
+            # connection down; each thread still only *uses* its own.
+            conn = sqlite3.connect(self.path, timeout=60,
+                                   check_same_thread=False)
+            conn.execute("PRAGMA journal_mode=WAL")
+            self._local.conn = conn
+            with self._conns_lock:
+                self._all_conns.append(conn)
+        return self._local.conn
+
+    def _create(self):
+        con = self._conn()
+        for t, spec in schema.TABLES.items():
+            cols = ", ".join(
+                f'"{c}" {"TEXT" if typ == "JSON" else typ}'
+                for c, typ in spec["columns"])
+            pk = ", ".join(spec["key"])
+            con.execute(
+                f'CREATE TABLE IF NOT EXISTS "{t}" ({cols}, PRIMARY KEY ({pk}))')
+        con.commit()
+
+    def write(self, table: str, frame: dict) -> int:
+        spec = schema.TABLES[table]
+        cols = [c for c, _ in spec["columns"]]
+        jsoncols = {c for c, typ in spec["columns"] if typ == "JSON"}
+        n = len(next(iter(frame.values())))
+
+        def cell(c, i):
+            v = _normalize(frame[c][i]) if c in frame else None
+            if c in jsoncols:
+                return json.dumps(v) if v is not None else None
+            return v
+
+        rows = [tuple(cell(c, i) for c in cols) for i in range(n)]
+        ph = ", ".join("?" * len(cols))
+        con = self._conn()
+        con.executemany(
+            f'INSERT OR REPLACE INTO "{table}" ({", ".join(cols)}) VALUES ({ph})',
+            rows)
+        con.commit()
+        return n
+
+    def read(self, table: str, where: dict | None = None) -> dict:
+        spec = schema.TABLES[table]
+        cols = [c for c, _ in spec["columns"]]
+        jsoncols = {c for c, typ in spec["columns"] if typ == "JSON"}
+        sql = f'SELECT {", ".join(cols)} FROM "{table}"'
+        args: list = []
+        if where:
+            sql += " WHERE " + " AND ".join(f'"{k}" = ?' for k in where)
+            args = list(where.values())
+        cur = self._conn().execute(sql, args)
+        out: dict[str, list] = {c: [] for c in cols}
+        for row in cur:
+            for c, v in zip(cols, row):
+                out[c].append(json.loads(v) if (c in jsoncols and v is not None)
+                              else v)
+        return out
+
+    def count(self, table: str) -> int:
+        return self._conn().execute(
+            f'SELECT COUNT(*) FROM "{table}"').fetchone()[0]
+
+    def close(self):
+        with self._conns_lock:
+            conns, self._all_conns = self._all_conns, []
+        for conn in conns:
+            try:
+                conn.close()
+            except sqlite3.Error:
+                pass
+        if hasattr(self._local, "conn"):
+            del self._local.conn
+
+
+class ParquetStore:
+    """Parquet-backed store: one file per (table, partition key prefix).
+
+    Idempotence by construction — a rerun of the same chip rewrites the same
+    file.  Suited to bulk analytics egress; requires pyarrow.
+    """
+
+    def __init__(self, path: str, keyspace: str = "default"):
+        self.root = os.path.join(path, keyspace)
+        os.makedirs(self.root, exist_ok=True)
+
+    # Partition prefix per table: one file per chip (cx, cy) for the three
+    # result tables; the full (tx, ty, name) key for tile so models with
+    # different names never clobber each other.
+    _PART = {"chip": 2, "pixel": 2, "segment": 2, "tile": 3}
+
+    def _file(self, table: str, frame: dict) -> str:
+        key = schema.primary_key(table)[: self._PART[table]]
+        part = "_".join(str(_normalize(frame[k][0])) for k in key)
+        d = os.path.join(self.root, table)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"{part}.parquet")
+
+    def write(self, table: str, frame: dict) -> int:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        cols = {c: [_normalize(v) for v in frame[c]] for c in frame}
+        pq.write_table(pa.table(cols), self._file(table, frame))
+        return len(next(iter(frame.values())))
+
+    def read(self, table: str, where: dict | None = None) -> dict:
+        import pyarrow.parquet as pq
+        d = os.path.join(self.root, table)
+        cols = schema.columns(table)
+        out: dict[str, list] = {c: [] for c in cols}
+        if not os.path.isdir(d):
+            return out
+        for f in sorted(os.listdir(d)):
+            t = pq.read_table(os.path.join(d, f)).to_pydict()
+            n = len(next(iter(t.values()), []))
+            for i in range(n):
+                if where and any(t.get(k, [None] * n)[i] != v
+                                 for k, v in where.items()):
+                    continue
+                for c in cols:
+                    out[c].append(t.get(c, [None] * n)[i])
+        return out
+
+    def count(self, table: str) -> int:
+        return len(self.read(table)["cx" if table != "tile" else "tx"])
+
+    def close(self):
+        pass
+
+
+def open_store(backend: str, path: str, keyspace: str):
+    """Factory used by the driver (cfg.store_backend)."""
+    if backend == "memory":
+        return MemoryStore(keyspace)
+    if backend == "sqlite":
+        return SqliteStore(path, keyspace)
+    if backend == "parquet":
+        return ParquetStore(path, keyspace)
+    raise ValueError(f"unknown store backend: {backend!r}")

@@ -1,0 +1,56 @@
+"""Logical table schemas and key design.
+
+Mirrors the reference's Cassandra schema (resources/schema.cql) and table
+modules:
+
+- chip    (cx, cy) -> dates[]                 (schema.cql:30-34, ccdc/chip.py)
+- pixel   (cx, cy, px, py) -> mask[]          (schema.cql:48-54, ccdc/pixel.py)
+- segment (cx, cy, px, py, sday, eday) -> 33 model columns + rfrawp
+                                              (schema.cql:103-142, ccdc/segment.py)
+- tile    (tx, ty, name) -> model, updated    (schema.cql:13-19, ccdc/tile.py)
+
+Array-valued columns (dates, mask, coefficients, rfrawp) are JSON-encoded in
+sqlite and native lists in parquet/memory.
+"""
+
+from __future__ import annotations
+
+from firebird_tpu.ccd.format import BAND_PREFIX
+
+_SEG_BANDS: list[tuple[str, str]] = []
+for _p in BAND_PREFIX:
+    _SEG_BANDS += [(f"{_p}mag", "REAL"), (f"{_p}rmse", "REAL"),
+                   (f"{_p}coef", "JSON"), (f"{_p}int", "REAL")]
+
+TABLES: dict[str, dict] = {
+    "chip": {
+        "columns": [("cx", "INTEGER"), ("cy", "INTEGER"), ("dates", "JSON")],
+        "key": ("cx", "cy"),
+    },
+    "pixel": {
+        "columns": [("cx", "INTEGER"), ("cy", "INTEGER"), ("px", "INTEGER"),
+                    ("py", "INTEGER"), ("mask", "JSON")],
+        "key": ("cx", "cy", "px", "py"),
+    },
+    "segment": {
+        "columns": ([("cx", "INTEGER"), ("cy", "INTEGER"), ("px", "INTEGER"),
+                     ("py", "INTEGER"), ("sday", "TEXT"), ("eday", "TEXT"),
+                     ("bday", "TEXT"), ("chprob", "REAL"),
+                     ("curqa", "INTEGER")]
+                    + _SEG_BANDS + [("rfrawp", "JSON")]),
+        "key": ("cx", "cy", "px", "py", "sday", "eday"),
+    },
+    "tile": {
+        "columns": [("tx", "INTEGER"), ("ty", "INTEGER"), ("name", "TEXT"),
+                    ("model", "TEXT"), ("updated", "TEXT")],
+        "key": ("tx", "ty", "name"),
+    },
+}
+
+
+def primary_key(table: str) -> tuple[str, ...]:
+    return TABLES[table]["key"]
+
+
+def columns(table: str) -> list[str]:
+    return [c for c, _ in TABLES[table]["columns"]]

@@ -1,0 +1,69 @@
+"""Async host-side writer: egress overlaps device compute.
+
+The reference throttles spark-cassandra concurrent writes from executors
+(CASSANDRA_OUTPUT_CONCURRENT_WRITES, ccdc/__init__.py:20); here a bounded
+queue + worker thread drains table frames while the TPU crunches the next
+batch.  ``flush()`` blocks until everything queued has landed and raises
+any pending write error (once — the error is cleared so the driver's
+per-chunk isolation can continue with later chunks, ccdc/core.py:115-124
+semantics).  ``close()`` never raises: a terminal error is logged and the
+worker is always shut down.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from firebird_tpu.obs import logger
+
+log = logger("change-detection")
+
+
+class AsyncWriter:
+    def __init__(self, store, max_queue: int = 16):
+        self.store = store
+        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._error: Exception | None = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            table, frame = item
+            try:
+                if self._error is None:
+                    self.store.write(table, frame)
+            except Exception as e:  # surfaced on the next write()/flush()
+                log.error("async write to %s failed: %s", table, e)
+                self._error = e
+            finally:
+                self._q.task_done()
+
+    def _pop_error(self) -> Exception | None:
+        err, self._error = self._error, None
+        return err
+
+    def write(self, table: str, frame: dict) -> None:
+        err = self._pop_error()
+        if err is not None:
+            raise err
+        self._q.put((table, frame))
+
+    def flush(self) -> None:
+        self._q.join()
+        err = self._pop_error()
+        if err is not None:
+            raise err
+
+    def close(self) -> None:
+        try:
+            self.flush()
+        except Exception as e:
+            log.error("async writer closed with pending error: %s", e)
+        self._q.put(None)
+        self._thread.join(timeout=30)

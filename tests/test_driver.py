@@ -1,0 +1,109 @@
+"""End-to-end driver + CLI tests: the minimum slice of SURVEY.md §7 —
+synthetic source -> packer -> CCD kernel -> format -> store -> CLI."""
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from firebird_tpu import cli, grid
+from firebird_tpu.config import Config
+from firebird_tpu.driver import core
+from firebird_tpu.ingest import SyntheticSource
+from firebird_tpu.store import MemoryStore
+
+ACQ = "1995-01-01/1997-06-01"  # short archive so CPU compile stays fast
+# chips_per_batch=1 keeps every kernel dispatch on the same [1,7,P,T]
+# compiled shape, so all tests in this module share one jit cache entry.
+CFG = Config(store_backend="memory", source_backend="synthetic",
+             chips_per_batch=1, dtype="float64")
+
+
+@pytest.fixture(scope="module")
+def run_result():
+    store = MemoryStore("test")
+    src = SyntheticSource(seed=9, start="1995-01-01", end="1998-01-01",
+                          cloud_frac=0.1)
+    done = core.changedetection(x=100, y=200, acquired=ACQ, number=2,
+                                chunk_size=2, cfg=CFG, source=src,
+                                store=store)
+    return done, store
+
+
+def test_changedetection_end_to_end(run_result):
+    done, store = run_result
+    assert len(done) == 2
+    # chip table: one row per chip with the aligned ISO dates
+    chips = store.read("chip")
+    assert len(chips["cx"]) == 2
+    assert all(d.startswith("1995-") for d in chips["dates"][0][:1])
+    # pixel table: 10k masks per chip
+    assert store.count("pixel") == 20000
+    # segment table: at least one row per pixel (sentinel or real)
+    assert store.count("segment") >= 20000
+    seg = store.read("segment", {"cx": done[0][0], "cy": done[0][1]})
+    assert len(seg["cx"]) >= 10000
+    # real segments carry models
+    real = [i for i, s in enumerate(seg["sday"]) if s != "0001-01-01"]
+    assert len(real) >= 9000
+    i = real[0]
+    assert seg["nicoef"][i] is not None and len(seg["nicoef"][i]) == 7
+    assert seg["nirmse"][i] > 0
+
+
+def test_rerun_is_idempotent(run_result):
+    done, store = run_result
+    src = SyntheticSource(seed=9, start="1995-01-01", end="1998-01-01",
+                          cloud_frac=0.1)
+    before = store.count("segment")
+    core.changedetection(x=100, y=200, acquired=ACQ, number=1, chunk_size=1,
+                         cfg=CFG, source=src, store=store)
+    assert store.count("segment") == before
+
+
+def test_chunk_failure_isolation():
+    """A source that explodes on one chunk must not kill the run
+    (core.py:115-124 semantics)."""
+    store = MemoryStore("test")
+    good = SyntheticSource(seed=9, start="1995-01-01", end="1998-01-01")
+    calls = {"n": 0}
+
+    class Flaky:
+        def chip(self, cx, cy, acquired=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise IOError("chipmunk down")
+            return good.chip(cx, cy, acquired)
+
+    done = core.changedetection(x=100, y=200, acquired=ACQ, number=2,
+                                chunk_size=1, cfg=CFG, source=Flaky(),
+                                store=store)
+    assert len(done) == 1           # first chunk failed, second landed
+    assert store.count("chip") == 1
+
+
+def test_cli_changedetection(monkeypatch, tmp_path):
+    monkeypatch.setenv("FIREBIRD_SOURCE", "synthetic")
+    monkeypatch.setenv("FIREBIRD_STORE_BACKEND", "sqlite")
+    monkeypatch.setenv("FIREBIRD_STORE_PATH", str(tmp_path / "fb.db"))
+    monkeypatch.setenv("FIREBIRD_DTYPE", "float64")
+    res = CliRunner().invoke(
+        cli.entrypoint,
+        ["changedetection", "-x", "100", "-y", "200", "-n", "1",
+         "-a", ACQ, "-c", "1"])
+    assert res.exit_code == 0, res.output
+
+    from firebird_tpu.store import SqliteStore
+    ks = Config.from_env().keyspace()
+    store = SqliteStore(str(tmp_path / "fb.db"), ks)
+    assert store.count("chip") == 1
+    assert store.count("segment") >= 10000
+
+
+def test_driver_source_factory():
+    assert isinstance(core.make_source(Config(source_backend="synthetic")),
+                      SyntheticSource)
+    from firebird_tpu.ingest import ChipmunkSource
+    assert isinstance(core.make_source(Config(source_backend="chipmunk")),
+                      ChipmunkSource)
+    with pytest.raises(ValueError):
+        core.make_source(Config(source_backend="nope"))

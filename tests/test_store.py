@@ -1,0 +1,114 @@
+"""Store tests: write-then-read round trips per table and idempotent
+upserts — the reference's pattern minus the live Cassandra container
+(test/test_cassandra.py, test_chip/pixel/segment/tile.py)."""
+
+import numpy as np
+import pytest
+
+from firebird_tpu.store import AsyncWriter, MemoryStore, ParquetStore, SqliteStore, open_store
+from firebird_tpu.store.schema import TABLES
+
+
+def seg_frame(cx=1, cy=2, px=3, py=4, sday="1999-01-01", chprob=1.0):
+    f = {"cx": [cx], "cy": [cy], "px": [px], "py": [py],
+         "sday": [sday], "eday": ["2000-01-01"], "bday": [sday],
+         "chprob": [chprob], "curqa": [8], "rfrawp": [None]}
+    for p in ("bl", "gr", "re", "ni", "s1", "s2", "th"):
+        f[f"{p}mag"] = [1.5]
+        f[f"{p}rmse"] = [0.5]
+        f[f"{p}coef"] = [[0.1, 0.2, 0.3]]
+        f[f"{p}int"] = [7.0]
+    return f
+
+
+def make_stores(tmp_path):
+    return [MemoryStore("ks"),
+            SqliteStore(str(tmp_path / "s.db"), "ks"),
+            ParquetStore(str(tmp_path / "pq"), "ks")]
+
+
+@pytest.mark.parametrize("backend", ["memory", "sqlite", "parquet"])
+def test_roundtrip_all_tables(tmp_path, backend):
+    store = open_store(backend, str(tmp_path / "st"), "ks")
+    store.write("chip", {"cx": [10], "cy": [20],
+                         "dates": [["1999-01-01", "1999-02-01"]]})
+    store.write("pixel", {"cx": [10], "cy": [20], "px": [10], "py": [20],
+                          "mask": [[1, 0]]})
+    store.write("segment", seg_frame(cx=10, cy=20))
+    store.write("tile", {"tx": [1], "ty": [2], "name": ["rf"],
+                         "model": ["BLOB"], "updated": ["2020-01-01"]})
+    assert store.read("chip", {"cx": 10, "cy": 20})["dates"][0] == \
+        ["1999-01-01", "1999-02-01"]
+    assert store.read("pixel")["mask"][0] == [1, 0]
+    seg = store.read("segment")
+    assert seg["blcoef"][0] == [0.1, 0.2, 0.3]
+    assert seg["chprob"][0] == 1.0
+    assert store.read("tile")["model"] == ["BLOB"]
+    store.close()
+
+
+@pytest.mark.parametrize("backend", ["memory", "sqlite"])
+def test_upsert_idempotence(tmp_path, backend):
+    """Rerunning the same keys must not duplicate rows — the reference's
+    durability model (PK upserts, SURVEY.md §5)."""
+    store = open_store(backend, str(tmp_path / "st"), "ks")
+    store.write("segment", seg_frame(chprob=0.5))
+    store.write("segment", seg_frame(chprob=0.9))  # same key, new value
+    out = store.read("segment")
+    assert len(out["cx"]) == 1
+    assert out["chprob"][0] == 0.9
+    # different sday -> second row (sday is part of the segment key)
+    store.write("segment", seg_frame(sday="2001-01-01"))
+    assert store.count("segment") == 2
+    store.close()
+
+
+def test_parquet_chip_rewrite_idempotent(tmp_path):
+    store = ParquetStore(str(tmp_path / "pq"), "ks")
+    store.write("segment", seg_frame(cx=5, cy=6, chprob=0.1))
+    store.write("segment", seg_frame(cx=5, cy=6, chprob=0.7))
+    out = store.read("segment", {"cx": 5})
+    assert len(out["cx"]) == 1 and out["chprob"][0] == 0.7
+
+
+def test_keyspace_isolation(tmp_path):
+    a = SqliteStore(str(tmp_path / "s.db"), "ks_a")
+    b = SqliteStore(str(tmp_path / "s.db"), "ks_b")
+    a.write("tile", {"tx": [1], "ty": [1], "name": ["m"], "model": ["A"],
+                     "updated": ["x"]})
+    assert b.count("tile") == 0
+
+
+def test_async_writer_drains_and_raises(tmp_path):
+    store = MemoryStore()
+    w = AsyncWriter(store)
+    for i in range(20):
+        w.write("chip", {"cx": [i], "cy": [0], "dates": [["1999-01-01"]]})
+    w.flush()
+    assert store.count("chip") == 20
+
+    class Boom(MemoryStore):
+        def write(self, table, frame):
+            raise RuntimeError("disk full")
+
+    w2 = AsyncWriter(Boom())
+    w2.write("chip", {"cx": [1], "cy": [0], "dates": [[]]})
+    with pytest.raises(RuntimeError, match="disk full"):
+        w2.flush()
+    w.close()
+
+
+def test_schema_matches_reference_column_set():
+    """Segment column set mirrors ccdc/segment.py:16-56 (39 cols incl.
+    rfrawp); chip/pixel/tile match their modules."""
+    seg_cols = [c for c, _ in TABLES["segment"]["columns"]]
+    assert len(seg_cols) == 38
+    for p in ("bl", "gr", "re", "ni", "s1", "s2", "th"):
+        for suffix in ("mag", "rmse", "coef", "int"):
+            assert f"{p}{suffix}" in seg_cols
+    assert TABLES["segment"]["key"] == ("cx", "cy", "px", "py", "sday", "eday")
+    assert [c for c, _ in TABLES["chip"]["columns"]] == ["cx", "cy", "dates"]
+    assert [c for c, _ in TABLES["pixel"]["columns"]] == \
+        ["cx", "cy", "px", "py", "mask"]
+    assert [c for c, _ in TABLES["tile"]["columns"]] == \
+        ["tx", "ty", "name", "model", "updated"]
