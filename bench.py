@@ -1,0 +1,71 @@
+"""Headline benchmark: CCDC pixels/sec on TPU vs the 2000-core Spark baseline.
+
+Protocol (BASELINE.md): the reference publishes no absolute numbers, so the
+baseline is measured — the per-pixel CPU implementation's rate (the NumPy
+oracle standing in for pinned lcmap-pyccd's ccd.detect, same spec) scaled by
+the reference's "runs on 2000 cores" claim (README.rst:11).  The TPU number
+is the steady-state kernel rate on a batch of full 100x100 chips with a
+realistic ~20-year archive.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax.numpy as jnp
+
+    from firebird_tpu.ccd import detect as cpu_detect
+    from firebird_tpu.ccd import kernel
+    from firebird_tpu.ingest import SyntheticSource, pack, pixel_timeseries
+
+    # ---- workload: 4 chips, ~20-year archive (T ~ 460 obs) ----
+    src = SyntheticSource(seed=7, start="1985-01-01", end="2005-01-01",
+                          cloud_frac=0.15)
+    chips = [src.chip(100 + 3000 * i, 200) for i in range(4)]
+    packed = pack(chips, bucket=64)
+    n_pixels = packed.n_chips * 10000
+
+    # ---- TPU kernel rate (compile excluded: one warmup, then timed) ----
+    seg = kernel.detect_packed(packed, dtype=jnp.float32)
+    seg.n_segments.block_until_ready()
+    t0 = time.time()
+    runs = 3
+    for _ in range(runs):
+        seg = kernel.detect_packed(packed, dtype=jnp.float32)
+        seg.n_segments.block_until_ready()
+    tpu_rate = n_pixels * runs / (time.time() - t0)
+
+    # ---- CPU per-pixel rate (the pyccd stand-in), extrapolated ----
+    sample = 12
+    rng = np.random.default_rng(0)
+    pix = rng.integers(0, 10000, sample)
+    t0 = time.time()
+    for p_ in pix:
+        cpu_detect(**pixel_timeseries(packed, 0, int(p_)))
+    cpu_rate = sample / (time.time() - t0)
+
+    baseline_2000_cores = cpu_rate * 2000.0
+    out = {
+        "metric": "ccdc_pixels_per_sec_one_chip",
+        "value": round(tpu_rate, 1),
+        "unit": "pixels/sec",
+        "vs_baseline": round(tpu_rate / baseline_2000_cores, 3),
+        "detail": {
+            "chips": packed.n_chips,
+            "obs_per_pixel": int(packed.n_obs[0]),
+            "cpu_ref_pixels_per_sec_per_core": round(cpu_rate, 2),
+            "baseline_2000_core_pixels_per_sec": round(baseline_2000_cores, 1),
+            "mean_segments": float(np.asarray(seg.n_segments).mean()),
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,8 +31,10 @@ from firebird_tpu.store import AsyncWriter, open_store
 from firebird_tpu.utils import dates as dt
 from firebird_tpu.utils.fn import partition_all, take
 
-_DTYPES = {"float32": jnp.float32, "float64": jnp.float64,
-           "bfloat16": jnp.bfloat16}
+# bfloat16 is deliberately absent: ordinal days (~730000) have a bf16 ulp of
+# 4096 days, which would corrupt segment dates; bf16 belongs inside matmul
+# precision hints, not the date-carrying compute dtype.
+_DTYPES = {"float32": jnp.float32, "float64": jnp.float64}
 
 
 def make_source(cfg: Config, kind: str | None = None):
@@ -59,11 +61,25 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
     core.py:53-75): ingest -> pack -> kernel -> chip/pixel/segment writes."""
     log.info("finding ccd segments for %d chips", len(cids))
     dtype = _DTYPES[cfg.dtype]
+    batches = list(partition_all(cfg.chips_per_batch, cids))
 
-    with cf.ThreadPoolExecutor(max_workers=max(cfg.input_parallelism, 1)) as ex:
-        for batch_ids in partition_all(cfg.chips_per_batch, cids):
-            chips = list(ex.map(
-                lambda xy: source.chip(xy[0], xy[1], acquired), batch_ids))
+    # Double-buffered ingest: batch i+1 fetches over HTTP while batch i is
+    # on the device.  Two executors — the single prefetch slot must not
+    # steal the chip-level workers (INPUT_PARTITIONS semantics) or a
+    # 1-worker pool would deadlock on the nested map.
+    with cf.ThreadPoolExecutor(
+            max_workers=max(cfg.input_parallelism, 1)) as chips_ex, \
+            cf.ThreadPoolExecutor(max_workers=1) as prefetch_ex:
+
+        def fetch_batch(bids):
+            return list(chips_ex.map(
+                lambda xy: source.chip(xy[0], xy[1], acquired), bids))
+
+        nxt = prefetch_ex.submit(fetch_batch, batches[0]) if batches else None
+        for i in range(len(batches)):
+            chips = nxt.result()
+            nxt = (prefetch_ex.submit(fetch_batch, batches[i + 1])
+                   if i + 1 < len(batches) else None)
             packed = pack(chips, bucket=cfg.obs_bucket, max_obs=cfg.max_obs)
             seg = kernel.detect_packed(packed, dtype=dtype)
             seg_host = kernel.ChipSegments(
@@ -116,10 +132,11 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
     try:
         for chunk in chunks:
             try:
-                done.extend(detect_chunk(
+                processed = detect_chunk(
                     chunk, source=source, writer=writer, acquired=acquired,
-                    cfg=cfg, counters=counters, log=log))
-                writer.flush()
+                    cfg=cfg, counters=counters, log=log)
+                writer.flush()      # a chunk only counts once its rows landed
+                done.extend(processed)
             except Exception as e:
                 # Chunk-level failure isolation (core.py:115-124): log and
                 # move on; idempotent writes make the rerun cheap.

@@ -1,0 +1,6 @@
+from firebird_tpu.parallel.mesh import (chip_sharding, detect_sharded,
+                                        make_mesh, replicated)
+from firebird_tpu.parallel.dist import init_distributed
+
+__all__ = ["make_mesh", "chip_sharding", "replicated", "detect_sharded",
+           "init_distributed"]

@@ -1,0 +1,41 @@
+"""Multi-host bring-up.
+
+Replaces the reference's Mesos + Spark driver/executor RPC (SURVEY.md §2.3):
+there is no task scheduler because execution is SPMD — every host runs the
+same program over its shard of the chip batch.  DCN coordination is
+jax.distributed; after initialize(), make_mesh() sees the global device set.
+"""
+
+from __future__ import annotations
+
+import os
+
+from firebird_tpu.obs import logger
+
+log = logger("change-detection")
+
+
+def init_distributed(coordinator: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> bool:
+    """Initialize jax.distributed from args or the standard env vars
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID).
+
+    Returns True if distributed mode was initialized, False for
+    single-process runs (the common dev path) — callers need no branching:
+    jax.devices() is correct either way.
+    """
+    import jax
+
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not coordinator:
+        return False
+    num_processes = num_processes or int(os.environ.get("JAX_NUM_PROCESSES", 1))
+    process_id = process_id if process_id is not None else \
+        int(os.environ.get("JAX_PROCESS_ID", 0))
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    log.info("jax.distributed up: %d processes, %d global devices",
+             num_processes, len(jax.devices()))
+    return True

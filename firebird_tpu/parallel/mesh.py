@@ -1,0 +1,88 @@
+"""Device mesh + sharding for the chip batch.
+
+The reference's only parallelism is bulk-synchronous data parallelism over
+space, realized as Spark partitioning + shuffle (SURVEY.md §2.4); its
+shuffles exist to fix partition counts and skew (timeseries.py:125,
+repartition to CORES*8).  On TPU that whole machinery collapses to a static
+even sharding of the chip axis over a jax.sharding.Mesh: CCDC needs no
+inter-chip communication, so XLA inserts no collectives on the forward path
+and scaling is embarrassing across ICI and DCN alike.  The mesh axes are
+('data',) — tensor/pipeline/sequence parallelism are deliberately absent,
+matching the algorithm (SURVEY.md §2.4 table; vmap covers the pixel axis,
+the time axis stays on-device per pixel).
+
+Multi-host: the same NamedSharding over a multi-host mesh; each host feeds
+its addressable shard of the chip batch (jax.make_array_from_process_local_data),
+and jax.distributed handles DCN bring-up (parallel.dist).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(devices=None, n_devices: int | None = None) -> Mesh:
+    """A 1-D data mesh over the given (or all) devices.
+
+    If the default platform has fewer than n_devices, falls back to the CPU
+    platform (where --xla_force_host_platform_device_count can provide
+    virtual devices for sharding validation without hardware).
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None and len(devices) < n_devices:
+            devices = jax.devices("cpu")
+        if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"need {n_devices} devices, have {len(devices)}")
+            devices = devices[:n_devices]
+    return Mesh(np.array(devices), axis_names=("data",))
+
+
+def chip_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (chip) axis across the data mesh axis."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_packed(packed, mesh: Mesh, dtype):
+    """Device-put a PackedChips batch with the chip axis sharded."""
+    import jax.numpy as jnp
+    from firebird_tpu.ccd.kernel import build_designs
+
+    C, _, _, T = packed.spectra.shape
+    if C % mesh.devices.size:
+        raise ValueError(
+            f"chip batch ({C}) must divide evenly over {mesh.devices.size} "
+            "devices — pad the batch (static even sharding, no shuffle)")
+    sh = chip_sharding(mesh)
+    Xs = np.stack([build_designs(packed.dates[c], int(packed.n_obs[c]))[0]
+                   for c in range(C)])
+    Xts = np.stack([build_designs(packed.dates[c], int(packed.n_obs[c]))[1]
+                    for c in range(C)])
+    valid = np.arange(T)[None, :] < packed.n_obs[:, None]
+    put = lambda a, d: jax.device_put(jnp.asarray(a, d), sh)
+    return (put(Xs, dtype), put(Xts, dtype),
+            put(packed.dates, dtype), put(valid, jnp.bool_),
+            put(packed.spectra, dtype),
+            put(packed.qas.astype(np.int32), jnp.int32))
+
+
+def detect_sharded(packed, mesh: Mesh, dtype=None):
+    """Run the CCD kernel with the chip batch sharded over the mesh.
+
+    This is the multi-device production path: same math as
+    kernel.detect_packed, chip axis split across devices, zero collectives.
+    """
+    import jax.numpy as jnp
+    from firebird_tpu.ccd.kernel import _detect_batch
+
+    dtype = dtype or jnp.float32
+    args = shard_packed(packed, mesh, dtype)
+    return _detect_batch(*args)

@@ -191,6 +191,17 @@ class ParquetStore:
     def write(self, table: str, frame: dict) -> int:
         import pyarrow as pa
         import pyarrow.parquet as pq
+        # One frame = one partition: the file is named after row 0's key
+        # prefix, so rows for a second chip would silently land in (and
+        # clobber) the first chip's file.
+        keyp = schema.primary_key(table)[: self._PART[table]]
+        first = tuple(_normalize(frame[k][0]) for k in keyp)
+        for i in range(1, len(frame[keyp[0]])):
+            if tuple(_normalize(frame[k][i]) for k in keyp) != first:
+                raise ValueError(
+                    f"ParquetStore.write({table!r}): frame spans multiple "
+                    f"partitions {first} vs row {i}; write one partition "
+                    "per frame")
         cols = {c: [_normalize(v) for v in frame[c]] for c in frame}
         pq.write_table(pa.table(cols), self._file(table, frame))
         return len(next(iter(frame.values())))

@@ -38,9 +38,11 @@ class AsyncWriter:
             try:
                 if self._error is None:
                     self.store.write(table, frame)
-            except Exception as e:  # surfaced on the next write()/flush()
+            except BaseException as e:  # incl. KeyboardInterrupt: a dead
+                # worker with un-acked items would hang flush() forever
                 log.error("async write to %s failed: %s", table, e)
-                self._error = e
+                self._error = e if isinstance(e, Exception) \
+                    else RuntimeError(f"writer interrupted: {e!r}")
             finally:
                 self._q.task_done()
 
@@ -48,13 +50,19 @@ class AsyncWriter:
         err, self._error = self._error, None
         return err
 
+    def _check_alive(self) -> None:
+        if not self._thread.is_alive():
+            raise RuntimeError("async writer thread is dead")
+
     def write(self, table: str, frame: dict) -> None:
         err = self._pop_error()
         if err is not None:
             raise err
+        self._check_alive()
         self._q.put((table, frame))
 
     def flush(self) -> None:
+        self._check_alive()
         self._q.join()
         err = self._pop_error()
         if err is not None:

@@ -99,8 +99,8 @@ def test_async_writer_drains_and_raises(tmp_path):
 
 
 def test_schema_matches_reference_column_set():
-    """Segment column set mirrors ccdc/segment.py:16-56 (39 cols incl.
-    rfrawp); chip/pixel/tile match their modules."""
+    """Segment column set mirrors ccdc/segment.py:16-56 (38 cols: 9 meta +
+    28 band + rfrawp); chip/pixel/tile match their modules."""
     seg_cols = [c for c, _ in TABLES["segment"]["columns"]]
     assert len(seg_cols) == 38
     for p in ("bl", "gr", "re", "ni", "s1", "s2", "th"):
