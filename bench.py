@@ -52,7 +52,7 @@ def main() -> None:
 
     baseline_2000_cores = cpu_rate * 2000.0
     out = {
-        "metric": "ccdc_pixels_per_sec_one_chip",
+        "metric": "ccdc_pixels_per_sec",
         "value": round(tpu_rate, 1),
         "unit": "pixels/sec",
         "vs_baseline": round(tpu_rate / baseline_2000_cores, 3),

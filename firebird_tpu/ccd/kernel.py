@@ -582,15 +582,22 @@ def build_designs(dates: np.ndarray, n_obs: int | None = None,
     return X.astype(dtype), Xt.astype(dtype)
 
 
+def prep_batch(packed) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side batch prep shared by the single-device and sharded paths:
+    stacked design matrices + validity mask for a PackedChips batch."""
+    C, _, _, T = packed.spectra.shape
+    designs = [build_designs(packed.dates[c], int(packed.n_obs[c]))
+               for c in range(C)]
+    Xs = np.stack([d[0] for d in designs])
+    Xts = np.stack([d[1] for d in designs])
+    valid = np.arange(T)[None, :] < packed.n_obs[:, None]
+    return Xs, Xts, valid
+
+
 def detect_packed(packed, dtype=jnp.float32) -> ChipSegments:
     """Run the kernel over a PackedChips batch -> ChipSegments with leading
     chip axis [C, P, ...]."""
-    C, _, _, T = packed.spectra.shape
-    Xs = np.stack([build_designs(packed.dates[c], int(packed.n_obs[c]))[0]
-                   for c in range(C)])
-    Xts = np.stack([build_designs(packed.dates[c], int(packed.n_obs[c]))[1]
-                    for c in range(C)])
-    valid = np.arange(T)[None, :] < packed.n_obs[:, None]
+    Xs, Xts, valid = prep_batch(packed)
     Y = jnp.asarray(packed.spectra, dtype=dtype)
     t_f = jnp.asarray(packed.dates, dtype=dtype)
     return _detect_batch(jnp.asarray(Xs, dtype), jnp.asarray(Xts, dtype),

@@ -70,6 +70,16 @@ class Config:
     # Framework version (reference: version.txt read in keyspace()).
     version: str = _VERSION
 
+    def __post_init__(self):
+        # Fail fast at construction: a bad dtype inside the driver's
+        # per-chunk failure isolation would log-and-skip every chunk and
+        # exit "successfully" having done nothing.
+        if self.dtype not in ("float32", "float64"):
+            raise ValueError(
+                f"FIREBIRD_DTYPE must be float32 or float64, got "
+                f"{self.dtype!r} (bfloat16 is rejected: ordinal days have a "
+                "bf16 ulp of 4096 days)")
+
     @classmethod
     def from_env(cls, env: dict | None = None, **overrides) -> "Config":
         """Build a Config from environment variables (explicitly, not at

@@ -52,22 +52,31 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def shard_packed(packed, mesh: Mesh, dtype):
-    """Device-put a PackedChips batch with the chip axis sharded."""
-    import jax.numpy as jnp
-    from firebird_tpu.ccd.kernel import build_designs
+    """Shard a PackedChips batch over the mesh's chip axis.
 
-    C, _, _, T = packed.spectra.shape
-    if C % mesh.devices.size:
+    Single-process: device_put onto the NamedSharding.  Multi-process
+    (jax.distributed): each host passes its process-local slice of the
+    global chip batch and jax.make_array_from_process_local_data assembles
+    the global sharded arrays — device_put cannot target non-addressable
+    devices.
+    """
+    import jax.numpy as jnp
+    from firebird_tpu.ccd.kernel import prep_batch
+
+    C = packed.spectra.shape[0]
+    multiproc = jax.process_count() > 1
+    n_local = (len(mesh.local_devices) if multiproc else mesh.devices.size)
+    if n_local == 0 or C % n_local:
         raise ValueError(
-            f"chip batch ({C}) must divide evenly over {mesh.devices.size} "
-            "devices — pad the batch (static even sharding, no shuffle)")
+            f"chip batch ({C}) must divide evenly over {n_local} "
+            "local devices — pad the batch (static even sharding, no shuffle)")
     sh = chip_sharding(mesh)
-    Xs = np.stack([build_designs(packed.dates[c], int(packed.n_obs[c]))[0]
-                   for c in range(C)])
-    Xts = np.stack([build_designs(packed.dates[c], int(packed.n_obs[c]))[1]
-                    for c in range(C)])
-    valid = np.arange(T)[None, :] < packed.n_obs[:, None]
-    put = lambda a, d: jax.device_put(jnp.asarray(a, d), sh)
+    Xs, Xts, valid = prep_batch(packed)
+    if multiproc:
+        put = lambda a, d: jax.make_array_from_process_local_data(
+            sh, np.asarray(a, dtype=d))
+    else:
+        put = lambda a, d: jax.device_put(jnp.asarray(a, d), sh)
     return (put(Xs, dtype), put(Xts, dtype),
             put(packed.dates, dtype), put(valid, jnp.bool_),
             put(packed.spectra, dtype),

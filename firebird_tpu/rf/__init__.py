@@ -1,0 +1,12 @@
+"""Random-forest land-cover classification (replaces ccdc/randomforest.py,
+ccdc/features.py, ccdc/udfs.py and the predict/persist path the reference
+left commented out at ccdc/core.py:190-240).
+
+- :mod:`firebird_tpu.rf.features` — the 33-column feature contract.
+- :mod:`firebird_tpu.rf.forest` — TPU-native random forest: histogram-based
+  level-wise training and batched inference, both jittable.
+- :mod:`firebird_tpu.rf.pipeline` — train / classify orchestration against
+  the keyed store.
+"""
+
+from firebird_tpu.rf.forest import RandomForest, train  # noqa: F401

@@ -1,0 +1,317 @@
+"""TPU-native random forest: histogram trees, level-wise, fully jittable.
+
+Replaces Spark ML's distributed ``RandomForestClassifier(numTrees=500)``
+(ccdc/randomforest.py:25-39).  Spark grows trees with distributed
+findBestSplits passes over binned features; the TPU-native formulation keeps
+the same statistical procedure — Poisson(1) bootstrap per tree (Spark's
+bagging with subsamplingRate=1.0), quantile-binned features, per-node class
+histograms, gini-gain splits over a sqrt(F) feature subset — but expresses
+it as dense array ops so the whole forest trains under ``jit``:
+
+- Trees are **complete binary trees of fixed depth** D.  A node that stops
+  splitting (no gain / below min leaf size) gets threshold=+inf so samples
+  fall through to its leftmost descendant; its class distribution is read at
+  depth D.  Fixed shapes mean no data-dependent tree topology — the shape
+  XLA wants.
+- Growth is **level-wise**: at level d every sample carries its node index
+  in [0, 2^d); one ``segment_sum`` scatter builds the [nodes, F, bins,
+  classes] histogram for the whole level, cumulative sums over bins give
+  every candidate split's left/right class counts at once.  This is the
+  MXU/VPU-friendly reformulation of Spark's per-node aggregation shuffle.
+- A chunk of trees trains at a time via ``vmap`` (bounded histogram
+  memory); chunks loop on the host.
+
+Inference walks all trees in lock-step (D gather steps, no branches) and
+sums per-tree leaf class distributions — Spark ML's ``rawPrediction``
+semantics (each tree contributes its leaf's normalized class distribution;
+randomforest.py:90-103 renames it ``rfrawp``).
+
+Label indexing follows StringIndexer(handleInvalid='keep'): classes ordered
+by descending training frequency (randomforest.py:35).  VectorIndexer's
+maxCategories=8 categorical detection (randomforest.py:36) is not
+replicated: quantile binning handles low-cardinality features natively
+(every distinct value gets its own bin edge), which is the same split
+family without the indexing pass.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NUM_TREES = 500          # randomforest.py:38
+DEFAULT_DEPTH = 8
+DEFAULT_BINS = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomForest:
+    """A trained forest in flat arrays (device- and serialization-friendly).
+
+    Internal nodes use breadth-first indexing: level d occupies
+    [2^d - 1, 2^(d+1) - 1); node i's children are 2i+1, 2i+2.  ``go right``
+    iff x[feature] > threshold.
+    """
+
+    feature: np.ndarray      # [T, 2^D - 1] int32
+    threshold: np.ndarray    # [T, 2^D - 1] float32 (+inf = always-left)
+    leaf_proba: np.ndarray   # [T, 2^D, C] float32, rows sum to 1
+    classes: np.ndarray      # [C] original label values, frequency-ordered
+
+    @property
+    def n_trees(self) -> int:
+        return self.feature.shape[0]
+
+    @property
+    def depth(self) -> int:
+        return int(np.log2(self.feature.shape[1] + 1))
+
+    @property
+    def n_classes(self) -> int:
+        return self.leaf_proba.shape[2]
+
+    # -- persistence (the tile table's `model` TEXT column, ccdc/tile.py) --
+
+    def dumps(self) -> str:
+        def enc(a):
+            a = np.ascontiguousarray(a)
+            return {"dtype": str(a.dtype), "shape": list(a.shape),
+                    "data": base64.b64encode(a.tobytes()).decode()}
+        return json.dumps({"format": "firebird_tpu.rf.v1",
+                           "feature": enc(self.feature),
+                           "threshold": enc(self.threshold),
+                           "leaf_proba": enc(self.leaf_proba),
+                           "classes": enc(self.classes)})
+
+    @classmethod
+    def loads(cls, s: str) -> "RandomForest":
+        d = json.loads(s)
+        if d.get("format") != "firebird_tpu.rf.v1":
+            raise ValueError(f"unknown model format: {d.get('format')!r}")
+        def dec(e):
+            a = np.frombuffer(base64.b64decode(e["data"]), dtype=e["dtype"])
+            return a.reshape(e["shape"]).copy()
+        return cls(feature=dec(d["feature"]), threshold=dec(d["threshold"]),
+                   leaf_proba=dec(d["leaf_proba"]), classes=dec(d["classes"]))
+
+    # -- inference --
+
+    def raw_predict(self, X: np.ndarray, batch: int = 16384) -> np.ndarray:
+        """rawPrediction [N, C]: sum over trees of leaf class distributions.
+
+        Batches are padded to a fixed size so XLA compiles once.  NaN
+        features compare false and route left (deterministic).
+        """
+        X = np.asarray(X, np.float32)
+        N = X.shape[0]
+        if N == 0:
+            return np.zeros((0, self.n_classes), np.float32)
+        f = jnp.asarray(self.feature)
+        t = jnp.asarray(self.threshold)
+        lp = jnp.asarray(self.leaf_proba)
+        out = np.empty((N, self.n_classes), np.float32)
+        for i in range(0, N, batch):
+            xb = X[i:i + batch]
+            n = xb.shape[0]
+            if n < batch:
+                xb = np.pad(xb, ((0, batch - n), (0, 0)))
+            out[i:i + batch] = np.asarray(
+                _raw_predict(f, t, lp, jnp.asarray(xb), self.depth))[:n]
+        return out
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Predicted original label values [N]."""
+        raw = self.raw_predict(X)
+        return self.classes[np.argmax(raw, axis=1)]
+
+
+@partial(jax.jit, static_argnums=(4,))
+def _raw_predict(feature, threshold, leaf_proba, X, depth):
+    """[T,M] trees x [N,F] samples -> [N,C] summed leaf distributions."""
+
+    def one_tree(tf, tt, tl):
+        node = jnp.zeros(X.shape[0], jnp.int32)
+        for d in range(depth):
+            nb = (2 ** d - 1) + node
+            fidx = tf[nb]                                   # [N]
+            xv = jnp.take_along_axis(X, fidx[:, None], axis=1)[:, 0]
+            node = 2 * node + (xv > tt[nb]).astype(jnp.int32)
+        return tl[node]                                     # [N, C]
+
+    return jnp.sum(jax.vmap(one_tree)(feature, threshold, leaf_proba), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Training
+# ---------------------------------------------------------------------------
+
+def _bin_edges(X: np.ndarray, n_bins: int) -> np.ndarray:
+    """Per-feature quantile edges [F, n_bins-1] (Spark's findSplits uses
+    sampled quantiles per feature; maxBins analogue is n_bins)."""
+    F = X.shape[1]
+    qs = np.linspace(0, 1, n_bins + 1)[1:-1]
+    edges = np.empty((F, n_bins - 1), np.float32)
+    for f in range(F):
+        col = X[:, f]
+        col = col[np.isfinite(col)]
+        if col.size == 0:
+            edges[f] = np.arange(n_bins - 1, dtype=np.float32)
+            continue
+        e = np.quantile(col, qs).astype(np.float32)
+        # Strictly increasing edges make bins well-defined; pad duplicates
+        # with tiny increments far above float32 ulp at these magnitudes.
+        e = np.maximum.accumulate(e)
+        dup = np.concatenate([[False], np.diff(e) == 0])
+        if dup.any():
+            e = e + np.cumsum(dup) * np.float32(1e-6) * np.maximum(
+                1.0, np.abs(e))
+        edges[f] = e
+    return edges
+
+
+def _binize(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """bin(x) = #(x > edge) in [0, n_bins-1]; NaN -> bin 0 (routes left,
+    matching inference where NaN > thr is false)."""
+    b = (np.nan_to_num(X, nan=-np.inf)[:, :, None]
+         > edges[None, :, :]).sum(axis=2)
+    return b.astype(np.int32)
+
+
+@partial(jax.jit, static_argnums=(3, 4, 5, 6, 7))
+def _train_chunk(Xb, y, keys, depth, n_bins, n_classes, mtry, min_leaf):
+    """Train a vmapped chunk of trees on binned features.
+
+    Xb [N, F] int32 bins, y [N] int32 class indices, keys [Tc] PRNG keys.
+    Returns (feature [Tc, 2^D-1], split_bin [Tc, 2^D-1], leaf_counts
+    [Tc, 2^D, C]); split_bin -1 marks always-left nodes.
+    """
+    N, F = Xb.shape
+    B, C = n_bins, n_classes
+
+    def one_tree(key):
+        kboot, knode = jax.random.split(key)
+        w = jax.random.poisson(kboot, 1.0, (N,)).astype(jnp.float32)
+
+        feats, bins = [], []
+        node = jnp.zeros(N, jnp.int32)
+        for d in range(depth):
+            n_nodes = 2 ** d
+            idx = ((node[:, None] * F + jnp.arange(F)[None, :]) * B + Xb)
+            idx = idx * C + y[:, None]                         # [N, F]
+            hist = jax.ops.segment_sum(
+                jnp.broadcast_to(w[:, None], (N, F)).reshape(-1),
+                idx.reshape(-1),
+                num_segments=n_nodes * F * B * C,
+            ).reshape(n_nodes, F, B, C)
+
+            left = jnp.cumsum(hist, axis=2)                    # [n,F,B,C]
+            total = left[:, :, -1:, :]
+            right = total - left
+            nl = left.sum(-1)                                  # [n,F,B]
+            nr = right.sum(-1)
+            # Maximizing sum_c l^2/nl + r^2/nr minimizes weighted gini.
+            score = (jnp.sum(left * left, -1) / jnp.maximum(nl, 1e-9)
+                     + jnp.sum(right * right, -1) / jnp.maximum(nr, 1e-9))
+            valid = (nl >= min_leaf) & (nr >= min_leaf)
+            # Last bin has no right side; exclude as a split point.
+            valid = valid & (jnp.arange(B)[None, None, :] < B - 1)
+
+            # sqrt(F) feature subset per node (featureSubsetStrategy='auto'
+            # for classification): mask features outside the node's draw.
+            u = jax.random.uniform(
+                jax.random.fold_in(knode, d), (n_nodes, F))
+            rank = jnp.argsort(jnp.argsort(u, axis=1), axis=1)
+            valid = valid & (rank[:, :, None] < mtry)
+
+            score = jnp.where(valid, score, -jnp.inf)
+            flat = score.reshape(n_nodes, F * B)
+            best = jnp.argmax(flat, axis=1)
+            bf = (best // B).astype(jnp.int32)                 # [n]
+            bb = (best % B).astype(jnp.int32)
+            best_score = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
+            # No-gain guard: splitting must beat the parent's own purity
+            # sum_c counts^2 / n (equality = pure node, nothing to gain).
+            parent = hist.sum((1, 2)) / F                      # [n, C]
+            pn = parent.sum(-1)
+            pscore = jnp.sum(parent * parent, -1) / jnp.maximum(pn, 1e-9)
+            use = jnp.isfinite(best_score) & (best_score > pscore + 1e-6)
+            bf = jnp.where(use, bf, 0)
+            bb = jnp.where(use, bb, -1)                        # -1: stay left
+            feats.append(bf)
+            bins.append(bb)
+
+            xb = jnp.take_along_axis(Xb, bf[node][:, None], 1)[:, 0]
+            go_right = (bb[node] >= 0) & (xb > bb[node])
+            node = 2 * node + go_right.astype(jnp.int32)
+
+        leaf_idx = node * C + y
+        leaf = jax.ops.segment_sum(
+            w, leaf_idx, num_segments=(2 ** depth) * C
+        ).reshape(2 ** depth, C)
+        return jnp.concatenate(feats), jnp.concatenate(bins), leaf
+
+    return jax.vmap(one_tree)(keys)
+
+
+def train(X: np.ndarray, y: np.ndarray, *, n_trees: int = NUM_TREES,
+          max_depth: int = DEFAULT_DEPTH, n_bins: int = DEFAULT_BINS,
+          min_leaf: int = 1, seed: int = 0,
+          trees_per_chunk: int = 16) -> RandomForest:
+    """Train a forest on host arrays X [N, F] (float), y [N] (labels).
+
+    Rows with any non-finite feature are dropped (the reference's join
+    produces only complete rows; sentinel segments never reach training).
+    """
+    X = np.asarray(X, np.float32)
+    y = np.asarray(y)
+    ok = np.isfinite(X).all(axis=1)
+    X, y = X[ok], y[ok]
+    if X.shape[0] == 0:
+        raise ValueError("no finite training rows")
+
+    # StringIndexer semantics: classes by descending frequency
+    # (ties broken by value for determinism).
+    vals, counts = np.unique(y, return_counts=True)
+    order = np.lexsort((vals, -counts))
+    classes = vals[order]
+    lut = {v: i for i, v in enumerate(classes)}
+    y_idx = np.array([lut[v] for v in y], np.int32)
+    C = len(classes)
+
+    edges = _bin_edges(X, n_bins)
+    Xb = jnp.asarray(_binize(X, edges))
+    yj = jnp.asarray(y_idx)
+    mtry = max(1, int(np.sqrt(X.shape[1])))
+
+    feats, bins, leaves = [], [], []
+    root = jax.random.PRNGKey(seed)
+    for c0 in range(0, n_trees, trees_per_chunk):
+        tc = min(trees_per_chunk, n_trees - c0)
+        keys = jax.vmap(jax.random.fold_in, (None, 0))(
+            root, jnp.arange(c0, c0 + tc))
+        f, b, l = _train_chunk(Xb, yj, keys, max_depth, n_bins, C,
+                               mtry, min_leaf)
+        feats.append(np.asarray(f))
+        bins.append(np.asarray(b))
+        leaves.append(np.asarray(l))
+    feature = np.concatenate(feats).astype(np.int32)
+    split_bin = np.concatenate(bins)
+    leaf = np.concatenate(leaves)
+
+    # bin threshold -> raw threshold: right iff bin > b iff x > edges[f, b];
+    # b == n_bins-1 can't occur (excluded above); b == -1 -> +inf.
+    thr = np.where(
+        split_bin >= 0,
+        edges[feature, np.clip(split_bin, 0, n_bins - 2)],
+        np.inf).astype(np.float32)
+
+    norm = leaf.sum(axis=2, keepdims=True)
+    leaf_proba = (leaf / np.maximum(norm, 1e-9)).astype(np.float32)
+    return RandomForest(feature=feature, threshold=thr,
+                        leaf_proba=leaf_proba, classes=classes)

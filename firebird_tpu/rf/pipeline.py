@@ -1,0 +1,185 @@
+"""Classification orchestration: train on the 3x3 neighborhood, classify
+the tile, persist predictions and the model.
+
+Replaces ccdc/core.py:156-251 **including the predict/persist path the
+reference left commented out** (core.py:190-240) and the empty model
+read/write stubs (ccdc/randomforest.py:17-22):
+
+- training mirrors randomforest.train (randomforest.py:42-87): aux rows
+  with trends[0] not in (0, 9), segments from the store windowed
+  'sday >= msday AND eday <= meday', features joined per pixel;
+- classification scores every real segment of the tile (the commented
+  filter 'sday >= 0 AND eday >= 0'), joins rfrawp back into the segment
+  rows by full key (ccdc/segment.py:103-116), and upserts them;
+- the trained model is serialized into the tile table
+  (tx, ty, name) -> model, updated (ccdc/tile.py:28-43).
+
+Segments are read from the store, so change detection must have run for
+the same keyspace first — the reference has the same dependency through
+pyccd.read (randomforest.py:69).
+"""
+
+from __future__ import annotations
+
+import datetime
+
+import numpy as np
+
+from firebird_tpu import grid
+from firebird_tpu.config import Config
+from firebird_tpu.obs import Counters, logger
+from firebird_tpu.rf import features, forest
+from firebird_tpu.store import AsyncWriter
+from firebird_tpu.utils.fn import take
+
+MODEL_NAME = "random-forest"
+
+
+def _chip_segments(store, cx: int, cy: int) -> dict | None:
+    seg = store.read("segment", where={"cx": int(cx), "cy": int(cy)})
+    return seg if seg["sday"] else None
+
+
+def training_data(cids, *, msday: int, meday: int, acquired: str,
+                  aux_source, store, log=None):
+    """Assemble (X [N, 33], y [N]) over a set of chip ids
+    (ref randomforest.train, ccdc/randomforest.py:42-87)."""
+    xs, ys = [], []
+    # Distinct detected chips ∩ requested chips (ccdc/randomforest.py:67's
+    # select(cx,cy).distinct()): skips the store scan for undetected chips.
+    have = store.chip_ids("segment")
+    for cx, cy in cids:
+        if (int(cx), int(cy)) not in have:
+            continue
+        seg = _chip_segments(store, cx, cy)
+        if seg is None:
+            continue
+        try:
+            aux = aux_source.aux(cx, cy, acquired)
+        except LookupError:
+            continue
+        mask = (features.real_rows(seg)
+                & features.segment_window(seg, msday, meday))
+        if not mask.any():
+            continue
+        X, meta = features.assemble(seg, aux, cx, cy, row_mask=mask)
+        label = np.asarray(meta["label"])
+        keep = ~np.isin(label, features.TRENDS_EXCLUDE)   # randomforest.py:63
+        keep &= np.isfinite(X).all(axis=1)
+        if keep.any():
+            xs.append(X[keep])
+            ys.append(label[keep])
+    if not xs:
+        return None, None
+    X = np.concatenate(xs)
+    y = np.concatenate(ys)
+    if log:
+        log.debug("feature row count:%d  feature columns:%d",
+                  X.shape[0], X.shape[1])
+    return X, y
+
+
+def train_tile(x, y, *, msday: int, meday: int, acquired: str, aux_source,
+               store, number: int | None = None, log=None,
+               **train_kw) -> forest.RandomForest | None:
+    """Train on the 3x3 tile neighborhood around (x, y); None when no
+    features exist (ref core.training, core.py:127-153)."""
+    log = log or logger("random-forest-training")
+    cids = grid.training(x, y)
+    if number is not None:
+        cids = list(take(number, cids))
+    X, yv = training_data(cids, msday=msday, meday=meday, acquired=acquired,
+                          aux_source=aux_source, store=store, log=log)
+    if X is None:
+        log.info("No features found to train model")   # randomforest.py:76
+        return None
+    log.info("training random forest on %d rows", X.shape[0])
+    return forest.train(X, yv, **train_kw)
+
+
+def save_model(store, tx: int, ty: int, model: forest.RandomForest,
+               name: str = MODEL_NAME) -> None:
+    """Persist a model into the tile table (ccdc/tile.py:28-43)."""
+    store.write("tile", {
+        "tx": [int(tx)], "ty": [int(ty)], "name": [name],
+        "model": [model.dumps()],
+        "updated": [datetime.datetime.now(datetime.timezone.utc).isoformat()],
+    })
+
+
+def load_model(store, tx: int, ty: int,
+               name: str = MODEL_NAME) -> forest.RandomForest | None:
+    """Read a model back from the tile table (completes the reference's
+    empty randomforest.read stub, ccdc/randomforest.py:21-22)."""
+    rows = store.read("tile", where={"tx": int(tx), "ty": int(ty),
+                                     "name": name})
+    return forest.RandomForest.loads(rows["model"][0]) if rows["model"] else None
+
+
+def classify_chip(model, seg: dict, aux: dict, cx: int, cy: int) -> dict | None:
+    """Score one chip's real segments; returns the updated segment frame
+    with rfrawp filled (ref randomforest.classify + segment.join,
+    randomforest.py:90-103, segment.py:103-116)."""
+    mask = features.real_rows(seg)
+    if not mask.any():
+        return None
+    X, _ = features.assemble(seg, aux, cx, cy, row_mask=mask)
+    raw = model.raw_predict(X)
+    rfrawp = list(seg["rfrawp"])
+    for k, i in enumerate(np.flatnonzero(mask)):
+        rfrawp[i] = [float(v) for v in raw[k]]   # dedensify, randomforest.py:106-123
+    out = dict(seg)
+    out["rfrawp"] = rfrawp
+    return out
+
+
+def classify_tile(x, y, *, msday: int, meday: int, acquired: str,
+                  cfg: Config | None = None, source=None, aux_source=None,
+                  store=None, number: int | None = None, **train_kw):
+    """Full classification driver (core.py:156-251, completed).
+
+    Trains on the 3x3 neighborhood, persists the model under the tile key,
+    scores every real segment of the center tile and upserts rfrawp.
+    Returns the trained model, or None when no training features exist.
+    """
+    name = "random-forest-classification"
+    log = logger(name)
+    counters = Counters()
+    cfg = cfg or Config.from_env()
+
+    log.info("beginning %s... x:%s y:%s acquired:%s", name, x, y, acquired)
+    model = train_tile(x, y, msday=msday, meday=meday, acquired=acquired,
+                       aux_source=aux_source, store=store, number=number,
+                       **train_kw)
+    if model is None:
+        return None
+
+    t = grid.tile(x, y)
+    save_model(store, t["x"], t["y"], model)
+
+    cids = grid.classification(x, y)
+    if number is not None:
+        cids = list(take(number, cids))
+    writer = AsyncWriter(store)
+    have = store.chip_ids("segment")
+    try:
+        for cx, cy in cids:
+            if (int(cx), int(cy)) not in have:
+                continue
+            seg = _chip_segments(store, cx, cy)
+            if seg is None:
+                continue
+            try:
+                aux = aux_source.aux(cx, cy, acquired)
+            except LookupError:
+                continue
+            updated = classify_chip(model, seg, aux, cx, cy)
+            if updated is None:
+                continue
+            writer.write("segment", updated)
+            counters.add("chips")
+            counters.add("segments", len(updated["sday"]))
+    finally:
+        writer.close()
+        log.info("classification complete: %s", counters.snapshot())
+    return model

@@ -66,6 +66,12 @@ class MemoryStore:
     def count(self, table: str) -> int:
         return len(self._tables[table])
 
+    def chip_ids(self, table: str = "segment") -> set[tuple[int, int]]:
+        """Distinct (cx, cy) present in a table (the reference's
+        select(cx, cy).distinct(), ccdc/randomforest.py:67)."""
+        with self._lock:
+            return {k[:2] for k in self._tables[table]}
+
     def close(self):
         pass
 
@@ -153,6 +159,12 @@ class SqliteStore:
         return self._conn().execute(
             f'SELECT COUNT(*) FROM "{table}"').fetchone()[0]
 
+    def chip_ids(self, table: str = "segment") -> set[tuple[int, int]]:
+        k1, k2 = schema.primary_key(table)[:2]
+        cur = self._conn().execute(
+            f'SELECT DISTINCT "{k1}", "{k2}" FROM "{table}"')
+        return {(r[0], r[1]) for r in cur}
+
     def close(self):
         with self._conns_lock:
             conns, self._all_conns = self._all_conns, []
@@ -226,6 +238,24 @@ class ParquetStore:
 
     def count(self, table: str) -> int:
         return len(self.read(table)["cx" if table != "tile" else "tx"])
+
+    def chip_ids(self, table: str = "segment") -> set[tuple[int, int]]:
+        d = os.path.join(self.root, table)
+        if not os.path.isdir(d):
+            return set()
+        # One file per (cx, cy) partition: parse keys from filenames,
+        # skipping anything that isn't a well-formed partition file.
+        out = set()
+        for f in os.listdir(d):
+            stem, ext = os.path.splitext(f)
+            parts = stem.split("_")
+            if ext != ".parquet" or len(parts) < 2:
+                continue
+            try:
+                out.add((int(parts[0]), int(parts[1])))
+            except ValueError:
+                continue
+        return out
 
     def close(self):
         pass

@@ -1,0 +1,180 @@
+"""Random-forest layer tests: the 33-feature contract (ccdc/features.py:20-37),
+the TPU-native forest trainer/inference, model serialization, and the
+completed classification pipeline (ccdc/core.py:156-251 incl. the path the
+reference left commented out)."""
+
+import numpy as np
+import pytest
+
+from firebird_tpu.config import Config
+from firebird_tpu.driver import core
+from firebird_tpu.ingest import SyntheticSource
+from firebird_tpu.rf import features, forest, pipeline
+from firebird_tpu.store import MemoryStore
+from firebird_tpu.utils import dates as dt
+
+# ---------------------------------------------------------------------------
+# Feature contract
+# ---------------------------------------------------------------------------
+
+REFERENCE_COLUMNS = [
+    'blmag', 'grmag', 'remag', 'nimag', 's1mag', 's2mag', 'thmag',
+    'blrmse', 'grrmse', 'rermse', 'nirmse', 's1rmse', 's2rmse', 'thrmse',
+    'blcoef', 'grcoef', 'recoef', 'nicoef', 's1coef', 's2coef', 'thcoef',
+    'blint', 'grint', 'reint', 'niint', 's1int', 's2int', 'thint',
+    'dem', 'aspect', 'slope', 'mpw', 'posidex']
+
+
+def test_columns_contract():
+    """Order is significant; altering invalidates persisted models
+    (ccdc/features.py:28-36)."""
+    assert list(features.COLUMNS) == REFERENCE_COLUMNS
+    assert len(features.COLUMNS) == 33
+
+
+def _seg_frame(cx, cy, rows):
+    """Minimal segment frame: rows = [(px, py, sday, eday)]."""
+    n = len(rows)
+    frame = {
+        "cx": [cx] * n, "cy": [cy] * n,
+        "px": [r[0] for r in rows], "py": [r[1] for r in rows],
+        "sday": [r[2] for r in rows], "eday": [r[3] for r in rows],
+        "bday": [r[3] for r in rows],
+        "chprob": [1.0] * n, "curqa": [8] * n, "rfrawp": [None] * n,
+    }
+    for p in ("bl", "gr", "re", "ni", "s1", "s2", "th"):
+        frame[f"{p}mag"] = list(np.arange(n, dtype=float))
+        frame[f"{p}rmse"] = [0.5] * n
+        frame[f"{p}coef"] = [[10.0 + i, 2.0, 3.0, 0, 0, 0, 0] for i in range(n)]
+        frame[f"{p}int"] = [7.0] * n
+    return frame
+
+
+def test_assemble_first_coefficient_rule():
+    """densify takes first(x) of list-valued columns (ccdc/udfs.py:19-21):
+    only coefficient 0 becomes a feature."""
+    cx, cy = 3000, 6000
+    seg = _seg_frame(cx, cy, [(cx, cy, "1990-01-01", "1995-01-01"),
+                              (cx + 30, cy - 60, "1990-01-01", "1995-01-01")])
+    aux = {name: np.full((100, 100), i + 1.0)
+           for i, name in enumerate(features.AUX_FEATURES)}
+    X, meta = features.assemble(seg, aux, cx, cy)
+    assert X.shape == (2, 33)
+    j = list(features.COLUMNS).index("blcoef")
+    np.testing.assert_allclose(X[:, j], [10.0, 11.0])   # first coef only
+    # aux gathered at (px, py): row1 is pixel (1 east, 2 south)
+    j = list(features.COLUMNS).index("dem")
+    np.testing.assert_allclose(X[:, j], [1.0, 1.0])
+    assert meta["px"] == [cx, cx + 30]
+
+
+def test_segment_window_and_sentinels():
+    cx, cy = 0, 0
+    seg = _seg_frame(cx, cy, [
+        (0, 0, "1990-01-01", "1995-01-01"),
+        (30, 0, "1985-01-01", "1995-01-01"),    # starts before window
+        (60, 0, "0001-01-01", "0001-01-01"),    # sentinel
+    ])
+    w = features.segment_window(seg, dt.to_ordinal("1989-01-01"),
+                                dt.to_ordinal("1996-01-01"))
+    r = features.real_rows(seg)
+    assert list(w & r) == [True, False, False]
+
+
+# ---------------------------------------------------------------------------
+# Forest
+# ---------------------------------------------------------------------------
+
+def _blobs(n=1500, f=6, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, classes, n)
+    centers = rng.normal(0, 5, (classes, f))
+    X = centers[y] + rng.normal(0, 1.0, (n, f))
+    return X.astype(np.float32), y + 10     # labels need not be 0-based
+
+
+def test_forest_accuracy_and_roundtrip():
+    X, y = _blobs()
+    m = forest.train(X, y, n_trees=24, max_depth=6, n_bins=32, seed=1)
+    acc = (m.predict(X) == y).mean()
+    assert acc > 0.95
+    # rawPrediction: one normalized distribution per tree, summed
+    raw = m.raw_predict(X[:10])
+    assert raw.shape == (10, m.n_classes)
+    np.testing.assert_allclose(raw.sum(axis=1), 24.0, rtol=1e-4)
+    # serialization round-trip preserves predictions exactly
+    m2 = forest.RandomForest.loads(m.dumps())
+    np.testing.assert_array_equal(m.raw_predict(X[:50]), m2.raw_predict(X[:50]))
+
+
+def test_forest_class_order_and_nan_rows():
+    X, y = _blobs(n=600, classes=2, seed=3)
+    # class imbalance: StringIndexer orders by descending frequency
+    keep = (y == 10) | (np.arange(600) % 3 == 0)
+    X, y = X[keep], y[keep]
+    Xn = X.copy()
+    Xn[0, 0] = np.nan                       # dropped from training
+    m = forest.train(Xn, y, n_trees=8, max_depth=5, n_bins=16, seed=0)
+    assert m.classes[0] == 10               # majority class first
+    # NaN at inference routes left deterministically, still returns a class
+    p = m.predict(np.full((2, X.shape[1]), np.nan, np.float32))
+    assert all(v in m.classes for v in p)
+
+
+def test_forest_generalizes():
+    X, y = _blobs(n=2000, seed=5)
+    m = forest.train(X[:1500], y[:1500], n_trees=24, max_depth=6, seed=2)
+    assert (m.predict(X[1500:]) == y[1500:]).mean() > 0.9
+
+
+# ---------------------------------------------------------------------------
+# Pipeline end-to-end
+# ---------------------------------------------------------------------------
+
+ACQ = "1995-01-01/1997-06-01"
+CFG = Config(store_backend="memory", source_backend="synthetic",
+             chips_per_batch=1, dtype="float64")
+
+
+@pytest.fixture(scope="module")
+def detected_store():
+    store = MemoryStore("test")
+    src = SyntheticSource(seed=9, start="1995-01-01", end="1998-01-01",
+                          cloud_frac=0.1)
+    core.changedetection(x=100, y=200, acquired=ACQ, number=2, chunk_size=2,
+                         cfg=CFG, source=src, store=store)
+    return store, src
+
+
+def test_classify_tile_end_to_end(detected_store):
+    store, src = detected_store
+    model = pipeline.classify_tile(
+        100, 200, msday=dt.to_ordinal("1990-01-01"),
+        meday=dt.to_ordinal("1999-01-01"), acquired=ACQ, cfg=CFG,
+        aux_source=src, store=store, n_trees=8, max_depth=5, n_bins=16)
+    assert model is not None
+    # model persisted under the tile key (ccdc/tile.py)
+    from firebird_tpu import grid
+    t = grid.tile(100, 200)
+    loaded = pipeline.load_model(store, t["x"], t["y"])
+    assert loaded is not None and loaded.n_trees == 8
+    # every real segment of the detected chips got an rfrawp vector
+    (cx, cy) = sorted(store.chip_ids("segment"))[0]
+    seg = store.read("segment", {"cx": cx, "cy": cy})
+    real = [i for i, s in enumerate(seg["sday"]) if s != "0001-01-01"]
+    scored = [i for i in real if seg["rfrawp"][i] is not None]
+    assert len(scored) == len(real) and len(real) > 0
+    assert len(seg["rfrawp"][scored[0]]) == model.n_classes
+    # labels predicted are within the synthetic trends alphabet (1..8)
+    top = np.argmax(np.asarray(seg["rfrawp"][scored[0]], float))
+    assert model.classes[top] in range(1, 9)
+
+
+def test_classify_tile_no_features(detected_store):
+    """Training window excluding every segment -> None (randomforest.py:76)."""
+    store, src = detected_store
+    model = pipeline.classify_tile(
+        100, 200, msday=dt.to_ordinal("2050-01-01"),
+        meday=dt.to_ordinal("2051-01-01"), acquired=ACQ, cfg=CFG,
+        aux_source=src, store=store, n_trees=4, max_depth=3)
+    assert model is None
