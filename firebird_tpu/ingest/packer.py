@@ -18,6 +18,7 @@ import dataclasses
 
 import numpy as np
 
+from firebird_tpu import native
 from firebird_tpu.ccd import params
 
 CHIP_SIDE = 100          # pixels per chip side (registry data_shape [100,100])
@@ -111,18 +112,21 @@ def pack(chips: list[ChipData], *, bucket: int = 64, max_obs: int = 0) -> Packed
     C = len(chips)
     cids = np.zeros((C, 2), np.int64)
     dates = np.zeros((C, cap), np.int32)
-    spectra = np.full((C, params.NUM_BANDS, PIXELS, cap), params.FILL_VALUE, np.int16)
-    qas = np.full((C, PIXELS, cap), QA_FILL_PACKED, np.uint16)
+    # The transpose-with-padding writes every cell, so plain empty buffers;
+    # the heavy [7,T,100,100] -> [7,P,cap] layout change runs in the native
+    # data plane when available (firebird_tpu/native/fastpack.cpp).
+    spectra = np.empty((C, params.NUM_BANDS, PIXELS, cap), np.int16)
+    qas = np.empty((C, PIXELS, cap), np.uint16)
     n_obs = np.zeros(C, np.int32)
 
     for i, c in enumerate(chips):
         T = min(c.dates.shape[0], cap)
         cids[i] = (c.cx, c.cy)
         dates[i, :T] = c.dates[:T]
-        # [7, T, 100, 100] -> [7, P, T]
-        spectra[i, :, :, :T] = (
-            c.spectra[:, :T].reshape(params.NUM_BANDS, T, PIXELS).transpose(0, 2, 1))
-        qas[i, :, :T] = c.qas[:T].reshape(T, PIXELS).T
+        native.pack_spectra(c.spectra[:, :T].reshape(params.NUM_BANDS, T, PIXELS),
+                            cap, params.FILL_VALUE, out=spectra[i])
+        native.pack_qa(c.qas[:T].reshape(T, PIXELS), cap,
+                       int(QA_FILL_PACKED), out=qas[i])
         n_obs[i] = T
     return PackedChips(cids=cids, dates=dates, spectra=spectra, qas=qas, n_obs=n_obs)
 

@@ -13,13 +13,13 @@ ccdc/timeseries.py:46-56).
 
 from __future__ import annotations
 
-import base64
 import json
 import urllib.parse
 import urllib.request
 
 import numpy as np
 
+from firebird_tpu import native
 from firebird_tpu.ccd import harmonic, params, synthetic
 from firebird_tpu.ingest.packer import CHIP_SIDE, ChipData
 from firebird_tpu.obs import logger
@@ -193,12 +193,18 @@ AUX_UBIDS = {
 def decode_raster(rec: dict, dtype=np.int16) -> np.ndarray:
     """Decode one chip record's base64 payload to a [100,100] array.
 
-    Payload is 20,000 bytes of little-endian int16 (or uint16 for QA) —
-    the wire format seen in test/data/chip_response.json.
+    Payload is little-endian (int16 spectra, uint16 QA, float32/byte AUX) —
+    the wire format seen in test/data/chip_response.json.  The decode runs
+    in the native data plane, straight into the result buffer.
     """
-    raw = base64.b64decode(rec["data"])
-    a = np.frombuffer(raw, dtype=np.dtype(dtype).newbyteorder("<"))
-    return a.reshape(CHIP_SIDE, CHIP_SIDE).astype(dtype)
+    data = rec["data"]
+    wire = np.dtype(dtype).newbyteorder("<")
+    out = np.empty(len(data) * 3 // 4 // wire.itemsize, wire)
+    n = native.b64_decode_into(data, out)
+    a = out[:n // wire.itemsize]
+    if wire != np.dtype(dtype):  # big-endian host: swap to native order
+        a = a.astype(dtype)
+    return a.reshape(CHIP_SIDE, CHIP_SIDE)
 
 
 def _default_http_get(url: str) -> list | dict:
