@@ -75,12 +75,13 @@ class ChipSegments:
     seg_coef: jnp.ndarray        # [.., P, S, 7, 8]
     mask: jnp.ndarray            # [.., P, T] bool — processing mask
     procedure: jnp.ndarray       # [.., P] int32
+    rounds: jnp.ndarray | None = None  # [..] int32 event-loop rounds (diag)
 
 
 jax.tree_util.register_pytree_node(
     ChipSegments,
     lambda s: ((s.n_segments, s.seg_meta, s.seg_rmse, s.seg_mag, s.seg_coef,
-                s.mask, s.procedure), None),
+                s.mask, s.procedure, s.rounds), None),
     lambda _, c: ChipSegments(*c),
 )
 
@@ -107,7 +108,7 @@ def _take_pix(a, idx):
     return jnp.take_along_axis(a, ii, axis=2)[..., 0]
 
 
-def _fit_lasso(X, Y, w, coefmask):
+def _fit_lasso(X, Y, w, coefmask, XX=None):
     """Batched Lasso via cyclic coordinate descent on Gram matrices.
 
     Mirrors harmonic.lasso_cd_gram exactly (same update, same iteration
@@ -120,14 +121,20 @@ def _fit_lasso(X, Y, w, coefmask):
         Y: [P, 7, T] observations.
         w: [P, T] 0/1 weights (the fit window).
         coefmask: [P, 8] allowed coefficients.
+        XX: optional [T, 64] flattened per-row outer products X[t] X[t]^T,
+            precomputed once per chip.  The 0/1 weights make the two Gram
+            formulations bit-identical per term, and [P,T]x[T,64] is one
+            MXU matmul instead of a [P,T,8] broadcast temporary.
 
     Returns:
         (coefs [P,7,8], rmse [P,7], resid [P,7,T] — residuals at ALL obs).
     """
+    K = params.MAX_COEFS
     n = jnp.maximum(jnp.sum(w, -1), 1.0)                       # [P]
-    Xw = w[:, :, None] * X[None]                               # [P,T,8]
-    G = jnp.einsum("ptc,td->pcd", Xw, X) / n[:, None, None]    # [P,8,8]
-    c = jnp.einsum("pbt,ptc->pbc", Y * w[:, None, :], X[None]) / n[:, None, None]
+    if XX is None:
+        XX = (X[:, :, None] * X[:, None, :]).reshape(-1, K * K)
+    G = (w @ XX).reshape(-1, K, K) / n[:, None, None]          # [P,8,8]
+    c = jnp.einsum("pbt,tc->pbc", Y * w[:, None, :], X) / n[:, None, None]
     diag = jnp.maximum(jnp.diagonal(G, axis1=-2, axis2=-1), 1e-12)  # [P,8]
     alpha = params.LASSO_ALPHA
 
@@ -264,6 +271,8 @@ def _detect_core(X, Xt, t, valid, Y, qa):
     S = MAX_SEGMENTS
     ar = jnp.arange(T)[None, :]
     fdtype = Y.dtype
+    # Per-row design outer products, shared by every Lasso Gram build.
+    XX = (X[:, :, None] * X[:, None, :]).reshape(T, -1)        # [T,64]
 
     # ---------------- QA triage (reference.detect) ----------------
     fill = _qa_bit(qa, params.QA_FILL_BIT) | ~valid[None, :]
@@ -319,7 +328,8 @@ def _detect_core(X, Xt, t, valid, Y, qa):
     alt_n = jnp.sum(alt_usable, -1)
     alt_fit = is_alt & (alt_n >= params.MEOW_SIZE)
     w_alt = (alt_usable & alt_fit[:, None]).astype(fdtype)
-    alt_coefs, alt_rmse, _ = _fit_lasso(X, Y, w_alt, _coefmask_for(alt_n, P))
+    alt_coefs, alt_rmse, _ = _fit_lasso(X, Y, w_alt, _coefmask_for(alt_n, P),
+                                        XX=XX)
     first_i = jnp.argmax(alt_usable, -1)
     last_i = T - 1 - jnp.argmax(alt_usable[:, ::-1], -1)
     alt_meta = jnp.stack([
@@ -389,7 +399,7 @@ def _detect_core(X, Xt, t, valid, Y, qa):
         w_stab = w_init & ~tm_removed[:, None]
         cm4 = jnp.arange(params.MAX_COEFS)[None, :] < 4
         cm4 = jnp.broadcast_to(cm4, (P, params.MAX_COEFS))
-        c4, r4, resid4 = _fit_lasso(X, Y, w_stab.astype(fdtype), cm4)
+        c4, r4, resid4 = _fit_lasso(X, Y, w_stab.astype(fdtype), cm4, XX=XX)
         r_first = _take_pix(resid4, i)                # [P,7]
         r_last = _take_pix(resid4, j)
         span = jnp.take(t, j) - t_i
@@ -406,78 +416,90 @@ def _detect_core(X, Xt, t, valid, Y, qa):
         init_bad = in_init & has_w & ~tm_removed & ~stable
 
         # ================= MONITOR fast-forward =================
-        pred = jnp.einsum("pbc,tc->pbt", st["coefs"], X)
-        resid = Y - pred
+        # All event logic runs in rank space on the absolute time axis:
+        # rank[p, t] = index of observation t in pixel p's compacted alive
+        # sequence.  Ranks are monotone in t among alive obs, so rank
+        # comparisons reproduce the compacted-sequence semantics without the
+        # argsort/compaction/scatter round-trip ([P,T] bitonic sorts are the
+        # expensive op on TPU, not the matmuls).
+        pred_d = jnp.einsum("pbc,tc->pbt", st["coefs"][:, _DET, :], X)
         dden = jnp.maximum(st["rmse"], vario)[:, _DET]            # [P,5]
-        s = jnp.sum((resid[:, _DET, :] / dden[:, :, None]) ** 2, axis=1)
+        s = jnp.sum(((Y[:, _DET, :] - pred_d) / dden[:, :, None]) ** 2, axis=1)
 
-        order = jnp.argsort(~alive, axis=-1, stable=True)         # [P,T]
-        inv_order = jnp.argsort(order, axis=-1)
         m = jnp.sum(alive, -1)                                    # [P]
-        sc = jnp.take_along_axis(s, order, -1)
-        validq = ar < m[:, None]
+        rank = Acum - 1                                           # [P,T]
         kq = jnp.sum(alive & (ar < st["cur_k"][:, None]), -1)     # cursor rank
 
-        exq = (sc > params.CHANGE_THRESHOLD) & validq
-        run6 = exq
-        for d in range(1, params.PEEK_SIZE):
-            shifted = jnp.concatenate(
-                [exq[:, d:], jnp.zeros((P, d), bool)], axis=1)
-            run6 = run6 & shifted
-        elig = validq & (ar >= kq[:, None])
-        brk = run6 & elig
+        INF = T + 1
+        ex = alive & (s > params.CHANGE_THRESHOLD)
+        # Consecutive-exceeding run length starting at each alive obs:
+        # (rank of next alive non-exceeding obs, else m) - own rank.
+        reset_r = jnp.where(alive & ~ex, rank, INF)
+        nrr = lax.cummin(reset_r, axis=1, reverse=True)
+        runlen = jnp.minimum(nrr, m[:, None]) - rank
+        elig = alive & (rank >= kq[:, None])
+        brk = elig & ex & (runlen >= params.PEEK_SIZE)
         has_brk = jnp.any(brk, -1)
-        bq = jnp.argmax(brk, -1)
+        b_abs = jnp.argmax(brk, -1)
 
-        oq = sc > params.OUTLIER_THRESHOLD
-        absq = elig & ~oq
+        o = s > params.OUTLIER_THRESHOLD
+        absq = elig & ~o
         n0 = jnp.sum(included, -1)
-        cumabs = jnp.cumsum(absq, -1)
-        n_inc = n0[:, None] + cumabs
+        n_inc = n0[:, None] + jnp.cumsum(absq, -1)
         refit_hit = absq & (n_inc >= params.REFIT_FACTOR
                             * st["n_last_fit"][:, None])
         has_refit = jnp.any(refit_hit, -1)
-        fq = jnp.argmax(refit_hit, -1)
+        f_abs = jnp.argmax(refit_hit, -1)
 
-        q_tail = jnp.maximum(m - (params.PEEK_SIZE - 1), kq)
+        q_tail = jnp.maximum(m - (params.PEEK_SIZE - 1), kq)      # a rank
 
-        INF = T + 1
-        b_ev = jnp.where(has_brk, bq, INF)
-        f_ev = jnp.where(has_refit, fq, INF)
+        def rank_at(idx):
+            return jnp.take_along_axis(rank, idx[:, None], -1)[:, 0]
+
+        b_ev = jnp.where(has_brk, rank_at(b_abs), INF)
+        f_ev = jnp.where(has_refit, rank_at(f_abs), INF)
         is_tail = in_mon & (q_tail <= jnp.minimum(b_ev, f_ev))
         is_brk = in_mon & ~is_tail & has_brk & (b_ev <= f_ev)
         is_refit = in_mon & ~is_tail & ~is_brk & has_refit
 
-        ev = jnp.where(is_tail, q_tail, jnp.where(is_brk, bq, fq))
+        ev_rank = jnp.where(is_tail, q_tail, jnp.where(is_brk, b_ev, f_ev))
 
         # Normal-rules region ends before the event (inclusive for refit).
-        normal_hi = jnp.where(is_refit, ev + 1, ev)               # exclusive
-        normalq = elig & (ar < normal_hi[:, None])
-        inc_q = normalq & ~oq
-        rem_q = normalq & oq
+        normal_hi = jnp.where(is_refit, ev_rank + 1, ev_rank)     # exclusive
+        normalq = elig & (rank < normal_hi[:, None])
+        inc_q = normalq & ~o
+        rem_q = normalq & o
         # Tail region: score <= threshold absorbed, else removed+counted.
-        tailq = validq & (ar >= q_tail[:, None]) & (ar >= kq[:, None]) \
-            & is_tail[:, None]
-        tail_ex = tailq & (sc > params.CHANGE_THRESHOLD)
+        tailq = elig & (rank >= q_tail[:, None]) & is_tail[:, None]
+        tail_ex = tailq & (s > params.CHANGE_THRESHOLD)
         inc_q = inc_q | (tailq & ~tail_ex)
         rem_q = rem_q | tail_ex
         n_exceed = jnp.sum(tail_ex, -1)
 
-        inc_abs = jnp.take_along_axis(inc_q, inv_order, -1) & in_mon[:, None]
-        rem_abs = jnp.take_along_axis(rem_q, inv_order, -1) & in_mon[:, None]
+        inc_abs = inc_q & in_mon[:, None]
+        rem_abs = rem_q & in_mon[:, None]
         included_mon = included | inc_abs
         alive_mon = alive & ~rem_abs
 
-        # Break bookkeeping
-        pos_ev = jnp.take_along_axis(order, jnp.minimum(ev, T - 1)[:, None],
-                                     -1)[:, 0]                    # abs idx
-        # Magnitudes: median residual over the PEEK run at the break.
-        runsel = (ar >= ev[:, None]) & (ar < (ev + params.PEEK_SIZE)[:, None]) \
-            & validq
-        runsel_abs = jnp.take_along_axis(runsel, inv_order, -1)
-        mags = jnp.stack(
-            [_masked_median(resid[:, b, :], runsel_abs) for b in range(7)],
-            axis=1)
+        # Break bookkeeping.  pos_ev: the event's absolute index (break ->
+        # new segment start; refit -> cursor bump past the refit point).
+        pos_ev = jnp.where(is_brk, b_abs, f_abs)
+        # Magnitudes: median full-band residual over the PEEK run at the
+        # break.  The run has at most PEEK_SIZE members — gather their
+        # absolute positions and take a tiny median instead of masked
+        # medians over the whole [P,T] axis.
+        relr = rank - ev_rank[:, None]
+        hit = (alive & (relr >= 0)
+               & (relr < params.PEEK_SIZE))[:, None, :] \
+            & (relr[:, None, :] == jnp.arange(params.PEEK_SIZE)[None, :, None])
+        run_idx = jnp.argmax(hit, -1)                             # [P,PEEK]
+        run_ok = jnp.any(hit, -1)                                 # [P,PEEK]
+        X_run = jnp.take(X, run_idx, axis=0)                      # [P,PEEK,8]
+        pred_run = jnp.einsum("pbc,pkc->pbk", st["coefs"], X_run)
+        Y_run = jnp.take_along_axis(Y, run_idx[:, None, :], axis=2)
+        resid_run = Y_run - pred_run                              # [P,7,PEEK]
+        mags = _masked_median(
+            resid_run, jnp.broadcast_to(run_ok[:, None, :], resid_run.shape))
 
         last_inc = T - 1 - jnp.argmax(included_mon[:, ::-1], -1)
         first_inc = jnp.argmax(included_mon, -1)
@@ -501,13 +523,12 @@ def _detect_core(X, Xt, t, valid, Y, qa):
 
         # ================= refit / init-ok shared fit =================
         n_ok = jnp.sum(w_stab, -1)
-        n_rf = jnp.take_along_axis(n_inc, jnp.minimum(ev, T - 1)[:, None],
-                                   -1)[:, 0]
+        n_rf = jnp.take_along_axis(n_inc, pos_ev[:, None], -1)[:, 0]
         w_full = jnp.where(init_ok[:, None], w_stab,
                            included_mon & is_refit[:, None])
         n_full = jnp.where(init_ok, n_ok, n_rf)
         cfull, rfull, _ = _fit_lasso(X, Y, w_full.astype(fdtype),
-                                     _coefmask_for(n_full, P))
+                                     _coefmask_for(n_full, P), XX=XX)
         do_fit = init_ok | is_refit
 
         # ================= next state =================
@@ -554,15 +575,20 @@ def _detect_core(X, Xt, t, valid, Y, qa):
     return ChipSegments(
         n_segments=state["nseg"],
         seg_meta=meta_b, seg_rmse=rmse_b, seg_mag=mag_b, seg_coef=coef_b,
-        mask=final_mask, procedure=procedure)
+        mask=final_mask, procedure=procedure, rounds=state["rounds"])
 
 
 # ---------------------------------------------------------------------------
 # Host-facing API
 # ---------------------------------------------------------------------------
 
-_detect_one = jax.jit(_detect_core)
-_detect_batch = jax.jit(jax.vmap(_detect_core))
+@functools.partial(jax.jit, static_argnames=("dtype",))
+def _detect_batch_wire(Xs, Xts, t, valid, Y_i16, qa_u16, *, dtype):
+    """Batch detect from wire dtypes: spectra/QA arrive as int16/uint16 and
+    widen on device — halves host->device transfer vs shipping float32."""
+    return jax.vmap(_detect_core)(Xs, Xts, t, valid,
+                                  Y_i16.astype(dtype),
+                                  qa_u16.astype(jnp.int32))
 
 
 def build_designs(dates: np.ndarray, n_obs: int | None = None,
@@ -598,11 +624,11 @@ def detect_packed(packed, dtype=jnp.float32) -> ChipSegments:
     """Run the kernel over a PackedChips batch -> ChipSegments with leading
     chip axis [C, P, ...]."""
     Xs, Xts, valid = prep_batch(packed)
-    Y = jnp.asarray(packed.spectra, dtype=dtype)
-    t_f = jnp.asarray(packed.dates, dtype=dtype)
-    return _detect_batch(jnp.asarray(Xs, dtype), jnp.asarray(Xts, dtype),
-                         t_f, jnp.asarray(valid),
-                         Y, jnp.asarray(packed.qas.astype(np.int32)))
+    return _detect_batch_wire(
+        jnp.asarray(Xs, dtype), jnp.asarray(Xts, dtype),
+        jnp.asarray(packed.dates, dtype=dtype), jnp.asarray(valid),
+        jnp.asarray(packed.spectra), jnp.asarray(packed.qas),
+        dtype=jnp.dtype(dtype))
 
 
 def segments_to_records(seg: ChipSegments, dates: np.ndarray,

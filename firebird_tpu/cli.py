@@ -18,9 +18,26 @@ def context_settings():
     return dict(token_normalize_func=lambda x: x.lower())
 
 
+def apply_platform(platform: str | None = None) -> None:
+    """Pin the JAX platform (e.g. 'cpu', 'tpu') before first use.
+
+    Deployment sitecustomize hooks may pin the JAX_PLATFORMS env var before
+    user environment settings can win; a runtime config update always
+    takes precedence, so FIREBIRD_JAX_PLATFORM is the reliable override.
+    """
+    import os
+
+    p = platform or os.environ.get("FIREBIRD_JAX_PLATFORM")
+    if p:
+        import jax
+
+        jax.config.update("jax_platforms", p)
+
+
 @click.group(context_settings=context_settings())
 def entrypoint():
     """firebird_tpu — TPU-native LCMAP CCDC."""
+    apply_platform()
 
 
 @entrypoint.command()

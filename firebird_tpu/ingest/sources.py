@@ -199,8 +199,12 @@ def decode_raster(rec: dict, dtype=np.int16) -> np.ndarray:
     """
     data = rec["data"]
     wire = np.dtype(dtype).newbyteorder("<")
-    out = np.empty(len(data) * 3 // 4 // wire.itemsize, wire)
+    out = np.empty(len(data) * 3 // 4 // wire.itemsize + 1, wire)
     n = native.b64_decode_into(data, out)
+    if n % wire.itemsize:
+        raise ValueError(
+            f"chip payload of {n} bytes is not a multiple of the "
+            f"{wire.itemsize}-byte wire dtype — truncated or corrupt")
     a = out[:n // wire.itemsize]
     if wire != np.dtype(dtype):  # big-endian host: swap to native order
         a = a.astype(dtype)

@@ -27,12 +27,22 @@ _tried = False
 
 
 def _build() -> bool:
+    # Compile to a process-private temp path and rename into place: the
+    # in-process lock doesn't cover concurrent builds from sibling worker
+    # processes, and rename() is atomic so nobody ever dlopens a
+    # half-written library.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", _LIB]
+           _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.rename(tmp, _LIB)
         return True
     except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -70,6 +80,19 @@ def _load():
         return _lib
 
 
+def _b64_fallback(data: bytes) -> bytes:
+    """Strict stdlib decode matching the native decoder: whitespace is
+    skipped (JSON payloads may wrap), any other invalid char raises."""
+    import base64
+    import binascii
+
+    try:
+        return base64.b64decode(data.translate(None, b" \t\r\n"),
+                                validate=True)
+    except binascii.Error as e:
+        raise ValueError(f"invalid base64 payload: {e}") from None
+
+
 def available() -> bool:
     """True when the C++ library is loaded (False = NumPy fallback)."""
     return _load() is not None
@@ -81,8 +104,7 @@ def b64_decode(data: bytes | str) -> bytes:
         data = data.encode("ascii")
     lib = _load()
     if lib is None:
-        import base64
-        return base64.b64decode(data)
+        return _b64_fallback(data)
     out = np.empty((len(data) // 4 + 1) * 3, np.uint8)
     n = lib.fb_b64_decode(
         data, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
@@ -108,8 +130,7 @@ def b64_decode_into(data: bytes | str, out: np.ndarray) -> int:
             f"out too small: {out.nbytes} bytes for {len(tail)} b64 chars")
     lib = _load()
     if lib is None or sys.byteorder != "little":
-        import base64
-        raw = base64.b64decode(data)
+        raw = _b64_fallback(data)
         flat = out.view(np.uint8).reshape(-1)
         flat[:len(raw)] = np.frombuffer(raw, np.uint8)
         return len(raw)

@@ -77,10 +77,11 @@ def shard_packed(packed, mesh: Mesh, dtype):
             sh, np.asarray(a, dtype=d))
     else:
         put = lambda a, d: jax.device_put(jnp.asarray(a, d), sh)
+    # Spectra/QA ship in wire dtypes (int16/uint16) and widen on device.
     return (put(Xs, dtype), put(Xts, dtype),
             put(packed.dates, dtype), put(valid, jnp.bool_),
-            put(packed.spectra, dtype),
-            put(packed.qas.astype(np.int32), jnp.int32))
+            put(packed.spectra, jnp.int16),
+            put(packed.qas, jnp.uint16))
 
 
 def detect_sharded(packed, mesh: Mesh, dtype=None):
@@ -90,8 +91,8 @@ def detect_sharded(packed, mesh: Mesh, dtype=None):
     kernel.detect_packed, chip axis split across devices, zero collectives.
     """
     import jax.numpy as jnp
-    from firebird_tpu.ccd.kernel import _detect_batch
+    from firebird_tpu.ccd.kernel import _detect_batch_wire
 
     dtype = dtype or jnp.float32
     args = shard_packed(packed, mesh, dtype)
-    return _detect_batch(*args)
+    return _detect_batch_wire(*args, dtype=jnp.dtype(dtype))

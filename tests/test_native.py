@@ -71,6 +71,20 @@ def test_pack_qa_matches_numpy(T, cap):
     np.testing.assert_array_equal(got, want)
 
 
+def test_fallback_b64_strict(monkeypatch):
+    """The stdlib fallback matches the native decoder's error contract:
+    whitespace skipped, any other invalid character raises ValueError."""
+    raw = bytes(range(256)) * 4
+    enc = base64.b64encode(raw).decode()
+    wrapped = "\n".join(enc[i: i + 76] for i in range(0, len(enc), 76))
+    _reload_fallback(monkeypatch)
+    assert native.b64_decode(wrapped) == raw
+    with pytest.raises(ValueError):
+        native.b64_decode("@@@@")
+    with pytest.raises(ValueError):
+        native.b64_decode("QUJD@@@@RUZH")
+
+
 def test_fallback_parity(monkeypatch):
     """The NumPy fallback and C++ agree on a full chip-sized workload."""
     rng = np.random.default_rng(7)
