@@ -167,32 +167,37 @@ def _coefmask_for(n, P):
     return jnp.arange(params.MAX_COEFS)[None, :] < nc[:, None]
 
 
-def _tmask_bad(Xt, Y2, w, vario2):
+def _tmask_bad(Xtw, Y2, w, vario2):
     """Batched Tmask: IRLS Huber harmonic fit on the Tmask bands.
 
     Mirrors harmonic.irls_huber + reference.tmask_outliers: fixed
     TMASK_IRLS_ITERS iterations, MAD sigma, Huber weights, outlier if the
     final absolute residual exceeds TMASK_CONST * variogram in any band.
 
+    Operates on the *compacted window* axis W (the gathered init-window
+    members, bounded by the host-computed window cap) — the per-iteration
+    median/MAD selections and Gram builds run over W instead of the full
+    series, which is what makes the per-round Tmask cheap.
+
     Args:
-        Xt: [T, 5] no-trend design.
-        Y2: [P, 2, T] Tmask-band observations.
-        w: [P, T] 0/1 window.
+        Xtw: [P, W, 5] no-trend design rows gathered at the window members.
+        Y2: [P, 2, W] Tmask-band observations at the window members.
+        w: [P, W] 0/1 validity of each gathered slot.
         vario2: [P, 2].
 
     Returns:
-        bad [P, T] bool (within the window).
+        bad [P, W] bool (within the window).
     """
     k = params.HUBER_K
-    nt = Xt.shape[1]
-    eye = 1e-9 * jnp.eye(nt, dtype=Xt.dtype)
+    nt = Xtw.shape[-1]
+    eye = 1e-9 * jnp.eye(nt, dtype=Xtw.dtype)
 
     def solve(wt):
-        # wt [P,2,T] weights -> beta [P,2,nt].  Cholesky, not LU: the Gram
+        # wt [P,2,W] weights -> beta [P,2,nt].  Cholesky, not LU: the Gram
         # is SPD (+ridge) and TPU XLA has no LuDecomposition expander.
-        Xw = wt[..., None] * Xt[None, None]                    # [P,2,T,nt]
-        G = jnp.einsum("pbtc,td->pbcd", Xw, Xt)                # [P,2,nt,nt]
-        cc = jnp.einsum("pbt,tc->pbc", Y2 * wt, Xt)
+        Xw = wt[..., None] * Xtw[:, None]                      # [P,2,W,nt]
+        G = jnp.einsum("pbwc,pwd->pbcd", Xw, Xtw)              # [P,2,nt,nt]
+        cc = jnp.einsum("pbw,pwc->pbc", Y2 * wt, Xtw)
         L = jnp.linalg.cholesky(G + eye)
         z = jax.scipy.linalg.solve_triangular(L, cc[..., None], lower=True)
         return jax.scipy.linalg.solve_triangular(
@@ -201,14 +206,14 @@ def _tmask_bad(Xt, Y2, w, vario2):
     w2 = jnp.broadcast_to(w[:, None, :], Y2.shape).astype(Y2.dtype)
     beta = solve(w2)
     for _ in range(params.TMASK_IRLS_ITERS):
-        r = Y2 - jnp.einsum("pbc,tc->pbt", beta, Xt)
+        r = Y2 - jnp.einsum("pbc,pwc->pbw", beta, Xtw)
         med = _masked_median(r, w2 > 0)
         mad = _masked_median(jnp.abs(r - med[..., None]), w2 > 0)
         sigma = jnp.maximum(mad / 0.6745, 1e-6)
         a = jnp.abs(r) / (k * sigma[..., None])
         huber = jnp.where(a <= 1.0, 1.0, 1.0 / jnp.maximum(a, 1e-12))
         beta = solve(w2 * huber)
-    r = jnp.abs(Y2 - jnp.einsum("pbc,tc->pbt", beta, Xt))
+    r = jnp.abs(Y2 - jnp.einsum("pbc,pwc->pbw", beta, Xtw))
     bad = (r > params.TMASK_CONST * vario2[..., None]) & (w2 > 0)
     return jnp.any(bad, axis=1)
 
@@ -262,15 +267,20 @@ def _first_at_or_after(mask, i):
     return jnp.any(m, -1), jnp.argmax(m, -1)
 
 
-def _detect_core(X, Xt, t, valid, Y, qa):
+def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None):
     """One chip: X [T,8], Xt [T,5], t [T] f32 ordinal days, valid [T] bool,
     Y [7,P,T] f32 (the packed layout), qa [P,T] int32.  Returns
-    ChipSegments (device)."""
+    ChipSegments (device).
+
+    ``wcap`` (static) bounds the member count of any initialization window;
+    window_cap() derives a rigorous bound from the chip's date grid.  None
+    falls back to the always-correct T."""
     Y = Y.transpose(1, 0, 2)                                   # -> [P,7,T]
     P, _, T = Y.shape
     S = MAX_SEGMENTS
     ar = jnp.arange(T)[None, :]
     fdtype = Y.dtype
+    W = T if wcap is None else min(wcap, T)
     # Per-row design outer products, shared by every Lasso Gram build.
     XX = (X[:, :, None] * X[:, None, :]).reshape(T, -1)        # [T,64]
 
@@ -380,6 +390,13 @@ def _detect_core(X, Xt, t, valid, Y, qa):
         has_i, i = _first_at_or_after(alive, st["cur_i"])
         t_i = jnp.take(t, i)
         Acum = jnp.cumsum(alive, -1)
+        rank = Acum - 1                                        # [P,T]
+        # pos_of_rank[p, q] = absolute index of pixel p's q-th alive obs
+        # (T where no such rank) — one scatter per round; lets the window
+        # and the break-run gather by rank instead of sorting.
+        pos_of_rank = jnp.full((P, T + 1), T, ar.dtype).at[
+            jnp.arange(P)[:, None], jnp.where(alive, rank, T)
+        ].set(jnp.broadcast_to(ar, (P, T)), mode="drop")[:, :T]
         A_before = jnp.take_along_axis(Acum, i[:, None], -1)[:, 0] \
             - jnp.take_along_axis(alive, i[:, None], -1)[:, 0]
         cnt = Acum - A_before[:, None]
@@ -390,10 +407,23 @@ def _detect_core(X, Xt, t, valid, Y, qa):
         w_init = alive & (ar >= i[:, None]) & (ar <= j[:, None]) \
             & (has_w & in_init)[:, None]
 
-        # Tmask screen
-        bad = _tmask_bad(Xt, Y[:, _TMB, :], w_init.astype(fdtype),
-                         vario[:, _TMB])
-        tm_removed = jnp.any(bad, -1)
+        # Tmask screen over the compacted window: the window members are
+        # exactly the alive obs with ranks [rank(i), rank(i)+n_win), so a
+        # rank-indexed gather bounds all IRLS median/Gram work by W << T.
+        n_win = jnp.sum(w_init, -1)                            # [P] <= W
+        r_i = A_before                                         # rank of i
+        cols = jnp.minimum(r_i[:, None] + jnp.arange(W)[None, :], T - 1)
+        win_idx = jnp.take_along_axis(pos_of_rank, cols, -1)   # [P,W]
+        valid_w = (jnp.arange(W)[None, :] < n_win[:, None])
+        safe_win = jnp.minimum(win_idx, T - 1)
+        Y2w = jnp.take_along_axis(Y[:, _TMB, :], safe_win[:, None, :], axis=2)
+        Xt_w = jnp.take(Xt, safe_win, axis=0)                  # [P,W,5]
+        bad_w = _tmask_bad(Xt_w, Y2w, valid_w.astype(fdtype),
+                           vario[:, _TMB])
+        bad = jnp.zeros((P, T), bool).at[
+            jnp.arange(P)[:, None], jnp.where(valid_w, win_idx, T)
+        ].set(bad_w, mode="drop")
+        tm_removed = jnp.any(bad_w, -1)
 
         # Stability fit: 4 coefs over the (pre-screen-clean) window.
         w_stab = w_init & ~tm_removed[:, None]
@@ -427,7 +457,6 @@ def _detect_core(X, Xt, t, valid, Y, qa):
         s = jnp.sum(((Y[:, _DET, :] - pred_d) / dden[:, :, None]) ** 2, axis=1)
 
         m = jnp.sum(alive, -1)                                    # [P]
-        rank = Acum - 1                                           # [P,T]
         kq = jnp.sum(alive & (ar < st["cur_k"][:, None]), -1)     # cursor rank
 
         INF = T + 1
@@ -486,14 +515,12 @@ def _detect_core(X, Xt, t, valid, Y, qa):
         pos_ev = jnp.where(is_brk, b_abs, f_abs)
         # Magnitudes: median full-band residual over the PEEK run at the
         # break.  The run has at most PEEK_SIZE members — gather their
-        # absolute positions and take a tiny median instead of masked
-        # medians over the whole [P,T] axis.
-        relr = rank - ev_rank[:, None]
-        hit = (alive & (relr >= 0)
-               & (relr < params.PEEK_SIZE))[:, None, :] \
-            & (relr[:, None, :] == jnp.arange(params.PEEK_SIZE)[None, :, None])
-        run_idx = jnp.argmax(hit, -1)                             # [P,PEEK]
-        run_ok = jnp.any(hit, -1)                                 # [P,PEEK]
+        # absolute positions by rank and take a tiny median instead of
+        # masked medians over the whole [P,T] axis.
+        rel = ev_rank[:, None] + jnp.arange(params.PEEK_SIZE)[None, :]
+        run_ok = rel < m[:, None]                                 # [P,PEEK]
+        run_idx = jnp.minimum(jnp.take_along_axis(
+            pos_of_rank, jnp.minimum(rel, T - 1), -1), T - 1)
         X_run = jnp.take(X, run_idx, axis=0)                      # [P,PEEK,8]
         pred_run = jnp.einsum("pbc,pkc->pbk", st["coefs"], X_run)
         Y_run = jnp.take_along_axis(Y, run_idx[:, None, :], axis=2)
@@ -582,13 +609,35 @@ def _detect_core(X, Xt, t, valid, Y, qa):
 # Host-facing API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("dtype",))
-def _detect_batch_wire(Xs, Xts, t, valid, Y_i16, qa_u16, *, dtype):
+@functools.partial(jax.jit, static_argnames=("dtype", "wcap"))
+def _detect_batch_wire(Xs, Xts, t, valid, Y_i16, qa_u16, *, dtype,
+                       wcap=None):
     """Batch detect from wire dtypes: spectra/QA arrive as int16/uint16 and
     widen on device — halves host->device transfer vs shipping float32."""
-    return jax.vmap(_detect_core)(Xs, Xts, t, valid,
-                                  Y_i16.astype(dtype),
-                                  qa_u16.astype(jnp.int32))
+    f = functools.partial(_detect_core, wcap=wcap)
+    return jax.vmap(f)(Xs, Xts, t, valid,
+                       Y_i16.astype(dtype), qa_u16.astype(jnp.int32))
+
+
+def window_cap(packed) -> int:
+    """A rigorous static bound on initialization-window member count.
+
+    A window [i, j] either closes on the observation count (exactly
+    MEOW_SIZE members) or on the INIT_DAYS span — in which case all members
+    but j lie within INIT_DAYS of t_i, so the count is bounded by the
+    densest INIT_DAYS stretch of the (chip-shared) date grid plus one.
+    Using all acquisitions (a superset of any alive set) keeps the bound
+    valid for every round of the event loop.  Rounded up to a multiple of
+    8 so minor date-grid differences reuse the compiled kernel.
+    """
+    cap = params.MEOW_SIZE
+    for c in range(packed.n_chips):
+        d = np.asarray(packed.dates[c][: int(packed.n_obs[c])], np.int64)
+        if d.size:
+            hi = np.searchsorted(d, d + params.INIT_DAYS, side="right")
+            cap = max(cap, int((hi - np.arange(d.size)).max()) + 1)
+    T = packed.spectra.shape[-1]
+    return min(-8 * (-cap // 8), T)
 
 
 def build_designs(dates: np.ndarray, n_obs: int | None = None,
@@ -628,7 +677,7 @@ def detect_packed(packed, dtype=jnp.float32) -> ChipSegments:
         jnp.asarray(Xs, dtype), jnp.asarray(Xts, dtype),
         jnp.asarray(packed.dates, dtype=dtype), jnp.asarray(valid),
         jnp.asarray(packed.spectra), jnp.asarray(packed.qas),
-        dtype=jnp.dtype(dtype))
+        dtype=jnp.dtype(dtype), wcap=window_cap(packed))
 
 
 def segments_to_records(seg: ChipSegments, dates: np.ndarray,

@@ -91,8 +91,16 @@ def detect_sharded(packed, mesh: Mesh, dtype=None):
     kernel.detect_packed, chip axis split across devices, zero collectives.
     """
     import jax.numpy as jnp
-    from firebird_tpu.ccd.kernel import _detect_batch_wire
+    from firebird_tpu.ccd.kernel import _detect_batch_wire, window_cap
 
     dtype = dtype or jnp.float32
+    # wcap is a static trace constant, so every process of an SPMD run must
+    # agree on it even though each only sees its local chip slice:
+    # max-reduce the per-host bound before tracing.
+    wcap = window_cap(packed)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        wcap = int(np.max(np.asarray(
+            multihost_utils.process_allgather(np.array([wcap])))))
     args = shard_packed(packed, mesh, dtype)
-    return _detect_batch_wire(*args, dtype=jnp.dtype(dtype))
+    return _detect_batch_wire(*args, dtype=jnp.dtype(dtype), wcap=wcap)
