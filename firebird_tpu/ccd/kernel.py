@@ -101,15 +101,8 @@ def _masked_median(x, m):
     return jnp.where(n > 0, med, 0.0)
 
 
-def _take_pix(a, idx):
-    """Gather a[..., idx] with per-pixel idx: a [P, B, T], idx [P] -> [P, B]."""
-    P, B, _ = a.shape
-    ii = jnp.broadcast_to(idx[:, None, None], (P, B, 1))
-    return jnp.take_along_axis(a, ii, axis=2)[..., 0]
-
-
-def _fit_lasso(X, Y, w, coefmask, XX=None):
-    """Batched Lasso via cyclic coordinate descent on Gram matrices.
+def _fit_lasso_coefs(X, Y, w, coefmask, XX=None):
+    """Batched Lasso coefficients via cyclic coordinate descent on Grams.
 
     Mirrors harmonic.lasso_cd_gram exactly (same update, same iteration
     count, intercept unpenalized); column restriction (4/6/8 coefs) is the
@@ -127,7 +120,7 @@ def _fit_lasso(X, Y, w, coefmask, XX=None):
             MXU matmul instead of a [P,T,8] broadcast temporary.
 
     Returns:
-        (coefs [P,7,8], rmse [P,7], resid [P,7,T] — residuals at ALL obs).
+        coefs [P,7,8].
     """
     K = params.MAX_COEFS
     n = jnp.maximum(jnp.sum(w, -1), 1.0)                       # [P]
@@ -152,12 +145,22 @@ def _fit_lasso(X, Y, w, coefmask, XX=None):
         return b
 
     b0 = jnp.zeros_like(c)
-    b = lax.fori_loop(0, params.LASSO_ITERS, one_iter, b0)
+    return lax.fori_loop(0, params.LASSO_ITERS, one_iter, b0)
+
+
+def _fit_lasso(X, Y, w, coefmask, XX=None):
+    """_fit_lasso_coefs plus the weighted-window RMSE.
+
+    Returns:
+        (coefs [P,7,8], rmse [P,7]).
+    """
+    b = _fit_lasso_coefs(X, Y, w, coefmask, XX=XX)
+    n = jnp.maximum(jnp.sum(w, -1), 1.0)
     pred = jnp.einsum("pbc,tc->pbt", b, X)
     r = Y - pred
     rmse = jnp.sqrt(jnp.maximum(
         jnp.sum(r * r * w[:, None, :], -1) / n[:, None], 0.0))
-    return b, rmse, r
+    return b, rmse
 
 
 def _coefmask_for(n, P):
@@ -338,8 +341,8 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None):
     alt_n = jnp.sum(alt_usable, -1)
     alt_fit = is_alt & (alt_n >= params.MEOW_SIZE)
     w_alt = (alt_usable & alt_fit[:, None]).astype(fdtype)
-    alt_coefs, alt_rmse, _ = _fit_lasso(X, Y, w_alt, _coefmask_for(alt_n, P),
-                                        XX=XX)
+    alt_coefs, alt_rmse = _fit_lasso(X, Y, w_alt, _coefmask_for(alt_n, P),
+                                     XX=XX)
     first_i = jnp.argmax(alt_usable, -1)
     last_i = T - 1 - jnp.argmax(alt_usable[:, ::-1], -1)
     alt_meta = jnp.stack([
@@ -425,13 +428,24 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None):
         ].set(bad_w, mode="drop")
         tm_removed = jnp.any(bad_w, -1)
 
-        # Stability fit: 4 coefs over the (pre-screen-clean) window.
+        # Stability fit: 4 coefs over the (pre-screen-clean) window.  RMSE
+        # and the endpoint residuals only involve window members (member 0
+        # is i, member n_win-1 is j), so residuals are evaluated on the
+        # compacted window instead of the full series.
         w_stab = w_init & ~tm_removed[:, None]
         cm4 = jnp.arange(params.MAX_COEFS)[None, :] < 4
         cm4 = jnp.broadcast_to(cm4, (P, params.MAX_COEFS))
-        c4, r4, resid4 = _fit_lasso(X, Y, w_stab.astype(fdtype), cm4, XX=XX)
-        r_first = _take_pix(resid4, i)                # [P,7]
-        r_last = _take_pix(resid4, j)
+        c4 = _fit_lasso_coefs(X, Y, w_stab.astype(fdtype), cm4, XX=XX)
+        Yw7 = jnp.take_along_axis(Y, safe_win[:, None, :], axis=2)  # [P,7,W]
+        Xw8 = jnp.take(X, safe_win, axis=0)                         # [P,W,8]
+        r_w = Yw7 - jnp.einsum("pbc,pwc->pbw", c4, Xw8)
+        stab_w = valid_w & ~bad_w
+        n4 = jnp.maximum(jnp.sum(stab_w, -1), 1.0)
+        r4 = jnp.sqrt(jnp.maximum(
+            jnp.sum(r_w * r_w * stab_w[:, None, :], -1) / n4[:, None], 0.0))
+        r_first = r_w[:, :, 0]                        # [P,7]
+        r_last = jnp.take_along_axis(
+            r_w, jnp.maximum(n_win - 1, 0)[:, None, None], axis=2)[..., 0]
         span = jnp.take(t, j) - t_i
         denom = params.STABILITY_FACTOR * jnp.maximum(r4, vario)  # [P,7]
         slope_day = c4[..., 1] / 365.25
@@ -554,8 +568,8 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None):
         w_full = jnp.where(init_ok[:, None], w_stab,
                            included_mon & is_refit[:, None])
         n_full = jnp.where(init_ok, n_ok, n_rf)
-        cfull, rfull, _ = _fit_lasso(X, Y, w_full.astype(fdtype),
-                                     _coefmask_for(n_full, P), XX=XX)
+        cfull, rfull = _fit_lasso(X, Y, w_full.astype(fdtype),
+                                  _coefmask_for(n_full, P), XX=XX)
         do_fit = init_ok | is_refit
 
         # ================= next state =================

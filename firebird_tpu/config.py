@@ -67,6 +67,10 @@ class Config:
     # JAX compute dtype for the CCD kernel ('float32' or 'float64').
     dtype: str = "float32"
 
+    # Device sharding of chip batches: 'auto' shards over all local devices
+    # when more than one is visible; 'off' forces single-device dispatch.
+    device_sharding: str = "auto"
+
     # Framework version (reference: version.txt read in keyspace()).
     version: str = _VERSION
 
@@ -79,6 +83,10 @@ class Config:
                 f"FIREBIRD_DTYPE must be float32 or float64, got "
                 f"{self.dtype!r} (bfloat16 is rejected: ordinal days have a "
                 "bf16 ulp of 4096 days)")
+        if self.device_sharding not in ("auto", "off"):
+            raise ValueError(
+                "FIREBIRD_DEVICE_SHARDING must be 'auto' or 'off', got "
+                f"{self.device_sharding!r}")
 
     @classmethod
     def from_env(cls, env: dict | None = None, **overrides) -> "Config":
@@ -100,6 +108,8 @@ class Config:
             chips_per_batch=int(e.get("FIREBIRD_CHIPS_PER_BATCH", cls.chips_per_batch)),
             max_obs=int(e.get("FIREBIRD_MAX_OBS", cls.max_obs)),
             dtype=e.get("FIREBIRD_DTYPE", cls.dtype),
+            device_sharding=e.get("FIREBIRD_DEVICE_SHARDING",
+                                  cls.device_sharding),
         )
         kw.update(overrides)
         return cls(**kw)

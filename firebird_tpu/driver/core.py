@@ -56,12 +56,67 @@ def make_aux_source(cfg: Config, kind: str | None = None):
     return make_source(cfg, kind)
 
 
+def _pad_batch(packed, target: int):
+    """Pad a PackedChips batch to `target` chips (repeating the last chip);
+    returns (padded, real_count)."""
+    from firebird_tpu.ingest.packer import PackedChips
+
+    C = packed.n_chips
+    if C >= target:
+        return packed, C
+    pad = target - C
+    rep = lambda a: np.concatenate([a, np.repeat(a[-1:], pad, axis=0)])
+    return PackedChips(cids=rep(packed.cids), dates=rep(packed.dates),
+                       spectra=rep(packed.spectra), qas=rep(packed.qas),
+                       n_obs=rep(packed.n_obs)), C
+
+
+def detect_batch(packed, dtype, sharding: str = "auto",
+                 pad_to: int | None = None):
+    """Run the CCD kernel over a packed batch on every local device.
+
+    Single device (or sharding='off'): plain jit dispatch.  Multiple local
+    devices in a single process (the normal TPU-VM topology): the chip axis
+    is sharded over a data mesh of the local devices.  Multi-process runs
+    keep the single-device path — a globally sharded batch is a library
+    decision (parallel.mesh.detect_sharded), not something to spring on the
+    driver's per-host loop.
+
+    Batches are padded (repeating the last chip) up to `pad_to` — and to a
+    multiple of the device count when sharded — so a chunk's ragged final
+    batch reuses the same compiled kernel shape as its full batches; padded
+    results are dropped by the caller via the returned real count.
+    """
+    import jax
+
+    from firebird_tpu.ccd import kernel as k
+
+    n_dev = jax.local_device_count()
+    use_mesh = sharding != "off" and n_dev > 1 and jax.process_count() == 1
+    C = packed.n_chips
+    target = max(pad_to or 0, C)
+    if use_mesh:
+        target = -n_dev * (-target // n_dev)
+    padded, real = _pad_batch(packed, target)
+    if not use_mesh:
+        return k.detect_packed(padded, dtype=dtype), real
+    from firebird_tpu.parallel import make_mesh
+    from firebird_tpu.parallel.mesh import detect_sharded
+
+    mesh = make_mesh(devices=jax.local_devices())
+    return detect_sharded(padded, mesh, dtype=dtype), real
+
+
 def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
     """Run change detection for one chunk of chip ids (ref core.detect,
     core.py:53-75): ingest -> pack -> kernel -> chip/pixel/segment writes."""
     log.info("finding ccd segments for %d chips", len(cids))
     dtype = _DTYPES[cfg.dtype]
     batches = list(partition_all(cfg.chips_per_batch, cids))
+    # Pad a ragged final batch onto the full-batch compiled shape only when
+    # a full batch exists to share it with; a single small batch would pay
+    # the padding compute for no compile reuse.
+    pad_to = cfg.chips_per_batch if len(batches) > 1 else None
 
     # Double-buffered ingest: batch i+1 fetches over HTTP while batch i is
     # on the device.  Two executors — the single prefetch slot must not
@@ -81,12 +136,13 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
             nxt = (prefetch_ex.submit(fetch_batch, batches[i + 1])
                    if i + 1 < len(batches) else None)
             packed = pack(chips, bucket=cfg.obs_bucket, max_obs=cfg.max_obs)
-            seg = kernel.detect_packed(packed, dtype=dtype)
+            seg, n_real = detect_batch(packed, dtype, cfg.device_sharding,
+                                       pad_to=pad_to)
             seg_host = kernel.ChipSegments(
                 *[np.asarray(getattr(seg, f)) for f in
                   ("n_segments", "seg_meta", "seg_rmse", "seg_mag",
                    "seg_coef", "mask", "procedure")])
-            for c in range(packed.n_chips):
+            for c in range(n_real):
                 one = kernel.ChipSegments(
                     *[getattr(seg_host, f)[c] for f in
                       ("n_segments", "seg_meta", "seg_rmse", "seg_mag",

@@ -13,9 +13,12 @@ from firebird_tpu.store import MemoryStore
 
 ACQ = "1995-01-01/1997-06-01"  # short archive so CPU compile stays fast
 # chips_per_batch=1 keeps every kernel dispatch on the same [1,7,P,T]
-# compiled shape, so all tests in this module share one jit cache entry.
+# compiled shape, so all tests in this module share one jit cache entry;
+# device_sharding='off' keeps full-chip dispatches from padding 1 -> 8
+# virtual devices (the sharded driver path is covered on sliced batches by
+# test_detect_batch_shards_and_pads).
 CFG = Config(store_backend="memory", source_backend="synthetic",
-             chips_per_batch=1, dtype="float64")
+             chips_per_batch=1, dtype="float64", device_sharding="off")
 
 
 @pytest.fixture(scope="module")
@@ -81,11 +84,49 @@ def test_chunk_failure_isolation():
     assert store.count("chip") == 1
 
 
+def test_detect_batch_shards_and_pads():
+    """detect_batch pads a 3-chip batch over the 8 virtual devices and
+    matches the single-device result (pixel-sliced to stay quick)."""
+    import jax
+
+    from firebird_tpu.ccd import kernel
+    from firebird_tpu.ingest import SyntheticSource, pack
+    from firebird_tpu.ingest.packer import PackedChips
+
+    assert jax.local_device_count() == 8
+    src = SyntheticSource(seed=3, start="1995-01-01", end="1997-01-01")
+    p = pack([src.chip(100 + 3000 * i, 200) for i in range(3)], bucket=32)
+    small = PackedChips(cids=p.cids, dates=p.dates,
+                        spectra=p.spectra[:, :, :64, :],
+                        qas=p.qas[:, :64, :], n_obs=p.n_obs)
+    import jax.numpy as jnp
+    seg, n_real = core.detect_batch(small, jnp.float64, "auto")
+    assert n_real == 3
+    assert seg.n_segments.shape[0] == 8      # padded over the mesh
+    ref = kernel.detect_packed(small, dtype=jnp.float64)
+    for f in ("n_segments", "seg_meta", "mask", "procedure"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(seg, f))[:3], np.asarray(getattr(ref, f)))
+
+
+def test_pad_batch_noop_and_repeat():
+    from firebird_tpu.ingest import SyntheticSource, pack
+
+    src = SyntheticSource(seed=3, start="1995-01-01", end="1996-01-01")
+    p = pack([src.chip(100, 200)], bucket=32)
+    same, n = core._pad_batch(p, 1)
+    assert same is p and n == 1
+    padded, n = core._pad_batch(p, 4)
+    assert n == 1 and padded.n_chips == 4
+    np.testing.assert_array_equal(padded.spectra[3], p.spectra[0])
+
+
 def test_cli_changedetection(monkeypatch, tmp_path):
     monkeypatch.setenv("FIREBIRD_SOURCE", "synthetic")
     monkeypatch.setenv("FIREBIRD_STORE_BACKEND", "sqlite")
     monkeypatch.setenv("FIREBIRD_STORE_PATH", str(tmp_path / "fb.db"))
     monkeypatch.setenv("FIREBIRD_DTYPE", "float64")
+    monkeypatch.setenv("FIREBIRD_DEVICE_SHARDING", "off")
     res = CliRunner().invoke(
         cli.entrypoint,
         ["changedetection", "-x", "100", "-y", "200", "-n", "1",
