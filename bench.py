@@ -8,38 +8,48 @@ is the steady-state kernel rate on a batch of full 100x100 chips with a
 realistic ~20-year archive.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Structure: the measurement runs in a child process under a timeout, because
+the TPU tunnel can hang indefinitely when unhealthy; if the accelerator
+attempt dies or stalls, a reduced CPU-platform run still produces a valid
+(honestly labeled) benchmark line rather than nothing.
 """
 
 import json
+import subprocess
 import sys
 import time
 
-import numpy as np
 
+def measure(cpu_only: bool) -> None:
+    if cpu_only:
+        import jax
 
-def main() -> None:
+        jax.config.update("jax_platforms", "cpu")
+    import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from firebird_tpu.ccd import detect as cpu_detect
     from firebird_tpu.ccd import kernel
     from firebird_tpu.ingest import SyntheticSource, pack, pixel_timeseries
 
-    # ---- workload: 4 chips, ~20-year archive (T ~ 460 obs) ----
+    # ---- workload: full chips, ~20-year archive (T ~ 460 obs) ----
+    n_chips, runs = (1, 1) if cpu_only else (4, 3)
     src = SyntheticSource(seed=7, start="1985-01-01", end="2005-01-01",
                           cloud_frac=0.15)
-    chips = [src.chip(100 + 3000 * i, 200) for i in range(4)]
+    chips = [src.chip(100 + 3000 * i, 200) for i in range(n_chips)]
     packed = pack(chips, bucket=64)
     n_pixels = packed.n_chips * 10000
 
-    # ---- TPU kernel rate (compile excluded: one warmup, then timed) ----
+    # ---- device kernel rate (compile excluded: one warmup, then timed) ----
     seg = kernel.detect_packed(packed, dtype=jnp.float32)
     seg.n_segments.block_until_ready()
     t0 = time.time()
-    runs = 3
     for _ in range(runs):
         seg = kernel.detect_packed(packed, dtype=jnp.float32)
         seg.n_segments.block_until_ready()
-    tpu_rate = n_pixels * runs / (time.time() - t0)
+    dev_rate = n_pixels * runs / (time.time() - t0)
 
     # ---- CPU per-pixel rate (the pyccd stand-in), extrapolated ----
     sample = 12
@@ -53,18 +63,41 @@ def main() -> None:
     baseline_2000_cores = cpu_rate * 2000.0
     out = {
         "metric": "ccdc_pixels_per_sec",
-        "value": round(tpu_rate, 1),
+        "value": round(dev_rate, 1),
         "unit": "pixels/sec",
-        "vs_baseline": round(tpu_rate / baseline_2000_cores, 3),
+        "vs_baseline": round(dev_rate / baseline_2000_cores, 3),
         "detail": {
+            "platform": jax.devices()[0].platform,
             "chips": packed.n_chips,
             "obs_per_pixel": int(packed.n_obs[0]),
+            "kernel_rounds": int(np.asarray(seg.rounds)[0]),
             "cpu_ref_pixels_per_sec_per_core": round(cpu_rate, 2),
             "baseline_2000_core_pixels_per_sec": round(baseline_2000_cores, 1),
             "mean_segments": float(np.asarray(seg.n_segments).mean()),
         },
     }
     print(json.dumps(out))
+
+
+def main() -> int:
+    if "--child" in sys.argv:
+        measure(cpu_only="--cpu" in sys.argv)
+        return 0
+    for args, timeout in (([], 900), (["--cpu"], 1800)):
+        try:
+            r = subprocess.run([sys.executable, __file__, "--child"] + args,
+                               capture_output=True, text=True,
+                               timeout=timeout)
+        except subprocess.TimeoutExpired:
+            continue
+        lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+        if r.returncode == 0 and lines:
+            print(lines[-1])
+            return 0
+    print(json.dumps({"metric": "ccdc_pixels_per_sec", "value": 0.0,
+                      "unit": "pixels/sec", "vs_baseline": 0.0,
+                      "detail": {"error": "all benchmark attempts failed"}}))
+    return 1
 
 
 if __name__ == "__main__":

@@ -13,6 +13,13 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent compile cache: the suite's dominant cost is XLA compiles of the
+# CCD kernel; caching them on disk makes reruns several times faster.
+_cache = os.path.join(os.path.dirname(__file__), os.pardir, ".cache", "jax")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.abspath(_cache))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 # The axon sitecustomize registers the TPU platform and pins
 # JAX_PLATFORMS=axon before any env var we set can win; override through
 # jax.config instead (must happen before first jax use).
