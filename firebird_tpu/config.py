@@ -71,6 +71,14 @@ class Config:
     # when more than one is visible; 'off' forces single-device dispatch.
     device_sharding: str = "auto"
 
+    # Retries per chip fetch before the chunk is failed (reference semantics:
+    # Spark task retry absorbed transient ingest errors).
+    fetch_retries: int = 3
+
+    # When set, the run executes under jax.profiler.trace writing to this
+    # directory (the tracing subsystem the reference lacked, SURVEY.md §5).
+    profile_dir: str = ""
+
     # Framework version (reference: version.txt read in keyspace()).
     version: str = _VERSION
 
@@ -87,6 +95,9 @@ class Config:
             raise ValueError(
                 "FIREBIRD_DEVICE_SHARDING must be 'auto' or 'off', got "
                 f"{self.device_sharding!r}")
+        if self.fetch_retries < 0:
+            raise ValueError("FIREBIRD_FETCH_RETRIES must be >= 0, got "
+                             f"{self.fetch_retries}")
 
     @classmethod
     def from_env(cls, env: dict | None = None, **overrides) -> "Config":
@@ -110,6 +121,9 @@ class Config:
             dtype=e.get("FIREBIRD_DTYPE", cls.dtype),
             device_sharding=e.get("FIREBIRD_DEVICE_SHARDING",
                                   cls.device_sharding),
+            fetch_retries=int(e.get("FIREBIRD_FETCH_RETRIES",
+                                    cls.fetch_retries)),
+            profile_dir=e.get("FIREBIRD_PROFILE_DIR", cls.profile_dir),
         )
         kw.update(overrides)
         return cls(**kw)

@@ -16,6 +16,8 @@ gap (SURVEY.md §5 durability model).
 from __future__ import annotations
 
 import concurrent.futures as cf
+import contextlib
+import time
 import traceback
 
 import jax.numpy as jnp
@@ -126,9 +128,24 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
             max_workers=max(cfg.input_parallelism, 1)) as chips_ex, \
             cf.ThreadPoolExecutor(max_workers=1) as prefetch_ex:
 
+        def fetch_one(xy):
+            # Per-fetch retry with backoff: the reference delegated transient
+            # ingest failures to Spark's task retry; here a blip on one chip
+            # must not fail the whole chunk.
+            for attempt in range(cfg.fetch_retries + 1):
+                try:
+                    return source.chip(xy[0], xy[1], acquired)
+                except Exception as e:
+                    if attempt == cfg.fetch_retries:
+                        raise
+                    delay = min(2.0 ** attempt, 30.0)
+                    log.warning("chip (%s,%s) fetch failed (attempt %d: "
+                                "%s: %s), retrying in %.0fs", xy[0], xy[1],
+                                attempt + 1, type(e).__name__, e, delay)
+                    time.sleep(delay)
+
         def fetch_batch(bids):
-            return list(chips_ex.map(
-                lambda xy: source.chip(xy[0], xy[1], acquired), bids))
+            return list(chips_ex.map(fetch_one, bids))
 
         nxt = prefetch_ex.submit(fetch_batch, batches[0]) if batches else None
         for i in range(len(batches)):
@@ -184,20 +201,32 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
     log.info("tile h=%s v=%s: %d chips in %d chunks (acquired %s)",
              tile["h"], tile["v"], len(cids), len(chunks), acquired)
 
+    # Opt-in tracing (cfg.profile_dir): the whole run captures a JAX
+    # profiler trace viewable in TensorBoard/Perfetto — the tracing
+    # subsystem the reference lacked (SURVEY.md §5).
+    if cfg.profile_dir:
+        import jax
+
+        prof = jax.profiler.trace(cfg.profile_dir)
+    else:
+        prof = contextlib.nullcontext()
+
     done: list = []
     try:
-        for chunk in chunks:
-            try:
-                processed = detect_chunk(
-                    chunk, source=source, writer=writer, acquired=acquired,
-                    cfg=cfg, counters=counters, log=log)
-                writer.flush()      # a chunk only counts once its rows landed
-                done.extend(processed)
-            except Exception as e:
-                # Chunk-level failure isolation (core.py:115-124): log and
-                # move on; idempotent writes make the rerun cheap.
-                log.error("chunk failed (%d chips): %s", len(chunk), e)
-                traceback.print_exc()
+        with prof:
+            for chunk in chunks:
+                try:
+                    processed = detect_chunk(
+                        chunk, source=source, writer=writer,
+                        acquired=acquired, cfg=cfg, counters=counters,
+                        log=log)
+                    writer.flush()  # a chunk counts once its rows landed
+                    done.extend(processed)
+                except Exception as e:
+                    # Chunk-level failure isolation (core.py:115-124): log
+                    # and move on; idempotent writes make the rerun cheap.
+                    log.error("chunk failed (%d chips): %s", len(chunk), e)
+                    traceback.print_exc()
     finally:
         writer.close()
         snap = counters.snapshot()

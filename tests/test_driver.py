@@ -18,7 +18,8 @@ ACQ = "1995-01-01/1997-06-01"  # short archive so CPU compile stays fast
 # virtual devices (the sharded driver path is covered on sliced batches by
 # test_detect_batch_shards_and_pads).
 CFG = Config(store_backend="memory", source_backend="synthetic",
-             chips_per_batch=1, dtype="float64", device_sharding="off")
+             chips_per_batch=1, dtype="float64", device_sharding="off",
+             fetch_retries=0)
 
 
 @pytest.fixture(scope="module")
@@ -81,6 +82,31 @@ def test_chunk_failure_isolation():
                                 chunk_size=1, cfg=CFG, source=Flaky(),
                                 store=store)
     assert len(done) == 1           # first chunk failed, second landed
+    assert store.count("chip") == 1
+
+
+def test_transient_fetch_retries(monkeypatch):
+    """A transient per-chip fetch failure is absorbed by the retry loop
+    instead of failing the chunk (Spark-task-retry semantics)."""
+    monkeypatch.setattr(core.time, "sleep", lambda s: None)
+    store = MemoryStore("test")
+    good = SyntheticSource(seed=9, start="1995-01-01", end="1998-01-01")
+    calls = {"n": 0}
+
+    class Transient:
+        def chip(self, cx, cy, acquired=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise IOError("blip")
+            return good.chip(cx, cy, acquired)
+
+    cfg = Config(store_backend="memory", source_backend="synthetic",
+                 chips_per_batch=1, dtype="float64", device_sharding="off",
+                 fetch_retries=2)
+    done = core.changedetection(x=100, y=200, acquired=ACQ, number=1,
+                                chunk_size=1, cfg=cfg, source=Transient(),
+                                store=store)
+    assert len(done) == 1 and calls["n"] == 2
     assert store.count("chip") == 1
 
 
