@@ -46,14 +46,17 @@ def entrypoint():
 @click.option("--acquired", "-a", required=False, default=None)
 @click.option("--number", "-n", required=False, default=2500, type=int)
 @click.option("--chunk_size", "-c", required=False, default=2500, type=int)
-def changedetection(x, y, acquired, number, chunk_size):
+@click.option("--resume", "-r", is_flag=True, default=False,
+              help="skip chips whose segments are already stored (assumes "
+                   "the same acquired range as the stored run)")
+def changedetection(x, y, acquired, number, chunk_size, resume):
     """Run change detection for a tile and save results to the store."""
     from firebird_tpu.driver import core
 
     return core.changedetection(
         x=x, y=y,
         acquired=acquired or dates.default_acquired(),
-        number=number, chunk_size=chunk_size,
+        number=number, chunk_size=chunk_size, resume=resume,
     )
 
 

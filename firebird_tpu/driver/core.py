@@ -175,13 +175,15 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
 
 def changedetection(x, y, acquired: str | None = None, number: int = 2500,
                     chunk_size: int = 2500, cfg: Config | None = None,
-                    source=None, store=None):
+                    source=None, store=None, resume: bool = False):
     """Run change detection for a tile and save results (ref
     core.changedetection, core.py:78-124).
 
     Args mirror the reference CLI: tile point (x, y), ISO8601 acquired
     range, number of chips (testing), chunk size (failure-isolation
-    granularity).
+    granularity).  ``resume=True`` skips chips already present in the
+    store's chip table — the explicit restart the reference only got
+    implicitly from rerunning idempotent upserts over a whole tile.
 
     Returns the tuple of chip ids processed successfully.
     """
@@ -197,6 +199,19 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
 
     tile = grid.tile(x=x, y=y)
     cids = list(take(number, grid.chips(tile)))
+    skipped: tuple = ()
+    if resume:
+        # Key on the segment table: it is written LAST per chip through the
+        # FIFO writer, so its presence implies the chip/pixel rows landed
+        # too.  Resume assumes the same acquired range as the stored run —
+        # the store is namespaced by inputs+version (keyspace()), not by
+        # date range.
+        have = store.chip_ids("segment")
+        todo = [c for c in cids if c not in have]
+        skipped = tuple(c for c in cids if c in have)
+        cids = todo
+        log.info("resume: %d chips already stored (assuming same acquired "
+                 "range), %d to do", len(skipped), len(cids))
     chunks = list(partition_all(chunk_size, cids))
     log.info("tile h=%s v=%s: %d chips in %d chunks (acquired %s)",
              tile["h"], tile["v"], len(cids), len(chunks), acquired)
@@ -232,7 +247,7 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
         snap = counters.snapshot()
         log.info("change-detection complete: %s", snap)
 
-    return tuple(done)
+    return tuple(skipped) + tuple(done)
 
 
 def classification(x, y, msday: int, meday: int, acquired: str | None = None,

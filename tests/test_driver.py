@@ -85,6 +85,19 @@ def test_chunk_failure_isolation():
     assert store.count("chip") == 1
 
 
+def test_resume_skips_stored_chips(run_result):
+    done, store = run_result
+
+    class Explodes:
+        def chip(self, cx, cy, acquired=None):
+            raise AssertionError("resume must not refetch stored chips")
+
+    out = core.changedetection(x=100, y=200, acquired=ACQ, number=2,
+                               chunk_size=2, cfg=CFG, source=Explodes(),
+                               store=store, resume=True)
+    assert set(out) == set(done)    # all skipped, none refetched
+
+
 def test_transient_fetch_retries(monkeypatch):
     """A transient per-chip fetch failure is absorbed by the retry loop
     instead of failing the chunk (Spark-task-retry semantics)."""

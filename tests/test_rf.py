@@ -132,8 +132,10 @@ def test_forest_generalizes():
 # ---------------------------------------------------------------------------
 
 ACQ = "1995-01-01/1997-06-01"
+# device_sharding='off': full-chip dispatches must not pad 1 -> 8 virtual
+# devices (the sharded path is covered by test_driver/test_parallel).
 CFG = Config(store_backend="memory", source_backend="synthetic",
-             chips_per_batch=1, dtype="float64")
+             chips_per_batch=1, dtype="float64", device_sharding="off")
 
 
 @pytest.fixture(scope="module")
