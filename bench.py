@@ -60,6 +60,29 @@ def measure(cpu_only: bool) -> None:
         cpu_detect(**pixel_timeseries(packed, 0, int(p_)))
     cpu_rate = sample / (time.time() - t0)
 
+    # ---- streaming incremental rate (BASELINE.json config #4) ----
+    import dataclasses
+    from firebird_tpu.ccd import incremental
+
+    one = kernel.ChipSegments(*[
+        None if getattr(seg, f.name) is None else getattr(seg, f.name)[0]
+        for f in dataclasses.fields(seg)])
+    st = incremental.StreamState.from_chip(one)
+    anchor = float(packed.dates[0][0])
+    last = int(packed.n_obs[0]) - 1
+    t_new = float(packed.dates[0][last]) + 16.0
+    x_row = jnp.asarray(incremental.design_row(t_new, anchor))
+    y_new = jnp.asarray(packed.spectra[0, :, :, last].T.astype(np.float32))
+    qa_new = jnp.asarray(packed.qas[0, :, last].astype(np.int32))
+    st = incremental.step(st, x_row, y_new, qa_new, t_new)   # compile
+    st.nobs.block_until_ready()
+    sruns = 20
+    t0 = time.time()
+    for _ in range(sruns):
+        st = incremental.step(st, x_row, y_new, qa_new, t_new)
+    st.nobs.block_until_ready()
+    stream_rate = 10000 * sruns / (time.time() - t0)
+
     baseline_2000_cores = cpu_rate * 2000.0
     out = {
         "metric": "ccdc_pixels_per_sec",
@@ -74,6 +97,7 @@ def measure(cpu_only: bool) -> None:
             "cpu_ref_pixels_per_sec_per_core": round(cpu_rate, 2),
             "baseline_2000_core_pixels_per_sec": round(baseline_2000_cores, 1),
             "mean_segments": float(np.asarray(seg.n_segments).mean()),
+            "streaming_pixels_per_sec": round(stream_rate, 1),
         },
     }
     print(json.dumps(out))

@@ -1,0 +1,175 @@
+"""Streaming incremental CCDC: append observations, re-test change only.
+
+The batch kernel (ccd/kernel.py) fits the full archive.  Operationally,
+LCMAP appends a handful of new Landsat acquisitions per pixel per month;
+refitting 35 years for each is wasteful.  This module implements the
+lambda-architecture split the reference never had (its only mode is full
+reruns of `ccd.detect`, ccdc/pyccd.py:171-183):
+
+- **Hot path (here)**: keep each pixel's *open tail segment* — fitted
+  harmonic model, RMSE, variogram, trailing exceed count — as a compact
+  :class:`StreamState`, and for every new observation run exactly the batch
+  kernel's tail rules: QA triage, score against max(rmse, variogram) over
+  the detection bands, absorb / drop-outlier / count-exceeding, confirm a
+  break after PEEK_SIZE consecutive exceeding observations.  One jitted
+  [P]-wide step, microseconds per chip.
+- **Cold path (batch kernel)**: periodic full reruns pick up model refits
+  (which need the historical observations) and re-initialize pixels whose
+  tail broke.  ``needs_batch`` flags exactly those pixels.
+
+A streamed observation is always at the series end, so the tail rules
+apply: an exceeding observation is counted, never absorbed.  The batch
+kernel, seeing later clean data, retroactively *absorbs* an isolated
+exceeding observation under its normal-region rules — a conservative
+divergence (streaming under-counts nobs by the isolated exceeds) that the
+next cold-path rerun repairs.
+
+State is initialized from a batch result via :meth:`StreamState.from_chip`
+(the kernel exports the variogram for this) and round-trips through the
+keyed store as plain arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from firebird_tpu.ccd import harmonic, params
+from firebird_tpu.ccd.kernel import ChipSegments
+
+_DET = list(params.DETECTION_BANDS)
+
+
+@dataclasses.dataclass
+class StreamState:
+    """Per-pixel open-segment state (leading axis [P] or [C, P]).
+
+    A pixel is ``active`` when its last batch segment ran to the series end
+    under the standard procedure (CURVE_QA_END set) — only those have a
+    model whose change probability can be extended incrementally.
+    """
+
+    coefs: jnp.ndarray      # [.., P, 7, 8] internal-convention coefficients
+    rmse: jnp.ndarray       # [.., P, 7]
+    vario: jnp.ndarray      # [.., P, 7]
+    nobs: jnp.ndarray       # [.., P] int32 obs in the open segment
+    n_exceed: jnp.ndarray   # [.., P] int32 trailing consecutive exceeding
+    end_day: jnp.ndarray    # [.., P] float32 ordinal of last absorbed obs
+    exceed_day0: jnp.ndarray  # [.., P] float32 first day of the current
+    #   exceed run (0 when none, or unknown for runs begun before seeding —
+    #   the batch result stores only the count)
+    break_day: jnp.ndarray  # [.., P] float32 ordinal of confirmed break (0 = none)
+    active: jnp.ndarray     # [.., P] bool
+
+    @classmethod
+    def from_chip(cls, seg: ChipSegments) -> "StreamState":
+        """Seed streaming state from one chip's batch result ([P, ...])."""
+        if seg.vario is None:
+            raise ValueError("batch result lacks vario; rerun the kernel")
+        P = seg.n_segments.shape[0]
+        last = jnp.maximum(seg.n_segments - 1, 0)               # [P]
+        meta = jnp.take_along_axis(
+            seg.seg_meta, last[:, None, None].repeat(6, 2), axis=1)[:, 0]
+        curqa = meta[:, 4].astype(jnp.int32)
+        active = ((seg.procedure == 0) & (seg.n_segments >= 1)
+                  & (curqa & params.CURVE_QA_END > 0))
+        gather = lambda a: jnp.take_along_axis(
+            a, last.reshape((P,) + (1,) * (a.ndim - 1)), axis=1)[:, 0]
+        return cls(
+            coefs=gather(seg.seg_coef), rmse=gather(seg.seg_rmse),
+            # copy: step() donates its state, and a donated alias of the
+            # caller's batch result would invalidate seg.vario on devices
+            # that honor donation.
+            vario=jnp.array(seg.vario, copy=True),
+            nobs=meta[:, 5].astype(jnp.int32),
+            # chprob on an END segment is n_exceed / PEEK_SIZE.
+            n_exceed=jnp.round(meta[:, 3] * params.PEEK_SIZE).astype(jnp.int32),
+            end_day=meta[:, 1],
+            exceed_day0=jnp.zeros(P, meta.dtype),
+            break_day=jnp.zeros(P, meta.dtype),
+            active=active)
+
+    @property
+    def needs_batch(self) -> jnp.ndarray:
+        """Pixels whose tail broke — only a full batch rerun re-initializes
+        a fresh segment after the break."""
+        return self.break_day > 0
+
+
+jax.tree_util.register_pytree_node(
+    StreamState,
+    lambda s: ((s.coefs, s.rmse, s.vario, s.nobs, s.n_exceed, s.end_day,
+                s.exceed_day0, s.break_day, s.active), None),
+    lambda _, c: StreamState(*c),
+)
+
+
+def design_row(t_new: float, anchor: float, dtype=np.float32) -> np.ndarray:
+    """Host-side [8] design row for the new acquisition (float64 phases,
+    same convention as the batch designs — kernel.build_designs)."""
+    return harmonic.design_matrix(
+        np.array([t_new]), anchor, params.MAX_COEFS)[0].astype(dtype)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state: StreamState, x_row, y_new, qa_new, t_new) -> StreamState:
+    """Advance every pixel's open segment by one acquisition.
+
+    Args:
+        state: StreamState [P, ...] (donated; the update happens in place).
+        x_row: [8] design row for t_new (design_row()).
+        y_new: [P, 7] new spectral values (same band order as the kernel).
+        qa_new: [P] int32 bit-packed QA.
+        t_new: scalar ordinal day (float).
+
+    Returns the updated StreamState.  Tail rules mirror the batch kernel's
+    monitor fast-forward (kernel.py): clear+in-range obs only; score =
+    sum over detection bands of (residual / max(rmse, vario))^2;
+    score > CHANGE_THRESHOLD extends the exceed run (PEEK_SIZE consecutive
+    confirm a break dated at the run's first exceeding day); anything else
+    absorbs and resets the run.
+    """
+    fd = state.rmse.dtype
+    y = y_new.astype(fd)
+    t = jnp.asarray(t_new, fd)
+    fill = (qa_new >> params.QA_FILL_BIT) & 1 == 1
+    clear = (((qa_new >> params.QA_CLEAR_BIT) & 1 == 1)
+             | ((qa_new >> params.QA_WATER_BIT) & 1 == 1)) & ~fill
+    opt_ok = jnp.all((y[:, :6] > params.OPTICAL_MIN)
+                     & (y[:, :6] < params.OPTICAL_MAX), axis=1)
+    th_ok = (y[:, 6] > params.THERMAL_MIN) & (y[:, 6] < params.THERMAL_MAX)
+    usable = clear & opt_ok & th_ok & state.active & ~state.needs_batch
+
+    pred = jnp.einsum("pbc,c->pb", state.coefs, x_row.astype(fd))
+    resid = y - pred
+    dden = jnp.maximum(state.rmse, state.vario)[:, _DET]
+    s = jnp.sum((resid[:, _DET] / dden) ** 2, axis=1)
+
+    # Batch tail semantics: any score above CHANGE_THRESHOLD (including the
+    # far outlier tail) counts toward the exceed run; everything else is
+    # absorbed and resets the run.
+    exceed = usable & (s > params.CHANGE_THRESHOLD)
+    absorb = usable & ~exceed
+
+    n_exceed = jnp.where(exceed, state.n_exceed + 1,
+                         jnp.where(absorb, 0, state.n_exceed))
+    run_starts = exceed & (state.n_exceed == 0)
+    exceed_day0 = jnp.where(run_starts, t,
+                            jnp.where(absorb, jnp.zeros_like(t),
+                                      state.exceed_day0))
+    broke = usable & (n_exceed >= params.PEEK_SIZE) & ~state.needs_batch
+    # Runs already in progress at seed time have no recorded start day
+    # (exceed_day0 == 0); the confirmation day is the honest fallback.
+    bday = jnp.where(exceed_day0 > 0, exceed_day0, t)
+    return StreamState(
+        coefs=state.coefs, rmse=state.rmse, vario=state.vario,
+        nobs=state.nobs + absorb.astype(jnp.int32),
+        n_exceed=n_exceed,
+        end_day=jnp.where(absorb, t, state.end_day),
+        exceed_day0=exceed_day0,
+        break_day=jnp.where(broke, bday, state.break_day),
+        active=state.active)

@@ -76,12 +76,13 @@ class ChipSegments:
     mask: jnp.ndarray            # [.., P, T] bool — processing mask
     procedure: jnp.ndarray       # [.., P] int32
     rounds: jnp.ndarray | None = None  # [..] int32 event-loop rounds (diag)
+    vario: jnp.ndarray | None = None   # [.., P, 7] variogram (streaming seed)
 
 
 jax.tree_util.register_pytree_node(
     ChipSegments,
     lambda s: ((s.n_segments, s.seg_meta, s.seg_rmse, s.seg_mag, s.seg_coef,
-                s.mask, s.procedure, s.rounds), None),
+                s.mask, s.procedure, s.rounds, s.vario), None),
     lambda _, c: ChipSegments(*c),
 )
 
@@ -616,7 +617,8 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None):
     return ChipSegments(
         n_segments=state["nseg"],
         seg_meta=meta_b, seg_rmse=rmse_b, seg_mag=mag_b, seg_coef=coef_b,
-        mask=final_mask, procedure=procedure, rounds=state["rounds"])
+        mask=final_mask, procedure=procedure, rounds=state["rounds"],
+        vario=vario)
 
 
 # ---------------------------------------------------------------------------
@@ -692,6 +694,24 @@ def detect_packed(packed, dtype=jnp.float32) -> ChipSegments:
         jnp.asarray(packed.dates, dtype=dtype), jnp.asarray(valid),
         jnp.asarray(packed.spectra), jnp.asarray(packed.qas),
         dtype=jnp.dtype(dtype), wcap=window_cap(packed))
+
+
+def chip_slice(seg: ChipSegments, c: int, to_host: bool = False) -> ChipSegments:
+    """One chip's view of a batched ChipSegments ([C, ...] -> [...]).
+
+    Single-sources the field set: every field (including future additions)
+    is sliced, None-valued optionals pass through.  ``to_host`` fetches the
+    slices as numpy arrays.
+    """
+    out = []
+    for f in dataclasses.fields(seg):
+        v = getattr(seg, f.name)
+        if v is not None:
+            v = v[c]
+            if to_host:
+                v = np.asarray(v)
+        out.append(v)
+    return ChipSegments(*out)
 
 
 def segments_to_records(seg: ChipSegments, dates: np.ndarray,

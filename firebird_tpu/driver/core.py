@@ -155,15 +155,8 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
             packed = pack(chips, bucket=cfg.obs_bucket, max_obs=cfg.max_obs)
             seg, n_real = detect_batch(packed, dtype, cfg.device_sharding,
                                        pad_to=pad_to)
-            seg_host = kernel.ChipSegments(
-                *[np.asarray(getattr(seg, f)) for f in
-                  ("n_segments", "seg_meta", "seg_rmse", "seg_mag",
-                   "seg_coef", "mask", "procedure")])
             for c in range(n_real):
-                one = kernel.ChipSegments(
-                    *[getattr(seg_host, f)[c] for f in
-                      ("n_segments", "seg_meta", "seg_rmse", "seg_mag",
-                       "seg_coef", "mask", "procedure")])
+                one = kernel.chip_slice(seg, c, to_host=True)
                 frames = ccdformat.chip_frames(packed, c, one)
                 for table in ("chip", "pixel", "segment"):
                     writer.write(table, frames[table])
@@ -181,8 +174,9 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
 
     Args mirror the reference CLI: tile point (x, y), ISO8601 acquired
     range, number of chips (testing), chunk size (failure-isolation
-    granularity).  ``resume=True`` skips chips already present in the
-    store's chip table — the explicit restart the reference only got
+    granularity).  ``resume=True`` skips chips whose segments are already
+    stored (the segment table is written last per chip, so presence
+    implies completeness) — the explicit restart the reference only got
     implicitly from rerunning idempotent upserts over a whole tile.
 
     Returns the tuple of chip ids processed successfully.
