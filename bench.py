@@ -61,13 +61,9 @@ def measure(cpu_only: bool) -> None:
     cpu_rate = sample / (time.time() - t0)
 
     # ---- streaming incremental rate (BASELINE.json config #4) ----
-    import dataclasses
     from firebird_tpu.ccd import incremental
 
-    one = kernel.ChipSegments(*[
-        None if getattr(seg, f.name) is None else getattr(seg, f.name)[0]
-        for f in dataclasses.fields(seg)])
-    st = incremental.StreamState.from_chip(one)
+    st = incremental.StreamState.from_chip(kernel.chip_slice(seg, 0))
     anchor = float(packed.dates[0][0])
     last = int(packed.n_obs[0]) - 1
     t_new = float(packed.dates[0][last]) + 16.0

@@ -76,5 +76,45 @@ def classification(x, y, msday, meday, acquired):
     )
 
 
+@entrypoint.command()
+def products():
+    """List the products that can be run (ref `ccdc-products`,
+    docs/faq.rst:63-67)."""
+    from firebird_tpu import products as prod
+
+    for name in prod.available():
+        click.echo(name)
+
+
+def _parse_bounds(bounds) -> list[tuple[float, float]]:
+    out = []
+    for b in bounds:
+        x, y = b.split(",")
+        out.append((float(x), float(y)))
+    return out
+
+
+@entrypoint.command()
+@click.option("--bounds", "-b", multiple=True, required=True,
+              help="x,y projection point; repeat to extend the area")
+@click.option("--products", "-p", "product_names", multiple=True,
+              required=True, help="product name; repeat for several")
+@click.option("--product_dates", "-d", multiple=True, required=True,
+              help="ISO query date; repeat for several")
+@click.option("--acquired", "-a", required=False, default=None,
+              help="ISO8601 range; chips lacking stored segments are "
+                   "detected over it first")
+@click.option("--clip", is_flag=True, default=False,
+              help="mask pixels outside the bounds polygon")
+def save(bounds, product_names, product_dates, acquired, clip):
+    """Compute and save product rasters (ref `ccdc-save`,
+    docs/faq.rst:38-109 — the 0.5 capability dropped by 1.0)."""
+    from firebird_tpu import products as prod
+
+    return prod.save(bounds=_parse_bounds(bounds), products=product_names,
+                     product_dates=product_dates, acquired=acquired,
+                     clip=clip)
+
+
 if __name__ == "__main__":
     entrypoint()

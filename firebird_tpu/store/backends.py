@@ -191,7 +191,7 @@ class ParquetStore:
     # Partition prefix per table: one file per chip (cx, cy) for the three
     # result tables; the full (tx, ty, name) key for tile so models with
     # different names never clobber each other.
-    _PART = {"chip": 2, "pixel": 2, "segment": 2, "tile": 3}
+    _PART = {"chip": 2, "pixel": 2, "segment": 2, "tile": 3, "product": 4}
 
     def _file(self, table: str, frame: dict) -> str:
         key = schema.primary_key(table)[: self._PART[table]]
@@ -225,7 +225,17 @@ class ParquetStore:
         out: dict[str, list] = {c: [] for c in cols}
         if not os.path.isdir(d):
             return out
-        for f in sorted(os.listdir(d)):
+        # When the filter pins the whole partition key prefix, only that
+        # partition's file can match — skip the full-table scan (a per-chip
+        # read over a tile would otherwise be O(chips^2) file reads).
+        keyp = schema.primary_key(table)[: self._PART[table]]
+        if where and all(k in where for k in keyp):
+            part = "_".join(str(_normalize(where[k])) for k in keyp)
+            files = [f"{part}.parquet"] if os.path.exists(
+                os.path.join(d, f"{part}.parquet")) else []
+        else:
+            files = sorted(os.listdir(d))
+        for f in files:
             t = pq.read_table(os.path.join(d, f)).to_pydict()
             n = len(next(iter(t.values()), []))
             for i in range(n):

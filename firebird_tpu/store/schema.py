@@ -45,6 +45,14 @@ TABLES: dict[str, dict] = {
                     ("model", "TEXT"), ("updated", "TEXT")],
         "key": ("tx", "ty", "name"),
     },
+    # Derived product rasters (the reference 0.5 `ccdc-save` capability,
+    # docs/faq.rst:38-109; dropped by 1.0 — completed here, SURVEY.md §2.5).
+    # One row per (product, date, chip): row-major [100x100] cell values.
+    "product": {
+        "columns": [("name", "TEXT"), ("date", "TEXT"), ("cx", "INTEGER"),
+                    ("cy", "INTEGER"), ("cells", "JSON")],
+        "key": ("name", "date", "cx", "cy"),
+    },
 }
 
 

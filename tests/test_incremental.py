@@ -18,11 +18,7 @@ def slice_pixels(p: PackedChips, n: int) -> PackedChips:
 
 
 def batch_one(packed) -> kernel.ChipSegments:
-    seg = kernel.detect_packed(packed, dtype=jnp.float64)
-    import dataclasses
-    return kernel.ChipSegments(*[
-        None if getattr(seg, f.name) is None
-        else getattr(seg, f.name)[0] for f in dataclasses.fields(seg)])
+    return kernel.chip_slice(kernel.detect_packed(packed, dtype=jnp.float64), 0)
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,195 @@
+"""Products layer: the completed `ccdc-save` capability (docs/faq.rst:38-109,
+SURVEY.md §2.5).  Product math is tested against hand-built segment frames;
+the run modes against a synthetic end-to-end store."""
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from firebird_tpu import cli, products
+from firebird_tpu.ccd.params import FILL_VALUE
+from firebird_tpu.config import Config
+from firebird_tpu.ingest.packer import CHIP_SIDE, PIXELS
+from firebird_tpu.store import MemoryStore
+from firebird_tpu.utils import dates as dt
+
+# A real CONUS chip UL (grid-aligned): snap(1500, 3000) -> (-585, 5805).
+CX, CY = -585, 5805
+
+
+def frame(rows):
+    """Segment frame from (px, py, sday, eday, bday, chprob, curqa) rows."""
+    cols = ("px", "py", "sday", "eday", "bday", "chprob", "curqa")
+    return {c: [r[i] for r in rows] for i, c in enumerate(cols)}
+
+
+def put_segments(store, rows):
+    f = frame(rows)
+    n = len(f["px"])
+    f["cx"] = [CX] * n
+    f["cy"] = [CY] * n
+    store.write("segment", f)
+
+
+# ---------------------------------------------------------------------------
+# chip_product math
+# ---------------------------------------------------------------------------
+
+def test_seglength_inside_and_after_break():
+    # pixel 0: a segment containing D;  pixel 1: D after a confirmed break;
+    # pixel 2: sentinel row only (no models).
+    p1 = (CX + 30, CY)        # pixel index 1
+    p2 = (CX + 60, CY)        # pixel index 2
+    seg = frame([
+        (CX, CY, "2000-01-01", "2010-01-01", "2010-01-01", 0.4, 8),
+        (p1[0], p1[1], "1995-01-01", "2002-06-01", "2002-06-01", 1.0, 8),
+        (p2[0], p2[1], "0001-01-01", "0001-01-01", "0001-01-01", None, None),
+    ])
+    D = dt.to_ordinal("2005-03-01")
+    out = products.chip_product("seglength", D, CX, CY, seg)
+    assert out[0] == D - dt.to_ordinal("2000-01-01")
+    assert out[1] == D - dt.to_ordinal("2002-06-01")
+    assert out[2] == 0
+    assert np.all(out[3:] == 0)
+
+
+def test_ccd_breaks_in_query_year_only():
+    p1 = (CX + 30, CY)
+    seg = frame([
+        # break on 2014-03-01 (doy 60), confirmed
+        (CX, CY, "2000-01-01", "2014-02-25", "2014-03-01", 1.0, 8),
+        # break in a different year: not reported for 2014
+        (p1[0], p1[1], "2000-01-01", "2012-05-01", "2012-05-05", 1.0, 8),
+    ])
+    D = dt.to_ordinal("2014-07-01")
+    out = products.chip_product("ccd", D, CX, CY, seg)
+    assert out[0] == 60
+    assert out[1] == 0
+
+
+def test_ccd_ignores_unconfirmed_changes():
+    seg = frame([(CX, CY, "2000-01-01", "2014-02-25", "2014-03-01", 0.5, 8)])
+    out = products.chip_product("ccd", dt.to_ordinal("2014-07-01"), CX, CY, seg)
+    assert out[0] == 0
+
+
+def test_curveqa_of_containing_segment():
+    seg = frame([
+        (CX, CY, "2000-01-01", "2005-01-01", "2005-01-01", 1.0, 8),
+        (CX, CY, "2005-06-01", "2017-01-01", "2017-01-01", 0.0, 20),
+    ])
+    assert products.chip_product(
+        "curveqa", dt.to_ordinal("2003-01-01"), CX, CY, seg)[0] == 8
+    assert products.chip_product(
+        "curveqa", dt.to_ordinal("2010-01-01"), CX, CY, seg)[0] == 20
+    assert products.chip_product(   # in the gap between segments
+        "curveqa", dt.to_ordinal("2005-03-01"), CX, CY, seg)[0] == 0
+
+
+def test_unknown_product_rejected():
+    with pytest.raises(ValueError, match="unknown product"):
+        products.chip_product("bogus", 1, CX, CY, frame([]))
+    with pytest.raises(ValueError, match="unknown product"):
+        products.save([(0, 0)], ["bogus"], ["2014-01-01"],
+                      store=MemoryStore())
+
+
+# ---------------------------------------------------------------------------
+# Area selection
+# ---------------------------------------------------------------------------
+
+def test_covering_chips_bbox():
+    one = products.covering_chips([(CX + 10, CY - 10)])
+    assert one == [(CX, CY)]
+    # two corners spanning 2x2 chips
+    many = products.covering_chips([(CX + 10, CY - 10),
+                                    (CX + 3010, CY - 3010)])
+    assert set(many) == {(CX, CY), (CX + 3000, CY), (CX, CY - 3000),
+                         (CX + 3000, CY - 3000)}
+
+
+def test_clip_single_point_selects_one_pixel():
+    keep = products.clip_mask(CX, CY, [(CX + 95.0, CY - 65.0)])
+    assert keep.sum() == 1
+    # pixel (row 2, col 3) -> index 2*100+3
+    assert keep[2 * CHIP_SIDE + 3]
+
+
+def test_clip_triangle_subset_of_bbox():
+    tri = [(CX, CY), (CX + 1500.0, CY), (CX, CY - 1500.0)]
+    keep_tri = products.clip_mask(CX, CY, tri)
+    box = [(CX, CY), (CX + 1500.0, CY - 1500.0)]
+    keep_box = products.clip_mask(CX, CY, box)
+    assert 0 < keep_tri.sum() < keep_box.sum() < PIXELS
+    # triangle is roughly half its bounding box
+    assert abs(keep_tri.sum() / keep_box.sum() - 0.5) < 0.1
+
+
+# ---------------------------------------------------------------------------
+# The save run (store-backed)
+# ---------------------------------------------------------------------------
+
+def test_save_writes_product_rasters_idempotently():
+    store = MemoryStore()
+    put_segments(store, [
+        (CX, CY, "2000-01-01", "2010-01-01", "2010-01-01", 0.0, 8),
+    ])
+    keys = products.save([(CX + 10, CY - 10)], ["seglength", "curveqa"],
+                         ["2005-01-01", "2006-01-01"], store=store)
+    assert len(keys) == 4
+    assert store.count("product") == 4
+    # rerun upserts the same keys
+    products.save([(CX + 10, CY - 10)], ["seglength", "curveqa"],
+                  ["2005-01-01", "2006-01-01"], store=store)
+    assert store.count("product") == 4
+    got = store.read("product", {"name": "seglength", "date": "2005-01-01"})
+    cells = got["cells"][0]
+    assert len(cells) == PIXELS
+    assert cells[0] == dt.to_ordinal("2005-01-01") - dt.to_ordinal("2000-01-01")
+
+
+def test_save_clip_masks_outside_pixels():
+    store = MemoryStore()
+    # segment at pixel (row 2, col 3) — the pixel the clip point selects
+    put_segments(store, [
+        (CX + 90, CY - 60, "2000-01-01", "2010-01-01", "2010-01-01", 0.0, 8),
+    ])
+    products.save([(CX + 95.0, CY - 65.0)], ["curveqa"], ["2005-01-01"],
+                  clip=True, store=store)
+    cells = np.array(store.read("product")["cells"][0])
+    assert (cells != FILL_VALUE).sum() == 1
+    assert cells[2 * CHIP_SIDE + 3] == 8
+
+
+def test_save_skips_chips_with_no_segments():
+    store = MemoryStore()
+    keys = products.save([(CX, CY)], ["ccd"], ["2014-01-01"], store=store)
+    assert keys == []
+    assert store.count("product") == 0
+
+
+def test_cli_products_lists_available():
+    r = CliRunner().invoke(cli.entrypoint, ["products"])
+    assert r.exit_code == 0
+    assert set(r.output.split()) == set(products.PRODUCTS)
+
+
+def test_save_detects_missing_chips_end_to_end():
+    """acquired + empty store: save runs change detection first (the
+    self-contained ccdc-save shape), then derives products."""
+    from firebird_tpu.ingest import SyntheticSource
+
+    store = MemoryStore()
+    cfg = Config(store_backend="memory", source_backend="synthetic",
+                 chips_per_batch=1, dtype="float64", device_sharding="off",
+                 fetch_retries=0)
+    src = SyntheticSource(seed=9, start="1995-01-01", end="1998-01-01",
+                          cloud_frac=0.1)
+    keys = products.save([(100, 200)], ["seglength"], ["1996-06-01"],
+                         acquired="1995-01-01/1997-06-01", cfg=cfg,
+                         store=store, source=src)
+    assert len(keys) == 1
+    cells = np.array(store.read("product")["cells"][0])
+    assert cells.shape == (PIXELS,)
+    # most pixels have been in their first segment since early in the series
+    assert (cells > 0).mean() > 0.5
