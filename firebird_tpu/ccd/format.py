@@ -92,6 +92,12 @@ def chip_frames(packed, chip: int, seg) -> dict[str, dict]:
     Pixels with no segments contribute the sentinel row (sday=eday=bday=
     0001-01-01, ccdc/pyccd.py:99-103) so reruns stay idempotent.
     """
+    if packed.sensor.band_names != params.BAND_NAMES:
+        raise ValueError(
+            f"chip_frames writes the reference's Landsat segment schema "
+            f"(7 bands, ccdc/segment.py:16-56); got sensor "
+            f"{packed.sensor.name!r} with {packed.sensor.n_bands} bands — "
+            "persist non-Landsat results through a sensor-specific schema")
     cx, cy = (int(v) for v in packed.cids[chip])
     T = int(packed.n_obs[chip])
     dates_ord = packed.dates[chip][:T]

@@ -44,14 +44,12 @@ import numpy as np
 from jax import lax
 
 from firebird_tpu.ccd import harmonic, params
+from firebird_tpu.ccd.sensor import LANDSAT_ARD, chi2_thresholds
 
 MAX_SEGMENTS = 10
 
 PHASE_INIT, PHASE_MONITOR, PHASE_DONE = 0, 1, 2
 PROC_STANDARD, PROC_SNOW, PROC_INSUF, PROC_NODATA = 0, 1, 2, 3
-
-_DET = list(params.DETECTION_BANDS)
-_TMB = list(params.TMASK_BANDS)
 
 
 # ---------------------------------------------------------------------------
@@ -271,16 +269,22 @@ def _first_at_or_after(mask, i):
     return jnp.any(m, -1), jnp.argmax(m, -1)
 
 
-def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None):
+def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
+                 sensor=LANDSAT_ARD):
     """One chip: X [T,8], Xt [T,5], t [T] f32 ordinal days, valid [T] bool,
-    Y [7,P,T] f32 (the packed layout), qa [P,T] int32.  Returns
+    Y [B,P,T] f32 (the packed layout), qa [P,T] int32.  Returns
     ChipSegments (device).
 
     ``wcap`` (static) bounds the member count of any initialization window;
     window_cap() derives a rigorous bound from the chip's date grid.  None
-    falls back to the always-correct T."""
-    Y = Y.transpose(1, 0, 2)                                   # -> [P,7,T]
-    P, _, T = Y.shape
+    falls back to the always-correct T.  ``sensor`` (static) supplies the
+    band layout — detection/Tmask/range-check roles and count; the default
+    is the reference's Landsat ARD contract."""
+    _DET = list(sensor.detection_bands)
+    _TMB = list(sensor.tmask_bands)
+    CHANGE_THRESHOLD, OUTLIER_THRESHOLD = chi2_thresholds(len(_DET))
+    Y = Y.transpose(1, 0, 2)                                   # -> [P,B,T]
+    P, B, T = Y.shape
     S = MAX_SEGMENTS
     ar = jnp.arange(T)[None, :]
     fdtype = Y.dtype
@@ -299,10 +303,13 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None):
     clear_pct = n_clear / jnp.maximum(n_nonfill, 1)
     snow_pct = n_snow / jnp.maximum(n_clear + n_snow, 1)
 
-    opt_ok = jnp.all((Y[:, :6] > params.OPTICAL_MIN)
-                     & (Y[:, :6] < params.OPTICAL_MAX), axis=1)
-    th_ok = (Y[:, 6] > params.THERMAL_MIN) & (Y[:, 6] < params.THERMAL_MAX)
-    rng_ok = opt_ok & th_ok
+    opt = list(sensor.optical_bands)
+    rng_ok = jnp.all((Y[:, opt] > params.OPTICAL_MIN)
+                     & (Y[:, opt] < params.OPTICAL_MAX), axis=1)
+    if sensor.thermal_bands:
+        th = list(sensor.thermal_bands)
+        rng_ok &= jnp.all((Y[:, th] > params.THERMAL_MIN)
+                          & (Y[:, th] < params.THERMAL_MAX), axis=1)
 
     procedure = jnp.where(
         n_nonfill == 0, PROC_NODATA,
@@ -315,16 +322,17 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None):
     usable_std = _dedup_first(clear & rng_ok, same_prev)
     usable_snow = _dedup_first((clear | snow) & rng_ok, same_prev)
     cand_ins = ~fill & rng_ok
-    blue_med = _masked_median(Y[:, 0], cand_ins)
-    cand_ins = cand_ins & (Y[:, 0] < blue_med[:, None] + params.INSUF_CLEAR_BLUE_DELTA)
+    Yblue = Y[:, sensor.blue_band]
+    blue_med = _masked_median(Yblue, cand_ins)
+    cand_ins = cand_ins & (Yblue < blue_med[:, None] + params.INSUF_CLEAR_BLUE_DELTA)
     usable_ins = _dedup_first(cand_ins, same_prev)
 
     # ---------------- result buffers ----------------
     nseg0 = jnp.zeros(P, jnp.int32)
     meta0 = jnp.zeros((P, S, 6), fdtype)
-    rmse0 = jnp.zeros((P, S, 7), fdtype)
-    mag0 = jnp.zeros((P, S, 7), fdtype)
-    coef0 = jnp.zeros((P, S, 7, params.MAX_COEFS), fdtype)
+    rmse0 = jnp.zeros((P, S, B), fdtype)
+    mag0 = jnp.zeros((P, S, B), fdtype)
+    coef0 = jnp.zeros((P, S, B, params.MAX_COEFS), fdtype)
 
     def write_seg(bufs, nseg, wmask, meta, rmse_s, mag_s, coef_s):
         meta_b, rmse_b, mag_b, coef_b = bufs
@@ -355,7 +363,7 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None):
         alt_n.astype(fdtype)], axis=1)
     bufs = (meta0, rmse0, mag0, coef0)
     bufs, nseg = write_seg(bufs, nseg0, alt_fit, alt_meta, alt_rmse,
-                           jnp.zeros((P, 7), fdtype), alt_coefs)
+                           jnp.zeros((P, B), fdtype), alt_coefs)
     alt_mask = alt_usable & alt_fit[:, None]
 
     # ---------------- standard procedure state ----------------
@@ -371,8 +379,8 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None):
         cur_k=jnp.zeros(P, jnp.int32),
         alive=alive0,
         included=jnp.zeros((P, T), bool),
-        coefs=jnp.zeros((P, 7, params.MAX_COEFS), fdtype),
-        rmse=jnp.ones((P, 7), fdtype),
+        coefs=jnp.zeros((P, B, params.MAX_COEFS), fdtype),
+        rmse=jnp.ones((P, B), fdtype),
         n_last_fit=jnp.ones(P, jnp.int32),
         first_seg=jnp.ones(P, bool),
         nseg=nseg, bufs=bufs,
@@ -475,7 +483,7 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None):
         kq = jnp.sum(alive & (ar < st["cur_k"][:, None]), -1)     # cursor rank
 
         INF = T + 1
-        ex = alive & (s > params.CHANGE_THRESHOLD)
+        ex = alive & (s > CHANGE_THRESHOLD)
         # Consecutive-exceeding run length starting at each alive obs:
         # (rank of next alive non-exceeding obs, else m) - own rank.
         reset_r = jnp.where(alive & ~ex, rank, INF)
@@ -486,7 +494,7 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None):
         has_brk = jnp.any(brk, -1)
         b_abs = jnp.argmax(brk, -1)
 
-        o = s > params.OUTLIER_THRESHOLD
+        o = s > OUTLIER_THRESHOLD
         absq = elig & ~o
         n0 = jnp.sum(included, -1)
         n_inc = n0[:, None] + jnp.cumsum(absq, -1)
@@ -515,7 +523,7 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None):
         rem_q = normalq & o
         # Tail region: score <= threshold absorbed, else removed+counted.
         tailq = elig & (rank >= q_tail[:, None]) & is_tail[:, None]
-        tail_ex = tailq & (s > params.CHANGE_THRESHOLD)
+        tail_ex = tailq & (s > CHANGE_THRESHOLD)
         inc_q = inc_q | (tailq & ~tail_ex)
         rem_q = rem_q | tail_ex
         n_exceed = jnp.sum(tail_ex, -1)
@@ -625,12 +633,12 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None):
 # Host-facing API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("dtype", "wcap"))
+@functools.partial(jax.jit, static_argnames=("dtype", "wcap", "sensor"))
 def _detect_batch_wire(Xs, Xts, t, valid, Y_i16, qa_u16, *, dtype,
-                       wcap=None):
+                       wcap=None, sensor=LANDSAT_ARD):
     """Batch detect from wire dtypes: spectra/QA arrive as int16/uint16 and
     widen on device — halves host->device transfer vs shipping float32."""
-    f = functools.partial(_detect_core, wcap=wcap)
+    f = functools.partial(_detect_core, wcap=wcap, sensor=sensor)
     return jax.vmap(f)(Xs, Xts, t, valid,
                        Y_i16.astype(dtype), qa_u16.astype(jnp.int32))
 
@@ -687,13 +695,15 @@ def prep_batch(packed) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
 
 def detect_packed(packed, dtype=jnp.float32) -> ChipSegments:
     """Run the kernel over a PackedChips batch -> ChipSegments with leading
-    chip axis [C, P, ...]."""
+    chip axis [C, P, ...].  The batch's sensor spec selects the band
+    layout the kernel compiles for."""
     Xs, Xts, valid = prep_batch(packed)
     return _detect_batch_wire(
         jnp.asarray(Xs, dtype), jnp.asarray(Xts, dtype),
         jnp.asarray(packed.dates, dtype=dtype), jnp.asarray(valid),
         jnp.asarray(packed.spectra), jnp.asarray(packed.qas),
-        dtype=jnp.dtype(dtype), wcap=window_cap(packed))
+        dtype=jnp.dtype(dtype), wcap=window_cap(packed),
+        sensor=getattr(packed, "sensor", LANDSAT_ARD))
 
 
 def chip_slice(seg: ChipSegments, c: int, to_host: bool = False) -> ChipSegments:
@@ -715,7 +725,7 @@ def chip_slice(seg: ChipSegments, c: int, to_host: bool = False) -> ChipSegments
 
 
 def segments_to_records(seg: ChipSegments, dates: np.ndarray,
-                        pixel: int) -> dict:
+                        pixel: int, sensor=LANDSAT_ARD) -> dict:
     """Convert one pixel's kernel output to the oracle/pyccd result dict
     (change_models + processing_mask), for parity tests and the format
     layer.  ``seg`` must be single-chip ([P, ...]) host-fetched arrays."""
@@ -724,7 +734,7 @@ def segments_to_records(seg: ChipSegments, dates: np.ndarray,
     models = []
     for k in range(n):
         meta = np.asarray(seg.seg_meta[pixel, k], np.float64)
-        coefs = np.asarray(seg.seg_coef[pixel, k], np.float64)   # [7,8]
+        coefs = np.asarray(seg.seg_coef[pixel, k], np.float64)   # [B,8]
         coefs7, intercept = harmonic.to_pyccd_convention(coefs, anchor)
         rec = {
             "start_day": int(round(meta[0])), "end_day": int(round(meta[1])),
@@ -733,7 +743,7 @@ def segments_to_records(seg: ChipSegments, dates: np.ndarray,
             "change_probability": float(meta[3]),
             "curve_qa": int(round(meta[4])),
         }
-        for b, name in enumerate(params.BAND_NAMES):
+        for b, name in enumerate(sensor.band_names):
             rec[name] = {
                 "magnitude": float(seg.seg_mag[pixel, k, b]),
                 "rmse": float(seg.seg_rmse[pixel, k, b]),

@@ -23,6 +23,22 @@ DEFAULT_MEANS = np.array([400.0, 600.0, 500.0, 2500.0, 1500.0, 800.0, 2900.0])
 DEFAULT_AMPS = np.array([50.0, 80.0, 80.0, 400.0, 250.0, 120.0, 500.0])
 
 
+def means_amps(sensor) -> tuple[np.ndarray, np.ndarray]:
+    """Per-band (means, amps) sized to a sensor spec.
+
+    Landsat ARD gets the calibrated defaults; other band counts cycle the
+    optical palette (plausible vegetation-reflectance scale), with thermal
+    bands pinned to the thermal default so range checks behave."""
+    B = sensor.n_bands
+    if B == DEFAULT_MEANS.shape[0] and sensor.thermal_bands == (6,):
+        return DEFAULT_MEANS.copy(), DEFAULT_AMPS.copy()
+    means = np.resize(DEFAULT_MEANS[:6], B).astype(np.float64)
+    amps = np.resize(DEFAULT_AMPS[:6], B).astype(np.float64)
+    for b in sensor.thermal_bands:
+        means[b], amps[b] = DEFAULT_MEANS[6], DEFAULT_AMPS[6]
+    return means, amps
+
+
 def acquisition_dates(start="1995-01-01", end="2015-01-01", cadence_days=16,
                       rng=None, drop_frac=0.0) -> np.ndarray:
     """Ordinal acquisition dates at a fixed cadence, optionally thinned."""

@@ -70,7 +70,7 @@ def _pad_batch(packed, target: int):
     rep = lambda a: np.concatenate([a, np.repeat(a[-1:], pad, axis=0)])
     return PackedChips(cids=rep(packed.cids), dates=rep(packed.dates),
                        spectra=rep(packed.spectra), qas=rep(packed.qas),
-                       n_obs=rep(packed.n_obs)), C
+                       n_obs=rep(packed.n_obs), sensor=packed.sensor), C
 
 
 def detect_batch(packed, dtype, sharding: str = "auto",

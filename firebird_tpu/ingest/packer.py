@@ -20,6 +20,7 @@ import numpy as np
 
 from firebird_tpu import native
 from firebird_tpu.ccd import params
+from firebird_tpu.ccd.sensor import LANDSAT_ARD, Sensor
 
 CHIP_SIDE = 100          # pixels per chip side (registry data_shape [100,100])
 PIXELS = CHIP_SIDE * CHIP_SIDE
@@ -33,8 +34,10 @@ class ChipData:
     """One chip's date-aligned time series.
 
     dates:   [T] ordinal days, ascending.
-    spectra: [7, T, 100, 100] int16 (band order blue..thermal).
-    qas:     [T, 100, 100] uint16 bit-packed QA.
+    spectra: [B, T, side, side] int16 (sensor band order; Landsat ARD:
+             blue..thermal, [7, T, 100, 100]).
+    qas:     [T, side, side] uint16 bit-packed QA.
+    sensor:  the band/geometry spec (default: the reference's Landsat ARD).
     """
 
     cx: int
@@ -42,12 +45,14 @@ class ChipData:
     dates: np.ndarray
     spectra: np.ndarray
     qas: np.ndarray
+    sensor: Sensor = LANDSAT_ARD
 
     def __post_init__(self):
         T = self.dates.shape[0]
-        assert self.spectra.shape == (params.NUM_BANDS, T, CHIP_SIDE, CHIP_SIDE), \
-            self.spectra.shape
-        assert self.qas.shape == (T, CHIP_SIDE, CHIP_SIDE), self.qas.shape
+        side = self.sensor.chip_side
+        assert self.spectra.shape == (self.sensor.n_bands, T, side, side), \
+            (self.spectra.shape, self.sensor.name)
+        assert self.qas.shape == (T, side, side), self.qas.shape
         assert T < 2 or bool(np.all(np.diff(self.dates) >= 0)), "dates must ascend"
 
 
@@ -57,13 +62,15 @@ class PackedChips:
 
     cids:    [C, 2] int64 chip ids (cx, cy).
     dates:   [C, T] int32, ascending within the valid prefix, 0-padded.
-    spectra: [C, 7, P, T] int16, FILL_VALUE-padded.
+    spectra: [C, B, P, T] int16, FILL_VALUE-padded.
     qas:     [C, P, T] uint16, fill-bit padded.
     n_obs:   [C] int32 valid observation count per chip.
+    sensor:  the shared band/geometry spec of every chip in the batch.
 
-    P = 10000 pixels in row-major order: pixel index p = row*100 + col where
-    (row, col) counts from the chip's upper-left, so the pixel's projection
-    coordinate is (px, py) = (cx + col*30, cy - row*30).
+    P = side*side pixels in row-major order: pixel index p = row*side + col
+    where (row, col) counts from the chip's upper-left, so the pixel's
+    projection coordinate is (px, py) = (cx + col*psz, cy - row*psz).
+    Landsat ARD: P = 10000, psz = 30 m.
     """
 
     cids: np.ndarray
@@ -71,6 +78,7 @@ class PackedChips:
     spectra: np.ndarray
     qas: np.ndarray
     n_obs: np.ndarray
+    sensor: Sensor = LANDSAT_ARD
 
     @property
     def n_chips(self) -> int:
@@ -83,10 +91,11 @@ class PackedChips:
     def pixel_coords(self, c: int) -> np.ndarray:
         """[P, 2] (px, py) projection coordinates of chip c's pixels."""
         cx, cy = self.cids[c]
-        cols = np.arange(CHIP_SIDE) * PIXEL_SIZE_M
-        rows = np.arange(CHIP_SIDE) * PIXEL_SIZE_M
-        px = cx + np.tile(cols, CHIP_SIDE)
-        py = cy - np.repeat(rows, CHIP_SIDE)
+        side, psz = self.sensor.chip_side, self.sensor.pixel_size_m
+        cols = np.arange(side) * psz
+        rows = np.arange(side) * psz
+        px = cx + np.tile(cols, side)
+        py = cy - np.repeat(rows, side)
         return np.stack([px, py], axis=1).astype(np.int64)
 
 
@@ -106,6 +115,10 @@ def pack(chips: list[ChipData], *, bucket: int = 64, max_obs: int = 0) -> Packed
     is ~1800 acquisitions).
     """
     assert chips, "cannot pack zero chips"
+    sensor = chips[0].sensor
+    assert all(c.sensor == sensor for c in chips), \
+        "all chips in a batch must share one sensor spec"
+    B, npix = sensor.n_bands, sensor.pixels
     T_max = max(c.dates.shape[0] for c in chips)
     cap = bucket_capacity(T_max, bucket, max_obs)
 
@@ -113,22 +126,23 @@ def pack(chips: list[ChipData], *, bucket: int = 64, max_obs: int = 0) -> Packed
     cids = np.zeros((C, 2), np.int64)
     dates = np.zeros((C, cap), np.int32)
     # The transpose-with-padding writes every cell, so plain empty buffers;
-    # the heavy [7,T,100,100] -> [7,P,cap] layout change runs in the native
-    # data plane when available (firebird_tpu/native/fastpack.cpp).
-    spectra = np.empty((C, params.NUM_BANDS, PIXELS, cap), np.int16)
-    qas = np.empty((C, PIXELS, cap), np.uint16)
+    # the heavy [B,T,side,side] -> [B,P,cap] layout change runs in the
+    # native data plane when available (firebird_tpu/native/fastpack.cpp).
+    spectra = np.empty((C, B, npix, cap), np.int16)
+    qas = np.empty((C, npix, cap), np.uint16)
     n_obs = np.zeros(C, np.int32)
 
     for i, c in enumerate(chips):
         T = min(c.dates.shape[0], cap)
         cids[i] = (c.cx, c.cy)
         dates[i, :T] = c.dates[:T]
-        native.pack_spectra(c.spectra[:, :T].reshape(params.NUM_BANDS, T, PIXELS),
+        native.pack_spectra(c.spectra[:, :T].reshape(B, T, npix),
                             cap, params.FILL_VALUE, out=spectra[i])
-        native.pack_qa(c.qas[:T].reshape(T, PIXELS), cap,
+        native.pack_qa(c.qas[:T].reshape(T, npix), cap,
                        int(QA_FILL_PACKED), out=qas[i])
         n_obs[i] = T
-    return PackedChips(cids=cids, dates=dates, spectra=spectra, qas=qas, n_obs=n_obs)
+    return PackedChips(cids=cids, dates=dates, spectra=spectra, qas=qas,
+                       n_obs=n_obs, sensor=sensor)
 
 
 def pixel_timeseries(p: PackedChips, c: int, pix: int) -> dict:
@@ -137,7 +151,7 @@ def pixel_timeseries(p: PackedChips, c: int, pix: int) -> dict:
     (ccdc/timeseries.py:104-115)."""
     T = int(p.n_obs[c])
     d = {n: p.spectra[c, b, pix, :T].copy()
-         for b, n in enumerate(params.BAND_NAMES_PLURAL)}
+         for b, n in enumerate(p.sensor.band_names_plural)}
     d["dates"] = p.dates[c, :T].astype(np.int64)
     d["qas"] = p.qas[c, pix, :T].copy()
     return d

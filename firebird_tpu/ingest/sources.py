@@ -55,12 +55,15 @@ class SyntheticSource:
 
     def __init__(self, seed: int = 0, *, start="1995-01-01", end="2005-01-01",
                  cadence_days: int = 16, change_frac: float = 0.25,
-                 cloud_frac: float = 0.15):
+                 cloud_frac: float = 0.15, sensor=None):
+        from firebird_tpu.ccd.sensor import LANDSAT_ARD
+
         self.seed = seed
         self.start, self.end = start, end
         self.cadence_days = cadence_days
         self.change_frac = change_frac
         self.cloud_frac = cloud_frac
+        self.sensor = sensor or LANDSAT_ARD
 
     def _rng(self, cx: int, cy: int, salt: int = 0) -> np.random.Generator:
         return np.random.default_rng(
@@ -71,28 +74,31 @@ class SyntheticSource:
         # queried with different acquired windows must agree on overlapping
         # dates (like FileSource slicing a fixed archive).
         rng = self._rng(cx, cy)
+        sn = self.sensor
+        B, csd = sn.n_bands, sn.chip_side
         t = synthetic.acquisition_dates(self.start, self.end, self.cadence_days)
         T = t.shape[0]
         ph = harmonic.day_phase(t).astype(np.float32)
 
-        means = synthetic.DEFAULT_MEANS.astype(np.float32)
-        amps = synthetic.DEFAULT_AMPS.astype(np.float32)
+        means, amps = synthetic.means_amps(sn)
+        means = means.astype(np.float32)
+        amps = amps.astype(np.float32)
         # Per-pixel level field (spatially smooth-ish random offsets).
-        level = rng.normal(0, 60, size=(CHIP_SIDE, CHIP_SIDE)).astype(np.float32)
+        level = rng.normal(0, 60, size=(csd, csd)).astype(np.float32)
 
-        spectra = np.empty((params.NUM_BANDS, T, CHIP_SIDE, CHIP_SIDE), np.int16)
+        spectra = np.empty((B, T, csd, csd), np.int16)
         noise_scale = 30.0
-        for b in range(params.NUM_BANDS):
+        for b in range(B):
             base = (means[b] + amps[b] * np.cos(ph))[:, None, None]
             series = base + level[None, :, :] + rng.normal(
-                0, noise_scale, size=(T, CHIP_SIDE, CHIP_SIDE)).astype(np.float32)
+                0, noise_scale, size=(T, csd, csd)).astype(np.float32)
             spectra[b] = np.clip(series, -32768, 32767).astype(np.int16)
 
         # Step change in a patch, at a chip-specific date in the middle half.
         if self.change_frac > 0:
-            side = max(1, int(CHIP_SIDE * np.sqrt(self.change_frac)))
-            r0 = int(rng.integers(0, CHIP_SIDE - side + 1))
-            c0 = int(rng.integers(0, CHIP_SIDE - side + 1))
+            side = max(1, int(csd * np.sqrt(self.change_frac)))
+            r0 = int(rng.integers(0, csd - side + 1))
+            c0 = int(rng.integers(0, csd - side + 1))
             k = int(rng.integers(T // 4, 3 * T // 4))
             delta = rng.uniform(500, 1000)
             # Keep shifted values inside the valid data ranges (params
@@ -100,20 +106,21 @@ class SyntheticSource:
             # seasonal low (mean - amplitude, minus level/noise spread)
             # sits near delta below OPTICAL_MIN, and in_range() would then
             # discard the whole post-change observation.
-            sign = np.where(rng.random(params.NUM_BANDS) < 0.5, -1.0, 1.0)
-            seasonal_low = synthetic.DEFAULT_MEANS - synthetic.DEFAULT_AMPS
+            sign = np.where(rng.random(B) < 0.5, -1.0, 1.0)
+            seasonal_low = means - amps
             sign = np.where(seasonal_low < delta + 300, 1.0, sign)
-            for b in range(params.NUM_BANDS):
+            for b in range(B):
                 spectra[b, k:, r0:r0 + side, c0:c0 + side] = np.clip(
                     spectra[b, k:, r0:r0 + side, c0:c0 + side]
                     + np.int16(sign[b] * delta), -32768, 32767)
 
-        qas = np.full((T, CHIP_SIDE, CHIP_SIDE), synthetic.QA_CLEAR, np.uint16)
+        qas = np.full((T, csd, csd), synthetic.QA_CLEAR, np.uint16)
         cloudy = rng.random(T) < self.cloud_frac
         qas[cloudy] = synthetic.QA_CLOUD
 
         t, spectra, qas = _slice_acquired(t, spectra, qas, acquired)
-        return ChipData(cx=int(cx), cy=int(cy), dates=t, spectra=spectra, qas=qas)
+        return ChipData(cx=int(cx), cy=int(cy), dates=t, spectra=spectra,
+                        qas=qas, sensor=sn)
 
     def aux(self, cx: int, cy: int, acquired: str | None = None) -> dict:
         """AUX layers: one [100,100] array per AUX_NAMES entry."""

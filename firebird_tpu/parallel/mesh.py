@@ -103,4 +103,5 @@ def detect_sharded(packed, mesh: Mesh, dtype=None):
         wcap = int(np.max(np.asarray(
             multihost_utils.process_allgather(np.array([wcap])))))
     args = shard_packed(packed, mesh, dtype)
-    return _detect_batch_wire(*args, dtype=jnp.dtype(dtype), wcap=wcap)
+    return _detect_batch_wire(*args, dtype=jnp.dtype(dtype), wcap=wcap,
+                              sensor=packed.sensor)

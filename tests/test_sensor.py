@@ -1,0 +1,129 @@
+"""Multi-sensor support: the kernel/packer generic over band layout and chip
+geometry (BASELINE.json config #5 — Sentinel-2 12-band, 10 m, 300x300-pixel
+chips), with Landsat ARD as the default spec."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from firebird_tpu.ccd import kernel, params
+from firebird_tpu.ccd.sensor import LANDSAT_ARD, SENTINEL2, chi2_thresholds
+from firebird_tpu.ccd.synthetic import means_amps
+from firebird_tpu.ingest import SyntheticSource, pack
+from firebird_tpu.ingest.packer import PackedChips
+from firebird_tpu.parallel import make_mesh
+from firebird_tpu.parallel.mesh import detect_sharded
+
+
+def slice_pixels(p: PackedChips, n: int) -> PackedChips:
+    return PackedChips(cids=p.cids, dates=p.dates,
+                       spectra=p.spectra[:, :, :n, :], qas=p.qas[:, :n, :],
+                       n_obs=p.n_obs, sensor=p.sensor)
+
+
+def test_sensor_specs_consistent():
+    assert LANDSAT_ARD.n_bands == params.NUM_BANDS
+    assert LANDSAT_ARD.band_names == params.BAND_NAMES
+    assert LANDSAT_ARD.detection_bands == params.DETECTION_BANDS
+    assert LANDSAT_ARD.pixels == 10000
+    assert SENTINEL2.n_bands == 12
+    assert SENTINEL2.pixels == 90000
+    assert SENTINEL2.thermal_bands == ()
+    # both use 5 detection bands -> identical chi2 thresholds, equal to the
+    # module constants pinned for the reference
+    chg, out = chi2_thresholds(len(LANDSAT_ARD.detection_bands))
+    assert chg == params.CHANGE_THRESHOLD
+    assert out == params.OUTLIER_THRESHOLD
+    assert chi2_thresholds(5) == (chg, out)
+    # detection/tmask roles land on the right wavelengths
+    names = SENTINEL2.band_names
+    assert [names[i] for i in SENTINEL2.detection_bands] == \
+        ["green", "red", "nir", "swir1", "swir2"]
+    assert [names[i] for i in SENTINEL2.tmask_bands] == ["green", "swir1"]
+
+
+def test_means_amps_sized_to_sensor():
+    m, a = means_amps(SENTINEL2)
+    assert m.shape == (12,) and a.shape == (12,)
+    assert np.all(m > 0)
+    from firebird_tpu.ccd import synthetic
+
+    m7, a7 = means_amps(LANDSAT_ARD)
+    np.testing.assert_array_equal(m7, synthetic.DEFAULT_MEANS)
+    np.testing.assert_array_equal(a7, synthetic.DEFAULT_AMPS)
+
+
+def test_s2_synthetic_chip_shape():
+    src = SyntheticSource(seed=3, start="1995-01-01", end="1997-01-01",
+                          sensor=SENTINEL2, change_frac=0.0, cloud_frac=0.1)
+    c = src.chip(0, 0)
+    T = c.dates.shape[0]
+    assert c.spectra.shape == (12, T, 300, 300)
+    assert c.qas.shape == (T, 300, 300)
+    assert c.sensor == SENTINEL2
+
+
+def test_s2_kernel_detects_step_change():
+    """The kernel compiled for the S2 spec finds the break every pixel of a
+    whole-chip step change carries, with no thermal screening."""
+    src = SyntheticSource(seed=3, start="1995-01-01", end="2000-01-01",
+                          sensor=SENTINEL2, change_frac=1.0, cloud_frac=0.1)
+    p = slice_pixels(pack([src.chip(0, 0)], bucket=32), 96)
+    seg = kernel.detect_packed(p, dtype=jnp.float64)
+    nseg = np.asarray(seg.n_segments)[0]
+    proc = np.asarray(seg.procedure)[0]
+    assert np.all(proc == kernel.PROC_STANDARD)
+    assert (nseg >= 2).mean() > 0.9         # break found almost everywhere
+    one = kernel.chip_slice(seg, 0, to_host=True)
+    rec = kernel.segments_to_records(one, p.dates[0][: int(p.n_obs[0])],
+                                     pixel=0, sensor=SENTINEL2)
+    assert set(SENTINEL2.band_names) <= set(rec["change_models"][0])
+    assert rec["change_models"][0]["swir2"]["rmse"] > 0
+    # a confirmed break: first segment has chprob 1
+    assert rec["change_models"][0]["change_probability"] == 1.0
+
+
+def test_s2_result_shapes_follow_band_count():
+    src = SyntheticSource(seed=4, start="1995-01-01", end="1997-01-01",
+                          sensor=SENTINEL2, change_frac=0.0)
+    p = slice_pixels(pack([src.chip(3000, 0)], bucket=32), 16)
+    seg = kernel.detect_packed(p, dtype=jnp.float64)
+    assert seg.seg_rmse.shape[-1] == 12
+    assert seg.seg_coef.shape[-2:] == (12, params.MAX_COEFS)
+    assert seg.vario.shape[-1] == 12
+
+
+def test_s2_pixel_coords_10m():
+    src = SyntheticSource(seed=3, start="1995-01-01", end="1996-01-01",
+                          sensor=SENTINEL2, change_frac=0.0)
+    p = pack([src.chip(0, 30000)], bucket=16)
+    xy = p.pixel_coords(0)
+    assert xy.shape == (90000, 2)
+    assert tuple(xy[0]) == (0, 30000)
+    assert tuple(xy[1]) == (10, 30000)          # 10 m pixels
+    assert tuple(xy[300]) == (0, 30000 - 10)    # row-major, 300-wide
+
+
+def test_s2_sharded_over_mesh():
+    """Config #5's point: the denser stack shards over the device mesh the
+    same way — chip axis split, zero collectives."""
+    src = SyntheticSource(seed=5, start="1995-01-01", end="2000-01-01",
+                          sensor=SENTINEL2, change_frac=1.0, cloud_frac=0.1)
+    chips = [src.chip(3000 * i, 0) for i in range(2)]
+    p = slice_pixels(pack(chips, bucket=32), 64)
+    mesh = make_mesh(n_devices=2)
+    seg = detect_sharded(p, mesh, dtype=jnp.float64)
+    nseg = np.asarray(seg.n_segments)
+    assert nseg.shape == (2, 64)
+    assert (nseg >= 2).mean() > 0.8
+
+
+def test_mixed_sensor_pack_rejected():
+    l = SyntheticSource(seed=1, start="1995-01-01", end="1996-01-01")
+    s = SyntheticSource(seed=1, start="1995-01-01", end="1996-01-01",
+                        sensor=SENTINEL2)
+    try:
+        pack([l.chip(0, 0), s.chip(0, 0)])
+    except AssertionError as e:
+        assert "sensor" in str(e)
+    else:
+        raise AssertionError("mixed-sensor pack must be rejected")
