@@ -7,9 +7,9 @@ preserved: primary keys are the natural keys, writes are upserts, so any
 rerun of a tile/chunk overwrites the same rows (SURVEY.md §5
 "checkpoint/resume = idempotent append writes").
 
-Backends: sqlite (dev/default), parquet (bulk/analytics), memory (tests).
-A Cassandra adapter can implement the same Store interface where a cluster
-exists; nothing above this layer would change.
+Backends: sqlite (dev/default), parquet (bulk/analytics), memory (tests),
+cassandra (production parity with the reference — needs cassandra-driver
+or an injected session).
 
 Writes are drained by an AsyncWriter on a host thread so device compute
 overlaps egress (the reference instead tuned spark-cassandra concurrent
@@ -17,8 +17,10 @@ writes, ccdc/__init__.py:20-22).
 """
 
 from firebird_tpu.store.schema import TABLES, primary_key
-from firebird_tpu.store.backends import MemoryStore, SqliteStore, ParquetStore, open_store
+from firebird_tpu.store.backends import (CassandraStore, MemoryStore,
+                                         ParquetStore, SqliteStore,
+                                         open_store)
 from firebird_tpu.store.writer import AsyncWriter
 
-__all__ = ["TABLES", "primary_key", "MemoryStore", "SqliteStore",
-           "ParquetStore", "open_store", "AsyncWriter"]
+__all__ = ["TABLES", "primary_key", "CassandraStore", "MemoryStore",
+           "SqliteStore", "ParquetStore", "open_store", "AsyncWriter"]

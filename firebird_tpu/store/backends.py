@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import re
 import sqlite3
 import threading
 
@@ -36,6 +37,23 @@ def _normalize(v):
     if isinstance(v, np.ndarray):
         return v.tolist()
     return v
+
+
+def _json_cols(table: str) -> set[str]:
+    return {c for c, typ in schema.TABLES[table]["columns"] if typ == "JSON"}
+
+
+def _encode_cell(frame: dict, c: str, i: int, jsoncols: set[str]):
+    """Frame cell -> wire value for text-cell backends (sqlite/cassandra):
+    normalized plain Python, JSON columns serialized, NaN -> NULL."""
+    v = _normalize(frame[c][i]) if c in frame else None
+    if c in jsoncols:
+        return json.dumps(v) if v is not None else None
+    return v
+
+
+def _decode_cell(c: str, v, jsoncols: set[str]):
+    return json.loads(v) if (c in jsoncols and v is not None) else v
 
 
 class MemoryStore:
@@ -118,18 +136,11 @@ class SqliteStore:
         con.commit()
 
     def write(self, table: str, frame: dict) -> int:
-        spec = schema.TABLES[table]
-        cols = [c for c, _ in spec["columns"]]
-        jsoncols = {c for c, typ in spec["columns"] if typ == "JSON"}
+        cols = schema.columns(table)
+        jsoncols = _json_cols(table)
         n = len(next(iter(frame.values())))
-
-        def cell(c, i):
-            v = _normalize(frame[c][i]) if c in frame else None
-            if c in jsoncols:
-                return json.dumps(v) if v is not None else None
-            return v
-
-        rows = [tuple(cell(c, i) for c in cols) for i in range(n)]
+        rows = [tuple(_encode_cell(frame, c, i, jsoncols) for c in cols)
+                for i in range(n)]
         ph = ", ".join("?" * len(cols))
         con = self._conn()
         con.executemany(
@@ -139,9 +150,8 @@ class SqliteStore:
         return n
 
     def read(self, table: str, where: dict | None = None) -> dict:
-        spec = schema.TABLES[table]
-        cols = [c for c, _ in spec["columns"]]
-        jsoncols = {c for c, typ in spec["columns"] if typ == "JSON"}
+        cols = schema.columns(table)
+        jsoncols = _json_cols(table)
         sql = f'SELECT {", ".join(cols)} FROM "{table}"'
         args: list = []
         if where:
@@ -151,8 +161,7 @@ class SqliteStore:
         out: dict[str, list] = {c: [] for c in cols}
         for row in cur:
             for c, v in zip(cols, row):
-                out[c].append(json.loads(v) if (c in jsoncols and v is not None)
-                              else v)
+                out[c].append(_decode_cell(c, v, jsoncols))
         return out
 
     def count(self, table: str) -> int:
@@ -271,8 +280,161 @@ class ParquetStore:
         pass
 
 
+class CassandraStore:
+    """Store over Apache Cassandra — the reference's production sink.
+
+    Parity with ccdc/cassandra.py + resources/schema.cql:
+    - same four (+product) tables; partition key = the first two key
+      columns, remaining key columns clustering — the natural-key PKs that
+      make rerun writes idempotent upserts (schema.cql:34,54,142;
+      mode('append'), cassandra.py:62-63).
+    - QUORUM consistency and bounded concurrent writes (cassandra.py:20-26,
+      reference default 2 concurrent writes).
+    - keyspace per inputs+version (ccdc/__init__.py:29-44 — Config.keyspace).
+
+    Array-valued columns are JSON-encoded text (uniform with the sqlite
+    backend) rather than frozen<list<...>>; the key design, not the cell
+    encoding, carries the durability semantics.
+
+    ``session`` is injectable (tests pass a fake; see tests/test_store.py).
+    Without it, the DataStax ``cassandra-driver`` package is required and a
+    clear error is raised when absent — the driver is not bundled.
+    """
+
+    _TYPES = {"INTEGER": "bigint", "REAL": "double", "TEXT": "text",
+              "JSON": "text"}
+
+    def __init__(self, contact_points=("127.0.0.1",), port: int = 9042,
+                 keyspace: str = "default", username: str = "",
+                 password: str = "", concurrent_writes: int = 2,
+                 replication: int = 1, session=None):
+        ks = re.sub(r"[^a-zA-Z0-9_]", "_", keyspace) or "default"
+        # A leading digit is not a valid unquoted CQL identifier.
+        self.keyspace = ks if not ks[0].isdigit() else f"ks_{ks}"
+        self.concurrent_writes = max(int(concurrent_writes), 1)
+        self._replication = int(replication)
+        self._cluster = None
+        if session is None:
+            session = self._connect(contact_points, port, username, password)
+        self.session = session
+        self._prepared: dict[str, object] = {}
+        self._ensure_schema()
+
+    def _connect(self, contact_points, port, username, password):
+        try:
+            from cassandra.cluster import Cluster
+        except ImportError as e:
+            raise RuntimeError(
+                "store backend 'cassandra' needs the cassandra-driver "
+                "package (or pass an explicit session=); install it or use "
+                "the sqlite/parquet backends") from e
+        auth = None
+        if username:
+            from cassandra.auth import PlainTextAuthProvider
+            auth = PlainTextAuthProvider(username=username, password=password)
+        self._cluster = Cluster(list(contact_points), port=port,
+                                auth_provider=auth)
+        session = self._cluster.connect()
+        from cassandra import ConsistencyLevel
+        session.default_consistency_level = ConsistencyLevel.QUORUM
+        return session
+
+    def _ensure_schema(self):
+        self.session.execute(
+            f"CREATE KEYSPACE IF NOT EXISTS {self.keyspace} WITH replication"
+            f" = {{'class': 'SimpleStrategy', 'replication_factor': "
+            f"{self._replication}}}")
+        for t, spec in schema.TABLES.items():
+            cols = ", ".join(f"{c} {self._TYPES[typ]}"
+                             for c, typ in spec["columns"])
+            key = spec["key"]
+            pk = (f"(({key[0]}, {key[1]})"
+                  + ("".join(f", {k}" for k in key[2:])) + ")")
+            self.session.execute(
+                f"CREATE TABLE IF NOT EXISTS {self.keyspace}.{t} "
+                f"({cols}, PRIMARY KEY {pk})")
+
+    def _prepare(self, table: str):
+        if table not in self._prepared:
+            cols = schema.columns(table)
+            ph = ", ".join("?" * len(cols))
+            self._prepared[table] = self.session.prepare(
+                f"INSERT INTO {self.keyspace}.{table} "
+                f"({', '.join(cols)}) VALUES ({ph})")
+        return self._prepared[table]
+
+    def write(self, table: str, frame: dict) -> int:
+        cols = schema.columns(table)
+        jsoncols = _json_cols(table)
+        stmt = self._prepare(table)
+        n = len(next(iter(frame.values())))
+        # Bounded in-flight async writes (the reference's
+        # spark.cassandra.output.concurrent.writes, ccdc/__init__.py:20).
+        pending = []
+        for i in range(n):
+            pending.append(self.session.execute_async(
+                stmt, tuple(_encode_cell(frame, c, i, jsoncols)
+                            for c in cols)))
+            if len(pending) >= self.concurrent_writes:
+                pending.pop(0).result()
+        for f in pending:
+            f.result()
+        return n
+
+    def read(self, table: str, where: dict | None = None) -> dict:
+        cols = schema.columns(table)
+        jsoncols = _json_cols(table)
+        cql = f"SELECT {', '.join(cols)} FROM {self.keyspace}.{table}"
+        params: tuple = ()
+        if where:
+            cql += " WHERE " + " AND ".join(f"{k} = %s" for k in where)
+            cql += " ALLOW FILTERING"
+            params = tuple(_normalize(v) for v in where.values())
+        out: dict[str, list] = {c: [] for c in cols}
+        for row in self.session.execute(cql, params):
+            for c, v in zip(cols, row):
+                out[c].append(_decode_cell(c, v, jsoncols))
+        return out
+
+    def count(self, table: str) -> int:
+        rows = self.session.execute(
+            f"SELECT COUNT(*) FROM {self.keyspace}.{table}", ())
+        return int(next(iter(rows))[0])
+
+    def chip_ids(self, table: str = "segment") -> set[tuple[int, int]]:
+        # The first two key columns are exactly the partition key, so
+        # DISTINCT reads only partition keys — a full-row scan here would
+        # stream millions of segment rows just to dedupe chips (resume
+        # path, driver/core.py).
+        k1, k2 = schema.primary_key(table)[:2]
+        rows = self.session.execute(
+            f"SELECT DISTINCT {k1}, {k2} FROM {self.keyspace}.{table}", ())
+        return {(r[0], r[1]) for r in rows}
+
+    def close(self):
+        if self._cluster is not None:
+            self._cluster.shutdown()
+
+
 def open_store(backend: str, path: str, keyspace: str):
-    """Factory used by the driver (cfg.store_backend)."""
+    """Factory used by the driver (cfg.store_backend).
+
+    For the 'cassandra' backend, connection settings come from the
+    reference's env contract (ccdc/__init__.py:17-22): CASSANDRA
+    (contact host[,host...]), CASSANDRA_PORT, CASSANDRA_USER,
+    CASSANDRA_PASS, CASSANDRA_OUTPUT_CONCURRENT_WRITES — credentials stay
+    in the environment, not in Config.
+    """
+    if backend == "cassandra":
+        hosts = os.environ.get("CASSANDRA", "127.0.0.1").split(",")
+        return CassandraStore(
+            contact_points=[h.strip() for h in hosts if h.strip()],
+            port=int(os.environ.get("CASSANDRA_PORT", "9042")),
+            keyspace=keyspace,
+            username=os.environ.get("CASSANDRA_USER", ""),
+            password=os.environ.get("CASSANDRA_PASS", ""),
+            concurrent_writes=int(
+                os.environ.get("CASSANDRA_OUTPUT_CONCURRENT_WRITES", "2")))
     if backend == "memory":
         return MemoryStore(keyspace)
     if backend == "sqlite":
