@@ -52,6 +52,15 @@ PHASE_INIT, PHASE_MONITOR, PHASE_DONE = 0, 1, 2
 PROC_STANDARD, PROC_SNOW, PROC_INSUF, PROC_NODATA = 0, 1, 2, 3
 
 
+def use_pallas() -> bool:
+    """Whether the Lasso CD loop runs as the Pallas VMEM-resident kernel
+    (FIREBIRD_PALLAS=1).  Read at trace time: set it before the first
+    detect call — already-compiled programs keep their path."""
+    import os
+
+    return os.environ.get("FIREBIRD_PALLAS", "0") == "1"
+
+
 # ---------------------------------------------------------------------------
 # Results container
 # ---------------------------------------------------------------------------
@@ -128,6 +137,22 @@ def _fit_lasso_coefs(X, Y, w, coefmask, XX=None):
     G = (w @ XX).reshape(-1, K, K) / n[:, None, None]          # [P,8,8]
     c = jnp.einsum("pbt,tc->pbc", Y * w[:, None, :], X) / n[:, None, None]
     diag = jnp.maximum(jnp.diagonal(G, axis1=-2, axis2=-1), 1e-12)  # [P,8]
+
+    if use_pallas():
+        on_tpu = jax.default_backend() == "tpu"
+        # Mosaic cannot lower float64; compiled Pallas is f32-on-TPU only.
+        # Off-TPU the same kernel runs interpreted (tests), any dtype.
+        if not on_tpu or c.dtype == jnp.float32:
+            from firebird_tpu.ccd import pallas_ops
+
+            return pallas_ops.lasso_cd(G, c, diag, coefmask,
+                                       interpret=not on_tpu)
+    return _lasso_cd_lax(G, c, diag, coefmask)
+
+
+def _lasso_cd_lax(G, c, diag, coefmask):
+    """The CD loop as a lax fori_loop (the default / reference path; the
+    Pallas VMEM-resident version is pallas_ops.lasso_cd)."""
     alpha = params.LASSO_ALPHA
 
     def one_iter(_, b):

@@ -1,0 +1,105 @@
+"""Pallas TPU kernels for the CCD hot ops.
+
+The Lasso coordinate-descent loop is the detector's serial core: every
+event-loop round runs LASSO_ITERS x MAX_COEFS sequential coordinate
+updates over [P, B, 8] Gram systems (kernel._fit_lasso_coefs; the round
+count is small, so the CD loop dominates the non-matmul step count).
+Under plain XLA each of those ~400 steps materializes its [P, B]
+intermediates between fused ops; this kernel keeps the whole state
+(G, c, diag, mask, b) resident in VMEM for all iterations, streaming each
+pixel block exactly once.
+
+Layout: the pixel axis goes LAST ([K, K, P], [B, K, P], ...) so it rides
+the 128-wide vector lanes and the tiny K=8 axis sits on sublanes — the
+natural VPU shape for the per-coordinate updates, which are elementwise
+over P.
+
+Enablement: `firebird_tpu.ccd.kernel` calls :func:`lasso_cd` when
+FIREBIRD_PALLAS=1 (off by default until benchmarked on hardware; CPU
+tests run the same kernel under ``interpret=True``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from firebird_tpu.ccd import params
+
+BLOCK_P = 512   # pixels per grid step (4 x 128 lanes, f32)
+
+
+def _cd_block(G_ref, c_ref, diag_ref, mask_ref, out_ref, *, iters, alpha,
+              n_coefs):
+    """One pixel block: full CD loop in VMEM.
+
+    G [K,K,Pb], c [B,K,Pb], diag [K,Pb], mask [K,Pb] (0/1) -> b [B,K,Pb].
+    """
+    G = G_ref[...]
+    c = c_ref[...]
+    diag = diag_ref[...]
+    mask = mask_ref[...]
+
+    def one_iter(_, b):
+        for j in range(n_coefs):
+            # rho_j = c_j - sum_k G[j,k] b_k + diag_j b_j   (all [B,Pb])
+            rho = (c[:, j] - jnp.sum(G[j][None, :, :] * b, axis=1)
+                   + diag[j][None, :] * b[:, j])
+            if j == 0:                       # intercept: unpenalized
+                bj = rho / diag[0][None, :]
+            else:
+                bj = (jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - alpha, 0.0)
+                      / diag[j][None, :])
+            bj = jnp.where(mask[j][None, :] > 0, bj, 0.0)
+            b = b.at[:, j].set(bj)
+        return b
+
+    out_ref[...] = lax.fori_loop(0, iters, one_iter, jnp.zeros_like(c))
+
+
+@functools.partial(jax.jit, static_argnames=("iters", "interpret"))
+def lasso_cd(G, c, diag, coefmask, *, iters=params.LASSO_ITERS,
+             interpret=False):
+    """Pallas port of kernel's CD loop (bit-compatible update order).
+
+    Args:
+        G: [P, K, K] normalized Gram matrices.
+        c: [P, B, K] normalized X^T y per band.
+        diag: [P, K] Gram diagonals (pre-floored).
+        coefmask: [P, K] allowed coefficients (bool or 0/1).
+    Returns:
+        b [P, B, K], identical (up to float assoc.) to the lax fori_loop
+        version in kernel._fit_lasso_coefs.
+    """
+    P, B, K = c.shape
+    dt = c.dtype
+    Pp = -BLOCK_P * (-P // BLOCK_P)
+    pad = Pp - P
+
+    # Pixel axis last; pad to the block multiple (diag pads to 1 so the
+    # padded lanes divide harmlessly; mask pads to 0 so they output 0).
+    Gt = jnp.pad(G.transpose(1, 2, 0), ((0, 0), (0, 0), (0, pad)))
+    ct = jnp.pad(c.transpose(1, 2, 0), ((0, 0), (0, 0), (0, pad)))
+    dg = jnp.pad(diag.T, ((0, 0), (0, pad)), constant_values=1.0)
+    mk = jnp.pad(coefmask.T.astype(dt), ((0, 0), (0, pad)))
+
+    kern = functools.partial(_cd_block, iters=iters,
+                             alpha=float(params.LASSO_ALPHA), n_coefs=K)
+    bt = pl.pallas_call(
+        kern,
+        grid=(Pp // BLOCK_P,),
+        in_specs=[
+            pl.BlockSpec((K, K, BLOCK_P), lambda i: (0, 0, i)),
+            pl.BlockSpec((B, K, BLOCK_P), lambda i: (0, 0, i)),
+            pl.BlockSpec((K, BLOCK_P), lambda i: (0, i)),
+            pl.BlockSpec((K, BLOCK_P), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((B, K, BLOCK_P), lambda i: (0, 0, i)),
+        out_shape=jax.ShapeDtypeStruct((B, K, Pp), dt),
+        interpret=interpret,
+    )(Gt, ct, dg, mk)
+    return bt[:, :, :P].transpose(2, 0, 1)
