@@ -101,6 +101,23 @@ def measure(cpu_only: bool) -> None:
     st.nobs.block_until_ready()
     stream_rate = 10000 * sruns / (time.time() - t0)
 
+    # ---- RF inference rate (BASELINE.json config #3) ----
+    # Same 500-tree forest on every platform (randomforest.py:38) so the
+    # number is comparable across bench runs.
+    from firebird_tpu.rf import forest
+
+    rngf = np.random.default_rng(1)
+    Xf = rngf.normal(0, 1, (2000, 33)).astype(np.float32)
+    yf = rngf.integers(1, 9, 2000)
+    model = forest.train(Xf, yf)
+    Xq = rngf.normal(0, 1, (10000, 33)).astype(np.float32)
+    np.asarray(model.raw_predict(Xq))          # compile + warmup
+    rf_runs = 5
+    t0 = time.time()
+    for _ in range(rf_runs):
+        np.asarray(model.raw_predict(Xq))
+    rf_rate = Xq.shape[0] * rf_runs / (time.time() - t0)
+
     baseline_2000_cores = cpu_rate * 2000.0
     out = {
         "metric": "ccdc_pixels_per_sec",
@@ -119,6 +136,7 @@ def measure(cpu_only: bool) -> None:
             "baseline_2000_core_pixels_per_sec": round(baseline_2000_cores, 1),
             "mean_segments": float(np.asarray(seg.n_segments).mean()),
             "streaming_pixels_per_sec": round(stream_rate, 1),
+            "rf_inference_segments_per_sec": round(rf_rate, 1),
         },
     }
     print(json.dumps(out))

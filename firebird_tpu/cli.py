@@ -52,7 +52,14 @@ def entrypoint():
 def changedetection(x, y, acquired, number, chunk_size, resume):
     """Run change detection for a tile and save results to the store."""
     from firebird_tpu.driver import core
+    from firebird_tpu.parallel import init_distributed
 
+    # Multi-host bring-up when the standard env vars are present
+    # (JAX_COORDINATOR_ADDRESS etc.); no-op single-process.  Only this
+    # command shards over hosts (driver host_shard) — classification is
+    # not host-sharded, and initialize() blocks until every process
+    # joins, so it must not run from the group callback.
+    init_distributed()
     return core.changedetection(
         x=x, y=y,
         acquired=acquired or dates.default_acquired(),

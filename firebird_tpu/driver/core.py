@@ -73,16 +73,40 @@ def _pad_batch(packed, target: int):
                        n_obs=rep(packed.n_obs), sensor=packed.sensor), C
 
 
+def host_shard(cids: list) -> list:
+    """This host's slice of a chip-id list under multi-host execution.
+
+    CCDC is embarrassingly parallel over chips, so multi-host scaling is
+    pure data decomposition: after parallel.init_distributed each process
+    takes a strided slice and runs the normal per-host loop against its
+    local devices; the keyed store upserts make the union of all hosts'
+    writes identical to a single-host run (the reference instead scaled by
+    adding Spark executors, README.rst:11 "2000 cores").  Single-process
+    runs return the list unchanged.
+    """
+    import jax
+
+    n = jax.process_count()
+    if n <= 1:
+        return cids
+    i = jax.process_index()
+    logger("change-detection").info(
+        "multi-host: process %d/%d takes %d of %d chips",
+        i, n, len(cids[i::n]), len(cids))
+    return cids[i::n]
+
+
 def detect_batch(packed, dtype, sharding: str = "auto",
                  pad_to: int | None = None):
     """Run the CCD kernel over a packed batch on every local device.
 
     Single device (or sharding='off'): plain jit dispatch.  Multiple local
-    devices in a single process (the normal TPU-VM topology): the chip axis
-    is sharded over a data mesh of the local devices.  Multi-process runs
-    keep the single-device path — a globally sharded batch is a library
-    decision (parallel.mesh.detect_sharded), not something to spring on the
-    driver's per-host loop.
+    devices (the normal TPU-VM topology): the chip axis is sharded over a
+    data mesh of this process's local devices — in multi-host runs each
+    process does the same over its own chips (driver host_shard), so the
+    two data-parallel levels compose: hosts split the tile, local devices
+    split each host's batches.  A single *globally* sharded batch is the
+    library path (parallel.mesh.detect_sharded), not the driver loop.
 
     Batches are padded (repeating the last chip) up to `pad_to` — and to a
     multiple of the device count when sharded — so a chunk's ragged final
@@ -94,7 +118,7 @@ def detect_batch(packed, dtype, sharding: str = "auto",
     from firebird_tpu.ccd import kernel as k
 
     n_dev = jax.local_device_count()
-    use_mesh = sharding != "off" and n_dev > 1 and jax.process_count() == 1
+    use_mesh = sharding != "off" and n_dev > 1
     C = packed.n_chips
     target = max(pad_to or 0, C)
     if use_mesh:
@@ -193,6 +217,7 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
 
     tile = grid.tile(x=x, y=y)
     cids = list(take(number, grid.chips(tile)))
+    cids = host_shard(cids)
     skipped: tuple = ()
     if resume:
         # Key on the segment table: it is written LAST per chip through the

@@ -18,6 +18,8 @@ and jax.distributed handles DCN bring-up (parallel.dist).
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -64,7 +66,10 @@ def shard_packed(packed, mesh: Mesh, dtype):
     from firebird_tpu.ccd.kernel import prep_batch
 
     C = packed.spectra.shape[0]
-    multiproc = jax.process_count() > 1
+    # Cross-host assembly only when the mesh actually spans processes —
+    # a multi-process run may still shard a host-local batch over a mesh
+    # of its own (addressable) devices via plain device_put.
+    multiproc = mesh.devices.size != len(mesh.local_devices)
     n_local = (len(mesh.local_devices) if multiproc else mesh.devices.size)
     if n_local == 0 or C % n_local:
         raise ValueError(
@@ -88,20 +93,50 @@ def detect_sharded(packed, mesh: Mesh, dtype=None):
     """Run the CCD kernel with the chip batch sharded over the mesh.
 
     This is the multi-device production path: same math as
-    kernel.detect_packed, chip axis split across devices, zero collectives.
+    kernel.detect_packed, chip axis split across devices.  The program is
+    a jitted ``jax.shard_map`` over the data axis, which (a) *guarantees*
+    the zero-collective property (any accidental cross-chip dependence
+    would fail to trace rather than silently all-gather), and (b) gives
+    each shard a plain single-device context, so Mosaic custom calls (the
+    Pallas CD kernel, FIREBIRD_PALLAS=1) need no SPMD partitioning rule.
     """
     import jax.numpy as jnp
-    from firebird_tpu.ccd.kernel import _detect_batch_wire, window_cap
+    from firebird_tpu.ccd.kernel import window_cap
 
     dtype = dtype or jnp.float32
-    # wcap is a static trace constant, so every process of an SPMD run must
-    # agree on it even though each only sees its local chip slice:
-    # max-reduce the per-host bound before tracing.
+    # wcap is a static trace constant, so every process of a cross-host
+    # SPMD dispatch must agree on it even though each only sees its local
+    # chip slice: max-reduce the per-host bound before tracing.  Host-local
+    # meshes (the driver's per-host loop) must NOT synchronize here —
+    # hosts run different batch counts and a barrier would deadlock.
     wcap = window_cap(packed)
-    if jax.process_count() > 1:
+    if mesh.devices.size != len(mesh.local_devices):
         from jax.experimental import multihost_utils
         wcap = int(np.max(np.asarray(
             multihost_utils.process_allgather(np.array([wcap])))))
     args = shard_packed(packed, mesh, dtype)
-    return _detect_batch_wire(*args, dtype=jnp.dtype(dtype), wcap=wcap,
-                              sensor=packed.sensor)
+    fn = _sharded_detect_fn(mesh, jnp.dtype(dtype), wcap, packed.sensor)
+    return fn(*args)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor):
+    """The jitted shard_map program, cached per (mesh, dtype, wcap, sensor)
+    — rebuilding the jit wrapper per batch would retrace every dispatch."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec
+    from firebird_tpu.ccd.kernel import _detect_core
+
+    core = functools.partial(_detect_core, wcap=wcap, sensor=sensor)
+
+    def local_batch(Xs, Xts, t, valid, Y_i16, qa_u16):
+        return jax.vmap(core)(Xs, Xts, t, valid, Y_i16.astype(dtype),
+                              qa_u16.astype(jnp.int32))
+
+    spec = PartitionSpec("data")
+    # check_vma=False: the kernel's scan/while carries start from
+    # shard-constant zeros, which the varying-axes checker would demand
+    # explicit pcasts for; the collective-freedom claim is structural
+    # (nothing in _detect_core mentions the mesh axis at all).
+    return jax.jit(jax.shard_map(local_batch, mesh=mesh, in_specs=(spec,) * 6,
+                                 out_specs=spec, check_vma=False))

@@ -64,6 +64,24 @@ def test_rerun_is_idempotent(run_result):
     assert store.count("segment") == before
 
 
+def test_host_shard_partitions_without_overlap(monkeypatch):
+    """Multi-host runs split the chip list disjointly and completely —
+    the union of all hosts' work equals the single-host run."""
+    import jax
+
+    cids = [(i, 0) for i in range(10)]
+    assert core.host_shard(cids) == cids      # single-process: unchanged
+
+    shards = []
+    monkeypatch.setattr(jax, "process_count", lambda: 3)
+    for i in range(3):
+        monkeypatch.setattr(jax, "process_index", lambda i=i: i)
+        shards.append(core.host_shard(cids))
+    flat = [c for s in shards for c in s]
+    assert sorted(flat) == cids               # complete, no overlap
+    assert max(len(s) for s in shards) - min(len(s) for s in shards) <= 1
+
+
 def test_chunk_failure_isolation():
     """A source that explodes on one chunk must not kill the run
     (core.py:115-124 semantics)."""

@@ -85,3 +85,31 @@ def _wire_args(p):
     Xs, Xts, valid = kernel.prep_batch(p)
     return (Xs.astype(np.float64), Xts.astype(np.float64),
             p.dates.astype(np.float64), valid, p.spectra, p.qas)
+
+
+def test_pallas_inside_sharded_detect(monkeypatch):
+    """The sharded production path (shard_map over the mesh) composes with
+    the Pallas CD loop: each shard runs its own single-device Mosaic call,
+    so no SPMD partitioning rule is needed."""
+    from firebird_tpu.ingest import SyntheticSource, pack
+    from firebird_tpu.ingest.packer import PackedChips
+    from firebird_tpu.parallel import make_mesh
+    from firebird_tpu.parallel.mesh import detect_sharded
+
+    src = SyntheticSource(seed=21, start="1995-01-01", end="1998-01-01",
+                          cloud_frac=0.1)
+    p = pack([src.chip(100 + 3000 * i, 200) for i in range(2)], bucket=32)
+    p = PackedChips(cids=p.cids, dates=p.dates,
+                    spectra=p.spectra[:, :, :48, :], qas=p.qas[:, :48, :],
+                    n_obs=p.n_obs, sensor=p.sensor)
+    mesh = make_mesh(n_devices=2)
+    ref = detect_sharded(p, mesh, dtype=jnp.float64)
+    monkeypatch.setenv("FIREBIRD_PALLAS", "1")
+    # fresh trace: a bigger wcap changes the static args, busting the cache
+    monkeypatch.setattr(kernel, "window_cap",
+                        lambda pk, _orig=kernel.window_cap: _orig(pk) + 8)
+    got = detect_sharded(p, mesh, dtype=jnp.float64)
+    np.testing.assert_array_equal(np.asarray(got.n_segments),
+                                  np.asarray(ref.n_segments))
+    np.testing.assert_allclose(np.asarray(got.seg_meta),
+                               np.asarray(ref.seg_meta), atol=1e-9)
