@@ -140,13 +140,13 @@ def chip_frames(packed, chip: int, seg) -> dict[str, dict]:
         segment[f"{p}rmse"] = np.where(real, rmse[:, b], np.nan)
         segment[f"{p}int"] = np.where(real, intercept[:, b], np.nan)
         col = np.empty(R, object)
-        col[:] = coefs7[:, b].tolist()      # one C-level conversion
+        col[:] = list(coefs7[:, b])         # rows stay numpy; backends pack
         col[~real] = None
         segment[f"{p}coef"] = col
 
-    mask = np.asarray(seg.mask, np.int8)[:, :T]
+    mask = np.asarray(seg.mask, np.uint8)[:, :T]
     mask_col = np.empty(P, object)
-    mask_col[:] = mask.tolist()             # one C-level conversion
+    mask_col[:] = list(mask)                # rows stay numpy; backends pack
     dates_col = np.empty(1, object)
     dates_col[0] = dates_iso
     pixel = {

@@ -718,10 +718,21 @@ def prep_batch(packed) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     return Xs, Xts, valid
 
 
+def ensure_x64(dtype) -> None:
+    """Enable jax x64 when a float64 run is requested — without it jnp
+    silently downcasts f64 arrays to f32 and a 'bit-parity run' actually
+    executes at single precision.  Called by every f64-capable entry
+    point (detect_packed, mesh.detect_sharded)."""
+    if jnp.dtype(dtype) == jnp.dtype(jnp.float64) \
+            and not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)
+
+
 def detect_packed(packed, dtype=jnp.float32) -> ChipSegments:
     """Run the kernel over a PackedChips batch -> ChipSegments with leading
     chip axis [C, P, ...].  The batch's sensor spec selects the band
     layout the kernel compiles for."""
+    ensure_x64(dtype)
     Xs, Xts, valid = prep_batch(packed)
     return _detect_batch_wire(
         jnp.asarray(Xs, dtype), jnp.asarray(Xts, dtype),

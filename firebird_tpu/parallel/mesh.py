@@ -53,7 +53,15 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_packed(packed, mesh: Mesh, dtype):
+def spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh includes devices of other processes — the one
+    predicate deciding both cross-host array assembly (shard_packed) and
+    cross-host wcap agreement (detect_sharded); host-local meshes must
+    take neither path or mismatched hosts deadlock."""
+    return mesh.devices.size != len(mesh.local_devices)
+
+
+def shard_packed(packed, mesh: Mesh, dtype, prepped=None):
     """Shard a PackedChips batch over the mesh's chip axis.
 
     Single-process: device_put onto the NamedSharding.  Multi-process
@@ -69,14 +77,14 @@ def shard_packed(packed, mesh: Mesh, dtype):
     # Cross-host assembly only when the mesh actually spans processes —
     # a multi-process run may still shard a host-local batch over a mesh
     # of its own (addressable) devices via plain device_put.
-    multiproc = mesh.devices.size != len(mesh.local_devices)
+    multiproc = spans_processes(mesh)
     n_local = (len(mesh.local_devices) if multiproc else mesh.devices.size)
     if n_local == 0 or C % n_local:
         raise ValueError(
             f"chip batch ({C}) must divide evenly over {n_local} "
             "local devices — pad the batch (static even sharding, no shuffle)")
     sh = chip_sharding(mesh)
-    Xs, Xts, valid = prep_batch(packed)
+    Xs, Xts, valid = prepped if prepped is not None else prep_batch(packed)
     if multiproc:
         put = lambda a, d: jax.make_array_from_process_local_data(
             sh, np.asarray(a, dtype=d))
@@ -101,28 +109,33 @@ def detect_sharded(packed, mesh: Mesh, dtype=None):
     Pallas CD kernel, FIREBIRD_PALLAS=1) need no SPMD partitioning rule.
     """
     import jax.numpy as jnp
-    from firebird_tpu.ccd.kernel import window_cap
+    from firebird_tpu.ccd.kernel import ensure_x64, window_cap
 
     dtype = dtype or jnp.float32
+    ensure_x64(dtype)
     # wcap is a static trace constant, so every process of a cross-host
     # SPMD dispatch must agree on it even though each only sees its local
     # chip slice: max-reduce the per-host bound before tracing.  Host-local
     # meshes (the driver's per-host loop) must NOT synchronize here —
     # hosts run different batch counts and a barrier would deadlock.
     wcap = window_cap(packed)
-    if mesh.devices.size != len(mesh.local_devices):
+    if spans_processes(mesh):
         from jax.experimental import multihost_utils
         wcap = int(np.max(np.asarray(
             multihost_utils.process_allgather(np.array([wcap])))))
     args = shard_packed(packed, mesh, dtype)
-    fn = _sharded_detect_fn(mesh, jnp.dtype(dtype), wcap, packed.sensor)
+    fn = sharded_detect_fn(mesh, jnp.dtype(dtype), wcap, packed.sensor)
     return fn(*args)
 
 
 @functools.lru_cache(maxsize=None)
-def _sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor):
+def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor):
     """The jitted shard_map program, cached per (mesh, dtype, wcap, sensor)
-    — rebuilding the jit wrapper per batch would retrace every dispatch."""
+    — rebuilding the jit wrapper per batch would retrace every dispatch.
+
+    Public two-step API (with shard_packed) for callers that need the
+    transfer and the dispatch separately — the bench times them apart;
+    detect_sharded composes them for everyone else."""
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec
     from firebird_tpu.ccd.kernel import _detect_core

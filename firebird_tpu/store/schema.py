@@ -9,18 +9,29 @@ modules:
                                               (schema.cql:103-142, ccdc/segment.py)
 - tile    (tx, ty, name) -> model, updated    (schema.cql:13-19, ccdc/tile.py)
 
-Array-valued columns (dates, mask, coefficients, rfrawp) are JSON-encoded in
-sqlite and native lists in parquet/memory.
+Column types: INTEGER/REAL/TEXT scalars; JSON for irregular values (ISO
+date lists); and packed-array types for the hot egress columns — BITS
+(uint8, the per-pixel processing mask), F64S (float64 vectors: model
+coefficients, rfrawp), I32S (int32 rasters: product cells).  Packed
+columns are raw little-endian bytes in sqlite/cassandra (the egress path
+is host-bound: JSON-encoding a 10k-pixel chip's masks alone costs
+seconds per chip) and plain lists in parquet/memory; every backend's
+read() returns plain lists either way.
 """
 
 from __future__ import annotations
 
+import numpy as np
+
 from firebird_tpu.ccd.format import BAND_PREFIX
+
+# numpy dtypes of the packed-array column types (little-endian on the wire)
+PACKED_DTYPES = {"BITS": np.uint8, "F64S": "<f8", "I32S": "<i4"}
 
 _SEG_BANDS: list[tuple[str, str]] = []
 for _p in BAND_PREFIX:
     _SEG_BANDS += [(f"{_p}mag", "REAL"), (f"{_p}rmse", "REAL"),
-                   (f"{_p}coef", "JSON"), (f"{_p}int", "REAL")]
+                   (f"{_p}coef", "F64S"), (f"{_p}int", "REAL")]
 
 TABLES: dict[str, dict] = {
     "chip": {
@@ -29,7 +40,7 @@ TABLES: dict[str, dict] = {
     },
     "pixel": {
         "columns": [("cx", "INTEGER"), ("cy", "INTEGER"), ("px", "INTEGER"),
-                    ("py", "INTEGER"), ("mask", "JSON")],
+                    ("py", "INTEGER"), ("mask", "BITS")],
         "key": ("cx", "cy", "px", "py"),
     },
     "segment": {
@@ -37,7 +48,7 @@ TABLES: dict[str, dict] = {
                      ("py", "INTEGER"), ("sday", "TEXT"), ("eday", "TEXT"),
                      ("bday", "TEXT"), ("chprob", "REAL"),
                      ("curqa", "INTEGER")]
-                    + _SEG_BANDS + [("rfrawp", "JSON")]),
+                    + _SEG_BANDS + [("rfrawp", "F64S")]),
         "key": ("cx", "cy", "px", "py", "sday", "eday"),
     },
     "tile": {
@@ -50,7 +61,7 @@ TABLES: dict[str, dict] = {
     # One row per (product, date, chip): row-major [100x100] cell values.
     "product": {
         "columns": [("name", "TEXT"), ("date", "TEXT"), ("cx", "INTEGER"),
-                    ("cy", "INTEGER"), ("cells", "JSON")],
+                    ("cy", "INTEGER"), ("cells", "I32S")],
         "key": ("name", "date", "cx", "cy"),
     },
 }
