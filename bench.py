@@ -177,9 +177,10 @@ def main() -> int:
         env.setdefault("JAX_COMPILATION_CACHE_DIR",
                        os.path.join(here, ".cache", "jax"))
         env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
-        if args:
+        if args and "--small" not in args:
             # CPU fallback: virtual 8-device mesh exercises the sharded
-            # production path and uses the host's cores.
+            # production path; the minimal --small attempt stays truly
+            # minimal (single device).
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                                 + " --xla_force_host_platform_device_count=8"
                                 ).strip()

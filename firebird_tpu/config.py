@@ -75,6 +75,12 @@ class Config:
     # Spark task retry absorbed transient ingest errors).
     fetch_retries: int = 3
 
+    # Async egress worker threads.  1 preserves global write order; more
+    # raise store throughput (parquet/cassandra scale well; sqlite WAL
+    # serializes writers anyway).  Per-chip ordering holds at any setting
+    # (frames are keyed by chip id).
+    writer_threads: int = 1
+
     # When set, the run executes under jax.profiler.trace writing to this
     # directory (the tracing subsystem the reference lacked, SURVEY.md §5).
     profile_dir: str = ""
@@ -123,6 +129,8 @@ class Config:
                                   cls.device_sharding),
             fetch_retries=int(e.get("FIREBIRD_FETCH_RETRIES",
                                     cls.fetch_retries)),
+            writer_threads=int(e.get("FIREBIRD_WRITER_THREADS",
+                                     cls.writer_threads)),
             profile_dir=e.get("FIREBIRD_PROFILE_DIR", cls.profile_dir),
         )
         kw.update(overrides)

@@ -182,8 +182,11 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
             for c in range(n_real):
                 one = kernel.chip_slice(seg, c, to_host=True)
                 frames = ccdformat.chip_frames(packed, c, one)
+                cid = (int(packed.cids[c][0]), int(packed.cids[c][1]))
                 for table in ("chip", "pixel", "segment"):
-                    writer.write(table, frames[table])
+                    # keyed: one chip's frames drain in order, so the
+                    # segment frame lands last (the resume invariant)
+                    writer.write(table, frames[table], key=cid)
                 counters.add("chips")
                 counters.add("pixels", one.n_segments.shape[0])
                 counters.add("segments", int(one.n_segments.sum()))
@@ -213,7 +216,7 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
     source = source or make_source(cfg)
     store = store or open_store(cfg.store_backend, cfg.store_path,
                                 cfg.keyspace())
-    writer = AsyncWriter(store)
+    writer = AsyncWriter(store, workers=cfg.writer_threads)
 
     tile = grid.tile(x=x, y=y)
     cids = list(take(number, grid.chips(tile)))

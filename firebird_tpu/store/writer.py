@@ -2,16 +2,23 @@
 
 The reference throttles spark-cassandra concurrent writes from executors
 (CASSANDRA_OUTPUT_CONCURRENT_WRITES, ccdc/__init__.py:20); here a bounded
-queue + worker thread drains table frames while the TPU crunches the next
+queue + worker pool drains table frames while the TPU crunches the next
 batch.  ``flush()`` blocks until everything queued has landed and raises
 any pending write error (once — the error is cleared so the driver's
 per-chunk isolation can continue with later chunks, ccdc/core.py:115-124
 semantics).  ``close()`` never raises: a terminal error is logged and the
-worker is always shut down.
+workers are always shut down.
+
+Ordering: frames written with the same ``key`` drain through the same
+worker in submission order — the driver keys by chip id so the resume
+invariant holds (the segment frame lands last per chip, driver/core.py).
+Keyless writes round-robin and carry no ordering guarantee beyond a
+single worker.
 """
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 
@@ -21,18 +28,23 @@ log = logger("change-detection")
 
 
 class AsyncWriter:
-    def __init__(self, store, max_queue: int = 16):
+    def __init__(self, store, max_queue: int = 16, workers: int = 1):
         self.store = store
-        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
+        n = max(int(workers), 1)
+        self._qs = [queue.Queue(maxsize=max_queue) for _ in range(n)]
         self._error: Exception | None = None
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        self._rr = itertools.count()
+        self._threads = []
+        for q in self._qs:
+            t = threading.Thread(target=self._run, args=(q,), daemon=True)
+            t.start()
+            self._threads.append(t)
 
-    def _run(self):
+    def _run(self, q: queue.Queue):
         while True:
-            item = self._q.get()
+            item = q.get()
             if item is None:
-                self._q.task_done()
+                q.task_done()
                 return
             table, frame = item
             try:
@@ -44,26 +56,29 @@ class AsyncWriter:
                 self._error = e if isinstance(e, Exception) \
                     else RuntimeError(f"writer interrupted: {e!r}")
             finally:
-                self._q.task_done()
+                q.task_done()
 
     def _pop_error(self) -> Exception | None:
         err, self._error = self._error, None
         return err
 
     def _check_alive(self) -> None:
-        if not self._thread.is_alive():
+        if not all(t.is_alive() for t in self._threads):
             raise RuntimeError("async writer thread is dead")
 
-    def write(self, table: str, frame: dict) -> None:
+    def write(self, table: str, frame: dict, key=None) -> None:
+        """Queue a frame.  Frames sharing ``key`` keep submission order."""
         err = self._pop_error()
         if err is not None:
             raise err
         self._check_alive()
-        self._q.put((table, frame))
+        i = (hash(key) if key is not None else next(self._rr)) % len(self._qs)
+        self._qs[i].put((table, frame))
 
     def flush(self) -> None:
         self._check_alive()
-        self._q.join()
+        for q in self._qs:
+            q.join()
         err = self._pop_error()
         if err is not None:
             raise err
@@ -73,5 +88,7 @@ class AsyncWriter:
             self.flush()
         except Exception as e:
             log.error("async writer closed with pending error: %s", e)
-        self._q.put(None)
-        self._thread.join(timeout=30)
+        for q in self._qs:
+            q.put(None)
+        for t in self._threads:
+            t.join(timeout=30)

@@ -82,6 +82,47 @@ def test_keyspace_isolation(tmp_path):
     assert b.count("tile") == 0
 
 
+def test_async_writer_keyed_ordering():
+    """Frames sharing a key drain in submission order even with many
+    workers — the driver's resume invariant (segment frame last per
+    chip)."""
+    order: dict[tuple, list] = {}
+    lock = __import__("threading").Lock()
+
+    class Recorder(MemoryStore):
+        def write(self, table, frame):
+            k = (frame["cx"][0], frame["cy"][0])
+            with lock:
+                order.setdefault(k, []).append(table)
+            return 1
+
+    w = AsyncWriter(Recorder(), workers=4)
+    for i in range(24):
+        cid = (i, 0)
+        for t in ("chip", "pixel", "segment"):
+            w.write(t, {"cx": [i], "cy": [0]}, key=cid)
+    w.flush()
+    w.close()
+    assert len(order) == 24
+    for seq in order.values():
+        assert seq == ["chip", "pixel", "segment"]
+
+
+def test_async_writer_multiworker_raises_on_error():
+    class Boom(MemoryStore):
+        def write(self, table, frame):
+            raise RuntimeError("disk full")
+
+    w = AsyncWriter(Boom(), workers=3)
+    # the error may surface from write() (if a worker already failed) or
+    # from flush() — both are the contract
+    with pytest.raises(RuntimeError, match="disk full"):
+        for i in range(6):
+            w.write("chip", {"cx": [i], "cy": [0], "dates": [[]]}, key=(i,))
+        w.flush()
+    w.close()
+
+
 def test_async_writer_drains_and_raises(tmp_path):
     store = MemoryStore()
     w = AsyncWriter(store)
