@@ -54,6 +54,12 @@ class Config:
     # "controls parallel requests to chipmunk")
     input_parallelism: int = 1
 
+    # HTTP requests in flight per chip (the 8 logical bands fetched
+    # concurrently).  Total concurrent requests to the raster service is
+    # input_parallelism * band_parallelism; set to 1 to restore a strict
+    # INPUT_PARTITIONS ceiling.
+    band_parallelism: int = 8
+
     # Device batching: chips fitted per device dispatch (replaces
     # PRODUCT_PARTITIONS; sizing is per-device batch, not partition count).
     chips_per_batch: int = 8
@@ -122,6 +128,8 @@ class Config:
             source_backend=e.get("FIREBIRD_SOURCE", cls.source_backend),
             source_path=e.get("FIREBIRD_SOURCE_PATH", cls.source_path),
             input_parallelism=int(e.get("INPUT_PARTITIONS", cls.input_parallelism)),
+            band_parallelism=int(e.get("FIREBIRD_BAND_PARALLELISM",
+                                       cls.band_parallelism)),
             chips_per_batch=int(e.get("FIREBIRD_CHIPS_PER_BATCH", cls.chips_per_batch)),
             max_obs=int(e.get("FIREBIRD_MAX_OBS", cls.max_obs)),
             dtype=e.get("FIREBIRD_DTYPE", cls.dtype),

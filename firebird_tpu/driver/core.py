@@ -43,7 +43,8 @@ def make_source(cfg: Config, kind: str | None = None):
     """Source factory (cfg.source_backend): chipmunk | synthetic | file."""
     kind = kind or cfg.source_backend
     if kind == "chipmunk":
-        return ChipmunkSource(cfg.ard_url)
+        return ChipmunkSource(cfg.ard_url,
+                              band_parallelism=cfg.band_parallelism)
     if kind == "synthetic":
         return SyntheticSource(seed=0)
     if kind == "file":
@@ -54,7 +55,8 @@ def make_source(cfg: Config, kind: str | None = None):
 def make_aux_source(cfg: Config, kind: str | None = None):
     kind = kind or cfg.source_backend
     if kind == "chipmunk":
-        return ChipmunkSource(cfg.aux_url)
+        return ChipmunkSource(cfg.aux_url,
+                              band_parallelism=cfg.band_parallelism)
     return make_source(cfg, kind)
 
 

@@ -227,12 +227,21 @@ class ChipmunkSource:
     """HTTP client for the Chipmunk raster service.
 
     ``http_get`` is injectable (url -> parsed JSON) so tests run without a
-    network, mirroring the reference's function-injection seam.
+    network, mirroring the reference's function-injection seam; it is
+    called from ``band_parallelism`` threads concurrently and MUST be
+    thread-safe.  ``band_parallelism`` fans the 8 logical bands of one
+    chip out over a thread pool — a chip is 32 HTTP requests (8 bands x 4
+    platform ubids), and fetching them serially leaves the request latency
+    unamortized (the reference's INPUT_PARTITIONS only parallelizes across
+    chips); total in-flight requests = input_parallelism x
+    band_parallelism (Config.band_parallelism; 1 restores the strict
+    INPUT_PARTITIONS ceiling).
     """
 
-    def __init__(self, url: str, http_get=None):
+    def __init__(self, url: str, http_get=None, band_parallelism: int = 8):
         self.url = url.rstrip("/")
         self.http_get = http_get or _default_http_get
+        self.band_parallelism = max(int(band_parallelism), 1)
 
     def _chips(self, ubid: str, x: int, y: int, acquired: str) -> list:
         q = urllib.parse.urlencode(
@@ -250,13 +259,18 @@ class ChipmunkSource:
         return series
 
     def chip(self, cx: int, cy: int, acquired: str | None = None) -> ChipData:
+        import concurrent.futures as cf
+
         acquired = acquired or dt.default_acquired()
-        per_band = {}
-        for name in BAND_ORDER:
-            per_band[name] = self._band_series(ARD_UBIDS[name], cx, cy,
-                                               acquired, np.int16)
-        qa_series = self._band_series(ARD_UBIDS["qas"], cx, cy, acquired,
-                                      np.uint16)
+        names = list(BAND_ORDER) + ["qas"]
+        dtypes = {n: np.int16 for n in BAND_ORDER}
+        dtypes["qas"] = np.uint16
+        with cf.ThreadPoolExecutor(self.band_parallelism) as ex:
+            series = dict(zip(names, ex.map(
+                lambda n: self._band_series(ARD_UBIDS[n], cx, cy, acquired,
+                                            dtypes[n]), names)))
+        per_band = {n: series[n] for n in BAND_ORDER}
+        qa_series = series["qas"]
         # Date alignment: keep acquisitions present in every band + QA
         # (merlin's alignment step, SURVEY.md §3.3).
         common = set(qa_series)

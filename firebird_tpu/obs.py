@@ -32,24 +32,55 @@ _configured = False
 _lock = threading.Lock()
 
 
-def configure(level: int = logging.INFO) -> None:
-    """Install the ISO8601 stderr handler once (idempotent)."""
+def configure(level: int | None = None) -> None:
+    """Install the ISO8601 stderr handler once (idempotent).
+
+    Levels mirror the reference's per-subsystem log4j categories
+    (log4j.properties:48-53): FIREBIRD_LOG_LEVEL sets the root, and
+    FIREBIRD_LOG_LEVELS="pyccd=DEBUG,timeseries=WARNING" overrides
+    individual categories.
+    """
+    import os
+
     global _configured
     with _lock:
         if _configured:
             return
-        handler = logging.StreamHandler(sys.stderr)
-        handler.setFormatter(
-            logging.Formatter(
-                fmt="%(asctime)s %(levelname)s %(name)s: %(message)s",
-                datefmt="%Y-%m-%dT%H:%M:%S",
-            )
-        )
         root = logging.getLogger("firebird")
-        root.addHandler(handler)
+        if not root.handlers:      # never stack duplicate handlers
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(
+                logging.Formatter(
+                    fmt="%(asctime)s %(levelname)s %(name)s: %(message)s",
+                    datefmt="%Y-%m-%dT%H:%M:%S",
+                )
+            )
+            root.addHandler(handler)
+        if level is None:
+            level = _parse_level(os.environ.get("FIREBIRD_LOG_LEVEL", "INFO"),
+                                 logging.INFO)
         root.setLevel(level)
         root.propagate = False
+        for spec in os.environ.get("FIREBIRD_LOG_LEVELS", "").split(","):
+            if "=" in spec:
+                name, _, lv = spec.partition("=")
+                logging.getLogger(f"firebird.{name.strip()}").setLevel(
+                    _parse_level(lv, logging.INFO))
         _configured = True
+
+
+def _parse_level(name: str, default: int) -> int:
+    """Level name -> int; log4j's TRACE maps to DEBUG; unknown names fall
+    back to the default with a stderr warning instead of silently lying
+    about (or crashing on) the requested level."""
+    n = name.strip().upper()
+    levels = dict(logging.getLevelNamesMapping())
+    levels["TRACE"] = logging.DEBUG
+    if n in levels:
+        return levels[n]
+    print(f"firebird: unknown log level {name!r}, using "
+          f"{logging.getLevelName(default)}", file=sys.stderr)
+    return default
 
 
 def logger(name: str) -> logging.Logger:
