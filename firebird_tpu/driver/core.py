@@ -135,9 +135,31 @@ def detect_batch(packed, dtype, sharding: str = "auto",
     return detect_sharded(padded, mesh, dtype=dtype), real
 
 
+def drain_batch(seg, packed, n_real, *, writer, counters):
+    """Fetch one batch's results to the host, format, and queue writes
+    (the egress half of ref core.detect, core.py:69-72)."""
+    for c in range(n_real):
+        one = kernel.chip_slice(seg, c, to_host=True)
+        frames = ccdformat.chip_frames(packed, c, one)
+        cid = (int(packed.cids[c][0]), int(packed.cids[c][1]))
+        for table in ("chip", "pixel", "segment"):
+            # keyed: one chip's frames drain in order, so the segment
+            # frame lands last (the resume invariant)
+            writer.write(table, frames[table], key=cid)
+        counters.add("chips")
+        counters.add("pixels", one.n_segments.shape[0])
+        counters.add("segments", int(one.n_segments.sum()))
+
+
 def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
     """Run change detection for one chunk of chip ids (ref core.detect,
-    core.py:53-75): ingest -> pack -> kernel -> chip/pixel/segment writes."""
+    core.py:53-75): ingest -> pack -> kernel -> chip/pixel/segment writes.
+
+    Three-stage pipeline: a prefetch thread fetches batch i+1 while batch
+    i is on the device, and a drain thread fetches/formats batch i-1's
+    results while batch i computes — the main thread only packs and
+    dispatches.  In-flight drains are bounded to two batches of host
+    results."""
     log.info("finding ccd segments for %d chips", len(cids))
     dtype = _DTYPES[cfg.dtype]
     batches = list(partition_all(cfg.chips_per_batch, cids))
@@ -146,13 +168,14 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
     # the padding compute for no compile reuse.
     pad_to = cfg.chips_per_batch if len(batches) > 1 else None
 
-    # Double-buffered ingest: batch i+1 fetches over HTTP while batch i is
-    # on the device.  Two executors — the single prefetch slot must not
-    # steal the chip-level workers (INPUT_PARTITIONS semantics) or a
-    # 1-worker pool would deadlock on the nested map.
+    # Separate single-worker executors: the prefetch slot must not steal
+    # the chip-level workers (INPUT_PARTITIONS semantics) or a 1-worker
+    # pool would deadlock on the nested map; the drain slot keeps one
+    # batch's egress overlapping the next batch's compute.
     with cf.ThreadPoolExecutor(
             max_workers=max(cfg.input_parallelism, 1)) as chips_ex, \
-            cf.ThreadPoolExecutor(max_workers=1) as prefetch_ex:
+            cf.ThreadPoolExecutor(max_workers=1) as prefetch_ex, \
+            cf.ThreadPoolExecutor(max_workers=1) as drain_ex:
 
         def fetch_one(xy):
             # Per-fetch retry with backoff: the reference delegated transient
@@ -174,6 +197,7 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
             return list(chips_ex.map(fetch_one, bids))
 
         nxt = prefetch_ex.submit(fetch_batch, batches[0]) if batches else None
+        drains: list[cf.Future] = []
         for i in range(len(batches)):
             chips = nxt.result()
             nxt = (prefetch_ex.submit(fetch_batch, batches[i + 1])
@@ -181,17 +205,17 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
             packed = pack(chips, bucket=cfg.obs_bucket, max_obs=cfg.max_obs)
             seg, n_real = detect_batch(packed, dtype, cfg.device_sharding,
                                        pad_to=pad_to)
-            for c in range(n_real):
-                one = kernel.chip_slice(seg, c, to_host=True)
-                frames = ccdformat.chip_frames(packed, c, one)
-                cid = (int(packed.cids[c][0]), int(packed.cids[c][1]))
-                for table in ("chip", "pixel", "segment"):
-                    # keyed: one chip's frames drain in order, so the
-                    # segment frame lands last (the resume invariant)
-                    writer.write(table, frames[table], key=cid)
-                counters.add("chips")
-                counters.add("pixels", one.n_segments.shape[0])
-                counters.add("segments", int(one.n_segments.sum()))
+            drains.append(drain_ex.submit(
+                drain_batch, seg, packed, n_real, writer=writer,
+                counters=counters))
+            # Bound live batches to two (the one computing + the one
+            # draining): a deeper queue would pin additional device
+            # result buffers and packed inputs, risking HBM exhaustion
+            # the old inline drain never hit.
+            while len(drains) > 1:
+                drains.pop(0).result()
+        for f in drains:
+            f.result()
     return list(cids)
 
 
