@@ -55,7 +55,10 @@ def _cd_block(G_ref, c_ref, diag_ref, mask_ref, out_ref, *, iters, alpha,
                 bj = (jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - alpha, 0.0)
                       / diag[j][None, :])
             bj = jnp.where(mask[j][None, :] > 0, bj, 0.0)
-            b = b.at[:, j].set(bj)
+            # one-hot select, not b.at[:, j].set: scatter has no Mosaic
+            # lowering, and j is static so a select is exact
+            sel = (jnp.arange(n_coefs) == j)[None, :, None]
+            b = jnp.where(sel, bj[:, None, :], b)
         return b
 
     out_ref[...] = lax.fori_loop(0, iters, one_iter, jnp.zeros_like(c))

@@ -1,0 +1,159 @@
+"""Randomized kernel-vs-oracle fuzz parity.
+
+The deterministic parity tests (test_ccd_kernel.py) cover curated
+scenarios; this harness sweeps adversarial *random* ones — mixed QA bit
+patterns, duplicate acquisition dates, sparse and short archives, multiple
+step changes of varying magnitude, ramps, spikes, range-violating values —
+and asserts the TPU kernel reproduces the NumPy oracle decision-for-
+decision on every generated pixel.  Seeds are fixed, so failures are
+reproducible; any divergence is a real spec mismatch, not noise (both
+sides run float64 with the same Gram/coordinate-descent formulation).
+
+Date grids are sized so their bucketed time axes collide (pack bucket=64),
+keeping the number of distinct XLA compiles at two for the whole sweep.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from firebird_tpu.ccd import detect, kernel, params, synthetic
+from firebird_tpu.ingest import pack, pixel_timeseries
+from firebird_tpu.ingest.packer import PackedChips
+
+QA = {
+    "clear": np.uint16(1 << params.QA_CLEAR_BIT),
+    "water": np.uint16(1 << params.QA_WATER_BIT),
+    "shadow": np.uint16((1 << params.QA_SHADOW_BIT) | (1 << params.QA_CLOUD_BIT)),
+    "snow": np.uint16(1 << params.QA_SNOW_BIT),
+    "cloud": np.uint16(1 << params.QA_CLOUD_BIT),
+    "fill": np.uint16(1 << params.QA_FILL_BIT),
+}
+N_PIXELS = 40
+
+
+def _dates(start, end, cadence, drop, dup_frac, rng):
+    t = synthetic.acquisition_dates(start, end, cadence, rng=rng,
+                                    drop_frac=drop)
+    if dup_frac > 0:
+        dups = t[rng.random(t.shape[0]) < dup_frac]
+        t = np.sort(np.concatenate([t, dups]))
+    return t
+
+
+def _fuzz_pixel(t, rng, special=None):
+    """One adversarial (spectra [7,T], qa [T]) pair."""
+    T = t.shape[0]
+    noise = rng.uniform(10.0, 60.0)
+    slope = rng.uniform(-100.0, 100.0)
+    Y = synthetic.harmonic_series(t, rng, slope_per_year=slope, noise=noise)
+
+    # 0-3 step changes at random interior dates, random band subsets,
+    # deltas spanning sub-threshold to obvious.
+    for _ in range(rng.integers(0, 4)):
+        c = rng.integers(T // 6, 5 * T // 6)
+        delta = rng.uniform(150.0, 1500.0) * rng.choice([-1.0, 1.0])
+        bands = rng.random(7) < rng.uniform(0.4, 1.0)
+        Y[bands, c:] += delta
+
+    # spikes: short transients the Tmask/outlier screens should absorb
+    for _ in range(rng.integers(0, 3)):
+        s = rng.integers(0, T)
+        width = rng.integers(1, 3)
+        Y[:, s:s + width] += rng.choice([-3000.0, 3000.0])
+
+    # QA: per-pixel category mix
+    p_clear = rng.uniform(0.3, 1.0)
+    rest = 1.0 - p_clear
+    probs = np.array([p_clear, 0.1 * rest, 0.35 * rest, 0.2 * rest,
+                      0.25 * rest, 0.1 * rest])
+    cats = rng.choice(["clear", "water", "shadow", "snow", "cloud", "fill"],
+                      size=T, p=probs / probs.sum())
+    if special == "snowy":       # permanent-snow procedure territory
+        cats = rng.choice(["snow", "clear", "fill"], size=T,
+                          p=[0.85, 0.05, 0.10])
+    elif special == "cloudy":    # insufficient-clear territory
+        cats = rng.choice(["cloud", "shadow", "clear"], size=T,
+                          p=[0.6, 0.25, 0.15])
+    elif special == "fill":      # no-data
+        cats = np.full(T, "fill")
+    elif special == "short":     # clear count straddles MEOW_SIZE
+        cats = np.full(T, "cloud")
+        n = params.MEOW_SIZE + int(rng.integers(-2, 3))
+        cats[rng.choice(T, size=min(n, T), replace=False)] = "clear"
+    qa = np.array([QA[c] for c in cats], dtype=np.uint16)
+
+    # range violations on a few clear obs (kernel must drop like oracle)
+    viol = rng.random(T) < 0.02
+    Y[:, viol] = rng.choice([-30000.0, 20000.0])
+    Y[:, cats == "fill"] = params.FILL_VALUE
+    return Y, qa
+
+
+def _pack_pixels(t, Ys, qas, bucket=64):
+    P, T = len(Ys), t.shape[0]
+    Tb = -bucket * (-T // bucket)
+    spectra = np.stack([np.asarray(Y, np.int16) for Y in Ys])
+    spectra = np.pad(spectra.transpose(1, 0, 2)[None],
+                     ((0, 0), (0, 0), (0, 0), (0, Tb - T)),
+                     constant_values=params.FILL_VALUE)
+    qa = np.pad(np.stack(qas)[None], ((0, 0), (0, 0), (0, Tb - T)),
+                constant_values=int(QA["fill"]))
+    return PackedChips(cids=np.zeros((1, 2), np.int64),
+                       dates=np.pad(t[None], ((0, 0), (0, Tb - T))).astype(np.int32),
+                       spectra=spectra, qas=qa,
+                       n_obs=np.array([T], np.int32))
+
+
+GRIDS = [
+    # (start, end, cadence_days, drop_frac, dup_frac, seed) — first three
+    # bucket to T=128, the short one to T=64: two compiles total.
+    ("1995-01-01", "2000-01-01", 16, 0.15, 0.05, 101),
+    ("1999-01-01", "2003-01-01", 12, 0.10, 0.10, 202),
+    ("1990-01-01", "2000-01-01", 16, 0.50, 0.00, 303),
+    ("2000-01-01", "2002-06-01", 16, 0.00, 0.08, 404),
+]
+SPECIALS = {0: "snowy", 1: "cloudy", 2: "fill", 3: "short", 4: "short"}
+
+
+@pytest.mark.parametrize("grid", GRIDS, ids=[g[5] for g in [
+    (*g,) for g in GRIDS]])
+def test_fuzz_structural_parity(grid):
+    start, end, cad, drop, dup, seed = grid
+    rng = np.random.default_rng(seed)
+    t = _dates(start, end, cad, drop, dup, rng)
+    pixels = [_fuzz_pixel(t, rng, special=SPECIALS.get(i))
+              for i in range(N_PIXELS)]
+    p = _pack_pixels(t, [Y for Y, _ in pixels], [q for _, q in pixels])
+    seg = kernel.detect_packed(p, dtype=jnp.float64)
+    import dataclasses
+    seg = kernel.ChipSegments(*[np.asarray(getattr(seg, f.name)[0])
+                                for f in dataclasses.fields(seg)])
+    dates = p.dates[0][: int(p.n_obs[0])]
+
+    for i in range(N_PIXELS):
+        o = detect(**pixel_timeseries(p, 0, i))
+        k = kernel.segments_to_records(seg, dates, i)
+        assert k["procedure"] == o["procedure"], i
+        assert len(o["change_models"]) == len(k["change_models"]), i
+        assert o["processing_mask"] == k["processing_mask"], i
+        for om, km in zip(o["change_models"], k["change_models"]):
+            assert om["start_day"] == km["start_day"], i
+            assert om["end_day"] == km["end_day"], i
+            assert om["break_day"] == km["break_day"], i
+            assert om["curve_qa"] == km["curve_qa"], i
+            assert om["observation_count"] == km["observation_count"], i
+            assert om["change_probability"] == pytest.approx(
+                km["change_probability"], abs=1e-6), i
+        # numeric spot checks on a subset
+        if i % 6:
+            continue
+        for om, km in zip(o["change_models"], k["change_models"]):
+            for band in params.BAND_NAMES:
+                assert km[band]["rmse"] == pytest.approx(
+                    om[band]["rmse"], rel=1e-5, abs=1e-5), i
+                assert km[band]["magnitude"] == pytest.approx(
+                    om[band]["magnitude"], rel=1e-5, abs=1e-5), i
+                for a, b in zip(om[band]["coefficients"],
+                                km[band]["coefficients"]):
+                    assert b == pytest.approx(a, rel=1e-4, abs=1e-3), i
