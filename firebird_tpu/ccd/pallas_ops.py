@@ -56,8 +56,10 @@ def _cd_block(G_ref, c_ref, diag_ref, mask_ref, out_ref, *, iters, alpha,
                       / diag[j][None, :])
             bj = jnp.where(mask[j][None, :] > 0, bj, 0.0)
             # one-hot select, not b.at[:, j].set: scatter has no Mosaic
-            # lowering, and j is static so a select is exact
-            sel = (jnp.arange(n_coefs) == j)[None, :, None]
+            # lowering, and j is static so a select is exact.  The iota
+            # must be >=2D (Mosaic has no 1D iota) and traced (pallas_call
+            # rejects captured array constants).
+            sel = lax.broadcasted_iota(jnp.int32, (1, n_coefs, 1), 1) == j
             b = jnp.where(sel, bj[:, None, :], b)
         return b
 

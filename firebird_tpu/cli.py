@@ -123,5 +123,36 @@ def save(bounds, product_names, product_dates, acquired, clip):
                      clip=clip)
 
 
+@entrypoint.command()
+@click.option("--bounds", "-b", multiple=True, required=True,
+              help="x,y projection point; repeat to extend the area")
+@click.option("--shard", "-s", required=False, default=None,
+              help="i/n: print only the i-th of n strided shards, for "
+                   "splitting a fleet launch across workers")
+def tiles(bounds, shard):
+    """Enumerate tiles covering an area as h,v,ulx,uly,lrx,lry CSV rows.
+
+    Plays the role of the reference's resources/conus.csv + deploy loop
+    (one changedetection job per CSV row): generate the rows for any area,
+    optionally pre-sharded, and feed any point inside each row's tile
+    (e.g. its ulx,uly corner) to `changedetection`."""
+    from firebird_tpu import grid
+
+    recs = grid.tiles_for_bounds(_parse_bounds(bounds))
+    if shard is not None:
+        try:
+            i, n = (int(v) for v in shard.split("/"))
+        except ValueError as e:
+            raise click.BadParameter(
+                "shard must be i/n with 0 <= i < n") from e
+        if not 0 <= i < n:
+            raise click.BadParameter("shard must be i/n with 0 <= i < n")
+        recs = recs[i::n]
+    click.echo("h,v,ulx,uly,lrx,lry")
+    for r in recs:
+        click.echo(f"{r['h']},{r['v']},{r['ulx']:.0f},{r['uly']:.0f},"
+                   f"{r['lrx']:.0f},{r['lry']:.0f}")
+
+
 if __name__ == "__main__":
     entrypoint()

@@ -180,3 +180,32 @@ def classification(x: float, y: float, cfg: GridConfig = CONUS) -> list[tuple[in
     """Chip ids for classification: the single containing tile (ref
     ccdc/grid.py:92-103)."""
     return chips(tile(x, y, cfg))
+
+
+def cells_for_bounds(bounds: list[tuple[float, float]],
+                     g: GridDef) -> list[tuple[int, int]]:
+    """(h, v) cells of grid g covering the bounding box of the points,
+    row-major (north-to-south outer, west-to-east inner)."""
+    assert g.rx == 1.0 and g.ry == -1.0, "only rx=+1, ry=-1 grids supported"
+    xs = [p[0] for p in bounds]
+    ys = [p[1] for p in bounds]
+    h0, v0 = grid_pt(min(xs), max(ys), g)   # upper-left corner
+    h1, v1 = grid_pt(max(xs), min(ys), g)   # lower-right corner
+    return [(h, v) for v in range(v0, v1 + 1) for h in range(h0, h1 + 1)]
+
+
+def tiles_for_bounds(bounds: list[tuple[float, float]],
+                     cfg: GridConfig = CONUS) -> list[dict]:
+    """Tile records covering the bounding box of the given points.
+
+    The reference enumerates its run area as a static tile CSV
+    (resources/conus.csv, header h,v,ulx,uly,lrx,lry) consumed by deploy
+    scripts; here the enumeration is computed from the grid definition for
+    any area.  Returns [{'h','v','ulx','uly','lrx','lry'}, ...] in
+    row-major order (v then h), the same fields as that CSV.
+    """
+    out = []
+    for h, v in cells_for_bounds(bounds, cfg.tile):
+        tx, ty = proj_pt(h, v, cfg.tile)
+        out.append(dict(h=h, v=v, **extents(tx, ty, cfg.tile)))
+    return out

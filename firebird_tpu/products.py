@@ -137,12 +137,8 @@ def covering_chips(bounds: list[tuple[float, float]]) -> list[tuple[int, int]]:
     """Chip ids intersecting the bounding box of the bounds points
     (faq.rst "run a bigger area": several --bounds extend the area)."""
     g = grid.CONUS.chip
-    uls = [grid.snap(x, y)["chip"]["proj-pt"] for x, y in bounds]
-    xs = sorted({u[0] for u in uls})
-    ys = sorted({u[1] for u in uls})
-    cxs = np.arange(xs[0], xs[-1] + 1, g.sx)
-    cys = np.arange(ys[-1], ys[0] - 1, -g.sy)
-    return [(int(cx), int(cy)) for cy in cys for cx in cxs]
+    return [tuple(int(c) for c in grid.proj_pt(h, v, g))
+            for h, v in grid.cells_for_bounds(bounds, g)]
 
 
 def _point_in_poly(px: np.ndarray, py: np.ndarray, poly) -> np.ndarray:

@@ -226,3 +226,26 @@ def test_driver_source_factory():
                       ChipmunkSource)
     with pytest.raises(ValueError):
         core.make_source(Config(source_backend="nope"))
+
+
+def test_cli_tiles_csv_and_sharding():
+    runner = CliRunner()
+    args = ["tiles", "-b", "-543585,2378805", "-b", "-393585,2228805"]
+    r = runner.invoke(cli.entrypoint, args, catch_exceptions=False)
+    assert r.exit_code == 0
+    lines = r.output.strip().splitlines()
+    assert lines[0] == "h,v,ulx,uly,lrx,lry"
+    assert len(lines) == 1 + 4
+    # shards partition the full list
+    rows = set(lines[1:])
+    sharded = []
+    for i in range(3):
+        ri = runner.invoke(cli.entrypoint, args + ["-s", f"{i}/3"],
+                           catch_exceptions=False)
+        assert ri.exit_code == 0
+        sharded.extend(ri.output.strip().splitlines()[1:])
+    assert set(sharded) == rows and len(sharded) == len(rows)
+    # each row's tile center round-trips through grid.tile
+    h, v, ulx, uly, lrx, lry = lines[1].split(",")
+    t = grid.tile((float(ulx) + float(lrx)) / 2, (float(uly) + float(lry)) / 2)
+    assert (t["h"], t["v"]) == (int(h), int(v))

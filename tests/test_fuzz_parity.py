@@ -18,7 +18,7 @@ import pytest
 import jax.numpy as jnp
 
 from firebird_tpu.ccd import detect, kernel, params, synthetic
-from firebird_tpu.ingest import pack, pixel_timeseries
+from firebird_tpu.ingest import pixel_timeseries
 from firebird_tpu.ingest.packer import PackedChips
 
 QA = {
@@ -116,8 +116,7 @@ GRIDS = [
 SPECIALS = {0: "snowy", 1: "cloudy", 2: "fill", 3: "short", 4: "short"}
 
 
-@pytest.mark.parametrize("grid", GRIDS, ids=[g[5] for g in [
-    (*g,) for g in GRIDS]])
+@pytest.mark.parametrize("grid", GRIDS, ids=[str(g[5]) for g in GRIDS])
 def test_fuzz_structural_parity(grid):
     start, end, cad, drop, dup, seed = grid
     rng = np.random.default_rng(seed)

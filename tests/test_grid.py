@@ -100,3 +100,26 @@ def test_classification_is_one_tile():
 def test_coordinates_dtype():
     t = grid.tile(0, 0)
     assert t["chips"].dtype == np.int64
+
+
+def test_tiles_for_bounds_single_point():
+    recs = grid.tiles_for_bounds([(-543585.0, 2378805.0)])
+    assert len(recs) == 1
+    r = recs[0]
+    assert (r["h"], r["v"]) == (13, 6)
+    # extents match the tile record for the same point
+    t = grid.tile(-543585.0, 2378805.0)
+    assert (r["ulx"], r["uly"], r["lrx"], r["lry"]) == (
+        t["ulx"], t["uly"], t["lrx"], t["lry"])
+
+
+def test_tiles_for_bounds_bbox_and_order():
+    # span two tiles in h and two in v -> 4 tiles, row-major v-then-h
+    x0, y0 = -543585.0, 2378805.0
+    recs = grid.tiles_for_bounds([(x0, y0), (x0 + 150000.0, y0 - 150000.0)])
+    assert [(r["h"], r["v"]) for r in recs] == [
+        (13, 6), (14, 6), (13, 7), (14, 7)]
+    # every tile's extents contain no gaps: widths are the tile spacing
+    for r in recs:
+        assert r["lrx"] - r["ulx"] == 150000.0
+        assert r["uly"] - r["lry"] == 150000.0
