@@ -124,6 +124,49 @@ def measure(cpu_only: bool) -> None:
     st.nobs.block_until_ready()
     stream_rate = 10000 * sruns / (time.time() - t0)
 
+    # ---- Sentinel-2 12-band rate (BASELINE.json config #5) ----
+    # One 300x300-px 10 m chip (9x Landsat pixel density, 12 bands, no
+    # thermal); the CPU fallback runs a pixel slice and the minimal
+    # --small attempt skips it, so the ladder's slow attempts stay bounded.
+    s2_detail = {}
+    if not small:
+        from firebird_tpu.ccd.sensor import SENTINEL2
+        from firebird_tpu.ingest.packer import PackedChips
+
+        s2_src = SyntheticSource(seed=11, start="2019-01-01",
+                                 end="2020-01-01" if cpu_only
+                                 else "2021-01-01",
+                                 cloud_frac=0.15, sensor=SENTINEL2)
+        s2 = pack([s2_src.chip(100, 200)], bucket=64)
+        if cpu_only:
+            s2 = PackedChips(cids=s2.cids, dates=s2.dates,
+                             spectra=s2.spectra[:, :, :4096, :],
+                             qas=s2.qas[:, :4096, :], n_obs=s2.n_obs,
+                             sensor=s2.sensor)
+        s2_pixels = s2.spectra.shape[2]
+        # device-resident, same methodology as the Landsat rate above
+        Xs2, Xts2, valid2 = kernel.prep_batch(s2)
+        args2 = (jnp.asarray(Xs2, fdtype), jnp.asarray(Xts2, fdtype),
+                 jnp.asarray(s2.dates, dtype=fdtype), jnp.asarray(valid2),
+                 jnp.asarray(s2.spectra), jnp.asarray(s2.qas))
+        jax.block_until_ready(args2)
+        run2 = functools.partial(kernel._detect_batch_wire, dtype=fdtype,
+                                 wcap=kernel.window_cap(s2),
+                                 sensor=s2.sensor)
+        seg2 = run2(*args2)
+        seg2.n_segments.block_until_ready()       # compile + warmup
+        s2_runs = 1 if cpu_only else 3
+        t0 = time.time()
+        for _ in range(s2_runs):
+            seg2 = run2(*args2)
+            seg2.n_segments.block_until_ready()
+        s2_detail = {
+            "sentinel2_pixels_per_sec":
+                round(s2_pixels * s2_runs / (time.time() - t0), 1),
+            "sentinel2_pixels": int(s2_pixels),
+            "sentinel2_obs_per_pixel": int(s2.n_obs[0]),
+        }
+
     # ---- RF inference rate (BASELINE.json config #3) ----
     # Same 500-tree forest on every platform (randomforest.py:38) so the
     # number is comparable across bench runs.
@@ -160,6 +203,7 @@ def measure(cpu_only: bool) -> None:
             "baseline_2000_core_pixels_per_sec": round(baseline_2000_cores, 1),
             "mean_segments": float(np.asarray(seg.n_segments).mean()),
             "streaming_pixels_per_sec": round(stream_rate, 1),
+            **s2_detail,
             "rf_inference_segments_per_sec": round(rf_rate, 1),
         },
     }
@@ -174,7 +218,7 @@ def main() -> int:
     here = os.path.dirname(os.path.abspath(__file__))
     # Ladder of attempts: accelerator -> CPU 8-device mesh -> minimal CPU
     # single-chip, so a benchmark line is produced even on a slow host.
-    for args, timeout in (([], 900), (["--cpu"], 1800),
+    for args, timeout in (([], 1500), (["--cpu"], 2100),
                           (["--cpu", "--small"], 900)):
         env = dict(os.environ)
         # Persist XLA compiles across bench runs/rounds.
