@@ -53,6 +53,22 @@ def measure(cpu_only: bool) -> None:
     packed = pack(chips, bucket=64)
     n_pixels = packed.n_chips * 10000
 
+    def device_args(pk, prep):
+        Xs, Xts, valid = prep
+        return (jnp.asarray(Xs, fdtype), jnp.asarray(Xts, fdtype),
+                jnp.asarray(pk.dates, dtype=fdtype), jnp.asarray(valid),
+                jnp.asarray(pk.spectra), jnp.asarray(pk.qas))
+
+    def timed_rate(run_fn, run_args, pixels, n_runs):
+        """Steady-state pixels/sec: compile+warmup run, then timed runs."""
+        seg_ = run_fn(*run_args)
+        seg_.n_segments.block_until_ready()
+        t0_ = time.time()
+        for _ in range(n_runs):
+            seg_ = run_fn(*run_args)
+            seg_.n_segments.block_until_ready()
+        return pixels * n_runs / (time.time() - t0_), seg_
+
     # ---- device kernel rate ----
     # Steady-state, device-resident: production keeps the device fed by
     # prefetch (driver/core.py double-buffers ingest), so the kernel rate
@@ -74,12 +90,8 @@ def measure(cpu_only: bool) -> None:
         run_fn = pmesh.sharded_detect_fn(m, jnp.dtype(fdtype), wcap,
                                          packed.sensor)
     else:
-        Xs, Xts, valid = prepped
         t0 = time.time()
-        args = (jnp.asarray(Xs, fdtype), jnp.asarray(Xts, fdtype),
-                jnp.asarray(packed.dates, dtype=fdtype),
-                jnp.asarray(valid), jnp.asarray(packed.spectra),
-                jnp.asarray(packed.qas))
+        args = device_args(packed, prepped)
         jax.block_until_ready(args)
         run_fn = functools.partial(kernel._detect_batch_wire,
                                    dtype=fdtype, wcap=wcap,
@@ -87,13 +99,7 @@ def measure(cpu_only: bool) -> None:
     t_xfer = time.time() - t0
     wire_mb = sum(a.nbytes for a in args) / 1e6
 
-    seg = run_fn(*args)
-    seg.n_segments.block_until_ready()         # compile + warmup
-    t0 = time.time()
-    for _ in range(runs):
-        seg = run_fn(*args)
-        seg.n_segments.block_until_ready()
-    dev_rate = n_pixels * runs / (time.time() - t0)
+    dev_rate, seg = timed_rate(run_fn, args, n_pixels, runs)
     e2e_rate = n_pixels / (n_pixels / dev_rate + t_xfer)
 
     # ---- CPU per-pixel rate (the pyccd stand-in), extrapolated ----
@@ -145,24 +151,15 @@ def measure(cpu_only: bool) -> None:
                              sensor=s2.sensor)
         s2_pixels = s2.spectra.shape[2]
         # device-resident, same methodology as the Landsat rate above
-        Xs2, Xts2, valid2 = kernel.prep_batch(s2)
-        args2 = (jnp.asarray(Xs2, fdtype), jnp.asarray(Xts2, fdtype),
-                 jnp.asarray(s2.dates, dtype=fdtype), jnp.asarray(valid2),
-                 jnp.asarray(s2.spectra), jnp.asarray(s2.qas))
+        args2 = device_args(s2, kernel.prep_batch(s2))
         jax.block_until_ready(args2)
         run2 = functools.partial(kernel._detect_batch_wire, dtype=fdtype,
                                  wcap=kernel.window_cap(s2),
                                  sensor=s2.sensor)
-        seg2 = run2(*args2)
-        seg2.n_segments.block_until_ready()       # compile + warmup
-        s2_runs = 1 if cpu_only else 3
-        t0 = time.time()
-        for _ in range(s2_runs):
-            seg2 = run2(*args2)
-            seg2.n_segments.block_until_ready()
+        s2_rate, _ = timed_rate(run2, args2, s2_pixels,
+                                1 if cpu_only else 3)
         s2_detail = {
-            "sentinel2_pixels_per_sec":
-                round(s2_pixels * s2_runs / (time.time() - t0), 1),
+            "sentinel2_pixels_per_sec": round(s2_rate, 1),
             "sentinel2_pixels": int(s2_pixels),
             "sentinel2_obs_per_pixel": int(s2.n_obs[0]),
         }

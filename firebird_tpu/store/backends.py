@@ -19,10 +19,29 @@ import os
 import re
 import sqlite3
 import threading
+import time
 
 import numpy as np
 
 from firebird_tpu.store import schema
+
+
+def _retry_locked(fn, attempts: int = 240, delay: float = 0.25):
+    """Run fn, retrying while sqlite reports the database locked.
+
+    The WAL-conversion pragma and schema DDL need exclusive access for an
+    instant; when several processes open the same store simultaneously
+    (multi-host runs sharing one sqlite file) the loser gets 'database is
+    locked' immediately rather than waiting on the busy handler.  Setup is
+    the only place this can happen — writes ride the busy timeout.
+    """
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except sqlite3.OperationalError as e:
+            if "locked" not in str(e) or attempt == attempts - 1:
+                raise
+            time.sleep(delay)
 
 
 def _normalize(v):
@@ -150,7 +169,7 @@ class SqliteStore:
             # connection down; each thread still only *uses* its own.
             conn = sqlite3.connect(self.path, timeout=60,
                                    check_same_thread=False)
-            conn.execute("PRAGMA journal_mode=WAL")
+            _retry_locked(lambda: conn.execute("PRAGMA journal_mode=WAL"))
             # WAL + NORMAL is durable to application crash (not OS crash);
             # the durability model is rerun-idempotence (keyed upserts),
             # so trading fsync-per-commit for write throughput is right.
@@ -168,8 +187,9 @@ class SqliteStore:
             cols = ", ".join(
                 f'"{c}" {sql_type(typ)}' for c, typ in spec["columns"])
             pk = ", ".join(spec["key"])
-            con.execute(
-                f'CREATE TABLE IF NOT EXISTS "{t}" ({cols}, PRIMARY KEY ({pk}))')
+            sql = (f'CREATE TABLE IF NOT EXISTS "{t}" '
+                   f'({cols}, PRIMARY KEY ({pk}))')
+            _retry_locked(lambda: con.execute(sql))
         con.commit()
 
     def write(self, table: str, frame: dict) -> int:
