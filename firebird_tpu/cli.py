@@ -124,6 +124,23 @@ def save(bounds, product_names, product_dates, acquired, clip):
 
 
 @entrypoint.command()
+@click.option("--x", "-x", required=True, type=float)
+@click.option("--y", "-y", required=True, type=float)
+@click.option("--acquired", "-a", required=False, default=None)
+@click.option("--number", "-n", required=False, default=2500, type=int)
+def stream(x, y, acquired, number):
+    """Streaming incremental change detection (no reference equivalent —
+    its only mode is full reruns, ccdc/pyccd.py:171-183).  First run per
+    chip bootstraps batch detection and a state checkpoint; later runs
+    apply only new acquisitions and re-test change probability."""
+    from firebird_tpu.driver import stream as sdrv
+    from firebird_tpu.parallel import init_distributed
+
+    init_distributed()
+    return sdrv.stream(x=x, y=y, acquired=acquired, number=number)
+
+
+@entrypoint.command()
 @click.option("--bounds", "-b", multiple=True, required=True,
               help="x,y projection point; repeat to extend the area")
 @click.option("--shard", "-s", required=False, default=None,

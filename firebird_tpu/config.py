@@ -91,6 +91,10 @@ class Config:
     # directory (the tracing subsystem the reference lacked, SURVEY.md §5).
     profile_dir: str = ""
 
+    # Streaming-state checkpoint directory (driver/stream.py); empty means
+    # '<store_path>.stream' next to the store.
+    stream_dir: str = ""
+
     # Framework version (reference: version.txt read in keyspace()).
     version: str = _VERSION
 
@@ -140,6 +144,7 @@ class Config:
             writer_threads=int(e.get("FIREBIRD_WRITER_THREADS",
                                      cls.writer_threads)),
             profile_dir=e.get("FIREBIRD_PROFILE_DIR", cls.profile_dir),
+            stream_dir=e.get("FIREBIRD_STREAM_DIR", cls.stream_dir),
         )
         kw.update(overrides)
         return cls(**kw)

@@ -1,0 +1,117 @@
+"""Streaming driver end-to-end: bootstrap -> checkpoint -> apply -> publish.
+
+A controlled source whose archive contains a step change *after* the
+bootstrap window proves the full operational loop: the first stream() run
+bootstraps batch detection and seeds state; the second applies only the
+new acquisitions, absorbs the pre-change ones (eday advances), confirms
+the break (chprob 1.0 published, pixels flagged for the cold-path batch
+rerun); a third run with the same range is a no-op.
+"""
+
+import glob
+
+import numpy as np
+import pytest
+
+from firebird_tpu.ccd import params, synthetic
+from firebird_tpu.config import Config
+from firebird_tpu.driver import stream as sdrv
+from firebird_tpu.ingest.packer import ChipData
+from firebird_tpu.store import open_store
+from firebird_tpu.utils import dates as dt
+
+
+class StepSource:
+    """One chip whose every pixel steps +800 on all bands at CHANGE_DATE."""
+
+    CHANGE_DATE = "1999-06-01"
+
+    def __init__(self):
+        rng = np.random.default_rng(7)
+        self.t = synthetic.acquisition_dates("1995-01-01", "2001-01-01", 16)
+        T = self.t.shape[0]
+        base = synthetic.harmonic_series(self.t, rng)            # [7, T]
+        noise = rng.normal(0.0, 10.0, (7, T, 100, 100))
+        spectra = base[:, :, None, None] + noise
+        spectra[:, self.t >= dt.to_ordinal(self.CHANGE_DATE)] += 800.0
+        self.spectra = np.clip(spectra, -32768, 32767).astype(np.int16)
+        self.qas = np.full((T, 100, 100), synthetic.QA_CLEAR, np.uint16)
+
+    def chip(self, x, y, acquired):
+        lo, hi = (dt.to_ordinal(s) for s in acquired.split("/"))
+        m = (self.t >= lo) & (self.t <= hi)
+        return ChipData(cx=int(x), cy=int(y), dates=self.t[m],
+                        spectra=self.spectra[:, m], qas=self.qas[m])
+
+
+@pytest.fixture(scope="module")
+def runs(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("stream")
+    cfg = Config(store_backend="sqlite", store_path=str(tmp / "s.db"),
+                 stream_dir=str(tmp / "state"), source_backend="synthetic")
+    src = StepSource()
+    mk_store = lambda: open_store(cfg.store_backend, cfg.store_path,
+                                  cfg.keyspace())
+    s1 = sdrv.stream(100, 200, acquired="1995-01-01/1998-12-31", number=1,
+                     cfg=cfg, source=src, store=mk_store())
+    s2 = sdrv.stream(100, 200, acquired="1995-01-01/2000-12-31", number=1,
+                     cfg=cfg, source=src, store=mk_store())
+    s3 = sdrv.stream(100, 200, acquired="1995-01-01/2000-12-31", number=1,
+                     cfg=cfg, source=src, store=mk_store())
+    return cfg, s1, s2, s3, mk_store()
+
+
+def test_bootstrap_then_update_then_noop(runs):
+    cfg, s1, s2, s3, _ = runs
+    assert s1["bootstrapped"] == 1 and s1["updated"] == 0
+    assert s2["bootstrapped"] == 0 and s2["updated"] == 1
+    # ~46 sixteen-day acquisitions between 1999-01 and 2000-12
+    assert s2["obs_applied"] >= 40
+    # the step change broke every standard pixel
+    assert s2["pixels_need_batch"] >= 9000
+    # same range again: nothing new, flags persist in the checkpoint
+    assert s3["updated"] == 0 and s3["obs_applied"] == 0
+    assert s3["pixels_need_batch"] == s2["pixels_need_batch"]
+    assert glob.glob(f"{cfg.stream_dir}/state_*.npz")
+
+
+def test_published_rows_reflect_stream(runs):
+    _, _, _, _, store = runs
+    seg = store.read("segment")
+    chprob = np.array([v if v is not None else np.nan
+                       for v in seg["chprob"]], float)
+    eday = np.asarray(seg["eday"])
+    bday = np.asarray(seg["bday"])
+    # stream-confirmed breaks published: chprob 1.0 with a 1999 break day
+    broke = chprob == 1.0
+    assert broke.any()
+    years = {d[:4] for d in bday[broke]}
+    assert years == {"1999"}
+    # pre-change 1999 acquisitions were absorbed: eday advanced past the
+    # bootstrap horizon (1998-12-31) on the published tails
+    assert (eday[broke] >= "1999-01-01").all()
+    # the break is dated at the first exceeding acquisition, not later
+    assert (bday[broke] <= "1999-07-01").all()
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from firebird_tpu.ccd import incremental
+
+    P = 5
+    st = incremental.StreamState(
+        coefs=jnp.ones((P, 7, 8)), rmse=jnp.ones((P, 7)),
+        vario=jnp.ones((P, 7)), nobs=jnp.full(P, 3, jnp.int32),
+        n_exceed=jnp.zeros(P, jnp.int32), end_day=jnp.full(P, 7.0),
+        exceed_day0=jnp.zeros(P), break_day=jnp.zeros(P),
+        active=jnp.ones(P, bool))
+    side = dict(sday=np.ones(P), curqa=np.full(P, 24, np.int64),
+                anchor=np.float64(5.0), horizon=np.float64(7.0))
+    path = str(tmp_path / "st.npz")
+    sdrv.save_state(path, st, side)
+    st2, side2 = sdrv.load_state(path)
+    for f in sdrv._STATE_FIELDS:
+        np.testing.assert_array_equal(np.asarray(getattr(st, f)),
+                                      np.asarray(getattr(st2, f)))
+    assert float(side2["horizon"]) == 7.0 and int(side2["curqa"][0]) == 24
