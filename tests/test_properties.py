@@ -1,0 +1,71 @@
+"""Property-based tests (hypothesis) for the pure layers.
+
+SURVEY.md §4 lists "no property-based tests" among the reference's gaps.
+The grid geometry and date utilities are total pure functions over large
+domains — exactly where generative testing earns its keep.  Coordinates
+generate as whole meters (every LCMAP grid/chip coordinate is integral),
+keeping floor-snap properties exact rather than float-boundary flaky.
+"""
+
+from hypothesis import given, strategies as st
+
+from firebird_tpu import grid
+from firebird_tpu.utils import dates as dt
+
+# CONUS Albers projection coordinates span roughly these bounds.
+coords = st.integers(min_value=-2_500_000, max_value=3_500_000)
+
+
+@given(st.integers(-50, 50), st.integers(-50, 50))
+def test_grid_proj_roundtrip(h, v):
+    for g in (grid.CONUS.tile, grid.CONUS.chip):
+        x, y = grid.proj_pt(h, v, g)
+        assert grid.grid_pt(x, y, g) == (h, v)
+
+
+@given(coords, coords)
+def test_snap_idempotent(x, y):
+    s = grid.snap(x, y)
+    for level in ("tile", "chip"):
+        px, py = s[level]["proj-pt"]
+        again = grid.snap(px, py)[level]
+        assert again["grid-pt"] == s[level]["grid-pt"]
+        assert again["proj-pt"] == (px, py)
+
+
+@given(coords, coords)
+def test_point_lands_inside_its_tile(x, y):
+    t = grid.tile(x, y)
+    assert t["ulx"] <= x < t["lrx"]
+    assert t["lry"] < y <= t["uly"]
+
+
+@given(coords, coords)
+def test_tile_chips_partition_the_tile(x, y):
+    """Every tile has exactly 50x50 distinct chips, all inside its extents,
+    snapping back to themselves on the chip grid."""
+    t = grid.tile(x, y)
+    cids = grid.chips(t)
+    assert len(cids) == 2500 and len(set(cids)) == 2500
+    for cx, cy in (cids[0], cids[49], cids[-1]):
+        assert t["ulx"] <= cx < t["lrx"]
+        assert t["lry"] < cy <= t["uly"]
+        assert grid.snap(cx, cy)["chip"]["proj-pt"] == (cx, cy)
+
+
+@given(coords, coords, coords, coords)
+def test_cells_for_bounds_cover_their_points(x0, y0, x1, y1):
+    """Every bound point's tile is in the enumeration, and the enumeration
+    is exactly the covering rectangle (no gaps, no extras)."""
+    recs = grid.tiles_for_bounds([(x0, y0), (x1, y1)])
+    hv = {(r["h"], r["v"]) for r in recs}
+    for px, py in ((x0, y0), (x1, y1)):
+        assert grid.grid_pt(px, py, grid.CONUS.tile) in hv
+    hs = {h for h, _ in hv}
+    vs = {v for _, v in hv}
+    assert len(hv) == len(hs) * len(vs)    # full rectangle
+
+
+@given(st.integers(1, 3_650_000))
+def test_ordinal_iso_roundtrip(o):
+    assert dt.to_ordinal(dt.to_iso(o)) == o
