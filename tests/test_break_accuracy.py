@@ -67,3 +67,23 @@ def test_break_accuracy_across_seeds():
                 for p in range(N_PIX) if changed[p] and nseg[p] >= 2]
         rates.append(np.mean(hits) if hits else 0.0)
     assert min(rates) >= 0.9, rates
+
+
+def test_float32_break_agreement_with_float64():
+    """The production dtype (float32) must reproduce float64's break
+    decisions — BASELINE.md's secondary metric (break-date agreement) on
+    the dtype actually used on device."""
+    agree = total = 0
+    for seed in (4, 5):
+        packed, t, changed = _packed(seed)
+        a = kernel.detect_packed(packed, dtype=jnp.float32)
+        b = kernel.detect_packed(packed, dtype=jnp.float64)
+        na = np.asarray(a.n_segments)[0]
+        nb = np.asarray(b.n_segments)[0]
+        ma = np.asarray(a.seg_meta)[0]
+        mb = np.asarray(b.seg_meta)[0]
+        for p in range(N_PIX):
+            total += 1
+            agree += (na[p] == nb[p]) and np.array_equal(
+                np.round(ma[p, :na[p], 2]), np.round(mb[p, :nb[p], 2]))
+    assert agree / total >= 0.95, (agree, total)
