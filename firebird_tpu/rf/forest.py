@@ -102,12 +102,22 @@ class RandomForest:
 
     # -- inference --
 
-    def raw_predict(self, X: np.ndarray, batch: int = 16384) -> np.ndarray:
+    def raw_predict(self, X: np.ndarray, batch: int = 16384,
+                    dense: bool | None = None) -> np.ndarray:
         """rawPrediction [N, C]: sum over trees of leaf class distributions.
 
         Batches are padded to a fixed size so XLA compiles once.  NaN
         features compare false and route left (deterministic).
+
+        Two equivalent kernels (same decisions; sums differ only by f32
+        accumulation order): accelerators run the dense leaf-reachability
+        form (comparisons + matmul, MXU work); CPU runs the node walk
+        (256x less arithmetic; gathers are cheap there).  ``dense``
+        overrides the platform default.
         """
+        if dense is None:
+            dense = jax.default_backend() != "cpu"
+        kern = _raw_predict_dense if dense else _raw_predict_walk
         X = np.asarray(X, np.float32)
         N = X.shape[0]
         if N == 0:
@@ -122,7 +132,7 @@ class RandomForest:
             if n < batch:
                 xb = np.pad(xb, ((0, batch - n), (0, 0)))
             out[i:i + batch] = np.asarray(
-                _raw_predict(f, t, lp, jnp.asarray(xb), self.depth))[:n]
+                kern(f, t, lp, jnp.asarray(xb), self.depth))[:n]
         return out
 
     def predict(self, X: np.ndarray) -> np.ndarray:
@@ -132,8 +142,9 @@ class RandomForest:
 
 
 @partial(jax.jit, static_argnums=(4,))
-def _raw_predict(feature, threshold, leaf_proba, X, depth):
-    """[T,M] trees x [N,F] samples -> [N,C] summed leaf distributions."""
+def _raw_predict_walk(feature, threshold, leaf_proba, X, depth):
+    """Node-walk inference: depth data-dependent gathers per tree.  The
+    right shape for CPU, where gathers are cheap and arithmetic is not."""
 
     def one_tree(tf, tt, tl):
         node = jnp.zeros(X.shape[0], jnp.int32)
@@ -145,6 +156,54 @@ def _raw_predict(feature, threshold, leaf_proba, X, depth):
         return tl[node]                                     # [N, C]
 
     return jnp.sum(jax.vmap(one_tree)(feature, threshold, leaf_proba), axis=0)
+
+
+@partial(jax.jit, static_argnums=(4,))
+def _raw_predict_dense(feature, threshold, leaf_proba, X, depth):
+    """[T,M] trees x [N,F] samples -> [N,C] summed leaf distributions.
+
+    TPU-shaped: instead of walking each sample down its tree (depth
+    data-dependent gathers per tree — gather-bound, the MXU idle), every
+    node's comparison is evaluated at once ([N, M] from one column
+    gather), leaf reachability is a chain of static broadcast-AND ops
+    (leaf l is reached iff each level-d ancestor's bit equals bit
+    depth-1-d of l), and the leaf lookup becomes a [N, L] x [L, C]
+    matmul — MXU work.  Trees run in vmapped chunks under a scan to
+    bound the [chunk, N, L] intermediates.
+    """
+    T, M = feature.shape
+    L = M + 1
+    N = X.shape[0]
+    C = leaf_proba.shape[2]
+    chunk = 8
+    pad = -T % chunk
+    if pad:
+        # inert trees: all-left thresholds, zero leaf mass
+        feature = jnp.pad(feature, ((0, pad), (0, 0)))
+        threshold = jnp.pad(threshold, ((0, pad), (0, 0)),
+                            constant_values=jnp.inf)
+        leaf_proba = jnp.pad(leaf_proba, ((0, pad), (0, 0), (0, 0)))
+    # direction bit of leaf l at level d (static)
+    dirs = [((jnp.arange(L) >> (depth - 1 - d)) & 1).astype(bool)
+            for d in range(depth)]
+
+    def one_tree(tf, tt, tl):
+        bits = jnp.take(X, tf, axis=1) > tt[None, :]        # [N, M]
+        reached = jnp.ones((N, L), bool)
+        for d in range(depth):
+            lo = (1 << d) - 1
+            bd = bits[:, lo:lo + (1 << d)]                  # level-d nodes
+            reached &= jnp.repeat(bd, L >> d, axis=1) == dirs[d][None, :]
+        return jnp.dot(reached.astype(tl.dtype), tl)        # [N, C]
+
+    def step(acc, args):
+        return acc + jnp.sum(jax.vmap(one_tree)(*args), axis=0), None
+
+    acc, _ = jax.lax.scan(
+        step, jnp.zeros((N, C), leaf_proba.dtype),
+        (feature.reshape(-1, chunk, M), threshold.reshape(-1, chunk, M),
+         leaf_proba.reshape(-1, chunk, L, C)))
+    return acc
 
 
 # ---------------------------------------------------------------------------

@@ -180,3 +180,23 @@ def test_classify_tile_no_features(detected_store):
         meday=dt.to_ordinal("2051-01-01"), acquired=ACQ, cfg=CFG,
         aux_source=src, store=store, n_trees=4, max_depth=3)
     assert model is None
+
+
+def test_dense_inference_matches_walk():
+    """The accelerator (dense leaf-reachability) and CPU (node-walk)
+    inference kernels must agree to f32 accumulation order."""
+    rng = np.random.default_rng(9)
+    X = rng.normal(0, 1, (400, 33)).astype(np.float32)
+    y = rng.integers(1, 9, 400)
+    m = forest.train(X, y, n_trees=48)
+    Xq = rng.normal(0, 1, (600, 33)).astype(np.float32)
+    Xq[0, :5] = np.nan                      # NaN routes left in both
+    a = m.raw_predict(Xq, batch=512, dense=False)
+    b = m.raw_predict(Xq, batch=512, dense=True)
+    np.testing.assert_allclose(a, b, atol=1e-4)
+    # predictions agree wherever the top-2 classes aren't within
+    # accumulation noise of each other (ties may flip either way)
+    top2 = np.sort(a, axis=1)[:, -2:]
+    decided = (top2[:, 1] - top2[:, 0]) > 1e-3
+    assert decided.any()
+    assert (a.argmax(1) == b.argmax(1))[decided].all()
