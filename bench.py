@@ -207,6 +207,25 @@ def measure(cpu_only: bool) -> None:
     print(json.dumps(out))
 
 
+def probe_accelerator(timeout: float = 300.0) -> bool:
+    """Cheap health check before the full accelerator attempt: the tunnel
+    to the chip can hang indefinitely (even jax.devices() blocks), and the
+    full attempt's budget is 25 minutes — a tiny device round-trip under a
+    short timeout decides whether that budget is worth spending."""
+    code = ("import sys, jax, jax.numpy as jnp\n"
+            "d = jax.devices()[0]\n"
+            "if d.platform == 'cpu': sys.exit(1)\n"
+            "x = jnp.ones((128, 128))\n"
+            "(x @ x).block_until_ready()\n"
+            "print('PROBE_OK', d.platform)\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False
+    return r.returncode == 0 and "PROBE_OK" in r.stdout
+
+
 def main() -> int:
     if "--child" in sys.argv:
         measure(cpu_only="--cpu" in sys.argv)
@@ -215,8 +234,12 @@ def main() -> int:
     here = os.path.dirname(os.path.abspath(__file__))
     # Ladder of attempts: accelerator -> CPU 8-device mesh -> minimal CPU
     # single-chip, so a benchmark line is produced even on a slow host.
-    for args, timeout in (([], 1500), (["--cpu"], 2100),
-                          (["--cpu", "--small"], 900)):
+    ladder = [([], 1500), (["--cpu"], 2100), (["--cpu", "--small"], 900)]
+    if not probe_accelerator():
+        print("bench: accelerator probe failed/hung; skipping the "
+              "accelerator attempt", file=sys.stderr)
+        ladder = ladder[1:]
+    for args, timeout in ladder:
         env = dict(os.environ)
         # Persist XLA compiles across bench runs/rounds.
         env.setdefault("JAX_COMPILATION_CACHE_DIR",
