@@ -171,5 +171,26 @@ def tiles(bounds, shard):
                    f"{r['lrx']:.0f},{r['lry']:.0f}")
 
 
+@entrypoint.command()
+@click.option("--keyspace", "-k", required=False, default=None,
+              help="keyspace name; defaults to Config.keyspace() "
+                   "(derived from input URLs + version)")
+@click.option("--replication", "-r", required=False, default=1, type=int)
+def schema(keyspace, replication):
+    """Print the Cassandra DDL for the result tables as CQL.
+
+    The reference ships this as resources/schema.cql and loads it with
+    `make db-schema`; here the statements are generated from the table
+    definitions (store.schema.TABLES) — pipe to cqlsh to load:
+    `firebird schema | cqlsh`."""
+    from firebird_tpu.config import Config
+    from firebird_tpu.store.backends import cassandra_ddl
+
+    if keyspace is None:
+        keyspace = Config.from_env().keyspace()
+    for stmt in cassandra_ddl(keyspace, replication):
+        click.echo(stmt + ";")
+
+
 if __name__ == "__main__":
     entrypoint()

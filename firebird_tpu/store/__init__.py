@@ -19,8 +19,9 @@ writes, ccdc/__init__.py:20-22).
 from firebird_tpu.store.schema import TABLES, primary_key
 from firebird_tpu.store.backends import (CassandraStore, MemoryStore,
                                          ParquetStore, SqliteStore,
-                                         open_store)
+                                         cassandra_ddl, open_store)
 from firebird_tpu.store.writer import AsyncWriter
 
 __all__ = ["TABLES", "primary_key", "CassandraStore", "MemoryStore",
-           "SqliteStore", "ParquetStore", "open_store", "AsyncWriter"]
+           "SqliteStore", "ParquetStore", "cassandra_ddl", "open_store",
+           "AsyncWriter"]

@@ -16,7 +16,6 @@ from __future__ import annotations
 import json
 import math
 import os
-import re
 import sqlite3
 import threading
 import time
@@ -337,6 +336,37 @@ class ParquetStore:
         pass
 
 
+def sanitize_keyspace(keyspace: str) -> str:
+    """A valid unquoted CQL keyspace identifier (cqlstr semantics,
+    ccdc/__init__.py:44; unquoted CQL idents must start with a letter)."""
+    from firebird_tpu.config import _cqlstr
+
+    ks = _cqlstr(keyspace) or "default"
+    return ks if ks[0].isalpha() else f"ks_{ks}"
+
+
+def cassandra_ddl(keyspace: str, replication: int = 1) -> list[str]:
+    """The CQL DDL statements for the result tables — the reference ships
+    these as resources/schema.cql and loads them with `make db-schema`
+    (Makefile:24-39); here the single source of truth is schema.TABLES and
+    this generator (printed by `firebird schema`, executed verbatim by
+    CassandraStore._ensure_schema)."""
+    ks = sanitize_keyspace(keyspace)
+    stmts = [
+        f"CREATE KEYSPACE IF NOT EXISTS {ks} WITH replication"
+        f" = {{'class': 'SimpleStrategy', 'replication_factor': "
+        f"{int(replication)}}}"]
+    for t, spec in schema.TABLES.items():
+        cols = ", ".join(f"{c} {CassandraStore._TYPES[typ]}"
+                         for c, typ in spec["columns"])
+        key = spec["key"]
+        pk = (f"(({key[0]}, {key[1]})"
+              + ("".join(f", {k}" for k in key[2:])) + ")")
+        stmts.append(f"CREATE TABLE IF NOT EXISTS {ks}.{t} "
+                     f"({cols}, PRIMARY KEY {pk})")
+    return stmts
+
+
 class CassandraStore:
     """Store over Apache Cassandra — the reference's production sink.
 
@@ -365,9 +395,7 @@ class CassandraStore:
                  keyspace: str = "default", username: str = "",
                  password: str = "", concurrent_writes: int = 2,
                  replication: int = 1, session=None):
-        ks = re.sub(r"[^a-zA-Z0-9_]", "_", keyspace) or "default"
-        # A leading digit is not a valid unquoted CQL identifier.
-        self.keyspace = ks if not ks[0].isdigit() else f"ks_{ks}"
+        self.keyspace = sanitize_keyspace(keyspace)
         self.concurrent_writes = max(int(concurrent_writes), 1)
         self._replication = int(replication)
         self._cluster = None
@@ -397,19 +425,8 @@ class CassandraStore:
         return session
 
     def _ensure_schema(self):
-        self.session.execute(
-            f"CREATE KEYSPACE IF NOT EXISTS {self.keyspace} WITH replication"
-            f" = {{'class': 'SimpleStrategy', 'replication_factor': "
-            f"{self._replication}}}")
-        for t, spec in schema.TABLES.items():
-            cols = ", ".join(f"{c} {self._TYPES[typ]}"
-                             for c, typ in spec["columns"])
-            key = spec["key"]
-            pk = (f"(({key[0]}, {key[1]})"
-                  + ("".join(f", {k}" for k in key[2:])) + ")")
-            self.session.execute(
-                f"CREATE TABLE IF NOT EXISTS {self.keyspace}.{t} "
-                f"({cols}, PRIMARY KEY {pk})")
+        for stmt in cassandra_ddl(self.keyspace, self._replication):
+            self.session.execute(stmt)
 
     def _prepare(self, table: str):
         if table not in self._prepared:

@@ -230,6 +230,38 @@ def test_cassandra_schema_parity():
     assert "PRIMARY KEY ((cx, cy))" in chip_ddl
 
 
+def test_cassandra_ddl_generator_matches_backend():
+    """`firebird schema` prints exactly what CassandraStore executes (the
+    reference's resources/schema.cql + `make db-schema` path)."""
+    from firebird_tpu.store import cassandra_ddl
+
+    sess = FakeCqlSession()
+    CassandraStore(keyspace="my-ks!", session=sess)
+    assert sess.ddl == cassandra_ddl("my-ks!")
+    assert [d for d in cassandra_ddl("ks") if "CREATE TABLE" in d] \
+        and all(t in " ".join(cassandra_ddl("ks"))
+                for t in ("chip", "pixel", "segment", "tile", "product"))
+    # unquoted CQL identifiers must start with a letter
+    from firebird_tpu.store.backends import sanitize_keyspace
+
+    assert sanitize_keyspace("!prod") == "ks__prod"
+    assert sanitize_keyspace("9lives") == "ks_9lives"
+    assert sanitize_keyspace("") == "default"
+
+
+def test_cli_schema_command():
+    from click.testing import CliRunner
+
+    from firebird_tpu.cli import entrypoint
+
+    res = CliRunner().invoke(entrypoint, ["schema", "-k", "1bad ks!"])
+    assert res.exit_code == 0, res.output
+    assert "CREATE KEYSPACE IF NOT EXISTS ks_1bad_ks_" in res.output
+    for t in ("chip", "pixel", "segment", "tile", "product"):
+        assert f"ks_1bad_ks_.{t} " in res.output
+    assert res.output.rstrip().endswith(";")
+
+
 def test_cassandra_upsert_and_bounded_writes():
     sess = FakeCqlSession()
     store = CassandraStore(keyspace="ks", session=sess, concurrent_writes=2)
