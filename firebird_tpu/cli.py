@@ -172,6 +172,31 @@ def tiles(bounds, shard):
 
 
 @entrypoint.command()
+@click.option("--bounds", "-b", multiple=True, required=True,
+              help="x,y projection point; repeat to extend the area")
+@click.option("--products", "-p", "product_names", multiple=True,
+              required=True, help="product name; repeat for several")
+@click.option("--product_dates", "-d", multiple=True, required=True,
+              help="ISO query date; repeat for several")
+@click.option("--outdir", "-o", required=True,
+              help="directory for the raster files")
+@click.option("--format", "-f", "fmt", default="envi",
+              type=click.Choice(["envi", "npy"]),
+              help="envi: .dat+.hdr (opens in QGIS/GDAL); npy: .npy+.json")
+def export(bounds, product_names, product_dates, outdir, fmt):
+    """Export stored product rasters as georeferenced files.
+
+    Mosaics the per-chip product rows (computed by `firebird save`) over
+    the bounds area into one int32 raster per (product, date) and writes
+    it to --outdir; chips with no stored row fill with -9999."""
+    from firebird_tpu import export as exp
+
+    for p in exp.export(product_names, product_dates,
+                        _parse_bounds(bounds), outdir, fmt=fmt):
+        click.echo(p)
+
+
+@entrypoint.command()
 @click.option("--keyspace", "-k", required=False, default=None,
               help="keyspace name; defaults to Config.keyspace() "
                    "(derived from input URLs + version)")

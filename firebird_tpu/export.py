@@ -1,0 +1,138 @@
+"""Raster export: stored product rows -> georeferenced files on disk.
+
+The reference pipeline ends at its store — users then pull rasters out of
+Cassandra with external tooling.  This module completes that last mile
+natively: mosaic the per-chip product rows (products.save) covering an
+area into one int32 raster and write it as a georeferenced file, GDAL-free:
+
+- ``envi``: raw band-sequential int32 ``.dat`` + ENVI ``.hdr`` with
+  ``map info`` (Albers tie point at the mosaic's UL corner, 30 m pixels)
+  and the grid's WKT as ``coordinate system string`` — opens directly in
+  QGIS/ENVI/GDAL.
+- ``npy``: ``numpy.save`` array + a ``.json`` sidecar carrying the same
+  georeferencing (ulx, uly, pixel size, projection WKT).
+
+Missing chips (no stored product row) fill with FILL_VALUE (-9999), the
+same sentinel ``--clip`` writes outside the clip region.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from firebird_tpu import grid, products
+from firebird_tpu.ccd.params import FILL_VALUE
+from firebird_tpu.config import Config
+from firebird_tpu.ingest.packer import CHIP_SIDE, PIXEL_SIZE_M
+from firebird_tpu.obs import logger
+from firebird_tpu.store import open_store
+
+log = logger("export")
+
+FORMATS = ("envi", "npy")
+
+
+def mosaic(name: str, date: str, bounds, store) -> tuple[np.ndarray, float, float]:
+    """Assemble the stored product chips covering ``bounds`` into one
+    raster.
+
+    Returns ``(cells [H, W] int32, ulx, uly)`` — ulx/uly is the projection
+    coordinate of the raster's upper-left corner (the UL chip's UL pixel
+    corner).  Chips in the area with no stored row are FILL_VALUE.
+    """
+    cids = products.covering_chips(bounds)
+    ulx = min(cx for cx, _ in cids)
+    uly = max(cy for _, cy in cids)
+    chip_m = CHIP_SIDE * PIXEL_SIZE_M
+    W = int((max(cx for cx, _ in cids) - ulx) / chip_m) * CHIP_SIDE + CHIP_SIDE
+    H = int((uly - min(cy for _, cy in cids)) / chip_m) * CHIP_SIDE + CHIP_SIDE
+    out = np.full((H, W), FILL_VALUE, np.int32)
+    missing = 0
+    for cx, cy in cids:
+        rows = store.read("product", {"name": name, "date": date,
+                                      "cx": cx, "cy": cy})
+        if not rows["cells"]:
+            missing += 1
+            continue
+        cells = np.asarray(rows["cells"][0], np.int32).reshape(CHIP_SIDE,
+                                                               CHIP_SIDE)
+        r0 = int((uly - cy) / PIXEL_SIZE_M)
+        c0 = int((cx - ulx) / PIXEL_SIZE_M)
+        out[r0:r0 + CHIP_SIDE, c0:c0 + CHIP_SIDE] = cells
+    if missing:
+        log.warning("mosaic %s@%s: %d of %d chips have no stored product "
+                    "row (run `firebird save` first); filled with %d",
+                    name, date, missing, len(cids), FILL_VALUE)
+    return out, float(ulx), float(uly)
+
+
+def write_envi(base: str, cells: np.ndarray, ulx: float, uly: float,
+               proj: str | None = None) -> list[str]:
+    """``base``.dat (int32 little-endian BSQ) + ``base``.hdr."""
+    proj = proj or grid.CONUS_ALBERS_PROJ
+    dat, hdr = base + ".dat", base + ".hdr"
+    cells.astype("<i4").tofile(dat)
+    H, W = cells.shape
+    # ENVI: data type 3 = int32; tie point (1,1) is the UL pixel's corner.
+    lines = [
+        "ENVI",
+        "description = {firebird_tpu product raster}",
+        f"samples = {W}", f"lines = {H}", "bands = 1",
+        "header offset = 0", "file type = ENVI Standard",
+        "data type = 3", "interleave = bsq", "byte order = 0",
+        f"data ignore value = {FILL_VALUE}",
+        f"map info = {{Albers Conical Equal Area, 1, 1, {ulx:.1f}, "
+        f"{uly:.1f}, {PIXEL_SIZE_M:.1f}, {PIXEL_SIZE_M:.1f}, "
+        "units=Meters}",
+        f"coordinate system string = {{{proj}}}",
+    ]
+    with open(hdr, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return [dat, hdr]
+
+
+def write_npy(base: str, cells: np.ndarray, ulx: float, uly: float,
+              proj: str | None = None) -> list[str]:
+    """``base``.npy + ``base``.json georeferencing sidecar."""
+    npy, meta = base + ".npy", base + ".json"
+    np.save(npy, cells)
+    with open(meta, "w") as f:
+        json.dump({"ulx": ulx, "uly": uly, "pixel_size_m": PIXEL_SIZE_M,
+                   "fill": FILL_VALUE, "crs_wkt": proj
+                   or grid.CONUS_ALBERS_PROJ}, f, indent=1)
+    return [npy, meta]
+
+
+def export(product_names, product_dates, bounds, outdir: str,
+           fmt: str = "envi", cfg: Config | None = None,
+           store=None) -> list[str]:
+    """Export one raster file set per (product, date) over ``bounds``.
+
+    Reads the product table only — run ``products.save`` (or
+    ``firebird save``) first to compute and persist the product rows.
+    Returns the paths written.
+    """
+    if fmt not in FORMATS:
+        raise ValueError(f"unknown format {fmt!r}; available: {FORMATS}")
+    for p in product_names:
+        if p not in products.PRODUCTS:
+            raise ValueError(
+                f"unknown product {p!r}; available: {products.PRODUCTS}")
+    cfg = cfg or Config.from_env()
+    store = store or open_store(cfg.store_backend, cfg.store_path,
+                                cfg.keyspace())
+    os.makedirs(outdir, exist_ok=True)
+    writer = write_envi if fmt == "envi" else write_npy
+    paths: list[str] = []
+    for name in product_names:
+        for d in product_dates:
+            cells, ulx, uly = mosaic(name, d, bounds, store)
+            base = os.path.join(outdir, f"{name}_{d}")
+            wrote = writer(base, cells, ulx, uly)
+            log.info("exported %s@%s -> %s (%dx%d)", name, d, wrote[0],
+                     cells.shape[1], cells.shape[0])
+            paths += wrote
+    return paths

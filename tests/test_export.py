@@ -1,0 +1,100 @@
+"""Raster export: product rows -> georeferenced ENVI / npy mosaics."""
+
+import json
+import os
+
+import numpy as np
+from click.testing import CliRunner
+
+from firebird_tpu import cli, export, grid
+from firebird_tpu.ccd.params import FILL_VALUE
+from firebird_tpu.ingest.packer import CHIP_SIDE, PIXELS
+from firebird_tpu.store import MemoryStore, SqliteStore
+
+# Grid-aligned CONUS chip UL (as tests/test_products.py).
+CX, CY = -585, 5805
+CHIP_M = 3000
+
+
+def put_product(store, name, date, cx, cy, value):
+    cells = np.empty(1, object)
+    cells[0] = np.full(PIXELS, value, np.int32).tolist()
+    store.write("product", {"name": [name], "date": [date],
+                            "cx": [cx], "cy": [cy], "cells": cells})
+
+
+def test_mosaic_places_chips_and_fills_missing():
+    store = MemoryStore()
+    # 2x2 chip area; only 3 chips stored -> the 4th fills with FILL_VALUE
+    put_product(store, "seglength", "2014-01-01", CX, CY, 11)
+    put_product(store, "seglength", "2014-01-01", CX + CHIP_M, CY, 22)
+    put_product(store, "seglength", "2014-01-01", CX, CY - CHIP_M, 33)
+    bounds = [(CX + 10, CY - 10), (CX + 2 * CHIP_M - 10, CY - 2 * CHIP_M + 10)]
+    cells, ulx, uly = export.mosaic("seglength", "2014-01-01", bounds, store)
+    assert (ulx, uly) == (CX, CY)
+    assert cells.shape == (2 * CHIP_SIDE, 2 * CHIP_SIDE)
+    assert np.all(cells[:CHIP_SIDE, :CHIP_SIDE] == 11)
+    assert np.all(cells[:CHIP_SIDE, CHIP_SIDE:] == 22)
+    assert np.all(cells[CHIP_SIDE:, :CHIP_SIDE] == 33)
+    assert np.all(cells[CHIP_SIDE:, CHIP_SIDE:] == FILL_VALUE)
+
+
+def test_export_envi_roundtrip(tmp_path):
+    store = MemoryStore()
+    put_product(store, "curveqa", "2010-06-01", CX, CY, 8)
+    bounds = [(CX + 10, CY - 10)]
+    paths = export.export(["curveqa"], ["2010-06-01"], bounds,
+                          str(tmp_path), fmt="envi", store=store)
+    dat = next(p for p in paths if p.endswith(".dat"))
+    hdr = next(p for p in paths if p.endswith(".hdr"))
+    arr = np.fromfile(dat, "<i4").reshape(CHIP_SIDE, CHIP_SIDE)
+    assert np.all(arr == 8)
+    text = open(hdr).read()
+    assert f"samples = {CHIP_SIDE}" in text and f"lines = {CHIP_SIDE}" in text
+    assert "data type = 3" in text
+    assert f"{float(CX):.1f}" in text and f"{float(CY):.1f}" in text
+    assert "Albers" in text and grid.CONUS_ALBERS_PROJ[:20] in text
+
+
+def test_export_npy_sidecar(tmp_path):
+    store = MemoryStore()
+    put_product(store, "ccd", "2011-01-01", CX, CY, 60)
+    paths = export.export(["ccd"], ["2011-01-01"], [(CX + 10, CY - 10)],
+                          str(tmp_path), fmt="npy", store=store)
+    arr = np.load(next(p for p in paths if p.endswith(".npy")))
+    assert arr.shape == (CHIP_SIDE, CHIP_SIDE) and np.all(arr == 60)
+    meta = json.load(open(next(p for p in paths if p.endswith(".json"))))
+    assert meta["ulx"] == CX and meta["uly"] == CY
+    assert meta["pixel_size_m"] == 30.0 and meta["fill"] == FILL_VALUE
+    assert meta["crs_wkt"].startswith("PROJCS")
+
+
+def test_export_rejects_unknown(tmp_path):
+    store = MemoryStore()
+    for bad in (dict(product_names=["nope"], fmt="envi"),
+                dict(product_names=["ccd"], fmt="tiff")):
+        try:
+            export.export(bad["product_names"], ["2011-01-01"],
+                          [(CX, CY)], str(tmp_path), fmt=bad["fmt"],
+                          store=store)
+            raise AssertionError("expected ValueError")
+        except ValueError:
+            pass
+
+
+def test_cli_export_end_to_end(tmp_path, monkeypatch):
+    db = str(tmp_path / "fb.db")
+    monkeypatch.setenv("FIREBIRD_STORE_BACKEND", "sqlite")
+    monkeypatch.setenv("FIREBIRD_STORE_PATH", db)
+    from firebird_tpu.config import Config
+
+    store = SqliteStore(db, Config.from_env().keyspace())
+    put_product(store, "seglength", "2014-01-01", CX, CY, 5)
+    out = str(tmp_path / "rasters")
+    res = CliRunner().invoke(cli.entrypoint, [
+        "export", "-b", f"{CX + 10},{CY - 10}", "-p", "seglength",
+        "-d", "2014-01-01", "-o", out, "-f", "npy"])
+    assert res.exit_code == 0, res.output
+    npy = os.path.join(out, "seglength_2014-01-01.npy")
+    assert npy in res.output
+    assert np.all(np.load(npy) == 5)

@@ -144,15 +144,22 @@ def test_fuzz_structural_parity(grid):
             assert om["observation_count"] == km["observation_count"], i
             assert om["change_probability"] == pytest.approx(
                 km["change_probability"], abs=1e-6), i
-        # numeric spot checks on a subset
+        # Numeric spot checks on a subset.  Tolerances: the two sides build
+        # bit-identical Gram *terms* but sum them in different orders
+        # (matmul over T vs gathered-window sum), and the fixed-iteration
+        # Lasso CD amplifies that roundoff on ill-conditioned fits — a
+        # 36-grid x 40-pixel sweep measured coef diffs up to ~5e-6 and
+        # magnitude diffs up to ~1e-4 relative (band-scale residual
+        # medians inherit the coef noise).  Derived quantities cannot be
+        # tighter than the coef tolerance below.
         if i % 6:
             continue
         for om, km in zip(o["change_models"], k["change_models"]):
             for band in params.BAND_NAMES:
                 assert km[band]["rmse"] == pytest.approx(
-                    om[band]["rmse"], rel=1e-5, abs=1e-5), i
+                    om[band]["rmse"], rel=2e-4, abs=1e-4), i
                 assert km[band]["magnitude"] == pytest.approx(
-                    om[band]["magnitude"], rel=1e-5, abs=1e-5), i
+                    om[band]["magnitude"], rel=2e-4, abs=1e-4), i
                 for a, b in zip(om[band]["coefficients"],
                                 km[band]["coefficients"]):
                     assert b == pytest.approx(a, rel=1e-4, abs=1e-3), i
