@@ -197,6 +197,34 @@ def export(bounds, product_names, product_dates, outdir, fmt):
 
 
 @entrypoint.command()
+@click.option("--x", "-x", required=False, default=None, type=float)
+@click.option("--y", "-y", required=False, default=None, type=float)
+@click.option("--acquired", "-a", required=False, default=None)
+@click.option("--n_pixels", "-n", required=False, default=100, type=int)
+@click.option("--dtype", required=False, default="float64",
+              type=click.Choice(["float32", "float64"]))
+@click.option("--seed", required=False, default=0, type=int)
+def validate(x, y, acquired, n_pixels, dtype, seed):
+    """Audit kernel-vs-oracle parity on one chip's sampled pixels.
+
+    Runs the accelerator kernel over the chip containing (x, y) (or a
+    default synthetic chip), replays sampled pixels through the float64
+    CPU oracle, and prints a JSON agreement report.  Exits non-zero if
+    structural agreement (procedures, model counts, break/start/end days,
+    masks) is not 100%."""
+    import json as _json
+
+    from firebird_tpu import validate as val
+
+    apply_platform()
+    report = val.validate(x=x, y=y, acquired=acquired, n_pixels=n_pixels,
+                          dtype=dtype, seed=seed)
+    click.echo(_json.dumps(report, indent=1))
+    if not report["structural_agreement"]:
+        raise SystemExit(2)
+
+
+@entrypoint.command()
 @click.option("--keyspace", "-k", required=False, default=None,
               help="keyspace name; defaults to Config.keyspace() "
                    "(derived from input URLs + version)")

@@ -121,6 +121,11 @@ def export(product_names, product_dates, bounds, outdir: str,
         if p not in products.PRODUCTS:
             raise ValueError(
                 f"unknown product {p!r}; available: {products.PRODUCTS}")
+    from firebird_tpu.utils import dates as dt
+
+    for d in product_dates:
+        dt.to_ordinal(d)  # malformed dates fail before any work, and a
+        # non-ISO spelling would never match the stored row keys
     cfg = cfg or Config.from_env()
     store = store or open_store(cfg.store_backend, cfg.store_path,
                                 cfg.keyspace())

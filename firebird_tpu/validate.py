@@ -1,0 +1,141 @@
+"""Kernel-vs-oracle parity audit on live data.
+
+The framework's correctness claim is *bit-identical break dates* against
+the per-pixel CPU reference implementation (BASELINE.md north star).  The
+test suite pins that on fixtures; this module makes it an operational
+check a user can run against any chip — synthetic, file-backed, or a real
+Chipmunk endpoint — and any dtype:
+
+    firebird validate -x 542000 -y 1650000 -n 200 --dtype float64
+
+runs the accelerator kernel over the chip, replays ``n`` sampled pixels
+through the float64 NumPy oracle (the pyccd stand-in,
+firebird_tpu.ccd.reference), and prints a JSON agreement report.  Exit
+status is non-zero when structural agreement (procedures, model counts,
+break/start/end days, processing masks) is not 100%, so the command slots
+into smoke suites as-is.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from firebird_tpu.ccd import detect as oracle_detect
+from firebird_tpu.ccd import kernel
+from firebird_tpu.config import Config
+from firebird_tpu.ingest import pack, pixel_timeseries
+from firebird_tpu.obs import logger
+
+log = logger("pyccd")
+
+STRUCTURAL = ("procedure", "n_models", "break_day", "start_day", "end_day",
+              "processing_mask", "curve_qa", "observation_count")
+
+
+def _rel_err(a: float, b: float) -> float:
+    return abs(a - b) / max(abs(a), abs(b), 1e-9)
+
+
+def validate_chip(packed, n_pixels: int = 100, dtype="float64",
+                  seed: int = 0) -> dict:
+    """Audit one packed chip: kernel at ``dtype`` vs the float64 oracle on
+    ``n_pixels`` sampled pixels.  Returns the report dict."""
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(dtype)
+    seg = kernel.detect_packed(packed, dtype=dtype)
+    one = kernel.chip_slice(seg, 0, to_host=True)
+    dates = packed.dates[0][: int(packed.n_obs[0])]
+
+    P = one.n_segments.shape[0]
+    rng = np.random.default_rng(seed)
+    pix = rng.permutation(P)[: min(n_pixels, P)]
+
+    mismatch = {f: 0 for f in STRUCTURAL}
+    chprob_max = 0.0
+    numeric = {"coefficients": 0.0, "intercept": 0.0, "rmse": 0.0,
+               "magnitude": 0.0}
+    bands_checked = 0
+    for p_ in pix:
+        o = oracle_detect(**pixel_timeseries(packed, 0, int(p_)))
+        k = kernel.segments_to_records(one, dates, int(p_),
+                                       sensor=packed.sensor)
+        if k["procedure"] != o["procedure"]:
+            mismatch["procedure"] += 1
+            continue
+        if k["processing_mask"] != o["processing_mask"]:
+            mismatch["processing_mask"] += 1
+        om_, km_ = o["change_models"], k["change_models"]
+        if len(om_) != len(km_):
+            mismatch["n_models"] += 1
+            continue
+        pixel_bad = set()
+        for om, km in zip(om_, km_):
+            for f in ("break_day", "start_day", "end_day", "curve_qa",
+                      "observation_count"):
+                if om[f] != km[f]:
+                    pixel_bad.add(f)
+            chprob_max = max(chprob_max, abs(om["change_probability"]
+                                             - km["change_probability"]))
+            for name in packed.sensor.band_names:
+                bands_checked += 1
+                numeric["rmse"] = max(numeric["rmse"],
+                                      _rel_err(om[name]["rmse"],
+                                               km[name]["rmse"]))
+                numeric["magnitude"] = max(numeric["magnitude"],
+                                           _rel_err(om[name]["magnitude"],
+                                                    km[name]["magnitude"]))
+                numeric["intercept"] = max(numeric["intercept"],
+                                           _rel_err(om[name]["intercept"],
+                                                    km[name]["intercept"]))
+                for a, b in zip(om[name]["coefficients"],
+                                km[name]["coefficients"]):
+                    numeric["coefficients"] = max(numeric["coefficients"],
+                                                  _rel_err(a, b))
+        for f in pixel_bad:  # count mismatching *pixels*, not models —
+            mismatch[f] += 1  # the agreement ratio denominator is pixels
+
+    n = int(len(pix))
+    structural_ok = not any(mismatch.values())
+    return {
+        "pixels_audited": n,
+        "dtype": str(dtype),
+        "obs_per_pixel": int(packed.n_obs[0]),
+        "structural_agreement": structural_ok,
+        "mismatches": mismatch,
+        "break_day_agreement": (n - mismatch["procedure"]
+                                - mismatch["n_models"]
+                                - mismatch["break_day"]) / max(n, 1),
+        "change_probability_max_abs_err": chprob_max,
+        "numeric_max_rel_err": numeric,
+        "band_segments_checked": bands_checked,
+    }
+
+
+def validate(x=None, y=None, acquired: str | None = None,
+             n_pixels: int = 100, dtype: str = "float64", seed: int = 0,
+             cfg: Config | None = None, source=None) -> dict:
+    """Fetch one chip (the chip containing (x, y), or a default synthetic
+    chip) and audit it.  See :func:`validate_chip`."""
+    from firebird_tpu import grid
+    from firebird_tpu.driver.core import make_source
+    from firebird_tpu.utils import dates as dt
+
+    cfg = cfg or Config.from_env()
+    source = source or make_source(cfg)
+    if (x is None) != (y is None):
+        raise ValueError("validate needs both x and y (or neither, for "
+                         "the default synthetic chip)")
+    if x is None:
+        cx, cy = 100, 200
+    else:
+        cx, cy = (int(v) for v in
+                  grid.snap(float(x), float(y))["chip"]["proj-pt"])
+    acquired = acquired or dt.default_acquired()
+    log.info("validate: chip (%d, %d), %d pixels, dtype %s",
+             cx, cy, n_pixels, dtype)
+    packed = pack([source.chip(cx, cy, acquired)], bucket=cfg.obs_bucket,
+                  max_obs=cfg.max_obs)
+    report = validate_chip(packed, n_pixels=n_pixels, dtype=dtype, seed=seed)
+    report["chip"] = [cx, cy]
+    return report

@@ -71,10 +71,11 @@ def test_export_npy_sidecar(tmp_path):
 
 def test_export_rejects_unknown(tmp_path):
     store = MemoryStore()
-    for bad in (dict(product_names=["nope"], fmt="envi"),
-                dict(product_names=["ccd"], fmt="tiff")):
+    for bad in (dict(products=["nope"], dates=["2011-01-01"], fmt="envi"),
+                dict(products=["ccd"], dates=["2011-01-01"], fmt="tiff"),
+                dict(products=["ccd"], dates=["2011/01/01"], fmt="envi")):
         try:
-            export.export(bad["product_names"], ["2011-01-01"],
+            export.export(bad["products"], bad["dates"],
                           [(CX, CY)], str(tmp_path), fmt=bad["fmt"],
                           store=store)
             raise AssertionError("expected ValueError")
