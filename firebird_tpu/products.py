@@ -18,6 +18,10 @@ definitions and pinned here:
 - ``ccd``: day-of-year (1..366) of a confirmed change (``chprob >= 1``)
   whose break day falls in the same calendar year as D, else 0.
 - ``curveqa``: the ``curqa`` flag of the segment containing D, else 0.
+- ``cover`` (beyond the reference list): the predicted land-cover label of
+  the segment containing D — the stored ``rfrawp`` vote vector's argmax
+  mapped through the tile model's class order; 0 when the segment was
+  never classified or no model is stored for the tile.
 
 Run modes (faq.rst examples): every chip intersecting the bounding box of
 the ``bounds`` points is produced; ``clip`` masks pixels outside the
@@ -42,7 +46,7 @@ from firebird_tpu.utils import dates as dt
 
 log = logger("products")
 
-PRODUCTS = ("seglength", "ccd", "curveqa")
+PRODUCTS = ("seglength", "ccd", "curveqa", "cover")
 
 
 def available() -> tuple[str, ...]:
@@ -79,11 +83,17 @@ class ChipSegmentArrays:
                                 for v in seg["chprob"]])
         self.curqa = np.array([0 if v is None else int(v)
                                for v in seg["curqa"]], np.int32)
+        # argmax class index of each row's rfrawp vote vector (-1 when the
+        # segment was never classified) — the cover product's input
+        raw = seg.get("rfrawp") or [None] * len(seg["sday"])
+        self.rfidx = np.array([int(np.argmax(v)) if v else -1
+                               for v in raw], np.int64)
         self.real = self.sday > 1
 
 
 def chip_product(name: str, date_ord: int, cx: int, cy: int,
-                 seg: dict | ChipSegmentArrays) -> np.ndarray:
+                 seg: dict | ChipSegmentArrays,
+                 classes: np.ndarray | None = None) -> np.ndarray:
     """One product raster for one chip.
 
     ``seg`` is the segment-table frame for the chip (dict of columns, as
@@ -92,6 +102,11 @@ def chip_product(name: str, date_ord: int, cx: int, cy: int,
     in the packer's row-major pixel order.  Sentinel rows (sday ==
     0001-01-01, ccdc/pyccd.py:99-103) contribute nothing: their ordinals
     (1) never contain or precede a real query date with chprob/curqa set.
+
+    ``cover`` (the predicted land-cover label of the segment containing D,
+    from the stored rfrawp vote vectors) additionally needs ``classes`` —
+    the trained model's label order (forest.RandomForest.classes) that
+    maps vote argmax to the original label values.
     """
     if name not in PRODUCTS:
         raise ValueError(f"unknown product {name!r}; available: {PRODUCTS}")
@@ -101,6 +116,22 @@ def chip_product(name: str, date_ord: int, cx: int, cy: int,
     if a.pix.size == 0:
         return out
     contains = a.real & (a.sday <= date_ord) & (date_ord <= a.eday)
+
+    if name == "cover":
+        if classes is None:
+            raise ValueError("the cover product needs the trained model's "
+                             "class order (classes=)")
+        classes = np.asarray(classes)
+        stale = contains & (a.rfidx >= classes.shape[0])
+        if np.any(stale):
+            log.warning(
+                "cover chip (%d, %d): %d segments hold vote vectors longer "
+                "than the stored model's %d classes (stale rfrawp vs a "
+                "retrained model?) — emitted as 0", cx, cy,
+                int(np.sum(stale)), classes.shape[0])
+        hit = contains & (a.rfidx >= 0) & (a.rfidx < classes.shape[0])
+        out[a.pix[hit]] = classes[a.rfidx[hit]].astype(np.int32)
+        return out
 
     if name == "seglength":
         # Most recent confirmed break at or before D, per pixel.
@@ -227,6 +258,25 @@ def save(bounds, products, product_dates, acquired: str | None = None,
             finally:
                 writer.close()
 
+    # The cover product maps stored rfrawp votes through the trained
+    # model's class order; models are persisted per tile (tile table), so
+    # cache one lookup per tile across the chip loop.
+    model_classes: dict[tuple[int, int], np.ndarray | None] = {}
+
+    def classes_for(cx: int, cy: int) -> np.ndarray | None:
+        t = grid.tile(cx, cy)
+        key = (int(t["x"]), int(t["y"]))
+        if key not in model_classes:
+            from firebird_tpu.rf import pipeline as rf_pipeline
+
+            m = rf_pipeline.load_model(store, key[0], key[1])
+            model_classes[key] = None if m is None else m.classes
+            if m is None:
+                log.warning("cover: no trained model stored for tile "
+                            "(%d, %d); its chips are skipped — run "
+                            "`firebird classification` first", *key)
+        return model_classes[key]
+
     written = []
     for cx, cy in cids:
         seg = store.read("segment", {"cx": cx, "cy": cy})
@@ -237,8 +287,12 @@ def save(bounds, products, product_dates, acquired: str | None = None,
         keep = clip_mask(cx, cy, bounds) if clip else None
         arrays = ChipSegmentArrays(cx, cy, seg)
         for name in products:
+            classes = classes_for(cx, cy) if name == "cover" else None
+            if name == "cover" and classes is None:
+                continue
             for d in product_dates:
-                vals = chip_product(name, date_ords[d], cx, cy, arrays)
+                vals = chip_product(name, date_ords[d], cx, cy, arrays,
+                                    classes=classes)
                 if keep is not None:
                     vals = np.where(keep, vals, FILL_VALUE).astype(np.int32)
                 cells = np.empty(1, object)

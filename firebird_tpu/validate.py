@@ -26,7 +26,7 @@ from firebird_tpu.config import Config
 from firebird_tpu.ingest import pack, pixel_timeseries
 from firebird_tpu.obs import logger
 
-log = logger("pyccd")
+log = logger("validate")
 
 STRUCTURAL = ("procedure", "n_models", "break_day", "start_day", "end_day",
               "processing_mask", "curve_qa", "observation_count")
@@ -42,6 +42,9 @@ def validate_chip(packed, n_pixels: int = 100, dtype="float64",
     ``n_pixels`` sampled pixels.  Returns the report dict."""
     import jax.numpy as jnp
 
+    if n_pixels <= 0:
+        raise ValueError("n_pixels must be positive — auditing zero pixels "
+                         "would report vacuous agreement")
     dtype = jnp.dtype(dtype)
     seg = kernel.detect_packed(packed, dtype=dtype)
     one = kernel.chip_slice(seg, 0, to_host=True)
@@ -122,10 +125,17 @@ def validate(x=None, y=None, acquired: str | None = None,
     from firebird_tpu.utils import dates as dt
 
     cfg = cfg or Config.from_env()
-    source = source or make_source(cfg)
     if (x is None) != (y is None):
         raise ValueError("validate needs both x and y (or neither, for "
                          "the default synthetic chip)")
+    if x is None and source is None:
+        # No location given: audit the documented default *synthetic* chip
+        # regardless of the configured source — chip (100, 200) is not a
+        # grid-aligned id a real endpoint could serve.
+        from firebird_tpu.ingest import SyntheticSource
+
+        source = SyntheticSource(seed=0)
+    source = source or make_source(cfg)
     if x is None:
         cx, cy = 100, 200
     else:

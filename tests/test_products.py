@@ -86,6 +86,59 @@ def test_curveqa_of_containing_segment():
         "curveqa", dt.to_ordinal("2005-03-01"), CX, CY, seg)[0] == 0
 
 
+def test_cover_product_maps_votes_through_classes():
+    seg = frame([
+        (CX, CY, "2000-01-01", "2010-01-01", "2010-01-01", 0.4, 8),
+        (CX + 30, CY, "2000-01-01", "2010-01-01", "2010-01-01", 0.4, 8),
+        (CX + 60, CY, "0001-01-01", "0001-01-01", "0001-01-01", None, None),
+    ])
+    # pixel 0 classified (argmax -> index 2), pixel 1 never classified
+    seg["rfrawp"] = [[1.0, 3.0, 7.0], None, None]
+    D = dt.to_ordinal("2005-01-01")
+    out = products.chip_product("cover", D, CX, CY, seg,
+                                classes=np.array([4, 6, 9]))
+    assert out[0] == 9
+    assert out[1] == 0 and out[2] == 0
+    with pytest.raises(ValueError, match="class order"):
+        products.chip_product("cover", D, CX, CY, seg)
+
+
+def test_save_cover_end_to_end():
+    from firebird_tpu import grid
+    from firebird_tpu.rf import forest
+    from firebird_tpu.rf.pipeline import save_model
+
+    store = MemoryStore()
+    rng = np.random.default_rng(0)
+    model = forest.train(rng.normal(0, 1, (60, 33)).astype(np.float32),
+                         rng.integers(1, 4, 60), n_trees=5, max_depth=3)
+    t = grid.tile(CX, CY)
+    save_model(store, t["x"], t["y"], model)
+    f = frame([(CX, CY, "2000-01-01", "2010-01-01", "2010-01-01", 0.4, 8)])
+    votes = np.zeros(model.n_classes)
+    votes[-1] = 1.0                      # argmax -> last class
+    f["rfrawp"] = [votes.tolist()]
+    f["cx"], f["cy"] = [CX], [CY]
+    store.write("segment", f)
+    written = products.save([(CX + 10, CY - 10)], ["cover"], ["2005-06-01"],
+                            store=store)
+    assert written == [("cover", "2005-06-01", CX, CY)]
+    cells = store.read("product", {"name": "cover"})["cells"][0]
+    assert cells[0] == int(model.classes[-1])
+    assert sum(cells) == int(model.classes[-1])
+
+
+def test_save_cover_without_model_skips_chip():
+    store = MemoryStore()
+    f = frame([(CX, CY, "2000-01-01", "2010-01-01", "2010-01-01", 0.4, 8)])
+    f["cx"], f["cy"] = [CX], [CY]
+    store.write("segment", f)
+    written = products.save([(CX + 10, CY - 10)], ["cover", "curveqa"],
+                            ["2005-06-01"], store=store)
+    # cover skipped (no trained model stored), curveqa still written
+    assert written == [("curveqa", "2005-06-01", CX, CY)]
+
+
 def test_unknown_product_rejected():
     with pytest.raises(ValueError, match="unknown product"):
         products.chip_product("bogus", 1, CX, CY, frame([]))
