@@ -20,10 +20,10 @@ from __future__ import annotations
 
 import numpy as np
 
-from firebird_tpu.ccd import detect as oracle_detect
 from firebird_tpu.ccd import kernel
+from firebird_tpu.ccd.reference import detect_sensor
 from firebird_tpu.config import Config
-from firebird_tpu.ingest import pack, pixel_timeseries
+from firebird_tpu.ingest import pack
 from firebird_tpu.obs import logger
 
 log = logger("validate")
@@ -59,8 +59,11 @@ def validate_chip(packed, n_pixels: int = 100, dtype="float64",
     numeric = {"coefficients": 0.0, "intercept": 0.0, "rmse": 0.0,
                "magnitude": 0.0}
     bands_checked = 0
+    T = int(packed.n_obs[0])
     for p_ in pix:
-        o = oracle_detect(**pixel_timeseries(packed, 0, int(p_)))
+        # the sensor-generic oracle, so non-Landsat sources audit too
+        o = detect_sensor(dates, packed.spectra[0, :, int(p_), :T],
+                          packed.qas[0, int(p_), :T], packed.sensor)
         k = kernel.segments_to_records(one, dates, int(p_),
                                        sensor=packed.sensor)
         if k["procedure"] != o["procedure"]:

@@ -25,10 +25,7 @@ from firebird_tpu.ingest.packer import PackedChips
 
 def _unwrap_chip(seg):
     """Batched device ChipSegments -> chip 0 as host arrays."""
-    import dataclasses
-
-    return kernel.ChipSegments(*[np.asarray(getattr(seg, f.name)[0])
-                                 for f in dataclasses.fields(seg)])
+    return kernel.chip_slice(seg, 0, to_host=True)
 
 
 def _assert_structural(o, k, i):

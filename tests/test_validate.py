@@ -56,6 +56,23 @@ def test_validate_detects_divergence(monkeypatch):
     assert rep["mismatches"]["n_models"] > 0
 
 
+def test_validate_chip_sentinel2():
+    """The audit is sensor-generic: a 12-band S2 chip replays through the
+    sensor-generic oracle, not the Landsat keyword API."""
+    from firebird_tpu.ccd.sensor import SENTINEL2
+    from test_fuzz_parity import SPECIALS, _dates, _fuzz_pixel, _pack_pixels
+
+    rng = np.random.default_rng(4)
+    t = _dates("2019-01-01", "2021-01-01", 10, 0.1, 0.0, rng)
+    pixels = [_fuzz_pixel(t, rng, special=SPECIALS.get(i), sensor=SENTINEL2)
+              for i in range(16)]
+    p = _pack_pixels(t, [Y for Y, _ in pixels], [q for _, q in pixels],
+                     bucket=32, sensor=SENTINEL2)
+    rep = validate.validate_chip(p, n_pixels=12, dtype="float64")
+    assert rep["structural_agreement"], rep["mismatches"]
+    assert rep["break_day_agreement"] == 1.0
+
+
 def test_validate_rejects_single_coordinate(monkeypatch):
     monkeypatch.setenv("FIREBIRD_SOURCE", "synthetic")
     try:
