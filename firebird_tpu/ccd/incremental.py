@@ -40,8 +40,7 @@ import numpy as np
 
 from firebird_tpu.ccd import harmonic, params
 from firebird_tpu.ccd.kernel import ChipSegments
-
-_DET = list(params.DETECTION_BANDS)
+from firebird_tpu.ccd.sensor import LANDSAT_ARD, chi2_thresholds
 
 
 @dataclasses.dataclass
@@ -53,9 +52,9 @@ class StreamState:
     model whose change probability can be extended incrementally.
     """
 
-    coefs: jnp.ndarray      # [.., P, 7, 8] internal-convention coefficients
-    rmse: jnp.ndarray       # [.., P, 7]
-    vario: jnp.ndarray      # [.., P, 7]
+    coefs: jnp.ndarray      # [.., P, B, 8] internal-convention coefficients
+    rmse: jnp.ndarray       # [.., P, B]
+    vario: jnp.ndarray      # [.., P, B]
     nobs: jnp.ndarray       # [.., P] int32 obs in the open segment
     n_exceed: jnp.ndarray   # [.., P] int32 trailing consecutive exceeding
     end_day: jnp.ndarray    # [.., P] float32 ordinal of last absorbed obs
@@ -115,16 +114,19 @@ def design_row(t_new: float, anchor: float, dtype=np.float32) -> np.ndarray:
         np.array([t_new]), anchor, params.MAX_COEFS)[0].astype(dtype)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def step(state: StreamState, x_row, y_new, qa_new, t_new) -> StreamState:
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("sensor",))
+def step(state: StreamState, x_row, y_new, qa_new, t_new, *,
+         sensor=LANDSAT_ARD) -> StreamState:
     """Advance every pixel's open segment by one acquisition.
 
     Args:
         state: StreamState [P, ...] (donated; the update happens in place).
         x_row: [8] design row for t_new (design_row()).
-        y_new: [P, 7] new spectral values (same band order as the kernel).
+        y_new: [P, B] new spectral values (same band order as the kernel).
         qa_new: [P] int32 bit-packed QA.
         t_new: scalar ordinal day (float).
+        sensor: static band layout — detection/range roles and the chi2
+            threshold's dof, as in the batch kernel.
 
     Returns the updated StreamState.  Tail rules mirror the batch kernel's
     monitor fast-forward (kernel.py): clear+in-range obs only; score =
@@ -133,16 +135,22 @@ def step(state: StreamState, x_row, y_new, qa_new, t_new) -> StreamState:
     confirm a break dated at the run's first exceeding day); anything else
     absorbs and resets the run.
     """
+    _DET = list(sensor.detection_bands)
+    CHANGE_THRESHOLD, _ = chi2_thresholds(len(_DET))
     fd = state.rmse.dtype
     y = y_new.astype(fd)
     t = jnp.asarray(t_new, fd)
     fill = (qa_new >> params.QA_FILL_BIT) & 1 == 1
     clear = (((qa_new >> params.QA_CLEAR_BIT) & 1 == 1)
              | ((qa_new >> params.QA_WATER_BIT) & 1 == 1)) & ~fill
-    opt_ok = jnp.all((y[:, :6] > params.OPTICAL_MIN)
-                     & (y[:, :6] < params.OPTICAL_MAX), axis=1)
-    th_ok = (y[:, 6] > params.THERMAL_MIN) & (y[:, 6] < params.THERMAL_MAX)
-    usable = clear & opt_ok & th_ok & state.active & ~state.needs_batch
+    opt = list(sensor.optical_bands)
+    rng_ok = jnp.all((y[:, opt] > params.OPTICAL_MIN)
+                     & (y[:, opt] < params.OPTICAL_MAX), axis=1)
+    if sensor.thermal_bands:
+        th = list(sensor.thermal_bands)
+        rng_ok &= jnp.all((y[:, th] > params.THERMAL_MIN)
+                          & (y[:, th] < params.THERMAL_MAX), axis=1)
+    usable = clear & rng_ok & state.active & ~state.needs_batch
 
     pred = jnp.einsum("pbc,c->pb", state.coefs, x_row.astype(fd))
     resid = y - pred
@@ -152,7 +160,7 @@ def step(state: StreamState, x_row, y_new, qa_new, t_new) -> StreamState:
     # Batch tail semantics: any score above CHANGE_THRESHOLD (including the
     # far outlier tail) counts toward the exceed run; everything else is
     # absorbed and resets the run.
-    exceed = usable & (s > params.CHANGE_THRESHOLD)
+    exceed = usable & (s > CHANGE_THRESHOLD)
     absorb = usable & ~exceed
 
     n_exceed = jnp.where(exceed, state.n_exceed + 1,

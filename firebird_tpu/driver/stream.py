@@ -228,7 +228,8 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                         qa_new = jnp.asarray(
                             p.qas[0, :, ti].astype(np.int32))
                         st = incremental.step(st, x_row, y_new, qa_new,
-                                              float(t[ti]))
+                                              float(t[ti]),
+                                              sensor=p.sensor)
                     if new_idx.size:
                         side = dict(side, horizon=np.float64(t[-1]))
                         writer.write("segment", publish_frame(p, st, side),

@@ -48,7 +48,8 @@ def validate_chip(packed, n_pixels: int = 100, dtype="float64",
     dtype = jnp.dtype(dtype)
     seg = kernel.detect_packed(packed, dtype=dtype)
     one = kernel.chip_slice(seg, 0, to_host=True)
-    dates = packed.dates[0][: int(packed.n_obs[0])]
+    T = int(packed.n_obs[0])
+    dates = packed.dates[0][:T]
 
     P = one.n_segments.shape[0]
     rng = np.random.default_rng(seed)
@@ -59,7 +60,6 @@ def validate_chip(packed, n_pixels: int = 100, dtype="float64",
     numeric = {"coefficients": 0.0, "intercept": 0.0, "rmse": 0.0,
                "magnitude": 0.0}
     bands_checked = 0
-    T = int(packed.n_obs[0])
     for p_ in pix:
         # the sensor-generic oracle, so non-Landsat sources audit too
         o = detect_sensor(dates, packed.spectra[0, :, int(p_), :T],

@@ -113,6 +113,43 @@ def test_break_confirmation(seeded):
     np.testing.assert_array_equal(np.asarray(st.nobs), nobs)
 
 
+def test_stream_sentinel2_break():
+    """The streaming step is sensor-generic: a 12-band S2 state absorbs
+    in-model obs and confirms a break on shifted ones."""
+    from firebird_tpu.ccd.sensor import SENTINEL2
+
+    src = SyntheticSource(seed=9, start="2019-01-01", end="2021-06-01",
+                          cloud_frac=0.0, change_frac=0.0, sensor=SENTINEL2)
+    p = pack([src.chip(100, 200)], bucket=32)
+    p = PackedChips(cids=p.cids, dates=p.dates,
+                    spectra=p.spectra[:, :, :32, :],
+                    qas=p.qas[:, :32, :], n_obs=p.n_obs, sensor=p.sensor)
+    st = incremental.StreamState.from_chip(batch_one(p))
+    assert np.asarray(st.active).any()
+    anchor = float(p.dates[0][0])
+    T = int(p.n_obs[0])
+    last = p.spectra[0, :, :, T - 1].T.astype(np.float64)
+    t0 = float(p.dates[0][T - 1])
+    # in-model obs absorb
+    nobs0 = np.asarray(st.nobs).copy()
+    st = incremental.step(
+        st, jnp.asarray(incremental.design_row(t0 + 10, anchor, np.float64)),
+        jnp.asarray(last), jnp.full(32, synthetic.QA_CLEAR, jnp.int32),
+        t0 + 10, sensor=SENTINEL2)
+    act = np.asarray(st.active)
+    assert (np.asarray(st.nobs)[act] == nobs0[act] + 1).all()
+    # PEEK_SIZE shifted obs confirm a break on active pixels
+    for i in range(params.PEEK_SIZE):
+        t_new = t0 + 20 + 10 * i
+        st = incremental.step(
+            st, jnp.asarray(incremental.design_row(t_new, anchor,
+                                                   np.float64)),
+            jnp.asarray(last + 3000.0),
+            jnp.full(32, synthetic.QA_CLEAR, jnp.int32), t_new,
+            sensor=SENTINEL2)
+    assert np.asarray(st.needs_batch)[act].all()
+
+
 def test_cloudy_obs_is_noop(seeded):
     _, full, cut, T, K = seeded
     st = incremental.StreamState.from_chip(batch_one(cut))
