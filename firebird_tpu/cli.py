@@ -197,6 +197,29 @@ def export(bounds, product_names, product_dates, outdir, fmt):
 
 
 @entrypoint.command()
+@click.option("--x", "-x", required=True, type=float)
+@click.option("--y", "-y", required=True, type=float)
+@click.option("--acquired", "-a", required=False, default=None)
+@click.option("--number", "-n", required=False, default=2500, type=int)
+@click.option("--outdir", "-o", required=True,
+              help="directory for the .npz chip archive")
+@click.option("--aux", is_flag=True, default=False,
+              help="also mirror the AUX layers (training inputs)")
+def fetch(x, y, acquired, number, outdir, aux):
+    """Mirror a tile's chips into a local file archive.
+
+    Fetches from the configured source (FIREBIRD_SOURCE) and writes
+    FileSource .npz files; later runs read them offline with
+    FIREBIRD_SOURCE=file FIREBIRD_SOURCE_PATH=<outdir>."""
+    from firebird_tpu.driver import core
+
+    apply_platform()
+    n = core.fetch(x=x, y=y, outdir=outdir, acquired=acquired,
+                   number=number, aux=aux)
+    click.echo(f"{n} chips written to {outdir}")
+
+
+@entrypoint.command()
 @click.option("--x", "-x", required=False, default=None, type=float)
 @click.option("--y", "-y", required=False, default=None, type=float)
 @click.option("--acquired", "-a", required=False, default=None)

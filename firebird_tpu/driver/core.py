@@ -98,6 +98,77 @@ def host_shard(cids: list) -> list:
     return cids[i::n]
 
 
+def _with_retries(cfg: Config, log, what: str, fn):
+    """Run fn() under the driver's transient-failure policy: the reference
+    delegated these to Spark's task retry; here a blip on one fetch must
+    not fail the whole chunk.  Raises the last error after
+    cfg.fetch_retries retries."""
+    for attempt in range(cfg.fetch_retries + 1):
+        try:
+            return fn()
+        except Exception as e:
+            if attempt == cfg.fetch_retries:
+                raise
+            delay = min(2.0 ** attempt, 30.0)
+            log.warning("%s failed (attempt %d: %s: %s), retrying in %.0fs",
+                        what, attempt + 1, type(e).__name__, e, delay)
+            time.sleep(delay)
+
+
+def fetch(x, y, outdir: str, acquired: str | None = None,
+          number: int = 2500, aux: bool = False,
+          cfg: Config | None = None, source=None, aux_source=None) -> int:
+    """Mirror a tile's chips from the configured source into a FileSource
+    directory (.npz per chip) for offline reruns and fixture building.
+
+    The write side of ingest's FileSource: fetch once over the network,
+    then run any number of campaigns with FIREBIRD_SOURCE=file against the
+    local archive.  Uses the driver's fetch retries and INPUT_PARTITIONS
+    parallelism.  Returns the number of chips written.
+    """
+    import os
+
+    cfg = cfg or Config.from_env()
+    acquired = acquired or dt.default_acquired()
+    log = logger("timeseries")
+    source = source or make_source(cfg)
+    aux_source = aux_source or (make_aux_source(cfg) if aux else None)
+    os.makedirs(outdir, exist_ok=True)
+    sink = FileSource(outdir)
+
+    tile = grid.tile(x=x, y=y)
+    cids = list(take(number, grid.chips(tile)))
+    log.info("fetch: tile h=%s v=%s -> %s (%d chips, acquired %s, aux=%s)",
+             tile["h"], tile["v"], outdir, len(cids), acquired, aux)
+
+    def one(xy):
+        # Chip and aux retry independently: a written chip is never
+        # re-fetched because the aux side flaked.
+        try:
+            _with_retries(cfg, log, f"chip ({xy[0]},{xy[1]}) fetch",
+                          lambda: sink.save_chip(
+                              source.chip(xy[0], xy[1], acquired)))
+        except Exception as e:
+            log.error("chip (%s,%s) failed: %s", xy[0], xy[1], e)
+            return 0
+        if aux_source is not None:
+            try:
+                _with_retries(cfg, log, f"aux ({xy[0]},{xy[1]}) fetch",
+                              lambda: sink.save_aux(
+                                  xy[0], xy[1],
+                                  aux_source.aux(xy[0], xy[1], acquired)))
+            except Exception as e:
+                log.error("aux (%s,%s) failed: %s — archive holds the "
+                          "chip but no aux layers", xy[0], xy[1], e)
+        return 1
+
+    with cf.ThreadPoolExecutor(
+            max_workers=max(cfg.input_parallelism, 1)) as ex:
+        n = sum(ex.map(one, cids))
+    log.info("fetch complete: %d/%d chips written", n, len(cids))
+    return n
+
+
 def detect_batch(packed, dtype, sharding: str = "auto",
                  pad_to: int | None = None):
     """Run the CCD kernel over a packed batch on every local device.
@@ -178,20 +249,8 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
             cf.ThreadPoolExecutor(max_workers=1) as drain_ex:
 
         def fetch_one(xy):
-            # Per-fetch retry with backoff: the reference delegated transient
-            # ingest failures to Spark's task retry; here a blip on one chip
-            # must not fail the whole chunk.
-            for attempt in range(cfg.fetch_retries + 1):
-                try:
-                    return source.chip(xy[0], xy[1], acquired)
-                except Exception as e:
-                    if attempt == cfg.fetch_retries:
-                        raise
-                    delay = min(2.0 ** attempt, 30.0)
-                    log.warning("chip (%s,%s) fetch failed (attempt %d: "
-                                "%s: %s), retrying in %.0fs", xy[0], xy[1],
-                                attempt + 1, type(e).__name__, e, delay)
-                    time.sleep(delay)
+            return _with_retries(cfg, log, f"chip ({xy[0]},{xy[1]}) fetch",
+                                 lambda: source.chip(xy[0], xy[1], acquired))
 
         def fetch_batch(bids):
             return list(chips_ex.map(fetch_one, bids))

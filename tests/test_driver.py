@@ -199,6 +199,35 @@ def test_pad_batch_noop_and_repeat():
     np.testing.assert_array_equal(padded.spectra[3], p.spectra[0])
 
 
+def test_fetch_mirrors_tile_to_file_source(tmp_path):
+    """fetch writes a FileSource archive that reproduces the live source:
+    same chip payloads, usable by a subsequent file-sourced run."""
+    import numpy as np
+
+    from firebird_tpu.driver import core
+    from firebird_tpu.ingest import FileSource, SyntheticSource
+
+    src = SyntheticSource(seed=2, start="1995-01-01", end="1996-06-01")
+    cfg = Config(source_backend="synthetic", store_backend="memory")
+    n = core.fetch(x=542000, y=1650000, outdir=str(tmp_path), number=3,
+                   aux=True, cfg=cfg, source=src, aux_source=src)
+    assert n == 3
+    files = sorted(p.name for p in tmp_path.iterdir())
+    assert len([f for f in files if f.startswith("chip_")]) == 3
+    assert len([f for f in files if f.startswith("aux_")]) == 3
+    # round-trip equality against the live source for one chip
+    cx, cy = (int(v) for v in grid.tile(542000, 1650000)["chips"][0])
+    live = src.chip(cx, cy, "1995-01-01/1996-06-01")
+    mirrored = FileSource(str(tmp_path)).chip(cx, cy,
+                                              "1995-01-01/1996-06-01")
+    np.testing.assert_array_equal(live.spectra, mirrored.spectra)
+    np.testing.assert_array_equal(live.qas, mirrored.qas)
+    np.testing.assert_array_equal(live.dates, mirrored.dates)
+    aux = FileSource(str(tmp_path)).aux(cx, cy)
+    assert set(aux) == {"dem", "trends", "aspect", "posidex", "slope",
+                        "mpw"}
+
+
 def test_cli_changedetection(monkeypatch, tmp_path):
     monkeypatch.setenv("FIREBIRD_SOURCE", "synthetic")
     monkeypatch.setenv("FIREBIRD_STORE_BACKEND", "sqlite")
