@@ -216,7 +216,12 @@ def fetch(x, y, acquired, number, outdir, aux):
     apply_platform()
     n = core.fetch(x=x, y=y, outdir=outdir, acquired=acquired,
                    number=number, aux=aux)
+    expected = min(number, 2500)
     click.echo(f"{n} chips written to {outdir}")
+    if n < expected:
+        click.echo(f"WARNING: {expected - n} chips failed permanently — "
+                   "the archive is incomplete", err=True)
+        raise SystemExit(3)
 
 
 @entrypoint.command()
@@ -245,6 +250,43 @@ def validate(x, y, acquired, n_pixels, dtype, seed):
     click.echo(_json.dumps(report, indent=1))
     if not report["structural_agreement"]:
         raise SystemExit(2)
+
+
+@entrypoint.command()
+@click.option("--x", "-x", required=False, default=None, type=float,
+              help="with -y: also report this tile's chip progress")
+@click.option("--y", "-y", required=False, default=None, type=float)
+def status(x, y):
+    """Inspect the configured results store: per-table row counts, chips
+    with stored segments, and (with -x/-y) one tile's completion — the
+    operational view behind `changedetection --resume`."""
+    import json as _json
+
+    from firebird_tpu import grid
+    from firebird_tpu.config import Config
+    from firebird_tpu.store import TABLES, open_store
+
+    if (x is None) != (y is None):
+        raise click.BadParameter("tile progress needs both -x and -y")
+    cfg = Config.from_env()
+    store = open_store(cfg.store_backend, cfg.store_path, cfg.keyspace())
+    done = store.chip_ids("segment")
+    out = {
+        "backend": cfg.store_backend,
+        "path": cfg.store_path,
+        "keyspace": cfg.keyspace(),
+        "tables": {t: store.count(t) for t in TABLES},
+        "chips_with_segments": len(done),
+    }
+    if x is not None:
+        tile = grid.tile(x, y)
+        cids = [tuple(int(v) for v in c) for c in grid.chips(tile)]
+        out["tile"] = {
+            "h": tile["h"], "v": tile["v"],
+            "chips_done": sum(1 for c in cids if c in done),
+            "chips_total": len(cids),
+        }
+    click.echo(_json.dumps(out, indent=1))
 
 
 @entrypoint.command()

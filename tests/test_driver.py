@@ -199,6 +199,35 @@ def test_pad_batch_noop_and_repeat():
     np.testing.assert_array_equal(padded.spectra[3], p.spectra[0])
 
 
+def test_cli_status_reports_store_and_tile_progress(tmp_path, monkeypatch):
+    from firebird_tpu.store import SqliteStore
+
+    db = str(tmp_path / "fb.db")
+    monkeypatch.setenv("FIREBIRD_STORE_BACKEND", "sqlite")
+    monkeypatch.setenv("FIREBIRD_STORE_PATH", db)
+    store = SqliteStore(db, Config.from_env().keyspace())
+    tile = grid.tile(542000, 1650000)
+    cx, cy = (int(v) for v in tile["chips"][0])
+    store.write("segment", {
+        "cx": [cx], "cy": [cy], "px": [cx], "py": [cy],
+        "sday": ["2000-01-01"], "eday": ["2005-01-01"],
+        "bday": ["2005-01-01"], "chprob": [1.0], "curqa": [8]})
+    res = CliRunner().invoke(cli.entrypoint, [
+        "status", "-x", "542000", "-y", "1650000"])
+    assert res.exit_code == 0, res.output
+    import json
+
+    rep = json.loads(res.output)
+    assert rep["backend"] == "sqlite"
+    assert rep["tables"]["segment"] == 1
+    assert rep["chips_with_segments"] == 1
+    assert rep["tile"] == {"h": 20, "v": 11, "chips_done": 1,
+                           "chips_total": 2500}
+    # one coordinate without the other is a usage error
+    res = CliRunner().invoke(cli.entrypoint, ["status", "-x", "542000"])
+    assert res.exit_code != 0
+
+
 def test_fetch_mirrors_tile_to_file_source(tmp_path):
     """fetch writes a FileSource archive that reproduces the live source:
     same chip payloads, usable by a subsequent file-sourced run."""
