@@ -98,10 +98,47 @@ jax.tree_util.register_pytree_node(
 # Small batched primitives
 # ---------------------------------------------------------------------------
 
+def _bitonic_sort_last(x):
+    """Ascending sort along the (static) last axis as a bitonic network:
+    log^2(W) stages of reshape + min/max + select, no generic Sort HLO.
+    XLA's Sort is the slowest primitive in this kernel on both CPU and
+    TPU for the many-rows/short-axis shapes the medians use; the network
+    is pure elementwise VPU work and produces bit-identical values for
+    non-NaN data.  A NaN input poisons its whole row (min/max propagate),
+    unlike Sort's NaNs-last — acceptable here because the only upstream
+    NaN source is a degenerate Tmask Gram, whose terminal behavior
+    (comparisons read False, nothing flagged) is NaN-absorbing either
+    way.  Non-power-of-two axes pad with +inf (dropped before
+    returning)."""
+    W = x.shape[-1]
+    if W <= 1:
+        return x
+    n = 1 << (W - 1).bit_length()
+    if n != W:
+        pad = jnp.full(x.shape[:-1] + (n - W,), jnp.inf, x.dtype)
+        x = jnp.concatenate([x, pad], axis=-1)
+    k = 2
+    while k <= n:
+        j = k // 2
+        while j >= 1:
+            shp = x.shape
+            x2 = x.reshape(shp[:-1] + (n // (2 * j), 2, j))
+            a, b = x2[..., 0, :], x2[..., 1, :]
+            lo, hi = jnp.minimum(a, b), jnp.maximum(a, b)
+            # ascending iff bit k of the element's absolute index is 0;
+            # that bit is constant across the (pair, lane) axes.
+            asc = ((jnp.arange(n // (2 * j)) * (2 * j)) & k) == 0
+            asc = asc[(None,) * (lo.ndim - 2) + (slice(None), None)]
+            x = jnp.stack([jnp.where(asc, lo, hi),
+                           jnp.where(asc, hi, lo)], axis=-2).reshape(shp)
+            j //= 2
+        k *= 2
+    return x[..., :W]
+
+
 def _masked_median(x, m):
     """Median of x where m, along the last axis (numpy even-count average)."""
-    big = jnp.where(m, x, jnp.inf)
-    s = jnp.sort(big, axis=-1)
+    s = _bitonic_sort_last(jnp.where(m, x, jnp.inf))
     n = jnp.sum(m, axis=-1)
     lo = jnp.take_along_axis(s, jnp.maximum((n - 1) // 2, 0)[..., None], -1)[..., 0]
     hi = jnp.take_along_axis(s, jnp.maximum(n // 2, 0)[..., None], -1)[..., 0]
@@ -194,6 +231,46 @@ def _coefmask_for(n, P):
     return jnp.arange(params.MAX_COEFS)[None, :] < nc[:, None]
 
 
+def _chol_solve_small(G, c):
+    """Solve G x = c for SPD G [.., n, n], c [.., n] with n tiny and
+    static: fully unrolled Cholesky + two substitutions as elementwise
+    ops over the batch lanes — no LAPACK-style Cholesky/TriangularSolve
+    HLOs, which are latency-bound at small n.
+
+    Numerically non-PD lanes (a pivot <= 0) return NaN, matching
+    jnp.linalg.cholesky — callers' downstream comparisons then read
+    False, which is the degenerate-Gram contract _tmask_bad relies on
+    (flag nothing rather than fabricate huge betas)."""
+    n = G.shape[-1]
+    ok = None
+    L = [[None] * n for _ in range(n)]
+    for i in range(n):
+        for j in range(i + 1):
+            s = G[..., i, j]
+            for q in range(j):
+                s = s - L[i][q] * L[j][q]
+            if i == j:
+                pos = s > 0
+                ok = pos if ok is None else ok & pos
+                L[i][j] = jnp.sqrt(jnp.maximum(s, 1e-30))
+            else:
+                L[i][j] = s / L[j][j]
+    y = [None] * n
+    for i in range(n):
+        s = c[..., i]
+        for q in range(i):
+            s = s - L[i][q] * y[q]
+        y[i] = s / L[i][i]
+    x = [None] * n
+    for i in reversed(range(n)):
+        s = y[i]
+        for q in range(i + 1, n):
+            s = s - L[q][i] * x[q]
+        x[i] = s / L[i][i]
+    out = jnp.stack(x, axis=-1)
+    return jnp.where(ok[..., None], out, jnp.nan)
+
+
 def _tmask_bad(Xtw, Y2, w, vario2):
     """Batched Tmask: IRLS Huber harmonic fit on the Tmask bands.
 
@@ -220,15 +297,15 @@ def _tmask_bad(Xtw, Y2, w, vario2):
     eye = 1e-9 * jnp.eye(nt, dtype=Xtw.dtype)
 
     def solve(wt):
-        # wt [P,2,W] weights -> beta [P,2,nt].  Cholesky, not LU: the Gram
-        # is SPD (+ridge) and TPU XLA has no LuDecomposition expander.
+        # wt [P,2,W] weights -> beta [P,2,nt].  SPD solve via an unrolled
+        # Cholesky over the batch lanes (_chol_solve_small): nt is a tiny
+        # static 5, and XLA's batched Cholesky/TriangularSolve run a
+        # LAPACK-shaped blocked algorithm that is latency-bound at this
+        # size on both CPU and TPU.
         Xw = wt[..., None] * Xtw[:, None]                      # [P,2,W,nt]
         G = jnp.einsum("pbwc,pwd->pbcd", Xw, Xtw)              # [P,2,nt,nt]
         cc = jnp.einsum("pbw,pwc->pbc", Y2 * wt, Xtw)
-        L = jnp.linalg.cholesky(G + eye)
-        z = jax.scipy.linalg.solve_triangular(L, cc[..., None], lower=True)
-        return jax.scipy.linalg.solve_triangular(
-            L, z, lower=True, trans=1)[..., 0]
+        return _chol_solve_small(G + eye, cc)
 
     w2 = jnp.broadcast_to(w[:, None, :], Y2.shape).astype(Y2.dtype)
     beta = solve(w2)

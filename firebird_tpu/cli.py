@@ -214,12 +214,11 @@ def fetch(x, y, acquired, number, outdir, aux):
     from firebird_tpu.driver import core
 
     apply_platform()
-    n = core.fetch(x=x, y=y, outdir=outdir, acquired=acquired,
-                   number=number, aux=aux)
-    expected = min(number, 2500)
+    n, attempted = core.fetch(x=x, y=y, outdir=outdir, acquired=acquired,
+                              number=number, aux=aux)
     click.echo(f"{n} chips written to {outdir}")
-    if n < expected:
-        click.echo(f"WARNING: {expected - n} chips failed permanently — "
+    if n < attempted:
+        click.echo(f"WARNING: {attempted - n} chips failed permanently — "
                    "the archive is incomplete", err=True)
         raise SystemExit(3)
 

@@ -117,14 +117,15 @@ def _with_retries(cfg: Config, log, what: str, fn):
 
 def fetch(x, y, outdir: str, acquired: str | None = None,
           number: int = 2500, aux: bool = False,
-          cfg: Config | None = None, source=None, aux_source=None) -> int:
+          cfg: Config | None = None, source=None,
+          aux_source=None) -> tuple[int, int]:
     """Mirror a tile's chips from the configured source into a FileSource
     directory (.npz per chip) for offline reruns and fixture building.
 
     The write side of ingest's FileSource: fetch once over the network,
     then run any number of campaigns with FIREBIRD_SOURCE=file against the
     local archive.  Uses the driver's fetch retries and INPUT_PARTITIONS
-    parallelism.  Returns the number of chips written.
+    parallelism.  Returns (chips written, chips attempted).
     """
     import os
 
@@ -166,7 +167,7 @@ def fetch(x, y, outdir: str, acquired: str | None = None,
             max_workers=max(cfg.input_parallelism, 1)) as ex:
         n = sum(ex.map(one, cids))
     log.info("fetch complete: %d/%d chips written", n, len(cids))
-    return n
+    return n, len(cids)
 
 
 def detect_batch(packed, dtype, sharding: str = "auto",

@@ -238,9 +238,10 @@ def test_fetch_mirrors_tile_to_file_source(tmp_path):
 
     src = SyntheticSource(seed=2, start="1995-01-01", end="1996-06-01")
     cfg = Config(source_backend="synthetic", store_backend="memory")
-    n = core.fetch(x=542000, y=1650000, outdir=str(tmp_path), number=3,
-                   aux=True, cfg=cfg, source=src, aux_source=src)
-    assert n == 3
+    n, attempted = core.fetch(x=542000, y=1650000, outdir=str(tmp_path),
+                              number=3, aux=True, cfg=cfg, source=src,
+                              aux_source=src)
+    assert (n, attempted) == (3, 3)
     files = sorted(p.name for p in tmp_path.iterdir())
     assert len([f for f in files if f.startswith("chip_")]) == 3
     assert len([f for f in files if f.startswith("aux_")]) == 3
