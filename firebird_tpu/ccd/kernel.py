@@ -348,8 +348,18 @@ def _dedup_first(cand, same_prev):
 
 
 def _variogram(Y, usable):
-    """[P,7] median |successive difference| over usable obs, floor 1e-6."""
-    order = jnp.argsort(~usable, axis=-1, stable=True)          # usable first
+    """[P,B] median |successive difference| over usable obs, floor 1e-6."""
+    # Compact usable-first by rank scatter instead of a [P,T] stable
+    # argsort (the kernel's last generic Sort HLO): order[p, q] = absolute
+    # index of p's q-th usable obs; slots beyond m fill with T-1 — their
+    # successive diffs are masked off by pair_ok below, so the values are
+    # bit-identical to the argsort formulation where it matters.
+    P_, T_ = usable.shape
+    ar_ = jnp.arange(T_)[None, :]
+    rank_ = jnp.cumsum(usable, -1) - 1
+    order = jnp.full((P_, T_ + 1), T_ - 1, ar_.dtype).at[
+        jnp.arange(P_)[:, None], jnp.where(usable, rank_, T_)
+    ].set(jnp.broadcast_to(ar_, (P_, T_)), mode="drop")[:, :T_]
     m = jnp.sum(usable, -1)                                     # [P]
     Yc = jnp.take_along_axis(Y, order[:, None, :].repeat(Y.shape[1], 1), axis=2)
     d = jnp.abs(Yc[..., 1:] - Yc[..., :-1])                     # [P,7,T-1]
