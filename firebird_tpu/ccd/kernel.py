@@ -357,12 +357,12 @@ def _variogram(Y, usable):
     P_, T_ = usable.shape
     ar_ = jnp.arange(T_)[None, :]
     rank_ = jnp.cumsum(usable, -1) - 1
-    order = jnp.full((P_, T_ + 1), T_ - 1, ar_.dtype).at[
+    order = jnp.full((P_, T_), T_ - 1, ar_.dtype).at[
         jnp.arange(P_)[:, None], jnp.where(usable, rank_, T_)
-    ].set(jnp.broadcast_to(ar_, (P_, T_)), mode="drop")[:, :T_]
+    ].set(jnp.broadcast_to(ar_, (P_, T_)), mode="drop")
     m = jnp.sum(usable, -1)                                     # [P]
     Yc = jnp.take_along_axis(Y, order[:, None, :].repeat(Y.shape[1], 1), axis=2)
-    d = jnp.abs(Yc[..., 1:] - Yc[..., :-1])                     # [P,7,T-1]
+    d = jnp.abs(Yc[..., 1:] - Yc[..., :-1])                     # [P,B,T-1]
     T = usable.shape[-1]
     pair_ok = jnp.arange(T - 1)[None, :] < (m - 1)[:, None]     # [P,T-1]
     v = _masked_median(d, pair_ok[:, None, :])

@@ -160,3 +160,38 @@ def test_padding_is_inert(packed):
     np.testing.assert_array_equal(a.n_segments, b.n_segments)
     np.testing.assert_allclose(a.seg_meta, b.seg_meta, rtol=1e-12)
     np.testing.assert_array_equal(a.mask, b.mask[:, :T])
+
+
+def test_bitonic_sort_matches_numpy():
+    """The sorting network behind the masked medians is bit-identical to
+    a full sort for finite/inf data at every width class (power-of-two,
+    odd, 1) and both dtypes."""
+    rng = np.random.default_rng(5)
+    for W in (1, 2, 3, 5, 8, 17, 24, 64, 100):
+        for dt in (np.float32, np.float64):
+            x = rng.normal(size=(40, W)).astype(dt)
+            x[rng.random(x.shape) < 0.2] = np.inf        # masked slots
+            got = np.asarray(kernel._bitonic_sort_last(jnp.asarray(x)))
+            np.testing.assert_array_equal(got, np.sort(x, axis=-1))
+
+
+def test_chol_solve_small_accuracy_and_degenerate_nan():
+    """The unrolled SPD solve matches LAPACK on well-conditioned systems
+    and returns NaN on numerically non-PD lanes (the flag-nothing
+    degenerate contract of the Tmask screen)."""
+    rng = np.random.default_rng(6)
+    A = rng.normal(size=(50, 5, 5))
+    G = np.einsum("pij,pkj->pik", A, A) + 1e-6 * np.eye(5)
+    c = rng.normal(size=(50, 5))
+    got = np.asarray(kernel._chol_solve_small(jnp.asarray(G), jnp.asarray(c)))
+    want = np.linalg.solve(G, c[..., None])[..., 0]
+    np.testing.assert_allclose(got, want, rtol=1e-8, atol=1e-8)
+    # one lane made indefinite -> that lane (and only that lane) is NaN
+    G_bad = G.copy()
+    G_bad[7] = -np.eye(5)
+    got = np.asarray(kernel._chol_solve_small(jnp.asarray(G_bad),
+                                              jnp.asarray(c)))
+    assert np.isnan(got[7]).all()
+    ok = np.ones(50, bool)
+    ok[7] = False
+    assert np.isfinite(got[ok]).all()
