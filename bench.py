@@ -52,12 +52,59 @@ def measure(cpu_only: bool) -> None:
     chips = [src.chip(100 + 3000 * i, 200) for i in range(n_chips)]
     packed = pack(chips, bucket=64)
     n_pixels = packed.n_chips * 10000
+    fdtype = jnp.float32
 
     def device_args(pk, prep):
         Xs, Xts, valid = prep
         return (jnp.asarray(Xs, fdtype), jnp.asarray(Xts, fdtype),
                 jnp.asarray(pk.dates, dtype=fdtype), jnp.asarray(valid),
                 jnp.asarray(pk.spectra), jnp.asarray(pk.qas))
+
+    # ---- CD-path auto-tune (accelerator only) ----
+    # The Lasso coordinate-descent loop has two implementations: the lax
+    # fori_loop default and the Pallas VMEM-resident kernel
+    # (FIREBIRD_PALLAS=1; f32-on-TPU only).  Which is faster depends on
+    # the toolchain, so time both on a small probe chip and keep the
+    # winner for the full run.  The flag is read at trace time, and the
+    # cache between variants is cleared so each probe really compiles its
+    # own path; a Pallas crash just keeps the default.
+    pallas_detail = {}
+    if not cpu_only and not small and jax.default_backend() == "tpu":
+        import functools as _ft
+        import os as _os
+
+        probe = pack([chips[0]], bucket=64)
+        pp = kernel.prep_batch(probe)
+        sl = (slice(None), slice(None), slice(0, 1024), slice(None))
+
+        def probe_rate(flag: str) -> float:
+            _os.environ["FIREBIRD_PALLAS"] = flag
+            jax.clear_caches()
+            args = device_args(probe, pp)
+            args = args[:4] + (args[4][sl], args[5][:, :1024, :])
+            f = _ft.partial(kernel._detect_batch_wire, dtype=jnp.float32,
+                            wcap=kernel.window_cap(probe),
+                            sensor=probe.sensor)
+            f(*args).n_segments.block_until_ready()      # compile
+            t0 = time.time()
+            for _ in range(2):
+                f(*args).n_segments.block_until_ready()
+            return 2.0 / (time.time() - t0)
+
+        try:
+            r0 = probe_rate("0")
+            r1 = probe_rate("1")
+            pick = "1" if r1 > r0 else "0"
+            pallas_detail = {"pallas_autotune":
+                             {"default_runs_per_sec": round(r0, 3),
+                              "pallas_runs_per_sec": round(r1, 3),
+                              "picked": pick}}
+        except Exception as e:
+            pick = "0"
+            pallas_detail = {"pallas_autotune": {"error": repr(e)[:200],
+                                                 "picked": pick}}
+        _os.environ["FIREBIRD_PALLAS"] = pick
+        jax.clear_caches()
 
     def timed_rate(run_fn, run_args, pixels, n_runs):
         """Steady-state pixels/sec: compile+warmup run, then timed runs."""
@@ -77,7 +124,6 @@ def measure(cpu_only: bool) -> None:
     # is reached through a tunnel whose bandwidth is not representative of
     # a TPU VM's DMA path.)
     wcap = kernel.window_cap(packed)
-    fdtype = jnp.float32
     prepped = kernel.prep_batch(packed)   # host-side; outside t_xfer
     if use_mesh:
         from firebird_tpu.parallel import make_mesh
@@ -199,6 +245,7 @@ def measure(cpu_only: bool) -> None:
             "cpu_ref_pixels_per_sec_per_core": round(cpu_rate, 2),
             "baseline_2000_core_pixels_per_sec": round(baseline_2000_cores, 1),
             "mean_segments": float(np.asarray(seg.n_segments).mean()),
+            **pallas_detail,
             "streaming_pixels_per_sec": round(stream_rate, 1),
             **s2_detail,
             "rf_inference_segments_per_sec": round(rf_rate, 1),
