@@ -107,7 +107,10 @@ def chip_frames(packed, chip: int, seg) -> dict[str, dict]:
     P = seg.n_segments.shape[0]
     coords = packed.pixel_coords(chip)                         # [P,2]
 
-    nseg = np.asarray(seg.n_segments, np.int64)
+    # clip to buffer capacity: detect_packed re-dispatches on overflow, so
+    # this only guards frames built from a raw kernel result
+    nseg = np.minimum(np.asarray(seg.n_segments, np.int64),
+                      seg.seg_meta.shape[-2])
     n_rows = np.maximum(nseg, 1)                               # sentinel rows
     pix_of_row = np.repeat(np.arange(P), n_rows)
     # per-row segment index; sentinel rows get -1

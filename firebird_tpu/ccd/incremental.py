@@ -70,7 +70,9 @@ class StreamState:
         if seg.vario is None:
             raise ValueError("batch result lacks vario; rerun the kernel")
         P = seg.n_segments.shape[0]
-        last = jnp.maximum(seg.n_segments - 1, 0)               # [P]
+        # clip to buffer capacity: guards raw check_capacity=False results
+        last = jnp.minimum(jnp.maximum(seg.n_segments - 1, 0),
+                           seg.seg_meta.shape[-2] - 1)          # [P]
         meta = jnp.take_along_axis(
             seg.seg_meta, last[:, None, None].repeat(6, 2), axis=1)[:, 0]
         curqa = meta[:, 4].astype(jnp.int32)

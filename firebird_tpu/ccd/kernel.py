@@ -382,7 +382,7 @@ def _first_at_or_after(mask, i):
 
 
 def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
-                 sensor=LANDSAT_ARD):
+                 sensor=LANDSAT_ARD, max_segments: int = MAX_SEGMENTS):
     """One chip: X [T,8], Xt [T,5], t [T] f32 ordinal days, valid [T] bool,
     Y [B,P,T] f32 (the packed layout), qa [P,T] int32.  Returns
     ChipSegments (device).
@@ -391,13 +391,17 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
     window_cap() derives a rigorous bound from the chip's date grid.  None
     falls back to the always-correct T.  ``sensor`` (static) supplies the
     band layout — detection/Tmask/range-check roles and count; the default
-    is the reference's Landsat ARD contract."""
+    is the reference's Landsat ARD contract.  ``max_segments`` (static) is
+    the result-buffer capacity; n_segments counts every closed segment
+    even past capacity, so a caller can detect overflow
+    (n_segments > max_segments) and re-dispatch with a larger buffer —
+    detect_packed does this automatically."""
     _DET = list(sensor.detection_bands)
     _TMB = list(sensor.tmask_bands)
     CHANGE_THRESHOLD, OUTLIER_THRESHOLD = chi2_thresholds(len(_DET))
     Y = Y.transpose(1, 0, 2)                                   # -> [P,B,T]
     P, B, T = Y.shape
-    S = MAX_SEGMENTS
+    S = max_segments
     ar = jnp.arange(T)[None, :]
     fdtype = Y.dtype
     W = T if wcap is None else min(wcap, T)
@@ -745,12 +749,16 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
 # Host-facing API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("dtype", "wcap", "sensor"))
+@functools.partial(jax.jit,
+                   static_argnames=("dtype", "wcap", "sensor",
+                                    "max_segments"))
 def _detect_batch_wire(Xs, Xts, t, valid, Y_i16, qa_u16, *, dtype,
-                       wcap=None, sensor=LANDSAT_ARD):
+                       wcap=None, sensor=LANDSAT_ARD,
+                       max_segments=MAX_SEGMENTS):
     """Batch detect from wire dtypes: spectra/QA arrive as int16/uint16 and
     widen on device — halves host->device transfer vs shipping float32."""
-    f = functools.partial(_detect_core, wcap=wcap, sensor=sensor)
+    f = functools.partial(_detect_core, wcap=wcap, sensor=sensor,
+                          max_segments=max_segments)
     return jax.vmap(f)(Xs, Xts, t, valid,
                        Y_i16.astype(dtype), qa_u16.astype(jnp.int32))
 
@@ -815,18 +823,66 @@ def ensure_x64(dtype) -> None:
         jax.config.update("jax_enable_x64", True)
 
 
-def detect_packed(packed, dtype=jnp.float32) -> ChipSegments:
+def capacity_bound(packed) -> int:
+    """An upper bound on segments any pixel of the batch can close:
+    closed segments have disjoint included-observation sets of at least
+    MEOW_SIZE members each, so T // MEOW_SIZE bounds the count."""
+    T = packed.spectra.shape[-1]
+    return max(T // params.MEOW_SIZE, 1)
+
+
+def capacity_retry(dispatch, read_worst, S: int, bound: int):
+    """The one overflow-retry policy, shared by the single-device and
+    sharded paths: run ``dispatch(S)``; if any pixel closed more segments
+    than S (``read_worst``, a host sync), double S (capped at the
+    rigorous ``bound``) and re-dispatch.  S >= bound skips the sync —
+    overflow is impossible there."""
+    S = max(S, 1)
+    while True:
+        seg = dispatch(S)
+        if S >= bound:
+            return seg
+        worst = read_worst(seg)
+        if worst <= S:
+            return seg
+        from firebird_tpu.obs import logger
+
+        logger("pyccd").info(
+            "segment capacity %d overflowed (deepest pixel closed %d); "
+            "re-dispatching at %d", S, worst, min(2 * S, bound))
+        S = min(2 * S, bound)
+
+
+def detect_packed(packed, dtype=jnp.float32,
+                  max_segments: int = MAX_SEGMENTS,
+                  check_capacity: bool = True) -> ChipSegments:
     """Run the kernel over a PackedChips batch -> ChipSegments with leading
     chip axis [C, P, ...].  The batch's sensor spec selects the band
-    layout the kernel compiles for."""
+    layout the kernel compiles for.
+
+    The segment buffers start at ``max_segments`` capacity; on the rare
+    chip where some pixel closes more segments than that (n_segments
+    counts true closes, writes past capacity are dropped), the batch is
+    re-dispatched with doubled capacity until every segment fits — each
+    capacity is a separate compiled program, cached for later batches.
+    ``check_capacity=False`` skips the overflow check, keeping the
+    dispatch fully asynchronous — the caller must then test
+    ``n_segments > capacity`` itself before trusting the buffers (the
+    driver does this on its drain thread, driver/core.py::drain_batch).
+    """
     ensure_x64(dtype)
     Xs, Xts, valid = prep_batch(packed)
-    return _detect_batch_wire(
-        jnp.asarray(Xs, dtype), jnp.asarray(Xts, dtype),
-        jnp.asarray(packed.dates, dtype=dtype), jnp.asarray(valid),
-        jnp.asarray(packed.spectra), jnp.asarray(packed.qas),
-        dtype=jnp.dtype(dtype), wcap=window_cap(packed),
-        sensor=getattr(packed, "sensor", LANDSAT_ARD))
+    args = (jnp.asarray(Xs, dtype), jnp.asarray(Xts, dtype),
+            jnp.asarray(packed.dates, dtype=dtype), jnp.asarray(valid),
+            jnp.asarray(packed.spectra), jnp.asarray(packed.qas))
+    kw = dict(dtype=jnp.dtype(dtype), wcap=window_cap(packed),
+              sensor=getattr(packed, "sensor", LANDSAT_ARD))
+    dispatch = lambda S: _detect_batch_wire(*args, max_segments=S, **kw)
+    if not check_capacity:
+        return dispatch(max(max_segments, 1))
+    return capacity_retry(dispatch,
+                          lambda seg: int(np.asarray(seg.n_segments).max()),
+                          max_segments, capacity_bound(packed))
 
 
 def chip_slice(seg: ChipSegments, c: int, to_host: bool = False) -> ChipSegments:
@@ -853,7 +909,10 @@ def segments_to_records(seg: ChipSegments, dates: np.ndarray,
     (change_models + processing_mask), for parity tests and the format
     layer.  ``seg`` must be single-chip ([P, ...]) host-fetched arrays."""
     anchor = float(dates[0]) if len(dates) else 0.0
-    n = int(seg.n_segments[pixel])
+    # n_segments counts true closes, which can exceed buffer capacity on a
+    # raw (non-retried) result; detect_packed re-dispatches so this clip
+    # only guards direct _detect_batch_wire callers.
+    n = min(int(seg.n_segments[pixel]), seg.seg_meta.shape[-2])
     models = []
     for k in range(n):
         meta = np.asarray(seg.seg_meta[pixel, k], np.float64)

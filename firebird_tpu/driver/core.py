@@ -171,7 +171,8 @@ def fetch(x, y, outdir: str, acquired: str | None = None,
 
 
 def detect_batch(packed, dtype, sharding: str = "auto",
-                 pad_to: int | None = None):
+                 pad_to: int | None = None, check_capacity: bool = False,
+                 max_segments: int | None = None):
     """Run the CCD kernel over a packed batch on every local device.
 
     Single device (or sharding='off'): plain jit dispatch.  Multiple local
@@ -198,18 +199,43 @@ def detect_batch(packed, dtype, sharding: str = "auto",
     if use_mesh:
         target = -n_dev * (-target // n_dev)
     padded, real = _pad_batch(packed, target)
+    # The default check_capacity=False keeps the dispatch asynchronous
+    # (no device sync on this thread); the drain thread — which fetches
+    # results anyway — detects segment-capacity overflow and re-runs the
+    # batch through this same function with the check on (drain_batch).
+    kw = dict(check_capacity=check_capacity)
+    if max_segments is not None:
+        kw["max_segments"] = max_segments
     if not use_mesh:
-        return k.detect_packed(padded, dtype=dtype), real
+        return k.detect_packed(padded, dtype=dtype, **kw), real
     from firebird_tpu.parallel import make_mesh
     from firebird_tpu.parallel.mesh import detect_sharded
 
     mesh = make_mesh(devices=jax.local_devices())
-    return detect_sharded(padded, mesh, dtype=dtype), real
+    return detect_sharded(padded, mesh, dtype=dtype, **kw), real
 
 
-def drain_batch(seg, packed, n_real, *, writer, counters):
+def drain_batch(seg, packed, n_real, *, writer, counters, dtype=None,
+                sharding: str = "auto", pad_to: int | None = None):
     """Fetch one batch's results to the host, format, and queue writes
-    (the egress half of ref core.detect, core.py:69-72)."""
+    (the egress half of ref core.detect, core.py:69-72).
+
+    Also the capacity backstop for the driver's asynchronous dispatch
+    (detect_batch defaults check_capacity=False): if any pixel closed
+    more segments than the result buffers hold, the batch is recomputed
+    here through the same (sharded-aware) dispatch with the capacity
+    check on — rare enough that the synchronous re-run does not matter."""
+    cap = seg.seg_meta.shape[-2]                   # [.., P, S, 6] -> S
+    worst = int(np.asarray(seg.n_segments).max())
+    if worst > cap:
+        logger("pyccd").info(
+            "segment capacity %d overflowed on drain (deepest pixel "
+            "closed %d); recomputing the batch", cap, worst)
+        seg, _ = detect_batch(packed, dtype or seg.seg_meta.dtype,
+                              sharding, pad_to=pad_to,
+                              check_capacity=True,
+                              max_segments=min(2 * cap,
+                                               kernel.capacity_bound(packed)))
     for c in range(n_real):
         one = kernel.chip_slice(seg, c, to_host=True)
         frames = ccdformat.chip_frames(packed, c, one)
@@ -267,7 +293,8 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
                                        pad_to=pad_to)
             drains.append(drain_ex.submit(
                 drain_batch, seg, packed, n_real, writer=writer,
-                counters=counters))
+                counters=counters, dtype=dtype,
+                sharding=cfg.device_sharding, pad_to=pad_to))
             # Bound live batches to two (the one computing + the one
             # draining): a deeper queue would pin additional device
             # result buffers and packed inputs, risking HBM exhaustion

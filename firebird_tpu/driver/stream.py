@@ -79,7 +79,8 @@ def _tail_identity(one: kernel.ChipSegments) -> tuple[np.ndarray, np.ndarray]:
     """(sday, curqa) of each pixel's last segment — the open tail whose row
     the stream will keep re-publishing under the same (sday, px, py) key."""
     nseg = np.asarray(one.n_segments, np.int64)
-    last = np.maximum(nseg - 1, 0)
+    # clip to buffer capacity: guards raw check_capacity=False results
+    last = np.minimum(np.maximum(nseg - 1, 0), one.seg_meta.shape[-2] - 1)
     meta = np.asarray(one.seg_meta, np.float64)[np.arange(nseg.shape[0]), last]
     return meta[:, 0], meta[:, 4].astype(np.int64)
 

@@ -97,7 +97,9 @@ def shard_packed(packed, mesh: Mesh, dtype, prepped=None):
             put(packed.qas, jnp.uint16))
 
 
-def detect_sharded(packed, mesh: Mesh, dtype=None):
+def detect_sharded(packed, mesh: Mesh, dtype=None,
+                   check_capacity: bool = True,
+                   max_segments: int | None = None):
     """Run the CCD kernel with the chip batch sharded over the mesh.
 
     This is the multi-device production path: same math as
@@ -109,7 +111,9 @@ def detect_sharded(packed, mesh: Mesh, dtype=None):
     Pallas CD kernel, FIREBIRD_PALLAS=1) need no SPMD partitioning rule.
     """
     import jax.numpy as jnp
-    from firebird_tpu.ccd.kernel import ensure_x64, window_cap
+    from firebird_tpu.ccd.kernel import (MAX_SEGMENTS, capacity_bound,
+                                         capacity_retry, ensure_x64,
+                                         window_cap)
 
     dtype = dtype or jnp.float32
     ensure_x64(dtype)
@@ -118,29 +122,51 @@ def detect_sharded(packed, mesh: Mesh, dtype=None):
     # chip slice: max-reduce the per-host bound before tracing.  Host-local
     # meshes (the driver's per-host loop) must NOT synchronize here —
     # hosts run different batch counts and a barrier would deadlock.
-    wcap = window_cap(packed)
-    if spans_processes(mesh):
+    multiproc = spans_processes(mesh)
+
+    def global_max(v: int) -> int:
+        if not multiproc:
+            return v
         from jax.experimental import multihost_utils
-        wcap = int(np.max(np.asarray(
-            multihost_utils.process_allgather(np.array([wcap])))))
+        return int(np.max(np.asarray(
+            multihost_utils.process_allgather(np.array([v])))))
+
+    wcap = global_max(window_cap(packed))
     args = shard_packed(packed, mesh, dtype)
-    fn = sharded_detect_fn(mesh, jnp.dtype(dtype), wcap, packed.sensor)
-    return fn(*args)
+
+    def dispatch(S):
+        return sharded_detect_fn(mesh, jnp.dtype(dtype), wcap,
+                                 packed.sensor, max_segments=S)(*args)
+
+    def read_worst(seg):
+        # Every process must agree on the retry, so max-reduce the local
+        # worst (read from addressable shards only — the global array is
+        # not fetchable under multi-process sharding).
+        return global_max(max(int(np.asarray(s.data).max())
+                              for s in seg.n_segments.addressable_shards))
+
+    S0 = max_segments or MAX_SEGMENTS
+    if not check_capacity:
+        return dispatch(max(S0, 1))
+    return capacity_retry(dispatch, read_worst, S0, capacity_bound(packed))
 
 
 @functools.lru_cache(maxsize=None)
-def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor):
-    """The jitted shard_map program, cached per (mesh, dtype, wcap, sensor)
-    — rebuilding the jit wrapper per batch would retrace every dispatch.
+def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor,
+                      max_segments: int | None = None):
+    """The jitted shard_map program, cached per (mesh, dtype, wcap, sensor,
+    capacity) — rebuilding the jit wrapper per batch would retrace every
+    dispatch.
 
     Public two-step API (with shard_packed) for callers that need the
     transfer and the dispatch separately — the bench times them apart;
     detect_sharded composes them for everyone else."""
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec
-    from firebird_tpu.ccd.kernel import _detect_core
+    from firebird_tpu.ccd.kernel import MAX_SEGMENTS, _detect_core
 
-    core = functools.partial(_detect_core, wcap=wcap, sensor=sensor)
+    core = functools.partial(_detect_core, wcap=wcap, sensor=sensor,
+                             max_segments=max_segments or MAX_SEGMENTS)
 
     def local_batch(Xs, Xts, t, valid, Y_i16, qa_u16):
         return jax.vmap(core)(Xs, Xts, t, valid, Y_i16.astype(dtype),

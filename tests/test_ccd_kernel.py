@@ -195,3 +195,52 @@ def test_chol_solve_small_accuracy_and_degenerate_nan():
     ok = np.ones(50, bool)
     ok[7] = False
     assert np.isfinite(got[ok]).all()
+
+
+def overflow_packed() -> PackedChips:
+    """A 4-pixel chip whose pixels close 11+ segments (shared by the
+    kernel-level and driver-level capacity-overflow tests)."""
+    t = synthetic.acquisition_dates("1985-01-01", "2005-01-01", 8)
+    rng = np.random.default_rng(12)
+    Y = synthetic.harmonic_series(t, rng, noise=20.0)
+    # one confirmed break per ~55 obs (440 days — enough for the 365-day
+    # init window plus the 6-obs confirmation run)
+    for k, c in enumerate(range(55, t.shape[0] - 55, 55)):
+        Y[:, c:] += 900.0 * (1 if k % 2 == 0 else -1)
+    px = synthetic.pixel(t, Y)
+    spectra = np.stack([px[n] for n in params.BAND_NAMES_PLURAL])
+    T = t.shape[0]
+    Tb = -64 * (-T // 64)
+    p = PackedChips(
+        cids=np.zeros((1, 2), np.int64),
+        dates=np.pad(t[None], ((0, 0), (0, Tb - T))).astype(np.int32),
+        spectra=np.pad(spectra[None, :, None].repeat(4, 2),
+                       ((0, 0), (0, 0), (0, 0), (0, Tb - T)),
+                       constant_values=params.FILL_VALUE),
+        qas=np.pad(px["qas"][None, None].repeat(4, 1),
+                   ((0, 0), (0, 0), (0, Tb - T)),
+                   constant_values=1 << params.QA_FILL_BIT),
+        n_obs=np.array([T], np.int32))
+    return p
+
+
+def test_segment_capacity_overflow_redispatches():
+    """A pixel that closes more than MAX_SEGMENTS segments must not crash
+    or silently truncate: detect_packed re-dispatches at doubled capacity
+    until every segment fits, and the result matches the (uncapped)
+    oracle.  Found by fuzzing — a dense 20-year grid with a level shift
+    every ~55 obs closes 11+ segments."""
+    p = overflow_packed()
+    t = p.dates[0][: int(p.n_obs[0])]
+    seg = kernel.detect_packed(p, dtype=jnp.float64)
+    o = detect(**pixel_timeseries(p, 0, 0))
+    n_oracle = len(o["change_models"])
+    assert n_oracle > kernel.MAX_SEGMENTS, "fixture must overflow capacity"
+    one = kernel.chip_slice(seg, 0, to_host=True)
+    assert int(one.n_segments[0]) == n_oracle
+    assert one.seg_meta.shape[1] >= n_oracle       # buffer actually grew
+    k = kernel.segments_to_records(one, t, 0)
+    assert len(k["change_models"]) == n_oracle
+    for om, km in zip(o["change_models"], k["change_models"]):
+        assert om["break_day"] == km["break_day"]
+        assert om["start_day"] == km["start_day"]

@@ -199,6 +199,34 @@ def test_pad_batch_noop_and_repeat():
     np.testing.assert_array_equal(padded.spectra[3], p.spectra[0])
 
 
+def test_drain_recomputes_on_capacity_overflow():
+    """The driver dispatches without the capacity check (to stay
+    asynchronous); the drain thread must detect an overflowed result and
+    recompute before persisting — all segments land in the store."""
+    import jax.numpy as jnp
+
+    from firebird_tpu.ccd import kernel
+    from firebird_tpu.obs import Counters
+    from firebird_tpu.store import AsyncWriter
+    from test_ccd_kernel import overflow_packed
+
+    p = overflow_packed()
+    seg = kernel.detect_packed(p, dtype=jnp.float64, check_capacity=False)
+    worst = int(np.asarray(seg.n_segments).max())
+    assert worst > kernel.MAX_SEGMENTS     # raw result really overflows
+    store = MemoryStore("overflow")
+    writer = AsyncWriter(store)
+    try:
+        core.drain_batch(seg, p, 1, writer=writer, counters=Counters(),
+                         dtype=jnp.float64)
+        writer.flush()
+    finally:
+        writer.close()
+    rows = store.read("segment", {"px": 0, "py": 0})
+    real = [s for s in rows["sday"] if s != "0001-01-01"]
+    assert len(real) == worst              # every closed segment persisted
+
+
 def test_cli_status_reports_store_and_tile_progress(tmp_path, monkeypatch):
     from firebird_tpu.store import SqliteStore
 
