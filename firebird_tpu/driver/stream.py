@@ -174,15 +174,10 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                 f"schema; got sensor {chip.sensor.name!r}")
         if not chip.dates.shape[0]:
             return None
-        p = pack([chip], bucket=cfg.obs_bucket, max_obs=cfg.max_obs)
-        if chip.dates.shape[0] > p.capacity:
-            # pack() keeps the oldest and truncates the newest — for a
-            # stream that would silently freeze the horizon forever
-            log.warning(
-                "chip (%s,%s): %d acquisitions exceed max_obs capacity "
-                "%d; newest truncated — raise FIREBIRD_MAX_OBS",
-                cid[0], cid[1], chip.dates.shape[0], p.capacity)
-        return p
+        # pack() itself warns when the archive exceeds max_obs capacity
+        # (oldest kept, newest truncated — for a stream that would freeze
+        # the horizon forever).
+        return pack([chip], bucket=cfg.obs_bucket, max_obs=cfg.max_obs)
 
     hi_iso = acquired.split("/")[1]
     try:

@@ -109,10 +109,10 @@ def pack(chips: list[ChipData], *, bucket: int = 64, max_obs: int = 0) -> Packed
     """Pack chips into one padded batch.
 
     If a chip has more observations than max_obs (when nonzero), the oldest
-    are kept and the newest truncated — and a warning is the caller's job to
-    surface; truncation loses data and max_obs should be sized to the
-    archive (a 40-year Landsat series at 16-day cadence with two platforms
-    is ~1800 acquisitions).
+    are kept and the newest truncated — logged as a warning here, because
+    truncation loses data: max_obs (FIREBIRD_MAX_OBS) should be sized to
+    the archive (a 40-year Landsat series at 16-day cadence with two
+    platforms is ~1800 acquisitions).
     """
     assert chips, "cannot pack zero chips"
     sensor = chips[0].sensor
@@ -121,6 +121,14 @@ def pack(chips: list[ChipData], *, bucket: int = 64, max_obs: int = 0) -> Packed
     B, npix = sensor.n_bands, sensor.pixels
     T_max = max(c.dates.shape[0] for c in chips)
     cap = bucket_capacity(T_max, bucket, max_obs)
+    if T_max > cap:
+        from firebird_tpu.obs import logger
+
+        logger("timeseries").warning(
+            "archive exceeds the packed capacity: a chip has %d "
+            "acquisitions but max_obs caps the time axis at %d — the "
+            "newest %d are DROPPED; raise FIREBIRD_MAX_OBS to cover the "
+            "archive", T_max, cap, T_max - cap)
 
     C = len(chips)
     cids = np.zeros((C, 2), np.int64)

@@ -139,3 +139,30 @@ def test_ubid_coverage():
                               "swir2s", "thermals", "qas"}
     for v in ARD_UBIDS.values():
         assert len(v) == 4
+
+
+def test_pack_warns_on_truncation():
+    """An archive longer than max_obs loses its newest acquisitions —
+    pack must say so (the driver's default FIREBIRD_MAX_OBS=512 vs a
+    ~1800-acquisition full Landsat archive is a realistic silent-loss
+    footgun otherwise)."""
+    import logging
+
+    from firebird_tpu.ingest import SyntheticSource, pack
+
+    src = SyntheticSource(seed=1, start="1995-01-01", end="1999-01-01")
+    chip = src.chip(100, 200)
+    records: list = []
+    h = logging.Handler()
+    h.emit = records.append
+    log = logging.getLogger("firebird.timeseries")
+    log.addHandler(h)
+    try:
+        p = pack([chip], bucket=32, max_obs=64)
+        assert p.spectra.shape[-1] == 64
+        assert any("DROPPED" in r.getMessage() for r in records)
+        records.clear()
+        pack([chip], bucket=32)              # uncapped: no warning
+        assert not records
+    finally:
+        log.removeHandler(h)
