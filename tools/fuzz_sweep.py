@@ -1,0 +1,147 @@
+"""Wide randomized kernel-vs-oracle parity sweep (the CI fuzz tests'
+big brother).
+
+CI runs a fixed handful of fuzz grids (tests/test_fuzz_parity.py); this
+tool sweeps hundreds more — random archive spans, cadences, drop/dup
+rates, QA mixes, step changes, spikes — and reports structural agreement
+between the accelerator kernel and the float64 NumPy oracle on every
+pixel.  The numbers cited in docs/ARCHITECTURE.md (§parity audit) come
+from runs of this tool.
+
+    python tools/fuzz_sweep.py --seeds 1000:1036            # Landsat
+    python tools/fuzz_sweep.py --seeds 3000:3016 --sensor sentinel2
+    python tools/fuzz_sweep.py --seeds 1000:1018 --compare-f32
+
+The docs' published envelope came from: Landsat seeds 1000:1036,
+2000:2036, 4000:4036, 6000:6036, 7000:7036 at --pixels 40 (180 grids);
+Sentinel-2 seeds 3000:3016, 5000:5016, 8000:8016 at --pixels 32
+(48 grids); f32 agreement seeds 1000:1018 at --pixels 40.
+
+Exit status is non-zero if any pixel diverges structurally (procedures,
+model counts, masks, break/start/end days, curve QA, observation counts).
+Magnitude/rmse are NOT checked here — their measured float64 envelope is
+~2.5e-4 relative (coordinate-descent roundoff amplification, see
+tests/test_fuzz_parity.py) and the structural fields are the contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(__file__), os.pardir,
+                                   ".cache", "jax"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, "tests"))
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import test_fuzz_parity as F  # noqa: E402
+from firebird_tpu.ccd import kernel  # noqa: E402
+from firebird_tpu.ccd.reference import detect_sensor  # noqa: E402
+from firebird_tpu.ccd.sensor import SENSORS  # noqa: E402
+
+# Grid-parameter distributions: Landsat draws from the full ARD era;
+# other sensors (Sentinel-2 launched 2015) draw recent-era spans.
+LANDSAT_STARTS = ["1985-01-01", "1990-06-01", "1995-01-01", "2000-01-01",
+                  "2005-01-01"]
+RECENT_STARTS = ["2016-01-01", "2018-01-01", "2019-06-01"]
+
+
+def run_grid(seed: int, sensor, n_pixels: int,
+             compare_f32: bool) -> int | None:
+    """One grid's divergence count, or None when the grid is skipped
+    (fewer than 4 surviving dates)."""
+    landsat = sensor.name == "landsat-ard"
+    starts = LANDSAT_STARTS if landsat else RECENT_STARTS
+    r = np.random.default_rng(seed)
+    start = starts[int(r.integers(0, len(starts)))]
+    years = int(r.integers(2, 16) if landsat else r.integers(2, 6))
+    cad = int(r.choice([8, 12, 16, 24, 32] if landsat else [5, 10, 16]))
+    drop = float(r.uniform(0.0, 0.6 if landsat else 0.5))
+    dup = float(r.uniform(0.0, 0.15 if landsat else 0.1))
+    # A fresh generator with the same seed deliberately replays the stream
+    # that chose the grid parameters — a historical quirk kept so the
+    # sweeps behind the docs' published numbers regenerate exactly; the
+    # grid-shape/pixel-noise correlation it introduces narrows the fuzz
+    # space only marginally (every seed still varies both).
+    rng = np.random.default_rng(seed)
+    t = F._dates(start, f"{int(start[:4]) + years}-01-01", cad, drop, dup,
+                 rng)
+    if t.shape[0] < 4:
+        print(f"SKIPPED seed={seed}: only {t.shape[0]} dates survive",
+              flush=True)
+        return None
+    pixels = [F._fuzz_pixel(t, rng, special=F.SPECIALS.get(i), sensor=sensor)
+              for i in range(n_pixels)]
+    p = F._pack_pixels(t, [Y for Y, _ in pixels], [q for _, q in pixels],
+                       sensor=sensor)
+    seg = F._unwrap_chip(kernel.detect_packed(p, dtype=jnp.float64))
+    s32 = (F._unwrap_chip(kernel.detect_packed(p, dtype=jnp.float32))
+           if compare_f32 else None)
+    dates = p.dates[0][: int(p.n_obs[0])]
+    T = dates.shape[0]
+    bad = 0
+    for i in range(n_pixels):
+        o = detect_sensor(dates, np.asarray(p.spectra[0, :, i, :T],
+                                            np.float64),
+                          p.qas[0, i, :T], sensor)
+        k = kernel.segments_to_records(seg, dates, i, sensor=sensor)
+        try:
+            F._assert_structural(o, k, i)
+        except AssertionError as e:
+            bad += 1
+            print(f"DIVERGENCE seed={seed} T={T} pixel={i}: {e}", flush=True)
+        if s32 is not None:
+            k32 = kernel.segments_to_records(s32, dates, i, sensor=sensor)
+            a, b = k["change_models"], k32["change_models"]
+            if (len(a) != len(b)
+                    or any(x["break_day"] != y["break_day"]
+                           or x["start_day"] != y["start_day"]
+                           or x["end_day"] != y["end_day"]
+                           for x, y in zip(a, b))):
+                bad += 1
+                print(f"F32-DIVERGENCE seed={seed} T={T} pixel={i}",
+                      flush=True)
+    print(f"grid seed={seed} T={T} done ({bad} divergences)", flush=True)
+    return bad
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seeds", default="1000:1036",
+                    help="seed range lo:hi (one grid per seed)")
+    ap.add_argument("--sensor", default="landsat-ard",
+                    choices=sorted(SENSORS))
+    ap.add_argument("--pixels", type=int, default=40,
+                    help="adversarial pixels per grid")
+    ap.add_argument("--compare-f32", action="store_true",
+                    help="also require f32/f64 break-date agreement")
+    args = ap.parse_args()
+    lo, hi = (int(v) for v in args.seeds.split(":"))
+    sensor = SENSORS[args.sensor]
+    total_bad = swept = 0
+    for seed in range(lo, hi):
+        bad = run_grid(seed, sensor, args.pixels, args.compare_f32)
+        if bad is None:
+            continue
+        swept += 1
+        total_bad += bad
+    print(f"SWEEP COMPLETE: {total_bad} divergences over {swept} grids "
+          f"x {args.pixels} px ({swept * args.pixels} pixels, "
+          f"sensor={sensor.name}, {hi - lo - swept} grids skipped)")
+    return 1 if total_bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
