@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import click
 
+from firebird_tpu.ccd.sensor import SENSORS
 from firebird_tpu.utils import dates
 
 
@@ -183,7 +184,10 @@ def tiles(bounds, shard):
 @click.option("--format", "-f", "fmt", default="envi",
               type=click.Choice(["envi", "npy"]),
               help="envi: .dat+.hdr (opens in QGIS/GDAL); npy: .npy+.json")
-def export(bounds, product_names, product_dates, outdir, fmt):
+@click.option("--sensor", "-s", "sensor_name", default="landsat-ard",
+              type=click.Choice(sorted(SENSORS)),
+              help="campaign sensor spec (chip/pixel geometry)")
+def export(bounds, product_names, product_dates, outdir, fmt, sensor_name):
     """Export stored product rasters as georeferenced files.
 
     Mosaics the per-chip product rows (computed by `firebird save`) over
@@ -192,7 +196,8 @@ def export(bounds, product_names, product_dates, outdir, fmt):
     from firebird_tpu import export as exp
 
     for p in exp.export(product_names, product_dates,
-                        _parse_bounds(bounds), outdir, fmt=fmt):
+                        _parse_bounds(bounds), outdir, fmt=fmt,
+                        sensor=SENSORS[sensor_name]):
         click.echo(p)
 
 

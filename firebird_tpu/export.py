@@ -25,8 +25,8 @@ import numpy as np
 
 from firebird_tpu import grid, products
 from firebird_tpu.ccd.params import FILL_VALUE
+from firebird_tpu.ccd.sensor import LANDSAT_ARD, Sensor
 from firebird_tpu.config import Config
-from firebird_tpu.ingest.packer import CHIP_SIDE, PIXEL_SIZE_M
 from firebird_tpu.obs import logger
 from firebird_tpu.store import open_store
 
@@ -35,20 +35,33 @@ log = logger("export")
 FORMATS = ("envi", "npy")
 
 
-def mosaic(name: str, date: str, bounds, store) -> tuple[np.ndarray, float, float]:
+def mosaic(name: str, date: str, bounds, store,
+           sensor: Sensor = LANDSAT_ARD) -> tuple[np.ndarray, float, float]:
     """Assemble the stored product chips covering ``bounds`` into one
     raster.
+
+    Chip geometry (pixels per side, meters per pixel) comes from the
+    campaign's ``sensor`` spec; stored rows whose cell count disagrees
+    with it fail loudly rather than mis-georeference.  The chip *ids*
+    themselves still come from the CONUS Albers grid
+    (products.covering_chips) — the only tiling the store keys on.
 
     Returns ``(cells [H, W] int32, ulx, uly)`` — ulx/uly is the projection
     coordinate of the raster's upper-left corner (the UL chip's UL pixel
     corner).  Chips in the area with no stored row are FILL_VALUE.
     """
+    side, psz = sensor.chip_side, sensor.pixel_size_m
+    if side * psz != grid.CONUS.chip.sx:
+        raise ValueError(
+            f"sensor {sensor.name!r} chip extent {side * psz} m disagrees "
+            f"with the chip grid spacing {grid.CONUS.chip.sx} m — the "
+            "mosaic would overlap or gap chips")
     cids = products.covering_chips(bounds)
     ulx = min(cx for cx, _ in cids)
     uly = max(cy for _, cy in cids)
-    chip_m = CHIP_SIDE * PIXEL_SIZE_M
-    W = int((max(cx for cx, _ in cids) - ulx) / chip_m) * CHIP_SIDE + CHIP_SIDE
-    H = int((uly - min(cy for _, cy in cids)) / chip_m) * CHIP_SIDE + CHIP_SIDE
+    chip_m = side * psz
+    W = int((max(cx for cx, _ in cids) - ulx) / chip_m) * side + side
+    H = int((uly - min(cy for _, cy in cids)) / chip_m) * side + side
     out = np.full((H, W), FILL_VALUE, np.int32)
     missing = 0
     for cx, cy in cids:
@@ -57,11 +70,16 @@ def mosaic(name: str, date: str, bounds, store) -> tuple[np.ndarray, float, floa
         if not rows["cells"]:
             missing += 1
             continue
-        cells = np.asarray(rows["cells"][0], np.int32).reshape(CHIP_SIDE,
-                                                               CHIP_SIDE)
-        r0 = int((uly - cy) / PIXEL_SIZE_M)
-        c0 = int((cx - ulx) / PIXEL_SIZE_M)
-        out[r0:r0 + CHIP_SIDE, c0:c0 + CHIP_SIDE] = cells
+        flat = np.asarray(rows["cells"][0], np.int32)
+        if flat.size != sensor.pixels:
+            raise ValueError(
+                f"product row ({name}@{date}, chip {cx},{cy}) has "
+                f"{flat.size} cells but sensor {sensor.name!r} chips are "
+                f"{side}x{side}; pass the campaign's sensor to export")
+        cells = flat.reshape(side, side)
+        r0 = int((uly - cy) / psz)
+        c0 = int((cx - ulx) / psz)
+        out[r0:r0 + side, c0:c0 + side] = cells
     if missing:
         log.warning("mosaic %s@%s: %d of %d chips have no stored product "
                     "row (run `firebird save` first); filled with %d",
@@ -70,7 +88,8 @@ def mosaic(name: str, date: str, bounds, store) -> tuple[np.ndarray, float, floa
 
 
 def write_envi(base: str, cells: np.ndarray, ulx: float, uly: float,
-               proj: str | None = None) -> list[str]:
+               proj: str | None = None,
+               pixel_size_m: float = LANDSAT_ARD.pixel_size_m) -> list[str]:
     """``base``.dat (int32 little-endian BSQ) + ``base``.hdr."""
     proj = proj or grid.CONUS_ALBERS_PROJ
     dat, hdr = base + ".dat", base + ".hdr"
@@ -85,7 +104,7 @@ def write_envi(base: str, cells: np.ndarray, ulx: float, uly: float,
         "data type = 3", "interleave = bsq", "byte order = 0",
         f"data ignore value = {FILL_VALUE}",
         f"map info = {{Albers Conical Equal Area, 1, 1, {ulx:.1f}, "
-        f"{uly:.1f}, {PIXEL_SIZE_M:.1f}, {PIXEL_SIZE_M:.1f}, "
+        f"{uly:.1f}, {pixel_size_m:.1f}, {pixel_size_m:.1f}, "
         "units=Meters}",
         f"coordinate system string = {{{proj}}}",
     ]
@@ -95,12 +114,13 @@ def write_envi(base: str, cells: np.ndarray, ulx: float, uly: float,
 
 
 def write_npy(base: str, cells: np.ndarray, ulx: float, uly: float,
-              proj: str | None = None) -> list[str]:
+              proj: str | None = None,
+              pixel_size_m: float = LANDSAT_ARD.pixel_size_m) -> list[str]:
     """``base``.npy + ``base``.json georeferencing sidecar."""
     npy, meta = base + ".npy", base + ".json"
     np.save(npy, cells)
     with open(meta, "w") as f:
-        json.dump({"ulx": ulx, "uly": uly, "pixel_size_m": PIXEL_SIZE_M,
+        json.dump({"ulx": ulx, "uly": uly, "pixel_size_m": pixel_size_m,
                    "fill": FILL_VALUE, "crs_wkt": proj
                    or grid.CONUS_ALBERS_PROJ}, f, indent=1)
     return [npy, meta]
@@ -108,7 +128,7 @@ def write_npy(base: str, cells: np.ndarray, ulx: float, uly: float,
 
 def export(product_names, product_dates, bounds, outdir: str,
            fmt: str = "envi", cfg: Config | None = None,
-           store=None) -> list[str]:
+           store=None, sensor: Sensor = LANDSAT_ARD) -> list[str]:
     """Export one raster file set per (product, date) over ``bounds``.
 
     Reads the product table only — run ``products.save`` (or
@@ -134,9 +154,10 @@ def export(product_names, product_dates, bounds, outdir: str,
     paths: list[str] = []
     for name in product_names:
         for d in product_dates:
-            cells, ulx, uly = mosaic(name, d, bounds, store)
+            cells, ulx, uly = mosaic(name, d, bounds, store, sensor=sensor)
             base = os.path.join(outdir, f"{name}_{d}")
-            wrote = writer(base, cells, ulx, uly)
+            wrote = writer(base, cells, ulx, uly,
+                           pixel_size_m=sensor.pixel_size_m)
             log.info("exported %s@%s -> %s (%dx%d)", name, d, wrote[0],
                      cells.shape[1], cells.shape[0])
             paths += wrote

@@ -85,9 +85,14 @@ class ChipSegmentArrays:
                                for v in seg["curqa"]], np.int32)
         # argmax class index of each row's rfrawp vote vector (-1 when the
         # segment was never classified) — the cover product's input
-        raw = seg.get("rfrawp") or [None] * len(seg["sday"])
-        self.rfidx = np.array([int(np.argmax(v)) if v else -1
-                               for v in raw], np.int64)
+        raw = seg.get("rfrawp")
+        if raw is None or len(raw) == 0:
+            raw = [None] * len(seg["sday"])
+        # `v is not None and len(v)` rather than truthiness: rfrawp columns
+        # may hold numpy arrays (no store round-trip), whose bool() raises.
+        self.rfidx = np.array(
+            [int(np.argmax(v)) if v is not None and len(v) else -1
+             for v in raw], np.int64)
         self.real = self.sday > 1
 
 

@@ -338,7 +338,12 @@ class ParquetStore:
 
 def sanitize_keyspace(keyspace: str) -> str:
     """A valid unquoted CQL keyspace identifier (cqlstr semantics,
-    ccdc/__init__.py:44; unquoted CQL idents must start with a letter)."""
+    ccdc/__init__.py:44; CQL's unquoted-identifier grammar requires a
+    leading *letter*, so digit- and underscore-leading names are prefixed
+    ``ks_``).  A non-letter-leading name could never have been created
+    unquoted by Cassandra itself, so the prefix cannot orphan existing
+    data; the mapping is called out in deploy/README.md regardless.
+    """
     from firebird_tpu.config import _cqlstr
 
     ks = _cqlstr(keyspace) or "default"

@@ -99,3 +99,19 @@ def test_cli_export_end_to_end(tmp_path, monkeypatch):
     npy = os.path.join(out, "seglength_2014-01-01.npy")
     assert npy in res.output
     assert np.all(np.load(npy) == 5)
+
+
+def test_mosaic_rejects_cell_count_sensor_mismatch():
+    # A Sentinel-2 campaign's rows must not silently mis-georeference
+    # through Landsat geometry (ADVICE r1): cell-count disagreement with
+    # the sensor spec fails loudly.
+    import pytest
+
+    from firebird_tpu.ccd.sensor import SENTINEL2
+
+    store = MemoryStore()
+    put_product(store, "seglength", "2014-01-01", CX, CY, 7)  # 100x100 cells
+    bounds = [(CX + 10, CY - 10)]
+    with pytest.raises(ValueError, match="sentinel2"):
+        export.mosaic("seglength", "2014-01-01", bounds, store,
+                      sensor=SENTINEL2)

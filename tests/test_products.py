@@ -246,3 +246,15 @@ def test_save_detects_missing_chips_end_to_end():
     assert cells.shape == (PIXELS,)
     # most pixels have been in their first segment since early in the series
     assert (cells > 0).mean() > 0.5
+
+
+def test_cover_rfidx_accepts_numpy_vote_arrays():
+    # rfrawp may hold numpy arrays when no store round-trip intervened;
+    # bool(array) raises, so the guard must be None/len-based (ADVICE r1).
+    seg = frame([
+        (CX, CY, "2000-01-01", "2010-01-01", "2010-01-01", 0.4, 8),
+        (CX + 30, CY, "2000-01-01", "2010-01-01", "2010-01-01", 0.4, 8),
+    ])
+    seg["rfrawp"] = [np.array([1.0, 3.0, 7.0]), np.array([])]
+    a = products.ChipSegmentArrays(CX, CY, seg)
+    assert a.rfidx.tolist() == [2, -1]

@@ -241,10 +241,12 @@ def test_cassandra_ddl_generator_matches_backend():
     assert [d for d in cassandra_ddl("ks") if "CREATE TABLE" in d] \
         and all(t in " ".join(cassandra_ddl("ks"))
                 for t in ("chip", "pixel", "segment", "tile", "product"))
-    # unquoted CQL identifiers must start with a letter
+    # unquoted CQL identifiers must start with a letter: digit- and
+    # underscore-leading names get the ks_ prefix (deploy/README.md)
     from firebird_tpu.store.backends import sanitize_keyspace
 
     assert sanitize_keyspace("!prod") == "ks__prod"
+    assert sanitize_keyspace("_prod") == "ks__prod"
     assert sanitize_keyspace("9lives") == "ks_9lives"
     assert sanitize_keyspace("") == "default"
 
