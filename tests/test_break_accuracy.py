@@ -49,9 +49,15 @@ def test_break_day_is_first_exceeding_acquisition():
         assert nseg[p] >= 2, f"missed break at changed pixel {p}"
         bday = int(round(meta[p, 0, 2]))      # first segment's break day
         assert meta[p, 0, 3] == 1.0           # confirmed (chprob 1)
+        # the only tolerated inexactness: one acquisition early, the known
+        # noise-driven mode (docs/DIVERGENCE.md "Known accuracy envelope")
+        assert bday in (truth, int(t[np.searchsorted(t, truth) - 1])), \
+            (p, bday, truth)
         exact += bday == truth
     n_changed = int(changed.sum())
-    assert exact / n_changed >= 0.9, (exact, n_changed)
+    # pinned to the measured envelope (22/24 exact on this seed, the two
+    # misses one acquisition early) so regressions can't hide in slack
+    assert exact >= 22, (exact, n_changed)
 
 
 def test_break_accuracy_across_seeds():
@@ -63,10 +69,14 @@ def test_break_accuracy_across_seeds():
         nseg = np.asarray(seg.n_segments)[0]
         meta = np.asarray(seg.seg_meta)[0]
         truth = int(t[np.searchsorted(t, dt.to_ordinal(CHANGE))])
+        # every planted change must be *detected* (else exactness over the
+        # detected subset could hide missed breaks entirely)
+        assert all(nseg[p] >= 2 for p in range(N_PIX) if changed[p]), seed
         hits = [int(round(meta[p, 0, 2])) == truth
-                for p in range(N_PIX) if changed[p] and nseg[p] >= 2]
-        rates.append(np.mean(hits) if hits else 0.0)
-    assert min(rates) >= 0.9, rates
+                for p in range(N_PIX) if changed[p]]
+        rates.append(np.mean(hits))
+    # measured: every changed pixel exact on all three seeds
+    assert min(rates) == 1.0, rates
 
 
 def test_float32_break_agreement_with_float64():
@@ -86,4 +96,7 @@ def test_float32_break_agreement_with_float64():
             total += 1
             agree += (na[p] == nb[p]) and np.array_equal(
                 np.round(ma[p, :na[p], 2]), np.round(mb[p, :nb[p], 2]))
-    assert agree / total >= 0.95, (agree, total)
+    # measured: 100% f32/f64 agreement here and on the 720-pixel fuzz
+    # sweeps (docs/ARCHITECTURE.md) — the north star is *bit-identical*
+    # break dates, so no slack is tolerated
+    assert agree == total, (agree, total)

@@ -58,8 +58,42 @@ LANDSAT_STARTS = ["1985-01-01", "1990-06-01", "1995-01-01", "2000-01-01",
 RECENT_STARTS = ["2016-01-01", "2018-01-01", "2019-06-01"]
 
 
+def pyccd_oracle():
+    """detect_sensor-shaped adapter over the real lcmap-pyccd package, for
+    closing docs/DIVERGENCE.md when an environment can install it
+    (pip install lcmap-pyccd==2018.03.12.dev-ncompare.b2).  Landsat-only:
+    pyccd's ccd.detect takes the 7 fixed band keywords."""
+    try:
+        import ccd as pyccd  # the lcmap-pyccd package namespace
+    except ImportError as e:
+        raise SystemExit(
+            "--oracle pyccd needs the lcmap-pyccd package installed "
+            "(unavailable offline; see docs/DIVERGENCE.md)") from e
+
+    def detect(dates, spectra, qas, sensor):
+        bands = dict(zip(("blues", "greens", "reds", "nirs", "swir1s",
+                          "swir2s", "thermals"), np.asarray(spectra)))
+        out = dict(pyccd.detect(dates=np.asarray(dates),
+                                qas=np.asarray(qas), **bands))
+        # Normalize to the reference result contract (reference.py:404-421):
+        # pyccd reports its procedure *function* name (e.g.
+        # "standard_procedure"); models may be attr-style records.
+        proc = str(out.get("procedure", ""))
+        for name in ("standard", "permanent-snow", "insufficient-clear"):
+            if name.replace("-", "_") in proc.replace("-", "_"):
+                out["procedure"] = name
+                break
+        out["change_models"] = [
+            m if isinstance(m, dict)
+            else getattr(m, "_asdict", lambda: dict(m))()
+            for m in out.get("change_models", [])]
+        return out
+
+    return detect
+
+
 def run_grid(seed: int, sensor, n_pixels: int,
-             compare_f32: bool) -> int | None:
+             compare_f32: bool, oracle=detect_sensor) -> int | None:
     """One grid's divergence count, or None when the grid is skipped
     (fewer than 4 surviving dates)."""
     landsat = sensor.name == "landsat-ard"
@@ -93,9 +127,8 @@ def run_grid(seed: int, sensor, n_pixels: int,
     T = dates.shape[0]
     bad = 0
     for i in range(n_pixels):
-        o = detect_sensor(dates, np.asarray(p.spectra[0, :, i, :T],
-                                            np.float64),
-                          p.qas[0, i, :T], sensor)
+        o = oracle(dates, np.asarray(p.spectra[0, :, i, :T], np.float64),
+                   p.qas[0, i, :T], sensor)
         k = kernel.segments_to_records(seg, dates, i, sensor=sensor)
         try:
             F._assert_structural(o, k, i)
@@ -127,12 +160,20 @@ def main() -> int:
                     help="adversarial pixels per grid")
     ap.add_argument("--compare-f32", action="store_true",
                     help="also require f32/f64 break-date agreement")
+    ap.add_argument("--oracle", default="reference",
+                    choices=("reference", "pyccd"),
+                    help="reference: in-tree float64 oracle; pyccd: the "
+                         "real lcmap-pyccd package (docs/DIVERGENCE.md)")
     args = ap.parse_args()
     lo, hi = (int(v) for v in args.seeds.split(":"))
     sensor = SENSORS[args.sensor]
+    if args.oracle == "pyccd" and args.sensor != "landsat-ard":
+        ap.error("--oracle pyccd supports landsat-ard only "
+                 "(pyccd's detect takes the 7 fixed band keywords)")
+    oracle = detect_sensor if args.oracle == "reference" else pyccd_oracle()
     total_bad = swept = 0
     for seed in range(lo, hi):
-        bad = run_grid(seed, sensor, args.pixels, args.compare_f32)
+        bad = run_grid(seed, sensor, args.pixels, args.compare_f32, oracle)
         if bad is None:
             continue
         swept += 1
