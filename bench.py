@@ -148,6 +148,17 @@ def measure(cpu_only: bool) -> None:
     dev_rate, seg = timed_rate(run_fn, args, n_pixels, runs)
     e2e_rate = n_pixels / (n_pixels / dev_rate + t_xfer)
 
+    # ---- closed-form FLOP model -> MFU / roofline (docs/ROOFLINE.md) ----
+    from firebird_tpu.ccd import flops as flopsmod
+
+    roofline = flopsmod.bench_detail(
+        pixels_per_sec=dev_rate, P=n_pixels,
+        T=int(packed.spectra.shape[-1]), W=wcap,
+        S=int(np.asarray(seg.seg_meta).shape[-2]),
+        rounds=float(np.asarray(seg.rounds).mean()),
+        device_kind=jax.devices()[0].device_kind,
+        dtype_bytes=jnp.dtype(fdtype).itemsize, sensor=packed.sensor)
+
     # ---- CPU per-pixel rate (the pyccd stand-in), extrapolated ----
     sample = 12
     rng = np.random.default_rng(0)
@@ -242,6 +253,7 @@ def measure(cpu_only: bool) -> None:
             "transfer_sec": round(t_xfer, 3),
             "pixels_per_sec_incl_transfer": round(e2e_rate, 1),
             "kernel_rounds": int(np.asarray(seg.rounds)[0]),
+            "roofline": roofline,
             "cpu_ref_pixels_per_sec_per_core": round(cpu_rate, 2),
             "baseline_2000_core_pixels_per_sec": round(baseline_2000_cores, 1),
             "mean_segments": float(np.asarray(seg.n_segments).mean()),
