@@ -196,9 +196,30 @@ AUX_UBIDS = {
     "posidex": ("AUX_POSIDEX",), "slope": ("AUX_SLOPE",), "mpw": ("AUX_MPW",),
 }
 
+# Fallback wire dtypes when no /registry is reachable (values transcribed
+# from the reference's recorded registry, test/data/registry_response.json:
+# SR/BT INT16, PIXELQA UINT16, ASPECT INT16, DEM/POSIDEX/SLOPE FLOAT32,
+# MPW/TRENDS BYTE).
+_FALLBACK_AUX_WIRE = {"dem": np.float32, "trends": np.uint8,
+                      "aspect": np.int16, "posidex": np.float32,
+                      "slope": np.float32, "mpw": np.uint8}
 
-def decode_raster(rec: dict, dtype=np.int16) -> np.ndarray:
-    """Decode one chip record's base64 payload to a [100,100] array.
+
+def _fallback_wire_dtypes() -> dict[str, np.dtype]:
+    out = {}
+    for name in BAND_ORDER:
+        for u in ARD_UBIDS[name]:
+            out[u] = np.dtype(np.int16)
+    for u in ARD_UBIDS["qas"]:
+        out[u] = np.dtype(np.uint16)
+    for name, ubids in AUX_UBIDS.items():
+        for u in ubids:
+            out[u] = np.dtype(_FALLBACK_AUX_WIRE[name])
+    return out
+
+
+def decode_raster(rec: dict, dtype=np.int16, side: int = CHIP_SIDE) -> np.ndarray:
+    """Decode one chip record's base64 payload to a [side,side] array.
 
     Payload is little-endian (int16 spectra, uint16 QA, float32/byte AUX) —
     the wire format seen in test/data/chip_response.json.  The decode runs
@@ -215,7 +236,7 @@ def decode_raster(rec: dict, dtype=np.int16) -> np.ndarray:
     a = out[:n // wire.itemsize]
     if wire != np.dtype(dtype):  # big-endian host: swap to native order
         a = a.astype(dtype)
-    return a.reshape(CHIP_SIDE, CHIP_SIDE)
+    return a.reshape(side, side)
 
 
 def _default_http_get(url: str) -> list | dict:
@@ -236,40 +257,148 @@ class ChipmunkSource:
     chips); total in-flight requests = input_parallelism x
     band_parallelism (Config.band_parallelism; 1 restores the strict
     INPUT_PARTITIONS ceiling).
+
+    ``registry='auto'`` (default) fetches ``/registry`` once, lazily, and
+    derives the ubid maps, wire dtypes, and chip side from it (merlin's
+    registry_fn role, SURVEY.md §2.2); on failure it falls back to the
+    built-in Collection-01 tables with a warning.  Pass a
+    :class:`~firebird_tpu.ingest.registry.Registry` to pin one, or ``None``
+    to force the built-in tables.
     """
 
-    def __init__(self, url: str, http_get=None, band_parallelism: int = 8):
+    def __init__(self, url: str, http_get=None, band_parallelism: int = 8,
+                 registry="auto"):
+        import threading
+
         self.url = url.rstrip("/")
         self.http_get = http_get or _default_http_get
         self.band_parallelism = max(int(band_parallelism), 1)
+        self._registry = registry
+        self._resolved = None
+        self._resolve_lock = threading.Lock()
+
+    @staticmethod
+    def _derive(reg):
+        """(ard_ubids, aux_ubids, {ubid: wire dtype}, sensor) from a
+        Registry.  A split deployment serves ARD and AUX from different
+        services (Config.ard_url / aux_url), so a registry listing only one
+        half is valid: the missing half keeps the built-in tables."""
+        import dataclasses
+
+        from firebird_tpu.ccd.sensor import LANDSAT_ARD
+
+        try:
+            ard = reg.ard_ubids()
+        except LookupError:
+            ard = None
+        try:
+            aux = reg.aux_ubids()
+        except LookupError:
+            aux = None
+        if ard is None and aux is None:
+            raise LookupError("registry has neither ARD nor AUX bands")
+        used = [u for ubids in (*(ard or {}).values(), *(aux or {}).values())
+                for u in ubids]
+        dtypes = {u: reg.wire_dtype(u) for u in used}
+        side = reg.chip_side(used)
+        if (ard is None or aux is None) and side != CHIP_SIDE:
+            # The built-in tables describe the fixed 100x100 Collection-01
+            # service; mixing them with a different registry geometry would
+            # decode the fallback half at the wrong shape.
+            raise LookupError(
+                f"partial registry declares chip side {side}, but the "
+                f"built-in tables covering its missing half are "
+                f"{CHIP_SIDE}x{CHIP_SIDE}")
+        fallback = _fallback_wire_dtypes()
+        if ard is None:
+            ard = ARD_UBIDS
+            dtypes.update((u, fallback[u])
+                          for us in ARD_UBIDS.values() for u in us)
+        if aux is None:
+            aux = AUX_UBIDS
+            dtypes.update((u, fallback[u])
+                          for us in AUX_UBIDS.values() for u in us)
+        sensor = LANDSAT_ARD
+        if side != sensor.chip_side:
+            # Chip extent is the grid's 3 km; a denser registry shape
+            # means finer pixels (e.g. side 300 -> 10 m).
+            sensor = dataclasses.replace(
+                sensor, name=f"{sensor.name}-{side}", chip_side=side,
+                pixel_size_m=max(1, (sensor.chip_side *
+                                     sensor.pixel_size_m) // side))
+        log.info("chipmunk registry: %d ubids across %d logical bands, "
+                 "chip side %d", len(used), len(ard) + len(aux), side)
+        return ard, aux, dtypes, sensor
+
+    def _resolve(self):
+        """(ard_ubids, aux_ubids, {ubid: wire dtype}, sensor) — from the
+        service registry when reachable, built-in Collection-01 tables
+        otherwise.  A pinned Registry propagates derivation errors; 'auto'
+        falls back with a warning.  Locked: the driver calls chip() from
+        input_parallelism threads, and every chip in a run must see one
+        sensor spec (packer requires a single spec per batch)."""
+        with self._resolve_lock:
+            if self._resolved is None:
+                from firebird_tpu.ccd.sensor import LANDSAT_ARD
+                from firebird_tpu.ingest.registry import Registry
+
+                reg = self._registry
+                if isinstance(reg, str) and reg == "auto":
+                    try:
+                        self._resolved = self._derive(
+                            Registry.fetch(self.http_get, self.url))
+                    except Exception as e:
+                        log.warning(
+                            "chipmunk /registry unusable at %s (%s); using "
+                            "built-in Collection-01 ubid tables", self.url, e)
+                        reg = None
+                if self._resolved is None:
+                    if reg is None:
+                        self._resolved = (ARD_UBIDS, AUX_UBIDS,
+                                          _fallback_wire_dtypes(), LANDSAT_ARD)
+                    else:
+                        self._resolved = self._derive(reg)
+            return self._resolved
 
     def _chips(self, ubid: str, x: int, y: int, acquired: str) -> list:
         q = urllib.parse.urlencode(
             {"ubid": ubid, "x": x, "y": y, "acquired": acquired})
         return self.http_get(f"{self.url}/chips?{q}") or []
 
-    def _band_series(self, ubids, cx, cy, acquired, dtype) -> dict[int, np.ndarray]:
-        """{ordinal_date: raster} merged across a logical band's ubids."""
+    def _band_series(self, ubids, cx, cy, acquired, dtypes,
+                     side) -> dict[int, np.ndarray]:
+        """{ordinal_date: raster} merged across a logical band's ubids.
+
+        The recorded service contract disagrees on ubid case (/registry
+        serves 'LE07_SRB1', the working /chips capture uses 'le07_srb1' —
+        reference test/data/{registry,chip}_response.json), so an empty
+        result for a mixed-case ubid is retried lowercased before being
+        treated as genuinely absent.
+        """
         series: dict[int, np.ndarray] = {}
         for ubid in ubids:
-            for rec in self._chips(ubid, cx, cy, acquired):
+            recs = self._chips(ubid, cx, cy, acquired)
+            if not recs and ubid != ubid.lower():
+                recs = self._chips(ubid.lower(), cx, cy, acquired)
+            for rec in recs:
                 d = dt.to_ordinal(rec["acquired"][:10])
                 if d not in series:  # first writer wins; skip wasted decodes
-                    series[d] = decode_raster(rec, dtype)
+                    series[d] = decode_raster(rec, dtypes[ubid], side)
         return series
 
     def chip(self, cx: int, cy: int, acquired: str | None = None) -> ChipData:
         import concurrent.futures as cf
 
         acquired = acquired or dt.default_acquired()
-        names = list(BAND_ORDER) + ["qas"]
-        dtypes = {n: np.int16 for n in BAND_ORDER}
-        dtypes["qas"] = np.uint16
+        ard, _aux, dtypes, sensor = self._resolve()
+        side = sensor.chip_side
+        bands = sensor.band_names_plural
+        names = list(bands) + ["qas"]
         with cf.ThreadPoolExecutor(self.band_parallelism) as ex:
             series = dict(zip(names, ex.map(
-                lambda n: self._band_series(ARD_UBIDS[n], cx, cy, acquired,
-                                            dtypes[n]), names)))
-        per_band = {n: series[n] for n in BAND_ORDER}
+                lambda n: self._band_series(ard[n], cx, cy, acquired,
+                                            dtypes, side), names)))
+        per_band = {n: series[n] for n in bands}
         qa_series = series["qas"]
         # Date alignment: keep acquisitions present in every band + QA
         # (merlin's alignment step, SURVEY.md §3.3).
@@ -278,24 +407,23 @@ class ChipmunkSource:
             common &= set(s)
         t = np.array(sorted(common), dtype=np.int64)
         T = t.shape[0]
-        spectra = np.empty((params.NUM_BANDS, T, CHIP_SIDE, CHIP_SIDE), np.int16)
-        for b, name in enumerate(BAND_ORDER):
+        spectra = np.empty((sensor.n_bands, T, side, side), np.int16)
+        for b, name in enumerate(bands):
             for k, d in enumerate(t):
                 spectra[b, k] = per_band[name][int(d)]
         qas = np.stack([qa_series[int(d)] for d in t]) if T else \
-            np.zeros((0, CHIP_SIDE, CHIP_SIDE), np.uint16)
+            np.zeros((0, side, side), np.uint16)
         log.debug("chipmunk chip (%s,%s): %d aligned acquisitions", cx, cy, T)
-        return ChipData(cx=int(cx), cy=int(cy), dates=t, spectra=spectra, qas=qas)
+        return ChipData(cx=int(cx), cy=int(cy), dates=t, spectra=spectra,
+                        qas=qas, sensor=sensor)
 
     def aux(self, cx: int, cy: int, acquired: str | None = None) -> dict:
         acquired = acquired or dt.default_acquired()
-        # Wire dtypes from the AUX registry (test/data/registry_response.json:
-        # ASPECT INT16, DEM/POSIDEX/SLOPE FLOAT32, MPW/TRENDS BYTE).
-        wire = {"dem": np.float32, "trends": np.uint8, "aspect": np.int16,
-                "posidex": np.float32, "slope": np.float32, "mpw": np.uint8}
+        _ard, auxm, dtypes, sensor = self._resolve()
+        side = sensor.chip_side
         out = {}
-        for name, ubids in AUX_UBIDS.items():
-            series = self._band_series(ubids, cx, cy, acquired, wire[name])
+        for name, ubids in auxm.items():
+            series = self._band_series(ubids, cx, cy, acquired, dtypes, side)
             if not series:
                 raise LookupError(f"no AUX {name} at ({cx},{cy})")
             out[name] = series[min(series)]
