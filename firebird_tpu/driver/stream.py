@@ -25,6 +25,7 @@ ordinal day).
 
 from __future__ import annotations
 
+import concurrent.futures as cf
 import os
 
 import jax.numpy as jnp
@@ -40,7 +41,7 @@ from firebird_tpu.ingest import pack
 from firebird_tpu.obs import logger
 from firebird_tpu.store import AsyncWriter, open_store
 from firebird_tpu.utils import dates as dt
-from firebird_tpu.utils.fn import take
+from firebird_tpu.utils.fn import partition_all, take
 
 _STATE_FIELDS = ("coefs", "rmse", "vario", "nobs", "n_exceed", "end_day",
                  "exceed_day0", "break_day", "active")
@@ -166,73 +167,100 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
              tile["h"], tile["v"], len(cids), acquired, sdir)
     summary = dict(bootstrapped=0, updated=0, obs_applied=0,
                    pixels_need_batch=0)
-    def fetch_packed(cid, rng_iso):
+
+    def fetch_chip(cid, rng_iso):
         chip = source.chip(cid[0], cid[1], rng_iso)
         if chip.sensor != LANDSAT_ARD:
             raise ValueError(
                 "stream publishes the reference's Landsat segment "
                 f"schema; got sensor {chip.sensor.name!r}")
-        if not chip.dates.shape[0]:
-            return None
+        return chip if chip.dates.shape[0] else None
+
+    def fetch_packed(cid, rng_iso):
+        chip = fetch_chip(cid, rng_iso)
         # pack() itself warns when the archive exceeds max_obs capacity
         # (oldest kept, newest truncated — for a stream that would freeze
         # the horizon forever).
-        return pack([chip], bucket=cfg.obs_bucket, max_obs=cfg.max_obs)
+        return None if chip is None else pack(
+            [chip], bucket=cfg.obs_bucket, max_obs=cfg.max_obs)
 
     hi_iso = acquired.split("/")[1]
+    boot = [c for c in cids if not os.path.exists(_state_path(sdir, c))]
+    upd = [c for c in cids if os.path.exists(_state_path(sdir, c))]
     try:
-        for cid in cids:
-            path = _state_path(sdir, cid)
-            if not os.path.exists(path):
-                p = fetch_packed(cid, acquired)
-                if p is None:
-                    log.warning("chip (%s,%s): no acquisitions in %s; "
-                                "skipping", cid[0], cid[1], acquired)
+        # --- bootstrap: batched, chip axis sharded over local devices ---
+        # Same two data-parallel levels as the batch driver: host_shard
+        # split the tile across processes above; detect_batch splits each
+        # batch over this process's local device mesh (driver/core.py).
+        # Streaming updates stay per-chip ([P]-wide steps, cheap); the
+        # batch detection is where the device time goes.
+        batches = list(partition_all(max(cfg.chips_per_batch, 1), boot))
+        pad_to = cfg.chips_per_batch if len(batches) > 1 else None
+        with cf.ThreadPoolExecutor(
+                max_workers=max(cfg.input_parallelism, 1)) as ex:
+            for bids in batches:
+                fetched = list(ex.map(lambda c: fetch_chip(c, acquired),
+                                      bids))
+                keep = [(cid, ch) for cid, ch in zip(bids, fetched)
+                        if ch is not None]
+                for cid, ch in zip(bids, fetched):
+                    if ch is None:
+                        log.warning("chip (%s,%s): no acquisitions in %s; "
+                                    "skipping", cid[0], cid[1], acquired)
+                if not keep:
                     continue
-                seg = kernel.detect_packed(p, dtype=jnp.float32)
-                frames = ccdformat.chip_frames(
-                    p, 0, kernel.chip_slice(seg, 0, to_host=True))
-                for table in ("chip", "pixel", "segment"):
-                    writer.write(table, frames[table], key=tuple(cid))
-                one = kernel.chip_slice(seg, 0)
-                st = incremental.StreamState.from_chip(one)
-                sday, curqa = _tail_identity(one)
+                p = pack([ch for _, ch in keep], bucket=cfg.obs_bucket,
+                         max_obs=cfg.max_obs)
+                seg, n_real = dcore.detect_batch(
+                    p, jnp.float32, cfg.device_sharding, pad_to=pad_to,
+                    check_capacity=True)
+                for c in range(n_real):
+                    cid = keep[c][0]
+                    frames = ccdformat.chip_frames(
+                        p, c, kernel.chip_slice(seg, c, to_host=True))
+                    for table in ("chip", "pixel", "segment"):
+                        writer.write(table, frames[table], key=tuple(cid))
+                    one = kernel.chip_slice(seg, c)
+                    st = incremental.StreamState.from_chip(one)
+                    sday, curqa = _tail_identity(one)
+                    T = int(p.n_obs[c])
+                    side = dict(sday=sday, curqa=curqa,
+                                anchor=np.float64(p.dates[c][0]),
+                                horizon=np.float64(p.dates[c][T - 1]))
+                    summary["bootstrapped"] += 1
+                    save_state(_state_path(sdir, cid), st, side)
+                    summary["pixels_need_batch"] += int(
+                        np.asarray(st.needs_batch).sum())
+
+        # --- update: apply only acquisitions past each chip's horizon ---
+        for cid in upd:
+            path = _state_path(sdir, cid)
+            st, side = load_state(path)
+            horizon = float(side["horizon"])
+            # fetch only the delta past the horizon — the whole point
+            # of the hot path is not re-ingesting the archive
+            p = (fetch_packed(cid, f"{dt.to_iso(int(horizon) + 1)}/{hi_iso}")
+                 if horizon < dt.to_ordinal(hi_iso) else None)
+            if p is not None:
                 T = int(p.n_obs[0])
-                side = dict(sday=sday, curqa=curqa,
-                            anchor=np.float64(p.dates[0][0]),
-                            horizon=np.float64(p.dates[0][T - 1]))
-                summary["bootstrapped"] += 1
-                save_state(path, st, side)
-            else:
-                st, side = load_state(path)
-                horizon = float(side["horizon"])
-                # fetch only the delta past the horizon — the whole point
-                # of the hot path is not re-ingesting the archive
-                p = (fetch_packed(cid,
-                                  f"{dt.to_iso(int(horizon) + 1)}/{hi_iso}")
-                     if horizon < dt.to_ordinal(hi_iso) else None)
-                if p is not None:
-                    T = int(p.n_obs[0])
-                    t = p.dates[0][:T].astype(np.float64)
-                    new_idx = np.nonzero(t > horizon)[0]
-                    anchor = float(side["anchor"])
-                    for ti in new_idx:
-                        x_row = jnp.asarray(
-                            incremental.design_row(float(t[ti]), anchor))
-                        y_new = jnp.asarray(
-                            p.spectra[0, :, :, ti].T.astype(np.float32))
-                        qa_new = jnp.asarray(
-                            p.qas[0, :, ti].astype(np.int32))
-                        st = incremental.step(st, x_row, y_new, qa_new,
-                                              float(t[ti]),
-                                              sensor=p.sensor)
-                    if new_idx.size:
-                        side = dict(side, horizon=np.float64(t[-1]))
-                        writer.write("segment", publish_frame(p, st, side),
-                                     key=tuple(cid))
-                        summary["updated"] += 1
-                        summary["obs_applied"] += int(new_idx.size)
-                        save_state(path, st, side)
+                t = p.dates[0][:T].astype(np.float64)
+                new_idx = np.nonzero(t > horizon)[0]
+                anchor = float(side["anchor"])
+                for ti in new_idx:
+                    x_row = jnp.asarray(
+                        incremental.design_row(float(t[ti]), anchor))
+                    y_new = jnp.asarray(
+                        p.spectra[0, :, :, ti].T.astype(np.float32))
+                    qa_new = jnp.asarray(p.qas[0, :, ti].astype(np.int32))
+                    st = incremental.step(st, x_row, y_new, qa_new,
+                                          float(t[ti]), sensor=p.sensor)
+                if new_idx.size:
+                    side = dict(side, horizon=np.float64(t[-1]))
+                    writer.write("segment", publish_frame(p, st, side),
+                                 key=tuple(cid))
+                    summary["updated"] += 1
+                    summary["obs_applied"] += int(new_idx.size)
+                    save_state(path, st, side)
             summary["pixels_need_batch"] += int(
                 np.asarray(st.needs_batch).sum())
         writer.flush()

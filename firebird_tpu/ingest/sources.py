@@ -176,6 +176,12 @@ class FileSource:
 # Chipmunk HTTP source
 # ---------------------------------------------------------------------------
 
+class UnsupportedWireError(ValueError):
+    """A service registry declares band dtypes the packed kernel wire format
+    (int16 spectra / uint16 QA) cannot carry.  Deliberately NOT swallowed by
+    the registry='auto' fallback: falling back to the built-in Collection-01
+    tables against such a service would just query ubids it doesn't serve."""
+
 # LCMAP ARD Collection-01 ubid layout: logical band -> ubids across
 # platforms (merlin's chipmunk-ard profile; ubid example 'le07_srb1' in
 # test/data/chip_response.json).
@@ -276,6 +282,13 @@ class ChipmunkSource:
         self._registry = registry
         self._resolved = None
         self._resolve_lock = threading.Lock()
+        # Case-resolution memo (see _band_series): ubid -> casing the
+        # service actually answers; _prefer_lower flips after the first
+        # successful lowercase retry so later ubids query lowercase first.
+        # GIL-atomic dict/flag writes; worst case under a race is one
+        # redundant HTTP request.
+        self._ubid_case: dict[str, str] = {}
+        self._prefer_lower = False
 
     @staticmethod
     def _derive(reg):
@@ -289,17 +302,33 @@ class ChipmunkSource:
 
         try:
             ard = reg.ard_ubids()
-        except LookupError:
+        except LookupError as e:
+            log.warning("registry ARD half unusable (%s); keeping the "
+                        "built-in Collection-01 ARD tables", e)
             ard = None
         try:
             aux = reg.aux_ubids()
-        except LookupError:
+        except LookupError as e:
+            log.warning("registry AUX half unusable (%s); keeping the "
+                        "built-in Collection-01 AUX tables", e)
             aux = None
         if ard is None and aux is None:
             raise LookupError("registry has neither ARD nor AUX bands")
         used = [u for ubids in (*(ard or {}).values(), *(aux or {}).values())
                 for u in ubids]
         dtypes = {u: reg.wire_dtype(u) for u in used}
+        if ard is not None:
+            # The packed kernel wire format is int16 spectra / uint16 QA
+            # (PackedChips contract); a registry declaring float spectra
+            # must fail loudly, not truncate on assignment.
+            for band, ubids in ard.items():
+                want = np.uint16 if band == "qas" else np.int16
+                bad = [u for u in ubids if dtypes[u] != want]
+                if bad:
+                    raise UnsupportedWireError(
+                        f"registry band {band!r} ubids {bad} declare wire "
+                        f"dtypes {[str(dtypes[u]) for u in bad]}; the packed "
+                        f"kernel wire format requires {np.dtype(want).name}")
         side = reg.chip_side(used)
         if (ard is None or aux is None) and side != CHIP_SIDE:
             # The built-in tables describe the fixed 100x100 Collection-01
@@ -347,6 +376,8 @@ class ChipmunkSource:
                     try:
                         self._resolved = self._derive(
                             Registry.fetch(self.http_get, self.url))
+                    except UnsupportedWireError:
+                        raise
                     except Exception as e:
                         log.warning(
                             "chipmunk /registry unusable at %s (%s); using "
@@ -373,13 +404,22 @@ class ChipmunkSource:
         serves 'LE07_SRB1', the working /chips capture uses 'le07_srb1' —
         reference test/data/{registry,chip}_response.json), so an empty
         result for a mixed-case ubid is retried lowercased before being
-        treated as genuinely absent.
+        treated as genuinely absent; the resolved casing is memoized per
+        ubid (and as a source-wide preference) so absent-platform chips
+        don't pay the double request on every query.
         """
         series: dict[int, np.ndarray] = {}
         for ubid in ubids:
-            recs = self._chips(ubid, cx, cy, acquired)
-            if not recs and ubid != ubid.lower():
+            first = self._ubid_case.get(
+                ubid, ubid.lower() if self._prefer_lower else ubid)
+            recs = self._chips(first, cx, cy, acquired)
+            if recs:
+                self._ubid_case.setdefault(ubid, first)
+            elif first != ubid.lower():
                 recs = self._chips(ubid.lower(), cx, cy, acquired)
+                if recs:
+                    self._ubid_case[ubid] = ubid.lower()
+                    self._prefer_lower = True
             for rec in recs:
                 d = dt.to_ordinal(rec["acquired"][:10])
                 if d not in series:  # first writer wins; skip wasted decodes

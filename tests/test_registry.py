@@ -232,6 +232,23 @@ def test_chips_query_retries_lowercase_ubid():
     assert any(u == u.lower() for u in served)
 
 
+def test_float_spectral_band_rejected_loudly():
+    """A registry declaring float spectra violates the packed int16 wire
+    contract; that must raise even under registry='auto' (falling back to
+    builtin ubids against such a service would silently yield no data)."""
+    from firebird_tpu.ingest.sources import UnsupportedWireError
+
+    entries = _mini_registry_entries(100)
+    for e in entries:
+        if e["ubid"] == "XX01_SRB1":
+            e["data_type"] = "FLOAT32"
+    src = ChipmunkSource("http://chipmunk/ard",
+                         http_get=lambda url: entries
+                         if url.endswith("/registry") else [])
+    with pytest.raises(UnsupportedWireError, match="blues"):
+        src.chip(0, 0, "1998-01-01/2000-01-01")
+
+
 def test_registry_error_paths():
     with pytest.raises(LookupError):
         Registry.fetch(lambda url: [], "http://x")
