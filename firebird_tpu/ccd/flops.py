@@ -55,19 +55,21 @@ def _lasso_fit_flops(P: int, T: int, B: int, with_rmse: bool) -> float:
 def _tmask_flops(P: int, W: int, nb: int) -> float:
     """One Tmask IRLS screen over the compacted window (kernel._tmask_bad).
 
-    (1 + TMASK_IRLS_ITERS) weighted SPD solves, each: Xw mult, Gram
-    einsum [P,nb,W,NT]x[P,W,NT], corr einsum, unrolled 5x5 Cholesky
-    (kernel.py:299-319); per-iteration residual einsum + two masked
-    medians over W (bitonic network, kernel.py:313-315).
+    One-time XtXt outer products [P,W,NT^2], then (1 + TMASK_IRLS_ITERS)
+    weighted SPD solves, each: flat Gram dot [P,nb,W]x[P,W,NT^2], corr
+    dot, unrolled 5x5 Cholesky (kernel._tmask_bad/_chol_solve_small);
+    per-iteration residual einsum + two masked medians over W (bitonic
+    network).
     """
     solves = 1 + params.TMASK_IRLS_ITERS
-    per_solve = (P * nb * W * NT                 # Xw = wt * Xtw
-                 + 2.0 * P * nb * W * NT * NT    # G
-                 + 2.0 * P * nb * W * NT         # cc
+    xtxt = P * W * NT * NT                       # outer products, once
+    per_solve = (2.0 * P * nb * W * NT * NT      # flat Gram dot
+                 + 2.0 * P * nb * W * NT         # cc (incl. Y2*wt mult)
                  + P * nb * (NT ** 3 / 3 + 2 * NT * NT))   # unrolled chol
     resid = 2.0 * P * nb * W * NT + 2.0 * P * nb * W
     med = 2 * _sort_flops(P * nb, W)             # med + mad networks
-    return solves * per_solve + (params.TMASK_IRLS_ITERS + 1) * resid \
+    return xtxt + solves * per_solve \
+        + (params.TMASK_IRLS_ITERS + 1) * resid \
         + params.TMASK_IRLS_ITERS * med
 
 
@@ -90,14 +92,23 @@ def round_flops(P: int, T: int, W: int, sensor=LANDSAT_ARD) -> dict:
     init_fit = _lasso_fit_flops(P, T, B, with_rmse=False)   # c4 stability
     init_resid = 2.0 * P * B * W * K + 6.0 * P * B * W      # r_w + rmse4
     tmask = _tmask_flops(P, W, nb)
-    monitor = (2.0 * P * D * T * K      # pred_d (kernel.py:594)
+    # One-hot window/run selections (the scatter-free MXU formulation):
+    # Yw7 [P,B,T]x[P,W,T], XW [P,W,T]x[T,K+NT], X_run/Y_run over PEEK
+    # (kernel body; these replaced serialized per-lane gathers and now
+    # carry a real share of the round's MXU work).
+    onehot = (2.0 * P * B * W * T                 # Yw7
+              + 2.0 * P * W * T * (K + NT)       # XW
+              + 2.0 * P * params.PEEK_SIZE * T * (K + B))   # X_run + Y_run
+    monitor = (2.0 * P * D * T * K      # pred_d
                + 4.0 * P * D * T        # score s
                + 2.0 * P * B * params.PEEK_SIZE * K          # pred_run
                + _sort_flops(P * B, params.PEEK_SIZE))       # mags median
     refit = _lasso_fit_flops(P, T, B, with_rmse=True)       # cfull
     return {"init_fit": init_fit, "init_resid": init_resid,
-            "tmask": tmask, "monitor": monitor, "refit": refit,
-            "total": init_fit + init_resid + tmask + monitor + refit}
+            "tmask": tmask, "onehot": onehot, "monitor": monitor,
+            "refit": refit,
+            "total": (init_fit + init_resid + tmask + onehot + monitor
+                      + refit)}
 
 
 def setup_flops(P: int, T: int, sensor=LANDSAT_ARD) -> float:
@@ -137,9 +148,15 @@ def round_bytes(P: int, T: int, W: int, S: int, dtype_bytes: int,
     pt_temps = 10.0 * P * T * dtype_bytes + 6.0 * P * T      # bools
     state = 2 * (2.0 * P * T                                  # alive+included
                  + P * B * K * dtype_bytes                    # coefs
-                 + P * S * (6 + 2 * B + B * K) * dtype_bytes)  # bufs
-    window = 2.0 * P * W * (NT + B) * dtype_bytes            # gathers
-    return y_reads + pt_temps + state + window
+                 + P * S * (6 + 2 * B + B * K) * dtype_bytes)  # bufs (flat)
+    # One-hot selection tensors: oh_w [P,W,T] bool written+read (bad
+    # reduce) plus its float view read by the two selection matmuls;
+    # oh_run [P,PEEK,T] float written+read.
+    onehot = (3.0 * P * W * T                                # oh_w bool
+              + 3.0 * P * W * T * dtype_bytes               # ohf
+              + 2.0 * P * params.PEEK_SIZE * T * dtype_bytes)
+    window = 2.0 * P * W * (NT + B + NT * NT) * dtype_bytes  # members+XtXt
+    return y_reads + pt_temps + state + onehot + window
 
 
 # ---------------------------------------------------------------------------

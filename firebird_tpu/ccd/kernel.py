@@ -137,11 +137,19 @@ def _bitonic_sort_last(x):
 
 
 def _masked_median(x, m):
-    """Median of x where m, along the last axis (numpy even-count average)."""
+    """Median of x where m, along the last axis (numpy even-count average).
+
+    The two order statistics are read with masked one-hot reduces instead
+    of take_along_axis: per-lane gathers along the minor axis lower to
+    serialized loops on TPU (each profiled at ~0.5 ms/round in the event
+    loop), while the reduce is one fused elementwise pass.
+    """
     s = _bitonic_sort_last(jnp.where(m, x, jnp.inf))
     n = jnp.sum(m, axis=-1)
-    lo = jnp.take_along_axis(s, jnp.maximum((n - 1) // 2, 0)[..., None], -1)[..., 0]
-    hi = jnp.take_along_axis(s, jnp.maximum(n // 2, 0)[..., None], -1)[..., 0]
+    k = jnp.arange(s.shape[-1])
+    sel = lambda i: jnp.sum(jnp.where(k == i[..., None], s, 0), -1)
+    lo = sel(jnp.maximum((n - 1) // 2, 0))
+    hi = sel(jnp.maximum(n // 2, 0))
     med = 0.5 * (lo + hi)
     return jnp.where(n > 0, med, 0.0)
 
@@ -232,21 +240,24 @@ def _coefmask_for(n, P):
 
 
 def _chol_solve_small(G, c):
-    """Solve G x = c for SPD G [.., n, n], c [.., n] with n tiny and
-    static: fully unrolled Cholesky + two substitutions as elementwise
-    ops over the batch lanes — no LAPACK-style Cholesky/TriangularSolve
-    HLOs, which are latency-bound at small n.
+    """Solve G x = c for SPD G [.., n*n] (row-major flat), c [.., n] with
+    n tiny and static: fully unrolled Cholesky + two substitutions as
+    elementwise ops over the batch lanes — no LAPACK-style
+    Cholesky/TriangularSolve HLOs, which are latency-bound at small n.
+    G is FLAT on purpose: a [.., 5, 5] trailing shape takes a TPU tiled
+    layout padded 8x128 (20x the logical bytes), and the per-IRLS-round
+    relayout copies showed up at ~2 ms each in the profile.
 
     Numerically non-PD lanes (a pivot <= 0) return NaN, matching
     jnp.linalg.cholesky — callers' downstream comparisons then read
     False, which is the degenerate-Gram contract _tmask_bad relies on
     (flag nothing rather than fabricate huge betas)."""
-    n = G.shape[-1]
+    n = c.shape[-1]
     ok = None
     L = [[None] * n for _ in range(n)]
     for i in range(n):
         for j in range(i + 1):
-            s = G[..., i, j]
+            s = G[..., i * n + j]
             for q in range(j):
                 s = s - L[i][q] * L[j][q]
             if i == j:
@@ -294,7 +305,14 @@ def _tmask_bad(Xtw, Y2, w, vario2):
     """
     k = params.HUBER_K
     nt = Xtw.shape[-1]
-    eye = 1e-9 * jnp.eye(nt, dtype=Xtw.dtype)
+    # Per-member design outer products, shared by every IRLS Gram build:
+    # each solve is then one [P,2,W]x[P,W,nt^2] dot producing a FLAT Gram
+    # instead of a 4-operand einsum whose [.., nt, nt] output takes a
+    # padded tiled layout (6 Gram einsums + relayout copies were ~27 ms
+    # of the profiled dispatch).
+    XtXt = (Xtw[..., :, None] * Xtw[..., None, :]
+            ).reshape(*Xtw.shape[:-1], nt * nt)                # [P,W,25]
+    eye = (1e-9 * jnp.eye(nt, dtype=Xtw.dtype)).reshape(nt * nt)
 
     def solve(wt):
         # wt [P,2,W] weights -> beta [P,2,nt].  SPD solve via an unrolled
@@ -302,9 +320,10 @@ def _tmask_bad(Xtw, Y2, w, vario2):
         # static 5, and XLA's batched Cholesky/TriangularSolve run a
         # LAPACK-shaped blocked algorithm that is latency-bound at this
         # size on both CPU and TPU.
-        Xw = wt[..., None] * Xtw[:, None]                      # [P,2,W,nt]
-        G = jnp.einsum("pbwc,pwd->pbcd", Xw, Xtw)              # [P,2,nt,nt]
-        cc = jnp.einsum("pbw,pwc->pbc", Y2 * wt, Xtw)
+        G = jnp.einsum("pbw,pwe->pbe", wt, XtXt,
+                       precision=lax.Precision.HIGHEST)        # [P,2,25]
+        cc = jnp.einsum("pbw,pwc->pbc", Y2 * wt, Xtw,
+                        precision=lax.Precision.HIGHEST)
         return _chol_solve_small(G + eye, cc)
 
     w2 = jnp.broadcast_to(w[:, None, :], Y2.shape).astype(Y2.dtype)
@@ -348,24 +367,31 @@ def _dedup_first(cand, same_prev):
 
 
 def _variogram(Y, usable):
-    """[P,B] median |successive difference| over usable obs, floor 1e-6."""
-    # Compact usable-first by rank scatter instead of a [P,T] stable
-    # argsort (the kernel's last generic Sort HLO): order[p, q] = absolute
-    # index of p's q-th usable obs; slots beyond m fill with T-1 — their
-    # successive diffs are masked off by pair_ok below, so the values are
-    # bit-identical to the argsort formulation where it matters.
-    P_, T_ = usable.shape
-    ar_ = jnp.arange(T_)[None, :]
-    rank_ = jnp.cumsum(usable, -1) - 1
-    order = jnp.full((P_, T_), T_ - 1, ar_.dtype).at[
-        jnp.arange(P_)[:, None], jnp.where(usable, rank_, T_)
-    ].set(jnp.broadcast_to(ar_, (P_, T_)), mode="drop")
+    """[P,B] median |successive difference| over usable obs, floor 1e-6.
+
+    Successive usable values pair up via an associative last-valid scan
+    along T (log T combine steps of elementwise selects) instead of
+    compacting with a [P,B,T] gather: per-lane gathers along the time
+    axis lower to serialized fusion loops on TPU — profiled at 0.77 s per
+    chip, 37% of the whole dispatch.  The difference set is identical to
+    the compacted successive-diff formulation (each usable obs with a
+    usable predecessor contributes exactly one pair), so the median is
+    bit-identical.
+    """
+    u = jnp.broadcast_to(usable[:, None, :], Y.shape)
+
+    def op(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, av), af | bf
+
+    v, f = lax.associative_scan(op, (jnp.where(u, Y, 0.0), u), axis=-1)
+    prev_v = jnp.concatenate([jnp.zeros_like(v[..., :1]), v[..., :-1]], -1)
+    prev_f = jnp.concatenate([jnp.zeros_like(f[..., :1]), f[..., :-1]], -1)
+    pair_ok = u & prev_f                        # usable with a predecessor
+    d = jnp.abs(Y - prev_v)                                     # [P,B,T]
     m = jnp.sum(usable, -1)                                     # [P]
-    Yc = jnp.take_along_axis(Y, order[:, None, :].repeat(Y.shape[1], 1), axis=2)
-    d = jnp.abs(Yc[..., 1:] - Yc[..., :-1])                     # [P,B,T-1]
-    T = usable.shape[-1]
-    pair_ok = jnp.arange(T - 1)[None, :] < (m - 1)[:, None]     # [P,T-1]
-    v = _masked_median(d, pair_ok[:, None, :])
+    v = _masked_median(d, pair_ok)
     return jnp.where((m >= 2)[:, None], jnp.maximum(v, 1e-6), 1.0)
 
 
@@ -383,6 +409,18 @@ def _first_at_or_after(mask, i):
 
 def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
                  sensor=LANDSAT_ARD, max_segments: int = MAX_SEGMENTS):
+    """One chip — traced under HIGHEST matmul precision: on TPU the
+    default f32 dot runs reduced-precision passes, which would silently
+    degrade every Gram/prediction below the f32 the oracle-parity
+    envelope was measured at (CPU tests run full f32 and would never
+    catch it)."""
+    with jax.default_matmul_precision("highest"):
+        return _detect_core_impl(X, Xt, t, valid, Y, qa, wcap=wcap,
+                                 sensor=sensor, max_segments=max_segments)
+
+
+def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
+                      sensor=LANDSAT_ARD, max_segments: int = MAX_SEGMENTS):
     """One chip: X [T,8], Xt [T,5], t [T] f32 ordinal days, valid [T] bool,
     Y [B,P,T] f32 (the packed layout), qa [P,T] int32.  Returns
     ChipSegments (device).
@@ -444,20 +482,29 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
     usable_ins = _dedup_first(cand_ins, same_prev)
 
     # ---------------- result buffers ----------------
+    # Buffers are FLAT [P, S*k] in the loop state: trailing [S, 7, 8]
+    # shapes take TPU tiled layouts padded to (8, 128) — 16x the logical
+    # bytes — and the per-round buffer select was the loop's single
+    # hottest op (24 ms/dispatch profiled).  Reshaped once on exit.
     nseg0 = jnp.zeros(P, jnp.int32)
-    meta0 = jnp.zeros((P, S, 6), fdtype)
-    rmse0 = jnp.zeros((P, S, B), fdtype)
-    mag0 = jnp.zeros((P, S, B), fdtype)
-    coef0 = jnp.zeros((P, S, B, params.MAX_COEFS), fdtype)
+    meta0 = jnp.zeros((P, S * 6), fdtype)
+    rmse0 = jnp.zeros((P, S * B), fdtype)
+    mag0 = jnp.zeros((P, S * B), fdtype)
+    coef0 = jnp.zeros((P, S * B * params.MAX_COEFS), fdtype)
 
     def write_seg(bufs, nseg, wmask, meta, rmse_s, mag_s, coef_s):
         meta_b, rmse_b, mag_b, coef_b = bufs
         oh = (nseg[:, None] == jnp.arange(S)[None, :]) & wmask[:, None]  # [P,S]
-        meta_b = jnp.where(oh[..., None], meta[:, None, :], meta_b)
-        rmse_b = jnp.where(oh[..., None], rmse_s[:, None, :], rmse_b)
-        mag_b = jnp.where(oh[..., None], mag_s[:, None, :], mag_b)
-        coef_b = jnp.where(oh[..., None, None], coef_s[:, None, :, :], coef_b)
-        return (meta_b, rmse_b, mag_b, coef_b), nseg + wmask.astype(jnp.int32)
+
+        def upd(buf, val):                     # buf [P,S*k], val [P,k]
+            kk = val.shape[-1]
+            m = jnp.broadcast_to(oh[:, :, None], (P, S, kk)).reshape(P, S * kk)
+            v = jnp.broadcast_to(val[:, None, :], (P, S, kk)).reshape(P, S * kk)
+            return jnp.where(m, v, buf)
+
+        bufs = (upd(meta_b, meta), upd(rmse_b, rmse_s), upd(mag_b, mag_s),
+                upd(coef_b, coef_s.reshape(P, -1)))
+        return bufs, nseg + wmask.astype(jnp.int32)
 
     # ---------------- snow / insufficient-clear: one fit ----------------
     alt_usable = jnp.where((procedure == PROC_SNOW)[:, None], usable_snow,
@@ -519,12 +566,6 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
         t_i = jnp.take(t, i)
         Acum = jnp.cumsum(alive, -1)
         rank = Acum - 1                                        # [P,T]
-        # pos_of_rank[p, q] = absolute index of pixel p's q-th alive obs
-        # (T where no such rank) — one scatter per round; lets the window
-        # and the break-run gather by rank instead of sorting.
-        pos_of_rank = jnp.full((P, T + 1), T, ar.dtype).at[
-            jnp.arange(P)[:, None], jnp.where(alive, rank, T)
-        ].set(jnp.broadcast_to(ar, (P, T)), mode="drop")[:, :T]
         A_before = jnp.take_along_axis(Acum, i[:, None], -1)[:, 0] \
             - jnp.take_along_axis(alive, i[:, None], -1)[:, 0]
         cnt = Acum - A_before[:, None]
@@ -537,20 +578,34 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
 
         # Tmask screen over the compacted window: the window members are
         # exactly the alive obs with ranks [rank(i), rank(i)+n_win), so a
-        # rank-indexed gather bounds all IRLS median/Gram work by W << T.
+        # rank-indexed selection bounds all IRLS median/Gram work by
+        # W << T.  Member positions come from a one-hot reduce over T
+        # (ranks are unique among alive obs) rather than a rank scatter +
+        # gather — scatters lower to sort + serialized-loop fusions on
+        # TPU (~32 ms/round profiled, the loop body's hottest ops).
         n_win = jnp.sum(w_init, -1)                            # [P] <= W
         r_i = A_before                                         # rank of i
-        cols = jnp.minimum(r_i[:, None] + jnp.arange(W)[None, :], T - 1)
-        win_idx = jnp.take_along_axis(pos_of_rank, cols, -1)   # [P,W]
+        rel_w = rank - r_i[:, None]                            # [P,T]
+        oh_w = (alive & (rel_w >= 0) & (rel_w < W))[:, None, :] \
+            & (rel_w[:, None, :] == jnp.arange(W)[None, :, None])  # [P,W,T]
         valid_w = (jnp.arange(W)[None, :] < n_win[:, None])
-        safe_win = jnp.minimum(win_idx, T - 1)
-        Y2w = jnp.take_along_axis(Y[:, _TMB, :], safe_win[:, None, :], axis=2)
-        Xt_w = jnp.take(Xt, safe_win, axis=0)                  # [P,W,5]
+        # Window members selected by one-hot MXU matmuls — exact (each
+        # output is 1.0 x one element; HIGHEST precision keeps f32 inputs
+        # unrounded) and an order of magnitude cheaper than per-lane
+        # take_along_axis gathers, which serialize on TPU (profiled at
+        # ~7 ms/round combined).  Empty slots read 0 and are masked by
+        # valid_w downstream, as the gathered garbage was before.
+        ohf = oh_w.astype(fdtype)                              # [P,W,T]
+        Yw7 = jnp.einsum("pbt,pwt->pbw", Y, ohf,
+                         precision=lax.Precision.HIGHEST)      # [P,7,W]
+        XW = jnp.einsum("pwt,tc->pwc", ohf,
+                        jnp.concatenate([X, Xt], axis=1),
+                        precision=lax.Precision.HIGHEST)       # [P,W,13]
+        Xw8, Xt_w = XW[..., :8], XW[..., 8:]
+        Y2w = Yw7[:, _TMB, :]
         bad_w = _tmask_bad(Xt_w, Y2w, valid_w.astype(fdtype),
                            vario[:, _TMB])
-        bad = jnp.zeros((P, T), bool).at[
-            jnp.arange(P)[:, None], jnp.where(valid_w, win_idx, T)
-        ].set(bad_w, mode="drop")
+        bad = jnp.any(oh_w & bad_w[:, :, None], axis=1)        # [P,T]
         tm_removed = jnp.any(bad_w, -1)
 
         # Stability fit: 4 coefs over the (pre-screen-clean) window.  RMSE
@@ -561,16 +616,15 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
         cm4 = jnp.arange(params.MAX_COEFS)[None, :] < 4
         cm4 = jnp.broadcast_to(cm4, (P, params.MAX_COEFS))
         c4 = _fit_lasso_coefs(X, Y, w_stab.astype(fdtype), cm4, XX=XX)
-        Yw7 = jnp.take_along_axis(Y, safe_win[:, None, :], axis=2)  # [P,7,W]
-        Xw8 = jnp.take(X, safe_win, axis=0)                         # [P,W,8]
         r_w = Yw7 - jnp.einsum("pbc,pwc->pbw", c4, Xw8)
         stab_w = valid_w & ~bad_w
         n4 = jnp.maximum(jnp.sum(stab_w, -1), 1.0)
         r4 = jnp.sqrt(jnp.maximum(
             jnp.sum(r_w * r_w * stab_w[:, None, :], -1) / n4[:, None], 0.0))
         r_first = r_w[:, :, 0]                        # [P,7]
-        r_last = jnp.take_along_axis(
-            r_w, jnp.maximum(n_win - 1, 0)[:, None, None], axis=2)[..., 0]
+        r_last = jnp.sum(jnp.where(
+            jnp.arange(W)[None, None, :] == jnp.maximum(n_win - 1, 0)[:, None, None],
+            r_w, 0.0), -1)                            # one-hot, no lane gather
         span = jnp.take(t, j) - t_i
         denom = params.STABILITY_FACTOR * jnp.maximum(r4, vario)  # [P,7]
         slope_day = c4[..., 1] / 365.25
@@ -653,16 +707,22 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
         # new segment start; refit -> cursor bump past the refit point).
         pos_ev = jnp.where(is_brk, b_abs, f_abs)
         # Magnitudes: median full-band residual over the PEEK run at the
-        # break.  The run has at most PEEK_SIZE members — gather their
-        # absolute positions by rank and take a tiny median instead of
+        # break.  The run has at most PEEK_SIZE members — locate their
+        # absolute positions by a one-hot reduce over T (same scatter-free
+        # construction as the window) and take a tiny median instead of
         # masked medians over the whole [P,T] axis.
-        rel = ev_rank[:, None] + jnp.arange(params.PEEK_SIZE)[None, :]
-        run_ok = rel < m[:, None]                                 # [P,PEEK]
-        run_idx = jnp.minimum(jnp.take_along_axis(
-            pos_of_rank, jnp.minimum(rel, T - 1), -1), T - 1)
-        X_run = jnp.take(X, run_idx, axis=0)                      # [P,PEEK,8]
+        relk = ev_rank[:, None] + jnp.arange(params.PEEK_SIZE)[None, :]
+        run_ok = relk < m[:, None]                                # [P,PEEK]
+        rel_ev = rank - ev_rank[:, None]                          # [P,T]
+        oh_run = (alive[:, None, :] & (
+            rel_ev[:, None, :]
+            == jnp.arange(params.PEEK_SIZE)[None, :, None])
+        ).astype(fdtype)                                          # [P,K,T]
+        X_run = jnp.einsum("pkt,tc->pkc", oh_run, X,
+                           precision=lax.Precision.HIGHEST)       # [P,K,8]
         pred_run = jnp.einsum("pbc,pkc->pbk", st["coefs"], X_run)
-        Y_run = jnp.take_along_axis(Y, run_idx[:, None, :], axis=2)
+        Y_run = jnp.einsum("pbt,pkt->pbk", Y, oh_run,
+                           precision=lax.Precision.HIGHEST)
         resid_run = Y_run - pred_run                              # [P,7,PEEK]
         mags = _masked_median(
             resid_run, jnp.broadcast_to(run_ok[:, None, :], resid_run.shape))
@@ -740,7 +800,10 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
                            jnp.where(is_alt[:, None], alt_mask, False))
     return ChipSegments(
         n_segments=state["nseg"],
-        seg_meta=meta_b, seg_rmse=rmse_b, seg_mag=mag_b, seg_coef=coef_b,
+        seg_meta=meta_b.reshape(P, S, 6),
+        seg_rmse=rmse_b.reshape(P, S, B),
+        seg_mag=mag_b.reshape(P, S, B),
+        seg_coef=coef_b.reshape(P, S, B, params.MAX_COEFS),
         mask=final_mask, procedure=procedure, rounds=state["rounds"],
         vario=vario)
 

@@ -183,14 +183,15 @@ def test_chol_solve_small_accuracy_and_degenerate_nan():
     A = rng.normal(size=(50, 5, 5))
     G = np.einsum("pij,pkj->pik", A, A) + 1e-6 * np.eye(5)
     c = rng.normal(size=(50, 5))
-    got = np.asarray(kernel._chol_solve_small(jnp.asarray(G), jnp.asarray(c)))
+    got = np.asarray(kernel._chol_solve_small(
+        jnp.asarray(G.reshape(50, 25)), jnp.asarray(c)))
     want = np.linalg.solve(G, c[..., None])[..., 0]
     np.testing.assert_allclose(got, want, rtol=1e-8, atol=1e-8)
     # one lane made indefinite -> that lane (and only that lane) is NaN
     G_bad = G.copy()
     G_bad[7] = -np.eye(5)
-    got = np.asarray(kernel._chol_solve_small(jnp.asarray(G_bad),
-                                              jnp.asarray(c)))
+    got = np.asarray(kernel._chol_solve_small(
+        jnp.asarray(G_bad.reshape(50, 25)), jnp.asarray(c)))
     assert np.isnan(got[7]).all()
     ok = np.ones(50, bool)
     ok[7] = False

@@ -1,0 +1,104 @@
+"""Profile one steady-state CCD kernel dispatch on the current device and
+attribute device time to kernel source lines.
+
+Usage: python tools/profile_kernel.py [--chips N]
+
+Captures a jax.profiler trace of one _detect_batch_wire dispatch (after a
+compile+warmup run), parses the Chrome trace the TPU runtime emits, maps
+each XLA op back to its HLO metadata (source file:line), and prints the
+aggregation — the measurement loop of the round-2 kernel work
+(VERDICT.md next #2).  No tensorboard plugin needed.
+"""
+
+import collections
+import functools
+import glob
+import gzip
+import json
+import re
+import sys
+import time
+
+import numpy as np
+
+
+def _device_op_times(trace_dir: str) -> collections.Counter:
+    p = sorted(glob.glob(trace_dir + "/**/*.trace.json.gz", recursive=True))[-1]
+    d = json.loads(gzip.open(p).read())
+    procs = {m.get("pid"): m["args"].get("name") for m in d["traceEvents"]
+             if m.get("ph") == "M" and m.get("name") == "process_name"}
+    agg = collections.Counter()
+    for e in d["traceEvents"]:
+        if e.get("ph") == "X" and "dur" in e \
+                and "TPU" in str(procs.get(e.get("pid"), "")):
+            agg[e["name"]] += e["dur"]
+    return agg
+
+
+def _hlo_line_map(hlo: str) -> dict:
+    """op name -> (source_line, op_name metadata) from optimized HLO."""
+    out = {}
+    for m in re.finditer(
+            r"%(\S+?) = [^\n]*?(?:op_name=\"([^\"]*)\")?[^\n]*?"
+            r"source_line=(\d+)", hlo):
+        out[m.group(1)] = (int(m.group(3)), m.group(2) or "")
+    return out
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from firebird_tpu.ccd import kernel
+    from firebird_tpu.ingest import SyntheticSource, pack
+
+    n_chips = int(sys.argv[sys.argv.index("--chips") + 1]) \
+        if "--chips" in sys.argv else 1
+    src = SyntheticSource(seed=7, start="1985-01-01", end="2005-01-01",
+                          cloud_frac=0.15)
+    packed = pack([src.chip(100 + 3000 * i, 200) for i in range(n_chips)],
+                  bucket=64)
+    Xs, Xts, valid = kernel.prep_batch(packed)
+    fd = jnp.float32
+    args = (jnp.asarray(Xs, fd), jnp.asarray(Xts, fd),
+            jnp.asarray(packed.dates, fd), jnp.asarray(valid),
+            jnp.asarray(packed.spectra), jnp.asarray(packed.qas))
+    f = functools.partial(kernel._detect_batch_wire, dtype=fd,
+                          wcap=kernel.window_cap(packed),
+                          sensor=packed.sensor)
+    lowered = jax.jit(f).lower(*args)
+    hlo = lowered.compile().as_text()
+    seg = f(*args)
+    np.asarray(seg.n_segments)                       # compile + warmup
+    t0 = time.time()
+    np.asarray(f(*args).n_segments)
+    wall = time.time() - t0
+    px = packed.n_chips * packed.sensor.pixels
+    print(f"device={jax.devices()[0].device_kind} chips={packed.n_chips} "
+          f"T={packed.spectra.shape[-1]} W={kernel.window_cap(packed)} "
+          f"rounds={int(np.asarray(seg.rounds)[0])} "
+          f"wall={wall:.3f}s px/s={px / wall:,.0f}")
+
+    tdir = "/tmp/fb_ktrace"
+    with jax.profiler.trace(tdir):
+        np.asarray(f(*args).n_segments)
+    agg = _device_op_times(tdir)
+    lines = _hlo_line_map(hlo)
+
+    by_line = collections.Counter()
+    umbrella = ("jit__detect_batch_wire", "while.")
+    for nm, us in agg.items():
+        if any(nm.startswith(u) for u in umbrella):
+            continue
+        ln, opname = lines.get(nm, (None, ""))
+        key = f"kernel.py:{ln}" if ln else f"<{nm.split('.')[0]}>"
+        by_line[(key, opname.split("/")[-1][:40])] += us
+    total = sum(by_line.values())
+    print(f"attributed device op time: {total/1e6:.3f}s")
+    for (key, opname), us in by_line.most_common(28):
+        print(f"{us/1e6:8.4f}s  {key:18s} {opname}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
