@@ -136,20 +136,23 @@ def _bitonic_sort_last(x):
     return x[..., :W]
 
 
-def _masked_median(x, m):
-    """Median of x where m, along the last axis (numpy even-count average).
+def _onehot_take(x, i):
+    """x[..., i] along the last axis via a masked one-hot reduce.
 
-    The two order statistics are read with masked one-hot reduces instead
-    of take_along_axis: per-lane gathers along the minor axis lower to
+    take_along_axis per-lane gathers along the minor axis lower to
     serialized loops on TPU (each profiled at ~0.5 ms/round in the event
-    loop), while the reduce is one fused elementwise pass.
+    loop); the reduce is one fused elementwise pass.
     """
+    k = jnp.arange(x.shape[-1])
+    return jnp.sum(jnp.where(k == i[..., None], x, 0), -1)
+
+
+def _masked_median(x, m):
+    """Median of x where m, along the last axis (numpy even-count average)."""
     s = _bitonic_sort_last(jnp.where(m, x, jnp.inf))
     n = jnp.sum(m, axis=-1)
-    k = jnp.arange(s.shape[-1])
-    sel = lambda i: jnp.sum(jnp.where(k == i[..., None], s, 0), -1)
-    lo = sel(jnp.maximum((n - 1) // 2, 0))
-    hi = sel(jnp.maximum(n // 2, 0))
+    lo = _onehot_take(s, jnp.maximum((n - 1) // 2, 0))
+    hi = _onehot_take(s, jnp.maximum(n // 2, 0))
     med = 0.5 * (lo + hi)
     return jnp.where(n > 0, med, 0.0)
 
@@ -202,7 +205,7 @@ def _lasso_cd_lax(G, c, diag, coefmask):
 
     def one_iter(_, b):
         for j in range(params.MAX_COEFS):
-            rho = (c[..., j] - jnp.einsum("pk,pbk->pb", G[:, j, :], b)
+            rho = (c[..., j] - jnp.sum(G[:, j, None, :] * b, -1)
                    + diag[:, j][:, None] * b[..., j])
             if j == 0:
                 bj = rho / diag[:, j][:, None]
@@ -319,24 +322,28 @@ def _tmask_bad(Xtw, Y2, w, vario2):
         # Cholesky over the batch lanes (_chol_solve_small): nt is a tiny
         # static 5, and XLA's batched Cholesky/TriangularSolve run a
         # LAPACK-shaped blocked algorithm that is latency-bound at this
-        # size on both CPU and TPU.
-        G = jnp.einsum("pbw,pwe->pbe", wt, XtXt,
-                       precision=lax.Precision.HIGHEST)        # [P,2,25]
-        cc = jnp.einsum("pbw,pwc->pbc", Y2 * wt, Xtw,
-                        precision=lax.Precision.HIGHEST)
+        # size on both CPU and TPU.  Gram/corr are broadcast-multiply-
+        # reduce fusions, NOT batched dots: a [2,W]x[W,25] matmul per
+        # pixel makes XLA grid over the 10k-pixel batch axis (profiled
+        # ~3.4 ms per solve vs ~0.1 ms of actual bytes).
+        G = jnp.sum(wt[:, :, :, None] * XtXt[:, None, :, :], axis=2)
+        cc = jnp.sum((Y2 * wt)[:, :, :, None] * Xtw[:, None, :, :], axis=2)
         return _chol_solve_small(G + eye, cc)
+
+    def pred(beta):
+        return jnp.sum(beta[:, :, None, :] * Xtw[:, None, :, :], axis=-1)
 
     w2 = jnp.broadcast_to(w[:, None, :], Y2.shape).astype(Y2.dtype)
     beta = solve(w2)
     for _ in range(params.TMASK_IRLS_ITERS):
-        r = Y2 - jnp.einsum("pbc,pwc->pbw", beta, Xtw)
+        r = Y2 - pred(beta)
         med = _masked_median(r, w2 > 0)
         mad = _masked_median(jnp.abs(r - med[..., None]), w2 > 0)
         sigma = jnp.maximum(mad / 0.6745, 1e-6)
         a = jnp.abs(r) / (k * sigma[..., None])
         huber = jnp.where(a <= 1.0, 1.0, 1.0 / jnp.maximum(a, 1e-12))
         beta = solve(w2 * huber)
-    r = jnp.abs(Y2 - jnp.einsum("pbc,pwc->pbw", beta, Xtw))
+    r = jnp.abs(Y2 - pred(beta))
     bad = (r > params.TMASK_CONST * vario2[..., None]) & (w2 > 0)
     return jnp.any(bad, axis=1)
 
@@ -586,7 +593,8 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
         n_win = jnp.sum(w_init, -1)                            # [P] <= W
         r_i = A_before                                         # rank of i
         rel_w = rank - r_i[:, None]                            # [P,T]
-        oh_w = (alive & (rel_w >= 0) & (rel_w < W))[:, None, :] \
+        # (the == against arange(W) already implies 0 <= rel_w < W)
+        oh_w = alive[:, None, :] \
             & (rel_w[:, None, :] == jnp.arange(W)[None, :, None])  # [P,W,T]
         valid_w = (jnp.arange(W)[None, :] < n_win[:, None])
         # Window members selected by one-hot MXU matmuls — exact (each
@@ -616,15 +624,13 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
         cm4 = jnp.arange(params.MAX_COEFS)[None, :] < 4
         cm4 = jnp.broadcast_to(cm4, (P, params.MAX_COEFS))
         c4 = _fit_lasso_coefs(X, Y, w_stab.astype(fdtype), cm4, XX=XX)
-        r_w = Yw7 - jnp.einsum("pbc,pwc->pbw", c4, Xw8)
+        r_w = Yw7 - jnp.sum(c4[:, :, None, :] * Xw8[:, None, :, :], -1)
         stab_w = valid_w & ~bad_w
         n4 = jnp.maximum(jnp.sum(stab_w, -1), 1.0)
         r4 = jnp.sqrt(jnp.maximum(
             jnp.sum(r_w * r_w * stab_w[:, None, :], -1) / n4[:, None], 0.0))
         r_first = r_w[:, :, 0]                        # [P,7]
-        r_last = jnp.sum(jnp.where(
-            jnp.arange(W)[None, None, :] == jnp.maximum(n_win - 1, 0)[:, None, None],
-            r_w, 0.0), -1)                            # one-hot, no lane gather
+        r_last = _onehot_take(r_w, jnp.maximum(n_win - 1, 0)[:, None])
         span = jnp.take(t, j) - t_i
         denom = params.STABILITY_FACTOR * jnp.maximum(r4, vario)  # [P,7]
         slope_day = c4[..., 1] / 365.25
@@ -720,7 +726,8 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
         ).astype(fdtype)                                          # [P,K,T]
         X_run = jnp.einsum("pkt,tc->pkc", oh_run, X,
                            precision=lax.Precision.HIGHEST)       # [P,K,8]
-        pred_run = jnp.einsum("pbc,pkc->pbk", st["coefs"], X_run)
+        pred_run = jnp.sum(st["coefs"][:, :, None, :]
+                           * X_run[:, None, :, :], -1)            # [P,B,K]
         Y_run = jnp.einsum("pbt,pkt->pbk", Y, oh_run,
                            precision=lax.Precision.HIGHEST)
         resid_run = Y_run - pred_run                              # [P,7,PEEK]

@@ -38,10 +38,10 @@ def _device_op_times(trace_dir: str) -> collections.Counter:
 def _hlo_line_map(hlo: str) -> dict:
     """op name -> (source_line, op_name metadata) from optimized HLO."""
     out = {}
-    for m in re.finditer(
-            r"%(\S+?) = [^\n]*?(?:op_name=\"([^\"]*)\")?[^\n]*?"
-            r"source_line=(\d+)", hlo):
-        out[m.group(1)] = (int(m.group(3)), m.group(2) or "")
+    for m in re.finditer(r"%(\S+?) = [^\n]*source_line=(\d+)", hlo):
+        line = m.group(0)
+        op = re.search(r'op_name="([^"]*)"', line)
+        out[m.group(1)] = (int(m.group(2)), op.group(1) if op else "")
     return out
 
 
