@@ -1,0 +1,56 @@
+# Dev / deploy automation, mirroring the reference's Makefile:24-56 target
+# chain (docker-compose bring-up, db-schema load, tests) and the
+# ccdc.install.example:86-94 run aliases — minus the Spark/Maven machinery
+# the TPU runtime doesn't have.
+
+COMPOSE := docker compose -f deploy/docker-compose.yml
+# Tile example: CONUS Albers point inside tile h=20 v=11.
+X ?= 542000
+Y ?= 1650000
+ACQUIRED ?= 1982-01-01/2017-12-31
+
+.PHONY: install test bench image db-up db-schema db-test db-down \
+        changedetection classification clean
+
+install:
+	pip install -e . --no-build-isolation
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+image:
+	docker build -f deploy/Dockerfile -t firebird .
+
+# ---- results store (reference Makefile:24-39 docker-up + db-schema) ----
+
+db-up:
+	$(COMPOSE) up -d --wait cassandra
+
+# Apply the generated DDL (`firebird schema`) through the container's
+# cqlsh — the reference pipes resources/schema.cql the same way.
+db-schema:
+	firebird schema | $(COMPOSE) exec -T cassandra cqlsh
+
+# Gated live round-trip test against the composed Cassandra (skips
+# cleanly when the service is unreachable).
+db-test:
+	CASSANDRA=127.0.0.1 CASSANDRA_PORT=9043 \
+	python -m pytest tests/test_cassandra_live.py -v
+
+db-down:
+	$(COMPOSE) down
+
+# ---- run aliases (ccdc.install.example:86-94) ----
+
+changedetection:
+	firebird changedetection -x $(X) -y $(Y) -a $(ACQUIRED)
+
+classification:
+	firebird classification -x $(X) -y $(Y) -s 724204 -e 735598
+
+clean:
+	rm -rf build dist *.egg-info .pytest_cache
+	find . -name __pycache__ -prune -exec rm -rf {} +
