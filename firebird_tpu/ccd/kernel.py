@@ -893,6 +893,27 @@ def ensure_x64(dtype) -> None:
         jax.config.update("jax_enable_x64", True)
 
 
+def working_set_bytes(T: int, W: int | None = None,
+                      S: int = MAX_SEGMENTS, sensor=LANDSAT_ARD,
+                      dtype_bytes: int = 4) -> int:
+    """Estimated peak device bytes one chip needs during a dispatch.
+
+    Drives chips-per-batch auto-sizing (driver.core.auto_chips_per_batch):
+    wire arrays (int16 spectra + uint16 QA), the widened float spectra plus
+    one [P,B,T]-sized live temporary, ~20 [P,T] loop temporaries (the scale
+    the profiled HLO shows), the one-hot window tensors, and the flat
+    result buffers (live twice across the while_loop boundary).
+    """
+    P, B, K = sensor.pixels, sensor.n_bands, params.MAX_COEFS
+    W = W or min(T, 48)
+    wire = P * B * T * 2 + P * T * 2
+    widened = 2 * P * B * T * dtype_bytes
+    pt_temps = 20 * P * T * dtype_bytes
+    onehot = P * W * T * (1 + dtype_bytes)
+    bufs = 2 * P * S * (6 + 2 * B + B * K) * dtype_bytes
+    return int(wire + widened + pt_temps + onehot + bufs)
+
+
 def capacity_bound(packed) -> int:
     """An upper bound on segments any pixel of the batch can close:
     closed segments have disjoint included-observation sets of at least

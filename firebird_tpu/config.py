@@ -62,6 +62,8 @@ class Config:
 
     # Device batching: chips fitted per device dispatch (replaces
     # PRODUCT_PARTITIONS; sizing is per-device batch, not partition count).
+    # <= 0 means auto-size from the device memory budget and the acquired
+    # range (driver.core.auto_chips_per_batch).
     chips_per_batch: int = 8
 
     # Max observations capacity per pixel time series (padded/bucketed).
@@ -136,6 +138,7 @@ class Config:
                                        cls.band_parallelism)),
             chips_per_batch=int(e.get("FIREBIRD_CHIPS_PER_BATCH", cls.chips_per_batch)),
             max_obs=int(e.get("FIREBIRD_MAX_OBS", cls.max_obs)),
+            obs_bucket=int(e.get("FIREBIRD_OBS_BUCKET", cls.obs_bucket)),
             dtype=e.get("FIREBIRD_DTYPE", cls.dtype),
             device_sharding=e.get("FIREBIRD_DEVICE_SHARDING",
                                   cls.device_sharding),

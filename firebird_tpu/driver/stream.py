@@ -154,6 +154,7 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
     """
     cfg = cfg or Config.from_env()
     acquired = acquired or dt.default_acquired()
+    cfg = dcore.resolve_batching(cfg, acquired)
     log = logger("stream")
     source = source or dcore.make_source(cfg)
     store = store or open_store(cfg.store_backend, cfg.store_path,
