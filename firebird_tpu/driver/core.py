@@ -100,12 +100,14 @@ def host_shard(cids: list) -> list:
 
 def estimate_obs(acquired: str, cfg: Config) -> int:
     """Conservative observation-count estimate for an acquired range:
-    two-satellite 8-day effective cadence over the span, bucket-rounded,
-    capped by cfg.max_obs (the packer's hard ceiling)."""
+    two-satellite 8-day effective cadence over the span, rounded/capped
+    by the packer's own capacity rule (bucket_capacity — max_obs=0 means
+    uncapped there, so the estimate must not treat it as a cap)."""
+    from firebird_tpu.ingest.packer import bucket_capacity
+
     lo, hi = dt.acquired_range(acquired)
-    t = min((max(hi - lo, 0) // 8) + 8, cfg.max_obs)
-    b = max(cfg.obs_bucket, 1)
-    return min(-b * (-t // b), cfg.max_obs)
+    t = (max(hi - lo, 0) // 8) + 8
+    return bucket_capacity(t, max(cfg.obs_bucket, 1), cfg.max_obs)
 
 
 def auto_chips_per_batch(cfg: Config, acquired: str, device=None) -> int:
@@ -119,8 +121,6 @@ def auto_chips_per_batch(cfg: Config, acquired: str, device=None) -> int:
     """
     import jax
 
-    from firebird_tpu.ccd import kernel as k
-
     dev = device if device is not None else jax.local_devices()[0]
     try:
         stats = dev.memory_stats() or {}
@@ -131,8 +131,8 @@ def auto_chips_per_batch(cfg: Config, acquired: str, device=None) -> int:
     if not limit:
         return fallback
     t_est = estimate_obs(acquired, cfg)
-    per = k.working_set_bytes(t_est, dtype_bytes=4 if cfg.dtype ==
-                              "float32" else 8)
+    per = kernel.working_set_bytes(t_est, dtype_bytes=4 if cfg.dtype ==
+                                   "float32" else 8)
     n = max(int(limit * 0.6 / per), 1)
     logger("change-detection").info(
         "auto chips_per_batch: T~%d, %.2f GB/chip against %.1f GB device "

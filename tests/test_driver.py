@@ -366,6 +366,9 @@ def test_auto_chips_per_batch_sizes_from_device_memory():
     # the estimate honors the packer's max_obs ceiling
     assert estimate_obs(acq, cfg) == cfg.max_obs
     assert estimate_obs("1998-01-01/1998-06-01", cfg) == cfg.obs_bucket
+    # max_obs=0 is the packer's "uncapped", NOT a zero cap: the full
+    # archive estimate must stay ~1700 obs, not collapse to 0
+    assert estimate_obs(acq, Config(chips_per_batch=0, max_obs=0)) > 1600
     # budget math is consistent with the working-set model
     t = estimate_obs(acq, cfg)
     assert n16 == max(1, int(16e9 * 0.6 / kernel.working_set_bytes(t)))
