@@ -414,6 +414,76 @@ def _first_at_or_after(mask, i):
     return jnp.any(m, -1), jnp.argmax(m, -1)
 
 
+def _monitor_chain(s, alive, included, rank, cur_k, n_last_fit, in_mon, *,
+                   change_thr: float, outlier_thr: float):
+    """The MONITOR fast-forward event logic: score-derived break/refit/
+    tail location in rank space (see the body walkthrough in
+    _detect_core_impl).  Pure function of the round state so the Pallas
+    twin (pallas_ops.monitor_chain, FIREBIRD_PALLAS=1) can replace it —
+    the chain is a pipeline of cumulative/reduce ops over T whose
+    intermediates otherwise stream through HBM between fusions.
+
+    Returns a dict: m, is_tail, is_brk, is_refit, ev_rank, pos_ev,
+    n_exceed, n_rf, inc_q, rem_q.
+    """
+    P, T = s.shape
+    ar = jnp.arange(T)[None, :]
+    INF = T + 1
+    m = jnp.sum(alive, -1)                                    # [P]
+    kq = jnp.sum(alive & (ar < cur_k[:, None]), -1)           # cursor rank
+
+    ex = alive & (s > change_thr)
+    # Consecutive-exceeding run length starting at each alive obs:
+    # (rank of next alive non-exceeding obs, else m) - own rank.
+    reset_r = jnp.where(alive & ~ex, rank, INF)
+    nrr = lax.cummin(reset_r, axis=1, reverse=True)
+    runlen = jnp.minimum(nrr, m[:, None]) - rank
+    elig = alive & (rank >= kq[:, None])
+    brk = elig & ex & (runlen >= params.PEEK_SIZE)
+    has_brk = jnp.any(brk, -1)
+    b_abs = jnp.argmax(brk, -1)
+
+    o = s > outlier_thr
+    absq = elig & ~o
+    n0 = jnp.sum(included, -1)
+    n_inc = n0[:, None] + jnp.cumsum(absq, -1)
+    refit_hit = absq & (n_inc >= params.REFIT_FACTOR
+                        * n_last_fit[:, None])
+    has_refit = jnp.any(refit_hit, -1)
+    f_abs = jnp.argmax(refit_hit, -1)
+
+    q_tail = jnp.maximum(m - (params.PEEK_SIZE - 1), kq)      # a rank
+
+    def rank_at(idx):
+        return jnp.take_along_axis(rank, idx[:, None], -1)[:, 0]
+
+    b_ev = jnp.where(has_brk, rank_at(b_abs), INF)
+    f_ev = jnp.where(has_refit, rank_at(f_abs), INF)
+    is_tail = in_mon & (q_tail <= jnp.minimum(b_ev, f_ev))
+    is_brk = in_mon & ~is_tail & has_brk & (b_ev <= f_ev)
+    is_refit = in_mon & ~is_tail & ~is_brk & has_refit
+
+    ev_rank = jnp.where(is_tail, q_tail, jnp.where(is_brk, b_ev, f_ev))
+
+    # Normal-rules region ends before the event (inclusive for refit).
+    normal_hi = jnp.where(is_refit, ev_rank + 1, ev_rank)     # exclusive
+    normalq = elig & (rank < normal_hi[:, None])
+    inc_q = normalq & ~o
+    rem_q = normalq & o
+    # Tail region: score <= threshold absorbed, else removed+counted.
+    tailq = elig & (rank >= q_tail[:, None]) & is_tail[:, None]
+    tail_ex = tailq & (s > change_thr)
+    inc_q = inc_q | (tailq & ~tail_ex)
+    rem_q = rem_q | tail_ex
+    n_exceed = jnp.sum(tail_ex, -1)
+
+    pos_ev = jnp.where(is_brk, b_abs, f_abs)
+    n_rf = jnp.take_along_axis(n_inc, pos_ev[:, None], -1)[:, 0]
+    return dict(m=m, is_tail=is_tail, is_brk=is_brk, is_refit=is_refit,
+                ev_rank=ev_rank, pos_ev=pos_ev, n_exceed=n_exceed,
+                n_rf=n_rf, inc_q=inc_q, rem_q=rem_q)
+
+
 def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
                  sensor=LANDSAT_ARD, max_segments: int = MAX_SEGMENTS):
     """One chip — traced under HIGHEST matmul precision: on TPU the
@@ -655,63 +725,29 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
         dden = jnp.maximum(st["rmse"], vario)[:, _DET]            # [P,5]
         s = jnp.sum(((Y[:, _DET, :] - pred_d) / dden[:, :, None]) ** 2, axis=1)
 
-        m = jnp.sum(alive, -1)                                    # [P]
-        kq = jnp.sum(alive & (ar < st["cur_k"][:, None]), -1)     # cursor rank
+        chain = _monitor_chain
+        if use_pallas():
+            on_tpu = jax.default_backend() == "tpu"
+            # Mosaic cannot lower float64; compiled Pallas is f32-on-TPU
+            # only (same gate as the Lasso CD kernel above).
+            if not on_tpu or s.dtype == jnp.float32:
+                from firebird_tpu.ccd import pallas_ops
 
-        INF = T + 1
-        ex = alive & (s > CHANGE_THRESHOLD)
-        # Consecutive-exceeding run length starting at each alive obs:
-        # (rank of next alive non-exceeding obs, else m) - own rank.
-        reset_r = jnp.where(alive & ~ex, rank, INF)
-        nrr = lax.cummin(reset_r, axis=1, reverse=True)
-        runlen = jnp.minimum(nrr, m[:, None]) - rank
-        elig = alive & (rank >= kq[:, None])
-        brk = elig & ex & (runlen >= params.PEEK_SIZE)
-        has_brk = jnp.any(brk, -1)
-        b_abs = jnp.argmax(brk, -1)
+                chain = functools.partial(pallas_ops.monitor_chain,
+                                          interpret=not on_tpu)
+        mon = chain(s, alive, included, rank, st["cur_k"],
+                    st["n_last_fit"], in_mon,
+                    change_thr=CHANGE_THRESHOLD,
+                    outlier_thr=OUTLIER_THRESHOLD)
+        m, n_exceed, n_rf = mon["m"], mon["n_exceed"], mon["n_rf"]
+        is_tail, is_brk, is_refit = (mon["is_tail"], mon["is_brk"],
+                                     mon["is_refit"])
+        ev_rank, pos_ev = mon["ev_rank"], mon["pos_ev"]
 
-        o = s > OUTLIER_THRESHOLD
-        absq = elig & ~o
-        n0 = jnp.sum(included, -1)
-        n_inc = n0[:, None] + jnp.cumsum(absq, -1)
-        refit_hit = absq & (n_inc >= params.REFIT_FACTOR
-                            * st["n_last_fit"][:, None])
-        has_refit = jnp.any(refit_hit, -1)
-        f_abs = jnp.argmax(refit_hit, -1)
-
-        q_tail = jnp.maximum(m - (params.PEEK_SIZE - 1), kq)      # a rank
-
-        def rank_at(idx):
-            return jnp.take_along_axis(rank, idx[:, None], -1)[:, 0]
-
-        b_ev = jnp.where(has_brk, rank_at(b_abs), INF)
-        f_ev = jnp.where(has_refit, rank_at(f_abs), INF)
-        is_tail = in_mon & (q_tail <= jnp.minimum(b_ev, f_ev))
-        is_brk = in_mon & ~is_tail & has_brk & (b_ev <= f_ev)
-        is_refit = in_mon & ~is_tail & ~is_brk & has_refit
-
-        ev_rank = jnp.where(is_tail, q_tail, jnp.where(is_brk, b_ev, f_ev))
-
-        # Normal-rules region ends before the event (inclusive for refit).
-        normal_hi = jnp.where(is_refit, ev_rank + 1, ev_rank)     # exclusive
-        normalq = elig & (rank < normal_hi[:, None])
-        inc_q = normalq & ~o
-        rem_q = normalq & o
-        # Tail region: score <= threshold absorbed, else removed+counted.
-        tailq = elig & (rank >= q_tail[:, None]) & is_tail[:, None]
-        tail_ex = tailq & (s > CHANGE_THRESHOLD)
-        inc_q = inc_q | (tailq & ~tail_ex)
-        rem_q = rem_q | tail_ex
-        n_exceed = jnp.sum(tail_ex, -1)
-
-        inc_abs = inc_q & in_mon[:, None]
-        rem_abs = rem_q & in_mon[:, None]
+        inc_abs = mon["inc_q"] & in_mon[:, None]
+        rem_abs = mon["rem_q"] & in_mon[:, None]
         included_mon = included | inc_abs
         alive_mon = alive & ~rem_abs
-
-        # Break bookkeeping.  pos_ev: the event's absolute index (break ->
-        # new segment start; refit -> cursor bump past the refit point).
-        pos_ev = jnp.where(is_brk, b_abs, f_abs)
         # Magnitudes: median full-band residual over the PEEK run at the
         # break.  The run has at most PEEK_SIZE members — locate their
         # absolute positions by a one-hot reduce over T (same scatter-free
@@ -756,7 +792,6 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
 
         # ================= refit / init-ok shared fit =================
         n_ok = jnp.sum(w_stab, -1)
-        n_rf = jnp.take_along_axis(n_inc, pos_ev[:, None], -1)[:, 0]
         w_full = jnp.where(init_ok[:, None], w_stab,
                            included_mon & is_refit[:, None])
         n_full = jnp.where(init_ok, n_ok, n_rf)

@@ -1,9 +1,19 @@
 """Pallas TPU kernels for the CCD hot ops.
 
-The Lasso coordinate-descent loop is the detector's serial core: every
-event-loop round runs LASSO_ITERS x MAX_COEFS sequential coordinate
-updates over [P, B, 8] Gram systems (kernel._fit_lasso_coefs; the round
-count is small, so the CD loop dominates the non-matmul step count).
+:func:`monitor_chain` — the MONITOR event logic (kernel._monitor_chain):
+a pipeline of ~15 cumulative/reduce ops over the [P, T] score plane whose
+intermediates otherwise stream through HBM between fusions (the round-2
+profile shows the loop body paying a ~0.3 ms-per-op floor at these
+shapes).  One block computes cursor ranks, break-run lengths (reverse
+cummin as a log-step shift scan), refit-ladder crossings (cumsum
+likewise), and the tail/break/refit event selection entirely in VMEM,
+with the pixel axis on lanes and T on sublanes.
+
+:func:`lasso_cd` — the Lasso coordinate-descent loop, the detector's
+serial core: every event-loop round runs LASSO_ITERS x MAX_COEFS
+sequential coordinate updates over [P, B, 8] Gram systems
+(kernel._fit_lasso_coefs; the round count is small, so the CD loop
+dominates the non-matmul step count).
 Under plain XLA each of those ~400 steps materializes its [P, B]
 intermediates between fused ops; this kernel keeps the whole state
 (G, c, diag, mask, b) resident in VMEM for all iterations, streaming each
@@ -108,3 +118,174 @@ def lasso_cd(G, c, diag, coefmask, *, iters=params.LASSO_ITERS,
         interpret=interpret,
     )(Gt, ct, dg, mk)
     return bt[:, :, :P].transpose(2, 0, 1)
+
+
+# ---------------------------------------------------------------------------
+# MONITOR event-chain kernel
+# ---------------------------------------------------------------------------
+
+def mon_block_p(T: int) -> int:
+    """Lane-block width for the monitor kernel, derived from T.
+
+    The kernel keeps ~12 [T, BP] planes live (inputs + scan temporaries),
+    so its VMEM footprint is linear in T; a fixed 512-lane block that fits
+    a bucketed 512-obs archive would blow VMEM on a multi-decade T~1800
+    series.  Budget ~10 MB of VMEM for the planes (leaving room for the
+    pipeline's double-buffered input blocks) and round down to the 128
+    lane width, floored at one lane tile.
+    """
+    budget = 10 * 2 ** 20
+    per_lane = 12 * max(T, 1) * 4
+    return max(128, min(512, (budget // per_lane) // 128 * 128))
+
+
+def _shift_scan_min_rev(x, T, fill):
+    """Reverse cummin along axis 0 (sublanes) as a log-step shift-min."""
+    k = 1
+    while k < T:
+        pad = jnp.full((k,) + x.shape[1:], fill, x.dtype)
+        x = jnp.minimum(x, jnp.concatenate([x[k:], pad], axis=0))
+        k *= 2
+    return x
+
+
+def _shift_scan_add(x, T):
+    """Inclusive cumsum along axis 0 (sublanes) as a log-step shift-add."""
+    k = 1
+    while k < T:
+        pad = jnp.zeros((k,) + x.shape[1:], x.dtype)
+        x = x + jnp.concatenate([pad, x[:T - k]], axis=0)
+        k *= 2
+    return x
+
+
+def _monitor_block(s_ref, alive_ref, inc_ref, rank_ref, curk_ref, nlast_ref,
+                   inmon_ref, m_ref, istail_ref, isbrk_ref, isrefit_ref,
+                   evrank_ref, posev_ref, nexc_ref, nrf_ref, incq_ref,
+                   remq_ref, *, change_thr, outlier_thr, peek, refit_factor,
+                   T):
+    """One pixel block of kernel._monitor_chain, everything in VMEM.
+
+    Planes are [T, Pb] (T on sublanes, pixels on lanes); per-pixel vectors
+    are [1, Pb].  Mirrors the jnp reference op for op — argmax becomes a
+    first-index min-reduce with the same no-hit default (0), and the
+    rank/count lookups become one-hot reduces (no gather in Mosaic).
+    """
+    s = s_ref[...]
+    alive = alive_ref[...] > 0
+    included = inc_ref[...] > 0
+    rank = rank_ref[...]
+    cur_k = curk_ref[...]
+    nlast = nlast_ref[...]
+    in_mon = inmon_ref[...] > 0
+
+    INF = jnp.int32(T + 1)
+    ti = lax.broadcasted_iota(jnp.int32, s.shape, 0)          # [T,Pb]
+    one = jnp.int32(1)
+    m = jnp.sum(jnp.where(alive, one, 0), 0, keepdims=True)   # [1,Pb]
+    kq = jnp.sum(jnp.where(alive & (ti < cur_k), one, 0), 0, keepdims=True)
+
+    ex = alive & (s > change_thr)
+    reset_r = jnp.where(alive & ~ex, rank, INF)
+    nrr = _shift_scan_min_rev(reset_r, T, T + 1)
+    runlen = jnp.minimum(nrr, m) - rank
+    elig = alive & (rank >= kq)
+    brk = elig & ex & (runlen >= peek)
+    has_brk = jnp.any(brk, 0, keepdims=True)
+    b_abs = jnp.where(has_brk,
+                      jnp.min(jnp.where(brk, ti, INF), 0, keepdims=True), 0)
+
+    o = s > outlier_thr
+    absq = elig & ~o
+    n0 = jnp.sum(jnp.where(included, one, 0), 0, keepdims=True)
+    n_inc = n0 + _shift_scan_add(jnp.where(absq, one, 0), T)
+    refit_hit = absq & (n_inc.astype(s.dtype)
+                        >= refit_factor * nlast.astype(s.dtype))
+    has_refit = jnp.any(refit_hit, 0, keepdims=True)
+    f_abs = jnp.where(
+        has_refit,
+        jnp.min(jnp.where(refit_hit, ti, INF), 0, keepdims=True), 0)
+
+    q_tail = jnp.maximum(m - (peek - 1), kq)
+
+    def at_idx(plane, idx):
+        return jnp.sum(jnp.where(ti == idx, plane, 0), 0, keepdims=True)
+
+    b_ev = jnp.where(has_brk, at_idx(rank, b_abs), INF)
+    f_ev = jnp.where(has_refit, at_idx(rank, f_abs), INF)
+    is_tail = in_mon & (q_tail <= jnp.minimum(b_ev, f_ev))
+    is_brk = in_mon & ~is_tail & has_brk & (b_ev <= f_ev)
+    is_refit = in_mon & ~is_tail & ~is_brk & has_refit
+
+    ev_rank = jnp.where(is_tail, q_tail, jnp.where(is_brk, b_ev, f_ev))
+    normal_hi = jnp.where(is_refit, ev_rank + 1, ev_rank)
+    normalq = elig & (rank < normal_hi)
+    inc_q = normalq & ~o
+    rem_q = normalq & o
+    tailq = elig & (rank >= q_tail) & is_tail
+    tail_ex = tailq & (s > change_thr)
+    inc_q = inc_q | (tailq & ~tail_ex)
+    rem_q = rem_q | tail_ex
+    n_exceed = jnp.sum(jnp.where(tail_ex, one, 0), 0, keepdims=True)
+    pos_ev = jnp.where(is_brk, b_abs, f_abs)
+    n_rf = at_idx(n_inc, pos_ev)
+
+    as_i = lambda b: jnp.where(b, one, 0)
+    m_ref[...] = m
+    istail_ref[...] = as_i(is_tail)
+    isbrk_ref[...] = as_i(is_brk)
+    isrefit_ref[...] = as_i(is_refit)
+    evrank_ref[...] = ev_rank
+    posev_ref[...] = pos_ev
+    nexc_ref[...] = n_exceed
+    nrf_ref[...] = n_rf
+    incq_ref[...] = as_i(inc_q)
+    remq_ref[...] = as_i(rem_q)
+
+
+@functools.partial(jax.jit, static_argnames=("change_thr", "outlier_thr",
+                                             "interpret"))
+def monitor_chain(s, alive, included, rank, cur_k, n_last_fit, in_mon, *,
+                  change_thr, outlier_thr, interpret=False):
+    """Pallas port of kernel._monitor_chain (same output contract).
+
+    Values are identical for every lane the caller uses: argmax' no-hit
+    default (0), the INF sentinels, and the normal/tail partition all
+    mirror the jnp reference exactly; the only arithmetic is integer.
+    """
+    P, T = s.shape
+    BP = mon_block_p(T)
+    Pp = -BP * (-P // BP)
+    pad = Pp - P
+    plane = lambda x, cv=0: jnp.pad(
+        jnp.asarray(x).T, ((0, 0), (0, pad)), constant_values=cv)
+    vec = lambda x, cv=0: jnp.pad(
+        jnp.asarray(x)[None, :], ((0, 0), (0, pad)), constant_values=cv)
+
+    i32 = jnp.int32
+    args = (plane(s), plane(alive.astype(i32)), plane(included.astype(i32)),
+            plane(rank.astype(i32)), vec(cur_k.astype(i32)),
+            vec(n_last_fit.astype(i32), 1), vec(in_mon.astype(i32)))
+    kern = functools.partial(_monitor_block, change_thr=float(change_thr),
+                             outlier_thr=float(outlier_thr),
+                             peek=int(params.PEEK_SIZE),
+                             refit_factor=float(params.REFIT_FACTOR), T=T)
+    pspec = pl.BlockSpec((T, BP), lambda i: (0, i))
+    vspec = pl.BlockSpec((1, BP), lambda i: (0, i))
+    vshape = jax.ShapeDtypeStruct((1, Pp), i32)
+    pshape = jax.ShapeDtypeStruct((T, Pp), i32)
+    outs = pl.pallas_call(
+        kern,
+        grid=(Pp // BP,),
+        in_specs=[pspec, pspec, pspec, pspec, vspec, vspec, vspec],
+        out_specs=[vspec] * 8 + [pspec] * 2,
+        out_shape=[vshape] * 8 + [pshape] * 2,
+        interpret=interpret,
+    )(*args)
+    m, istail, isbrk, isrefit, evrank, posev, nexc, nrf, incq, remq = outs
+    cut = lambda x: x[0, :P]
+    cutb = lambda x: x[0, :P] > 0
+    return dict(m=cut(m), is_tail=cutb(istail), is_brk=cutb(isbrk),
+                is_refit=cutb(isrefit), ev_rank=cut(evrank),
+                pos_ev=cut(posev), n_exceed=cut(nexc), n_rf=cut(nrf),
+                inc_q=(incq[:, :P] > 0).T, rem_q=(remq[:, :P] > 0).T)

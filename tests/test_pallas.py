@@ -113,3 +113,57 @@ def test_pallas_inside_sharded_detect(monkeypatch):
                                   np.asarray(ref.n_segments))
     np.testing.assert_allclose(np.asarray(got.seg_meta),
                                np.asarray(ref.seg_meta), atol=1e-9)
+
+
+def test_monitor_chain_matches_jnp_reference():
+    """pallas_ops.monitor_chain (interpret mode) reproduces
+    kernel._monitor_chain exactly on randomized round states — every
+    output, including the argmax no-hit defaults and INF sentinels."""
+    from firebird_tpu.ccd import pallas_ops
+
+    rng = np.random.default_rng(5)
+    P, T = 137, 96           # odd sizes force the block padding path
+    for trial in range(4):
+        alive = rng.random((P, T)) < 0.8
+        s = jnp.asarray(
+            rng.gamma(2.0, 6.0, (P, T)).astype(np.float32))
+        included = jnp.asarray((rng.random((P, T)) < 0.4) & alive)
+        rank = jnp.cumsum(jnp.asarray(alive), -1) - 1
+        cur_k = jnp.asarray(rng.integers(0, T, P), jnp.int32)
+        n_last_fit = jnp.asarray(rng.integers(1, 40, P), jnp.int32)
+        in_mon = jnp.asarray(rng.random(P) < 0.7)
+        alive = jnp.asarray(alive)
+        kw = dict(change_thr=11.07, outlier_thr=15.09)
+        want = kernel._monitor_chain(s, alive, included, rank, cur_k,
+                                     n_last_fit, in_mon, **kw)
+        got = pallas_ops.monitor_chain(s, alive, included, rank, cur_k,
+                                       n_last_fit, in_mon, interpret=True,
+                                       **kw)
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_array_equal(
+                np.asarray(got[k]), np.asarray(want[k]), err_msg=k)
+
+
+def test_monitor_chain_in_detect_matches_default(monkeypatch):
+    """FIREBIRD_PALLAS=1 routes the monitor chain (and the CD loop)
+    through Pallas; full-detect results must equal the default path."""
+    from firebird_tpu.ingest import SyntheticSource, pack
+
+    src = SyntheticSource(seed=33, start="1995-01-01", end="1999-01-01",
+                          cloud_frac=0.15)
+    p = pack([src.chip(100, 200)], bucket=32)
+    from firebird_tpu.ingest.packer import PackedChips
+    p = PackedChips(cids=p.cids, dates=p.dates,
+                    spectra=p.spectra[:, :, :64, :], qas=p.qas[:, :64, :],
+                    n_obs=p.n_obs, sensor=p.sensor)
+    ref = kernel.detect_packed(p, dtype=jnp.float32)
+    monkeypatch.setenv("FIREBIRD_PALLAS", "1")
+    monkeypatch.setattr(kernel, "window_cap",
+                        lambda pk, _orig=kernel.window_cap: _orig(pk) + 16)
+    got = kernel.detect_packed(p, dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(got.n_segments),
+                                  np.asarray(ref.n_segments))
+    np.testing.assert_allclose(np.asarray(got.seg_meta),
+                               np.asarray(ref.seg_meta), atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(got.mask), np.asarray(ref.mask))
