@@ -91,18 +91,31 @@ def measure(cpu_only: bool) -> None:
                 np.asarray(f(*args).n_segments)   # device_get: see timed_rate
             return 2.0 / (time.time() - t0)
 
-        try:
-            r0 = probe_rate("0")
-            r1 = probe_rate("1")
-            pick = "1" if r1 > r0 else "0"
-            pallas_detail = {"pallas_autotune":
-                             {"default_runs_per_sec": round(r0, 3),
-                              "pallas_runs_per_sec": round(r1, 3),
-                              "picked": pick}}
-        except Exception as e:
-            pick = "0"
-            pallas_detail = {"pallas_autotune": {"error": repr(e)[:200],
-                                                 "picked": pick}}
+        rates = {}
+
+        errors = {}
+
+        def safe_rate(flag: str) -> float:
+            try:
+                rates[flag] = probe_rate(flag)
+            except Exception as e:
+                rates[flag] = 0.0
+                errors[flag] = repr(e)[:160]
+            return rates[flag]
+
+        # Per-component tuning: each Pallas kernel races the default
+        # alone, then the individually-winning set races as a combo —
+        # a component that loses on this toolchain can't drag down the
+        # ones that win (kernel.use_pallas component gating).
+        base = safe_rate("0")
+        winners = [c for c in ("lasso", "monitor", "tmask")
+                   if safe_rate(c) > base]
+        if len(winners) > 1:
+            safe_rate(",".join(winners))
+        pick = max(rates, key=lambda k: rates[k])
+        pallas_detail = {"pallas_autotune": {
+            "runs_per_sec": {k: round(v, 3) for k, v in rates.items()},
+            "picked": pick, **({"errors": errors} if errors else {})}}
         _os.environ["FIREBIRD_PALLAS"] = pick
         jax.clear_caches()
 

@@ -52,13 +52,23 @@ PHASE_INIT, PHASE_MONITOR, PHASE_DONE = 0, 1, 2
 PROC_STANDARD, PROC_SNOW, PROC_INSUF, PROC_NODATA = 0, 1, 2, 3
 
 
-def use_pallas() -> bool:
-    """Whether the Lasso CD loop runs as the Pallas VMEM-resident kernel
-    (FIREBIRD_PALLAS=1).  Read at trace time: set it before the first
-    detect call — already-compiled programs keep their path."""
+def use_pallas(component: str = "lasso") -> bool:
+    """Whether `component` runs as its Pallas VMEM-resident kernel.
+
+    FIREBIRD_PALLAS is "0"/"" (none), "1" (all), or a comma list of
+    component names ("lasso,monitor,tmask") — bench.py tunes the
+    components independently on hardware, so a kernel that loses on a
+    given toolchain can't drag down the ones that win.  Read at trace
+    time: set it before the first detect call — already-compiled programs
+    keep their path."""
     import os
 
-    return os.environ.get("FIREBIRD_PALLAS", "0") == "1"
+    v = os.environ.get("FIREBIRD_PALLAS", "0")
+    if v in ("", "0"):
+        return False
+    if v == "1":
+        return True
+    return component in {c.strip() for c in v.split(",")}
 
 
 # ---------------------------------------------------------------------------
@@ -186,7 +196,7 @@ def _fit_lasso_coefs(X, Y, w, coefmask, XX=None):
     c = jnp.einsum("pbt,tc->pbc", Y * w[:, None, :], X) / n[:, None, None]
     diag = jnp.maximum(jnp.diagonal(G, axis1=-2, axis2=-1), 1e-12)  # [P,8]
 
-    if use_pallas():
+    if use_pallas("lasso"):
         on_tpu = jax.default_backend() == "tpu"
         # Mosaic cannot lower float64; compiled Pallas is f32-on-TPU only.
         # Off-TPU the same kernel runs interpreted (tests), any dtype.
@@ -681,8 +691,16 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
                         precision=lax.Precision.HIGHEST)       # [P,W,13]
         Xw8, Xt_w = XW[..., :8], XW[..., 8:]
         Y2w = Yw7[:, _TMB, :]
-        bad_w = _tmask_bad(Xt_w, Y2w, valid_w.astype(fdtype),
-                           vario[:, _TMB])
+        tmask_fn = _tmask_bad
+        if use_pallas("tmask"):
+            on_tpu = jax.default_backend() == "tpu"
+            if not on_tpu or fdtype == jnp.float32:
+                from firebird_tpu.ccd import pallas_ops
+
+                tmask_fn = functools.partial(pallas_ops.tmask_bad,
+                                             interpret=not on_tpu)
+        bad_w = tmask_fn(Xt_w, Y2w, valid_w.astype(fdtype),
+                         vario[:, _TMB])
         bad = jnp.any(oh_w & bad_w[:, :, None], axis=1)        # [P,T]
         tm_removed = jnp.any(bad_w, -1)
 
@@ -726,7 +744,7 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
         s = jnp.sum(((Y[:, _DET, :] - pred_d) / dden[:, :, None]) ** 2, axis=1)
 
         chain = _monitor_chain
-        if use_pallas():
+        if use_pallas("monitor"):
             on_tpu = jax.default_backend() == "tpu"
             # Mosaic cannot lower float64; compiled Pallas is f32-on-TPU
             # only (same gate as the Lasso CD kernel above).

@@ -24,9 +24,16 @@ the 128-wide vector lanes and the tiny K=8 axis sits on sublanes — the
 natural VPU shape for the per-coordinate updates, which are elementwise
 over P.
 
-Enablement: `firebird_tpu.ccd.kernel` calls :func:`lasso_cd` when
-FIREBIRD_PALLAS=1 (off by default until benchmarked on hardware; CPU
-tests run the same kernel under ``interpret=True``).
+:func:`tmask_bad` — the Tmask IRLS screen (kernel._tmask_bad): six
+sequential weighted SPD solves plus ten masked medians per round, each a
+separate fusion paying the per-op floor; the kernel runs the whole IRLS
+in VMEM, with a shift-exchange bitonic network for the medians.
+
+Enablement: `firebird_tpu.ccd.kernel` routes a component through its
+Pallas kernel when FIREBIRD_PALLAS names it — "1" enables all three,
+"lasso,monitor"-style lists pick a subset (kernel.use_pallas; bench.py
+auto-tunes the winning set on hardware; CPU tests run the same kernels
+under ``interpret=True``).
 """
 
 from __future__ import annotations
@@ -289,3 +296,197 @@ def monitor_chain(s, alive, included, rank, cur_k, n_last_fit, in_mon, *,
                 is_refit=cutb(isrefit), ev_rank=cut(evrank),
                 pos_ev=cut(posev), n_exceed=cut(nexc), n_rf=cut(nrf),
                 inc_q=(incq[:, :P] > 0).T, rem_q=(remq[:, :P] > 0).T)
+
+
+# ---------------------------------------------------------------------------
+# Tmask IRLS kernel
+# ---------------------------------------------------------------------------
+
+def tmask_block_p(W: int) -> int:
+    """Lane-block width for the Tmask kernel (footprint linear in the
+    padded window length; ~30 [W, BP] planes live through the IRLS)."""
+    budget = 10 * 2 ** 20
+    per_lane = 30 * max(W, 1) * 4
+    return max(128, min(512, (budget // per_lane) // 128 * 128))
+
+
+def _bitonic_sublane(x, n, fill):
+    """Ascending bitonic sort along axis 0 (length n, a power of two) via
+    index-arithmetic shift exchanges — no gather/scatter, Mosaic-friendly.
+    Produces the same sorted values as any sort (stability irrelevant for
+    order statistics)."""
+    i = lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    k = 2
+    while k <= n:
+        j = k // 2
+        while j >= 1:
+            fillp = jnp.full((j,) + x.shape[1:], fill, x.dtype)
+            up = jnp.concatenate([x[j:], fillp], axis=0)      # x[i + j]
+            dn = jnp.concatenate([fillp, x[:-j]], axis=0)     # x[i - j]
+            low = (i & j) == 0
+            partner = jnp.where(low, up, dn)
+            asc = (i & k) == 0
+            keep_small = low == asc
+            mn = jnp.minimum(x, partner)
+            mx = jnp.maximum(x, partner)
+            x = jnp.where(keep_small, mn, mx)
+            j //= 2
+        k *= 2
+    return x
+
+
+def _median_sublane(r, mask, n_pow):
+    """kernel._masked_median along axis 0: sort masked values (+inf
+    padding), average the two middle order statistics.  The plane is
+    padded up to the power-of-two network size — the bitonic exchange
+    indices are only correct on a full n_pow-row array."""
+    W = r.shape[0]
+    x = jnp.where(mask, r, jnp.inf)
+    if n_pow != W:
+        x = jnp.concatenate(
+            [x, jnp.full((n_pow - W,) + x.shape[1:], jnp.inf, x.dtype)], 0)
+    s = _bitonic_sublane(x, n_pow, jnp.inf)
+    i = lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    n = jnp.sum(jnp.where(mask, 1, 0), 0, keepdims=True)
+    lo_i = jnp.maximum((n - 1) // 2, 0)
+    hi_i = jnp.maximum(n // 2, 0)
+    lo = jnp.sum(jnp.where(i == lo_i, s, 0.0), 0, keepdims=True)
+    hi = jnp.sum(jnp.where(i == hi_i, s, 0.0), 0, keepdims=True)
+    return jnp.where(n > 0, 0.5 * (lo + hi), 0.0)             # [1, BP]
+
+
+def _tmask_block(xt_ref, y2_ref, w_ref, vario_ref, bad_ref, *, nt, nb,
+                 n_pow, iters, huber_k, tmask_const):
+    """One pixel block of kernel._tmask_bad, all six IRLS solves in VMEM.
+
+    xt [nt, W, BP], y2 [nb, W, BP], w [W, BP] (0/1), vario [nb, BP]
+    -> bad [W, BP] (int32 0/1).  Mirrors the jnp reference's arithmetic
+    order exactly: XtXt outer products precomputed once, Gram/corr as
+    weight-times-product reduces over W, the unrolled 5x5 Cholesky with
+    its NaN-on-non-PD contract, MAD/Huber iterations with the same
+    masked-median semantics.
+    """
+    X = [xt_ref[c] for c in range(nt)]                        # [W, BP] each
+    Y = [y2_ref[b] for b in range(nb)]
+    wm = w_ref[...]                                           # [W, BP] 0/1
+    vario = vario_ref[...]                                    # [nb, BP]
+
+    xx = {}
+    for ii in range(nt):
+        for jj in range(ii + 1):
+            xx[(ii, jj)] = X[ii] * X[jj]
+
+    def chol_solve(G, c):
+        # G: dict (i,j)->[1,BP] lower half; c: list of nt [1,BP]
+        ok = None
+        L = [[None] * nt for _ in range(nt)]
+        for ii in range(nt):
+            for jj in range(ii + 1):
+                sacc = G[(ii, jj)]
+                for q in range(jj):
+                    sacc = sacc - L[ii][q] * L[jj][q]
+                if ii == jj:
+                    pos = sacc > 0
+                    ok = pos if ok is None else ok & pos
+                    L[ii][jj] = jnp.sqrt(jnp.maximum(sacc, 1e-30))
+                else:
+                    L[ii][jj] = sacc / L[jj][jj]
+        yv = [None] * nt
+        for ii in range(nt):
+            sacc = c[ii]
+            for q in range(ii):
+                sacc = sacc - L[ii][q] * yv[q]
+            yv[ii] = sacc / L[ii][ii]
+        xv = [None] * nt
+        for ii in reversed(range(nt)):
+            sacc = yv[ii]
+            for q in range(ii + 1, nt):
+                sacc = sacc - L[q][ii] * xv[q]
+            xv[ii] = sacc / L[ii][ii]
+        nan = jnp.float32(jnp.nan)
+        return [jnp.where(ok, v, nan) for v in xv]
+
+    def solve(wt):
+        # wt: list of nb [W, BP] weight planes -> beta[b] = list of nt [1,BP]
+        betas = []
+        for b in range(nb):
+            G = {}
+            for ii in range(nt):
+                for jj in range(ii + 1):
+                    G[(ii, jj)] = jnp.sum(wt[b] * xx[(ii, jj)], 0,
+                                          keepdims=True) \
+                        + (1e-9 if ii == jj else 0.0)
+            c = [jnp.sum((Y[b] * wt[b]) * X[ii], 0, keepdims=True)
+                 for ii in range(nt)]
+            betas.append(chol_solve(G, c))
+        return betas
+
+    def pred(betas, b):
+        acc = betas[b][0] * X[0]
+        for c in range(1, nt):
+            acc = acc + betas[b][c] * X[c]
+        return acc                                            # [W, BP]
+
+    w0 = [wm for _ in range(nb)]
+    betas = solve(w0)
+    mask = wm > 0
+    for _ in range(iters):
+        wts = []
+        for b in range(nb):
+            r = Y[b] - pred(betas, b)
+            med = _median_sublane(r, mask, n_pow)
+            mad = _median_sublane(jnp.abs(r - med), mask, n_pow)
+            sigma = jnp.maximum(mad / 0.6745, 1e-6)
+            a = jnp.abs(r) / (huber_k * sigma)
+            huber = jnp.where(a <= 1.0, 1.0, 1.0 / jnp.maximum(a, 1e-12))
+            wts.append(wm * huber)
+        betas = solve(wts)
+
+    bad = None
+    for b in range(nb):
+        r = jnp.abs(Y[b] - pred(betas, b))
+        bb = (r > tmask_const * vario[b:b + 1]) & mask
+        bad = bb if bad is None else bad | bb
+    bad_ref[...] = jnp.where(bad, jnp.int32(1), 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def tmask_bad(Xtw, Y2, w, vario2, *, interpret=False):
+    """Pallas port of kernel._tmask_bad (same contract: [P,W] bool).
+
+    Replaces the six sequential Gram/corr reduces, Cholesky chains, and
+    ten masked medians per round — each a separate [P,*]-sized fusion
+    paying the profiled per-op floor — with one VMEM-resident pass per
+    pixel block.
+    """
+    P, W, nt = Xtw.shape
+    nb = Y2.shape[1]
+    BP = tmask_block_p(W)
+    Pp = -BP * (-P // BP)
+    pad = Pp - P
+    n_pow = 1 << max(1, (W - 1).bit_length())
+
+    xt = jnp.pad(Xtw.transpose(2, 1, 0), ((0, 0), (0, 0), (0, pad)))
+    y2 = jnp.pad(Y2.transpose(1, 2, 0), ((0, 0), (0, 0), (0, pad)))
+    wp = jnp.pad(w.T, ((0, 0), (0, pad)))
+    vp = jnp.pad(vario2.T, ((0, 0), (0, pad)), constant_values=1.0)
+
+    kern = functools.partial(
+        _tmask_block, nt=nt, nb=nb, n_pow=n_pow,
+        iters=int(params.TMASK_IRLS_ITERS),
+        huber_k=float(params.HUBER_K),
+        tmask_const=float(params.TMASK_CONST))
+    out = pl.pallas_call(
+        kern,
+        grid=(Pp // BP,),
+        in_specs=[
+            pl.BlockSpec((nt, W, BP), lambda i: (0, 0, i)),
+            pl.BlockSpec((nb, W, BP), lambda i: (0, 0, i)),
+            pl.BlockSpec((W, BP), lambda i: (0, i)),
+            pl.BlockSpec((nb, BP), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((W, BP), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((W, Pp), jnp.int32),
+        interpret=interpret,
+    )(xt, y2, wp, vp)
+    return (out[:, :P] > 0).T

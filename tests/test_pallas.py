@@ -167,3 +167,53 @@ def test_monitor_chain_in_detect_matches_default(monkeypatch):
     np.testing.assert_allclose(np.asarray(got.seg_meta),
                                np.asarray(ref.seg_meta), atol=1e-5)
     np.testing.assert_array_equal(np.asarray(got.mask), np.asarray(ref.mask))
+
+
+def test_tmask_bad_matches_jnp_reference():
+    """pallas_ops.tmask_bad (interpret) reproduces kernel._tmask_bad on
+    randomized windows — including degenerate all-masked and constant
+    (non-PD Gram -> NaN -> flag-nothing) pixels."""
+    from firebird_tpu.ccd import pallas_ops
+
+    rng = np.random.default_rng(9)
+    P, W, nt = 153, 24, 5
+    Xtw = rng.normal(0, 1, (P, W, nt)).astype(np.float32)
+    Xtw[:, :, 0] = 1.0
+    Y2 = (400 + 80 * rng.normal(0, 1, (P, 2, W))).astype(np.float32)
+    # a few outliers the screen should flag
+    Y2[rng.random((P, 2, W)) < 0.05] += 900
+    nwin = rng.integers(0, W + 1, P)
+    w = (np.arange(W)[None, :] < nwin[:, None]).astype(np.float32)
+    vario2 = np.abs(rng.normal(40, 10, (P, 2))).astype(np.float32)
+    Y2[7] = 444.0                      # constant series -> singular Gram
+    want = np.asarray(kernel._tmask_bad(
+        jnp.asarray(Xtw), jnp.asarray(Y2), jnp.asarray(w),
+        jnp.asarray(vario2)))
+    got = np.asarray(pallas_ops.tmask_bad(
+        jnp.asarray(Xtw), jnp.asarray(Y2), jnp.asarray(w),
+        jnp.asarray(vario2), interpret=True))
+    assert want.any() and not want.all()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_full_pallas_detect_matches_default(monkeypatch):
+    """FIREBIRD_PALLAS=lasso,monitor,tmask routes all three components
+    through Pallas; full-detect results must equal the default path."""
+    from firebird_tpu.ingest import SyntheticSource, pack
+    from firebird_tpu.ingest.packer import PackedChips
+
+    src = SyntheticSource(seed=44, start="1995-01-01", end="1999-01-01",
+                          cloud_frac=0.2)
+    p = pack([src.chip(100, 200)], bucket=32)
+    p = PackedChips(cids=p.cids, dates=p.dates,
+                    spectra=p.spectra[:, :, :64, :], qas=p.qas[:, :64, :],
+                    n_obs=p.n_obs, sensor=p.sensor)
+    ref = kernel.detect_packed(p, dtype=jnp.float32)
+    monkeypatch.setenv("FIREBIRD_PALLAS", "lasso,monitor,tmask")
+    monkeypatch.setattr(kernel, "window_cap",
+                        lambda pk, _orig=kernel.window_cap: _orig(pk) + 24)
+    got = kernel.detect_packed(p, dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(got.n_segments),
+                                  np.asarray(ref.n_segments))
+    np.testing.assert_allclose(np.asarray(got.seg_meta),
+                               np.asarray(ref.seg_meta), atol=1e-5)
