@@ -217,3 +217,16 @@ def test_full_pallas_detect_matches_default(monkeypatch):
                                   np.asarray(ref.n_segments))
     np.testing.assert_allclose(np.asarray(got.seg_meta),
                                np.asarray(ref.seg_meta), atol=1e-5)
+
+
+def test_use_pallas_component_parsing(monkeypatch):
+    for env, lasso, monitor, tmask in [
+            ("0", False, False, False), ("", False, False, False),
+            ("1", True, True, True),
+            ("lasso", True, False, False),
+            ("monitor,tmask", False, True, True),
+            (" lasso , tmask ", True, False, True)]:
+        monkeypatch.setenv("FIREBIRD_PALLAS", env)
+        assert kernel.use_pallas("lasso") is lasso, env
+        assert kernel.use_pallas("monitor") is monitor, env
+        assert kernel.use_pallas("tmask") is tmask, env
