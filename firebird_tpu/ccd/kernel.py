@@ -56,11 +56,12 @@ def use_pallas(component: str = "lasso") -> bool:
     """Whether `component` runs as its Pallas VMEM-resident kernel.
 
     FIREBIRD_PALLAS is "0"/"" (none), "1" (all), or a comma list of
-    component names ("lasso,monitor,tmask") — bench.py tunes the
+    component names ("lasso,monitor,tmask,fit") — bench.py tunes the
     components independently on hardware, so a kernel that loses on a
-    given toolchain can't drag down the ones that win.  Read at trace
-    time: set it before the first detect call — already-compiled programs
-    keep their path."""
+    given toolchain can't drag down the ones that win.  "fit" (the fused
+    Gram+corr+CD+RMSE kernel) supersedes "lasso" (CD loop only) at the
+    fit call sites.  Read at trace time: set it before the first detect
+    call — already-compiled programs keep their path."""
     import os
 
     v = os.environ.get("FIREBIRD_PALLAS", "0")
@@ -495,7 +496,8 @@ def _monitor_chain(s, alive, included, rank, cur_k, n_last_fit, in_mon, *,
 
 
 def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
-                 sensor=LANDSAT_ARD, max_segments: int = MAX_SEGMENTS):
+                 sensor=LANDSAT_ARD, max_segments: int = MAX_SEGMENTS,
+                 dtype=None):
     """One chip — traced under HIGHEST matmul precision: on TPU the
     default f32 dot runs reduced-precision passes, which would silently
     degrade every Gram/prediction below the f32 the oracle-parity
@@ -503,13 +505,16 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
     catch it)."""
     with jax.default_matmul_precision("highest"):
         return _detect_core_impl(X, Xt, t, valid, Y, qa, wcap=wcap,
-                                 sensor=sensor, max_segments=max_segments)
+                                 sensor=sensor, max_segments=max_segments,
+                                 dtype=dtype)
 
 
 def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
-                      sensor=LANDSAT_ARD, max_segments: int = MAX_SEGMENTS):
+                      sensor=LANDSAT_ARD, max_segments: int = MAX_SEGMENTS,
+                      dtype=None):
     """One chip: X [T,8], Xt [T,5], t [T] f32 ordinal days, valid [T] bool,
-    Y [B,P,T] f32 (the packed layout), qa [P,T] int32.  Returns
+    Y [B,P,T] (the packed layout — wire int16, widened here to ``dtype``,
+    or already-float arrays from direct callers), qa [P,T] int32.  Returns
     ChipSegments (device).
 
     ``wcap`` (static) bounds the member count of any initialization window;
@@ -524,14 +529,37 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
     _DET = list(sensor.detection_bands)
     _TMB = list(sensor.tmask_bands)
     CHANGE_THRESHOLD, OUTLIER_THRESHOLD = chi2_thresholds(len(_DET))
-    Y = Y.transpose(1, 0, 2)                                   # -> [P,B,T]
+    fdtype = jnp.dtype(dtype) if dtype is not None else Y.dtype
+    # Resident wire-dtype spectra [B,T,P] for the Pallas consumers (int16
+    # reads halve the round loop's dominant HBM term; widening in-register
+    # is exact), alongside the widened [P,B,T] float view the XLA paths
+    # read.  Both are materialized once, outside the event loop.
+    Yt_res = Y.transpose(0, 2, 1)                              # [B,T,P]
+    Y = Y.astype(fdtype).transpose(1, 0, 2)                    # -> [P,B,T]
     P, B, T = Y.shape
     S = max_segments
     ar = jnp.arange(T)[None, :]
-    fdtype = Y.dtype
     W = T if wcap is None else min(wcap, T)
     # Per-row design outer products, shared by every Lasso Gram build.
     XX = (X[:, :, None] * X[:, None, :]).reshape(T, -1)        # [T,64]
+
+    # The fused Pallas fit path (Gram+corr+CD+RMSE in VMEM, wire-dtype
+    # spectra reads); f32-on-TPU only, interpreted elsewhere (tests).
+    on_tpu = jax.default_backend() == "tpu"
+    fit_pallas = use_pallas("fit") and (not on_tpu or fdtype == jnp.float32)
+
+    def _fit(w, coefmask, with_rmse=True):
+        """One batched Lasso fit, routed to the winning implementation."""
+        if fit_pallas:
+            from firebird_tpu.ccd import pallas_ops
+
+            b, r = pallas_ops.lasso_fit(Yt_res, w, X, coefmask,
+                                        with_rmse=with_rmse,
+                                        interpret=not on_tpu)
+            return (b, r) if with_rmse else b
+        if with_rmse:
+            return _fit_lasso(X, Y, w, coefmask, XX=XX)
+        return _fit_lasso_coefs(X, Y, w, coefmask, XX=XX)
 
     # ---------------- QA triage (reference.detect) ----------------
     fill = _qa_bit(qa, params.QA_FILL_BIT) | ~valid[None, :]
@@ -600,8 +628,7 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
     alt_n = jnp.sum(alt_usable, -1)
     alt_fit = is_alt & (alt_n >= params.MEOW_SIZE)
     w_alt = (alt_usable & alt_fit[:, None]).astype(fdtype)
-    alt_coefs, alt_rmse = _fit_lasso(X, Y, w_alt, _coefmask_for(alt_n, P),
-                                     XX=XX)
+    alt_coefs, alt_rmse = _fit(w_alt, _coefmask_for(alt_n, P), True)
     first_i = jnp.argmax(alt_usable, -1)
     last_i = T - 1 - jnp.argmax(alt_usable[:, ::-1], -1)
     alt_meta = jnp.stack([
@@ -711,7 +738,7 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
         w_stab = w_init & ~tm_removed[:, None]
         cm4 = jnp.arange(params.MAX_COEFS)[None, :] < 4
         cm4 = jnp.broadcast_to(cm4, (P, params.MAX_COEFS))
-        c4 = _fit_lasso_coefs(X, Y, w_stab.astype(fdtype), cm4, XX=XX)
+        c4 = _fit(w_stab.astype(fdtype), cm4, False)
         r_w = Yw7 - jnp.sum(c4[:, :, None, :] * Xw8[:, None, :, :], -1)
         stab_w = valid_w & ~bad_w
         n4 = jnp.maximum(jnp.sum(stab_w, -1), 1.0)
@@ -813,8 +840,8 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
         w_full = jnp.where(init_ok[:, None], w_stab,
                            included_mon & is_refit[:, None])
         n_full = jnp.where(init_ok, n_ok, n_rf)
-        cfull, rfull = _fit_lasso(X, Y, w_full.astype(fdtype),
-                                  _coefmask_for(n_full, P), XX=XX)
+        cfull, rfull = _fit(w_full.astype(fdtype),
+                            _coefmask_for(n_full, P))
         do_fit = init_ok | is_refit
 
         # ================= next state =================
@@ -879,11 +906,12 @@ def _detect_batch_wire(Xs, Xts, t, valid, Y_i16, qa_u16, *, dtype,
                        wcap=None, sensor=LANDSAT_ARD,
                        max_segments=MAX_SEGMENTS):
     """Batch detect from wire dtypes: spectra/QA arrive as int16/uint16 and
-    widen on device — halves host->device transfer vs shipping float32."""
+    widen on device — halves host->device transfer vs shipping float32, and
+    the core keeps a wire-dtype resident copy so the Pallas fit path reads
+    int16 from HBM (docs/ROOFLINE.md item 1)."""
     f = functools.partial(_detect_core, wcap=wcap, sensor=sensor,
-                          max_segments=max_segments)
-    return jax.vmap(f)(Xs, Xts, t, valid,
-                       Y_i16.astype(dtype), qa_u16.astype(jnp.int32))
+                          max_segments=max_segments, dtype=dtype)
+    return jax.vmap(f)(Xs, Xts, t, valid, Y_i16, qa_u16.astype(jnp.int32))
 
 
 def window_cap(packed) -> int:

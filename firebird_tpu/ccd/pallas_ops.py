@@ -128,6 +128,136 @@ def lasso_cd(G, c, diag, coefmask, *, iters=params.LASSO_ITERS,
 
 
 # ---------------------------------------------------------------------------
+# Fused Lasso fit kernel (Gram + corr + CD + RMSE)
+# ---------------------------------------------------------------------------
+
+def fit_block_p(T: int, B: int, y_bytes: int) -> int:
+    """Lane-block width for the fit kernel: the [B, T, BP] spectra block
+    plus ~4 live [T, BP] f32 planes dominate the footprint."""
+    budget = 10 * 2 ** 20
+    per_lane = max(T, 1) * (B * y_bytes + 4 * 4)
+    return max(128, min(512, (budget // per_lane) // 128 * 128))
+
+
+def _fit_block(x_ref, xt_ref, xxt_ref, y_ref, w_ref, mask_ref, b_ref, r_ref,
+               *, B, K, iters, alpha, with_rmse):
+    """One pixel block: Gram/corr builds, the full CD loop, and the
+    weighted-window RMSE, all in VMEM.
+
+    x [T,K], xt [K,T], xxt [K*K,T] (chip-shared designs), y [B,T,BP]
+    (wire dtype — int16 widens in-register, exactly), w [T,BP] 0/1,
+    mask [K,BP] -> b [B,K,BP], rmse [B,BP].
+
+    Mirrors kernel._fit_lasso exactly: Gram and corr divided by the
+    window count before the CD loop, same update order, intercept
+    unpenalized, rmse over the same weighted window.
+    """
+    X = x_ref[...]
+    XT = xt_ref[...]
+    XXT = xxt_ref[...]
+    wb = w_ref[...]                                           # [T, BP]
+    mask = mask_ref[...]                                      # [K, BP]
+    f32 = wb.dtype
+
+    n = jnp.maximum(jnp.sum(wb, 0, keepdims=True), 1.0)       # [1, BP]
+    G = jnp.dot(XXT, wb, preferred_element_type=f32) / n      # [K*K, BP]
+    diag = jnp.maximum(
+        jnp.concatenate([G[j * K + j][None] for j in range(K)], 0), 1e-12)
+
+    cs = []
+    for bb in range(B):
+        Yb = y_ref[bb].astype(f32)                            # [T, BP]
+        cs.append(jnp.dot(XT, Yb * wb, preferred_element_type=f32)[None]
+                  / n[None])
+    c = jnp.concatenate(cs, 0)                                # [B, K, BP]
+
+    def one_iter(_, b):
+        for j in range(K):
+            Gj = G[j * K:(j + 1) * K]                         # [K, BP]
+            rho = (c[:, j] - jnp.sum(Gj[None, :, :] * b, axis=1)
+                   + diag[j][None, :] * b[:, j])
+            if j == 0:
+                bj = rho / diag[0][None, :]
+            else:
+                bj = (jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - alpha, 0.0)
+                      / diag[j][None, :])
+            bj = jnp.where(mask[j][None, :] > 0, bj, 0.0)
+            sel = lax.broadcasted_iota(jnp.int32, (1, K, 1), 1) == j
+            b = jnp.where(sel, bj[:, None, :], b)
+        return b
+
+    beta = lax.fori_loop(0, iters, one_iter, jnp.zeros_like(c))
+    b_ref[...] = beta
+
+    if with_rmse:
+        rs = []
+        for bb in range(B):
+            Yb = y_ref[bb].astype(f32)
+            pred = jnp.dot(X, beta[bb], preferred_element_type=f32)
+            r = Yb - pred
+            rs.append(jnp.sqrt(jnp.maximum(
+                jnp.sum(r * r * wb, 0, keepdims=True) / n, 0.0)))
+        r_ref[...] = jnp.concatenate(rs, 0)                   # [B, BP]
+    else:
+        r_ref[...] = jnp.zeros_like(r_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("with_rmse", "interpret"))
+def lasso_fit(Yt, w, X, coefmask, *, with_rmse=True, interpret=False):
+    """Fused Pallas twin of kernel._fit_lasso / _fit_lasso_coefs.
+
+    Under plain XLA the fit path materializes the [P,B,T] ``Y*w`` product
+    around each corr dot and re-reads the widened float spectra; this
+    kernel streams the *wire-dtype* resident spectra once per block and
+    keeps every intermediate (Gram, corr, CD state, predictions) in VMEM.
+
+    Args:
+        Yt: [B, T, P] resident spectra — wire int16 (widened in-register,
+            exact) or float32.
+        w: [P, T] 0/1 fit-window weights (float).
+        X: [T, K] design (chip-shared).
+        coefmask: [P, K] allowed coefficients.
+    Returns:
+        (coefs [P, B, K], rmse [P, B]) — rmse is zeros when
+        ``with_rmse=False``.
+    """
+    B, T, P = Yt.shape
+    K = X.shape[-1]
+    f32 = w.dtype
+    BP = fit_block_p(T, B, Yt.dtype.itemsize)
+    Pp = -BP * (-P // BP)
+    pad = Pp - P
+
+    XT = X.T                                                  # [K, T]
+    XXT = (X[:, :, None] * X[:, None, :]).reshape(T, K * K).T  # [K*K, T]
+    yp = jnp.pad(Yt, ((0, 0), (0, 0), (0, pad)))
+    wp = jnp.pad(w.T, ((0, 0), (0, pad)))
+    mk = jnp.pad(coefmask.T.astype(f32), ((0, 0), (0, pad)))
+
+    kern = functools.partial(_fit_block, B=B, K=K,
+                             iters=int(params.LASSO_ITERS),
+                             alpha=float(params.LASSO_ALPHA),
+                             with_rmse=bool(with_rmse))
+    full = lambda shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+    beta, rmse = pl.pallas_call(
+        kern,
+        grid=(Pp // BP,),
+        in_specs=[
+            full((T, K)), full((K, T)), full((K * K, T)),
+            pl.BlockSpec((B, T, BP), lambda i: (0, 0, i)),
+            pl.BlockSpec((T, BP), lambda i: (0, i)),
+            pl.BlockSpec((K, BP), lambda i: (0, i)),
+        ],
+        out_specs=[pl.BlockSpec((B, K, BP), lambda i: (0, 0, i)),
+                   pl.BlockSpec((B, BP), lambda i: (0, i))],
+        out_shape=[jax.ShapeDtypeStruct((B, K, Pp), f32),
+                   jax.ShapeDtypeStruct((B, Pp), f32)],
+        interpret=interpret,
+    )(X.astype(f32), XT.astype(f32), XXT.astype(f32), yp, wp, mk)
+    return beta[:, :, :P].transpose(2, 0, 1), rmse[:, :P].T
+
+
+# ---------------------------------------------------------------------------
 # MONITOR event-chain kernel
 # ---------------------------------------------------------------------------
 

@@ -166,10 +166,13 @@ def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor,
     from firebird_tpu.ccd.kernel import MAX_SEGMENTS, _detect_core
 
     core = functools.partial(_detect_core, wcap=wcap, sensor=sensor,
-                             max_segments=max_segments or MAX_SEGMENTS)
+                             max_segments=max_segments or MAX_SEGMENTS,
+                             dtype=dtype)
 
     def local_batch(Xs, Xts, t, valid, Y_i16, qa_u16):
-        return jax.vmap(core)(Xs, Xts, t, valid, Y_i16.astype(dtype),
+        # Wire-dtype spectra pass through: the core widens them itself and
+        # keeps an int16 resident copy for the Pallas fit path's HBM reads.
+        return jax.vmap(core)(Xs, Xts, t, valid, Y_i16,
                               qa_u16.astype(jnp.int32))
 
     spec = PartitionSpec("data")

@@ -230,3 +230,57 @@ def test_use_pallas_component_parsing(monkeypatch):
         assert kernel.use_pallas("lasso") is lasso, env
         assert kernel.use_pallas("monitor") is monitor, env
         assert kernel.use_pallas("tmask") is tmask, env
+
+
+def test_pallas_fit_matches_fit_lasso():
+    """pallas_ops.lasso_fit (interpret) matches kernel._fit_lasso on the
+    same systems, reading wire-dtype int16 spectra (widened in-register,
+    exact)."""
+    from firebird_tpu.ccd import harmonic, pallas_ops
+
+    rng = np.random.default_rng(3)
+    P, B, T = 141, 7, 60
+    t = np.sort(rng.integers(729000, 730500, T)).astype(np.float64)
+    X = jnp.asarray(harmonic.design_matrix(t, t[0], params.MAX_COEFS),
+                    jnp.float32)
+    Yi = rng.integers(0, 8000, (P, B, T)).astype(np.int16)
+    w = jnp.asarray((rng.random((P, T)) < 0.8), jnp.float32)
+    nc = rng.choice([4, 6, 8], P)
+    mask = jnp.asarray(np.arange(8)[None, :] < nc[:, None])
+    ref_b, ref_r = kernel._fit_lasso(X, jnp.asarray(Yi, jnp.float32), w,
+                                     mask)
+    Yt = jnp.asarray(Yi.transpose(1, 2, 0))           # [B,T,P] int16
+    got_b, got_r = pallas_ops.lasso_fit(Yt, w, X, mask, with_rmse=True,
+                                        interpret=True)
+    np.testing.assert_allclose(np.asarray(got_b), np.asarray(ref_b),
+                               rtol=1e-2, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(got_r), np.asarray(ref_r),
+                               rtol=1e-2, atol=1e-2)
+    nb, nr = pallas_ops.lasso_fit(Yt, w, X, mask, with_rmse=False,
+                                  interpret=True)
+    np.testing.assert_array_equal(np.asarray(nb), np.asarray(got_b))
+    assert not np.asarray(nr).any()
+
+
+def test_fit_kernel_in_detect_matches_default(monkeypatch):
+    """FIREBIRD_PALLAS=fit routes all three batched Lasso fits through the
+    fused Pallas kernel; segment decisions must equal the default path."""
+    from firebird_tpu.ingest import SyntheticSource, pack
+    from firebird_tpu.ingest.packer import PackedChips
+
+    src = SyntheticSource(seed=55, start="1995-01-01", end="1999-01-01",
+                          cloud_frac=0.15)
+    p = pack([src.chip(100, 200)], bucket=32)
+    p = PackedChips(cids=p.cids, dates=p.dates,
+                    spectra=p.spectra[:, :, :64, :], qas=p.qas[:, :64, :],
+                    n_obs=p.n_obs, sensor=p.sensor)
+    ref = kernel.detect_packed(p, dtype=jnp.float32)
+    monkeypatch.setenv("FIREBIRD_PALLAS", "fit")
+    monkeypatch.setattr(kernel, "window_cap",
+                        lambda pk, _orig=kernel.window_cap: _orig(pk) + 32)
+    got = kernel.detect_packed(p, dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(got.n_segments),
+                                  np.asarray(ref.n_segments))
+    np.testing.assert_array_equal(np.asarray(got.seg_meta[..., :3]),
+                                  np.asarray(ref.seg_meta[..., :3]))
+    np.testing.assert_array_equal(np.asarray(got.mask), np.asarray(ref.mask))
