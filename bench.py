@@ -297,7 +297,7 @@ def measure(cpu_only: bool) -> None:
 def probe_accelerator(timeout: float = 300.0) -> bool:
     """Cheap health check before the full accelerator attempt: the tunnel
     to the chip can hang indefinitely (even jax.devices() blocks), and the
-    full attempt's budget is 25 minutes — a tiny device round-trip under a
+    full attempt's budget is an hour — a tiny device round-trip under a
     short timeout decides whether that budget is worth spending."""
     code = ("import sys, jax, jax.numpy as jnp\n"
             "d = jax.devices()[0]\n"
@@ -324,7 +324,10 @@ def main() -> int:
     # CPU-rung budget: a cold cache compiles the full f32 kernel set from
     # scratch (~25 min on a slow host); the accelerator probe's savings in
     # the dead-tunnel case pay for the wider window.
-    ladder = [([], 1500), (["--cpu"], 2700), (["--cpu", "--small"], 900)]
+    # Accelerator budget 3600s: the per-component Pallas autotune is ~8
+    # compile cycles through the (slow) tunnel; a dead tunnel never spends
+    # it because the probe gates the attempt.
+    ladder = [([], 3600), (["--cpu"], 2700), (["--cpu", "--small"], 900)]
     if not probe_accelerator():
         print("bench: accelerator probe failed/hung; skipping the "
               "accelerator attempt", file=sys.stderr)

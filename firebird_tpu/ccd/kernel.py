@@ -429,7 +429,7 @@ def _monitor_chain(s, alive, included, rank, cur_k, n_last_fit, in_mon, *,
                    change_thr: float, outlier_thr: float):
     """The MONITOR fast-forward event logic: score-derived break/refit/
     tail location in rank space (see the body walkthrough in
-    _detect_core_impl).  Pure function of the round state so the Pallas
+    _mon_block).  Pure function of the round state so the Pallas
     twin (pallas_ops.monitor_chain, FIREBIRD_PALLAS=1) can replace it —
     the chain is a pipeline of cumulative/reduce ops over T whose
     intermediates otherwise stream through HBM between fusions.
@@ -498,38 +498,58 @@ def _monitor_chain(s, alive, included, rank, cur_k, n_last_fit, in_mon, *,
 def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
                  sensor=LANDSAT_ARD, max_segments: int = MAX_SEGMENTS,
                  dtype=None):
-    """One chip — traced under HIGHEST matmul precision: on TPU the
-    default f32 dot runs reduced-precision passes, which would silently
-    degrade every Gram/prediction below the f32 the oracle-parity
-    envelope was measured at (CPU tests run full f32 and would never
-    catch it)."""
-    with jax.default_matmul_precision("highest"):
-        return _detect_core_impl(X, Xt, t, valid, Y, qa, wcap=wcap,
-                                 sensor=sensor, max_segments=max_segments,
-                                 dtype=dtype)
+    """One chip (X [T,8], Xt [T,5], t [T], valid [T], Y [B,P,T], qa [P,T]
+    int32) — a batch of one through :func:`_detect_batch_core`."""
+    out = _detect_batch_core(X[None], Xt[None], t[None], valid[None],
+                             Y[None], qa[None], wcap=wcap, sensor=sensor,
+                             max_segments=max_segments, dtype=dtype)
+    return jax.tree_util.tree_map(lambda a: a[0], out)
 
 
-def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
-                      sensor=LANDSAT_ARD, max_segments: int = MAX_SEGMENTS,
-                      dtype=None):
-    """One chip: X [T,8], Xt [T,5], t [T] f32 ordinal days, valid [T] bool,
-    Y [B,P,T] (the packed layout — wire int16, widened here to ``dtype``,
-    or already-float arrays from direct callers), qa [P,T] int32.  Returns
-    ChipSegments (device).
+def _fit_chip(res, w, coefmask, with_rmse=True, *, fit_pallas, on_tpu):
+    """One chip's batched Lasso fit, routed to the winning implementation
+    (the fused Pallas Gram+corr+CD+RMSE kernel reads the wire-dtype
+    resident spectra; the lax path reads the widened float view)."""
+    if fit_pallas:
+        from firebird_tpu.ccd import pallas_ops
 
-    ``wcap`` (static) bounds the member count of any initialization window;
-    window_cap() derives a rigorous bound from the chip's date grid.  None
-    falls back to the always-correct T.  ``sensor`` (static) supplies the
-    band layout — detection/Tmask/range-check roles and count; the default
-    is the reference's Landsat ARD contract.  ``max_segments`` (static) is
-    the result-buffer capacity; n_segments counts every closed segment
-    even past capacity, so a caller can detect overflow
-    (n_segments > max_segments) and re-dispatch with a larger buffer —
-    detect_packed does this automatically."""
-    _DET = list(sensor.detection_bands)
-    _TMB = list(sensor.tmask_bands)
-    CHANGE_THRESHOLD, OUTLIER_THRESHOLD = chi2_thresholds(len(_DET))
-    fdtype = jnp.dtype(dtype) if dtype is not None else Y.dtype
+        b, r = pallas_ops.lasso_fit(res["Yt"], w, res["X"], coefmask,
+                                    with_rmse=with_rmse,
+                                    interpret=not on_tpu)
+        return (b, r) if with_rmse else b
+    if with_rmse:
+        return _fit_lasso(res["X"], res["Y"], w, coefmask, XX=res["XX"])
+    return _fit_lasso_coefs(res["X"], res["Y"], w, coefmask, XX=res["XX"])
+
+
+def _write_seg(bufs, nseg, wmask, meta, rmse_s, mag_s, coef_s, *, S):
+    """Append one segment row (where wmask) into the flat result buffers.
+
+    Buffers are FLAT [P, S*k]: trailing [S, 7, 8] shapes take TPU tiled
+    layouts padded to (8, 128) — 16x the logical bytes — and the per-round
+    buffer select was the loop's single hottest op (24 ms/dispatch
+    profiled).  Reshaped once on exit."""
+    meta_b, rmse_b, mag_b, coef_b = bufs
+    P = nseg.shape[0]
+    oh = (nseg[:, None] == jnp.arange(S)[None, :]) & wmask[:, None]  # [P,S]
+
+    def upd(buf, val):                     # buf [P,S*k], val [P,k]
+        kk = val.shape[-1]
+        m = jnp.broadcast_to(oh[:, :, None], (P, S, kk)).reshape(P, S * kk)
+        v = jnp.broadcast_to(val[:, None, :], (P, S, kk)).reshape(P, S * kk)
+        return jnp.where(m, v, buf)
+
+    bufs = (upd(meta_b, meta), upd(rmse_b, rmse_s), upd(mag_b, mag_s),
+            upd(coef_b, coef_s.reshape(P, -1)))
+    return bufs, nseg + wmask.astype(jnp.int32)
+
+
+def _prologue(X, Xt, t, valid, Y, qa, *, sensor, S, fdtype, fit):
+    """One chip's pre-loop work: QA triage, usable sets, the one-shot
+    snow/insufficient-clear fit, variogram, and the standard-procedure
+    start state.  Returns (res, state): ``res`` holds the loop-invariant
+    residents (spectra views, designs, variogram, procedure routing),
+    ``state`` the event-loop carry."""
     # Resident wire-dtype spectra [B,T,P] for the Pallas consumers (int16
     # reads halve the round loop's dominant HBM term; widening in-register
     # is exact), alongside the widened [P,B,T] float view the XLA paths
@@ -537,29 +557,9 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
     Yt_res = Y.transpose(0, 2, 1)                              # [B,T,P]
     Y = Y.astype(fdtype).transpose(1, 0, 2)                    # -> [P,B,T]
     P, B, T = Y.shape
-    S = max_segments
-    ar = jnp.arange(T)[None, :]
-    W = T if wcap is None else min(wcap, T)
     # Per-row design outer products, shared by every Lasso Gram build.
     XX = (X[:, :, None] * X[:, None, :]).reshape(T, -1)        # [T,64]
-
-    # The fused Pallas fit path (Gram+corr+CD+RMSE in VMEM, wire-dtype
-    # spectra reads); f32-on-TPU only, interpreted elsewhere (tests).
-    on_tpu = jax.default_backend() == "tpu"
-    fit_pallas = use_pallas("fit") and (not on_tpu or fdtype == jnp.float32)
-
-    def _fit(w, coefmask, with_rmse=True):
-        """One batched Lasso fit, routed to the winning implementation."""
-        if fit_pallas:
-            from firebird_tpu.ccd import pallas_ops
-
-            b, r = pallas_ops.lasso_fit(Yt_res, w, X, coefmask,
-                                        with_rmse=with_rmse,
-                                        interpret=not on_tpu)
-            return (b, r) if with_rmse else b
-        if with_rmse:
-            return _fit_lasso(X, Y, w, coefmask, XX=XX)
-        return _fit_lasso_coefs(X, Y, w, coefmask, XX=XX)
+    res = dict(X=X, Xt=Xt, t=t, Y=Y, Yt=Yt_res, XX=XX)
 
     # ---------------- QA triage (reference.detect) ----------------
     fill = _qa_bit(qa, params.QA_FILL_BIT) | ~valid[None, :]
@@ -596,30 +596,12 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
     cand_ins = cand_ins & (Yblue < blue_med[:, None] + params.INSUF_CLEAR_BLUE_DELTA)
     usable_ins = _dedup_first(cand_ins, same_prev)
 
-    # ---------------- result buffers ----------------
-    # Buffers are FLAT [P, S*k] in the loop state: trailing [S, 7, 8]
-    # shapes take TPU tiled layouts padded to (8, 128) — 16x the logical
-    # bytes — and the per-round buffer select was the loop's single
-    # hottest op (24 ms/dispatch profiled).  Reshaped once on exit.
+    # ---------------- result buffers (flat; see _write_seg) ----------------
     nseg0 = jnp.zeros(P, jnp.int32)
     meta0 = jnp.zeros((P, S * 6), fdtype)
     rmse0 = jnp.zeros((P, S * B), fdtype)
     mag0 = jnp.zeros((P, S * B), fdtype)
     coef0 = jnp.zeros((P, S * B * params.MAX_COEFS), fdtype)
-
-    def write_seg(bufs, nseg, wmask, meta, rmse_s, mag_s, coef_s):
-        meta_b, rmse_b, mag_b, coef_b = bufs
-        oh = (nseg[:, None] == jnp.arange(S)[None, :]) & wmask[:, None]  # [P,S]
-
-        def upd(buf, val):                     # buf [P,S*k], val [P,k]
-            kk = val.shape[-1]
-            m = jnp.broadcast_to(oh[:, :, None], (P, S, kk)).reshape(P, S * kk)
-            v = jnp.broadcast_to(val[:, None, :], (P, S, kk)).reshape(P, S * kk)
-            return jnp.where(m, v, buf)
-
-        bufs = (upd(meta_b, meta), upd(rmse_b, rmse_s), upd(mag_b, mag_s),
-                upd(coef_b, coef_s.reshape(P, -1)))
-        return bufs, nseg + wmask.astype(jnp.int32)
 
     # ---------------- snow / insufficient-clear: one fit ----------------
     alt_usable = jnp.where((procedure == PROC_SNOW)[:, None], usable_snow,
@@ -628,7 +610,7 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
     alt_n = jnp.sum(alt_usable, -1)
     alt_fit = is_alt & (alt_n >= params.MEOW_SIZE)
     w_alt = (alt_usable & alt_fit[:, None]).astype(fdtype)
-    alt_coefs, alt_rmse = _fit(w_alt, _coefmask_for(alt_n, P), True)
+    alt_coefs, alt_rmse = fit(res, w_alt, _coefmask_for(alt_n, P), True)
     first_i = jnp.argmax(alt_usable, -1)
     last_i = T - 1 - jnp.argmax(alt_usable[:, ::-1], -1)
     alt_meta = jnp.stack([
@@ -639,8 +621,8 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
                   float(params.CURVE_QA_INSUF_CLEAR)).astype(fdtype),
         alt_n.astype(fdtype)], axis=1)
     bufs = (meta0, rmse0, mag0, coef0)
-    bufs, nseg = write_seg(bufs, nseg0, alt_fit, alt_meta, alt_rmse,
-                           jnp.zeros((P, B), fdtype), alt_coefs)
+    bufs, nseg = _write_seg(bufs, nseg0, alt_fit, alt_meta, alt_rmse,
+                            jnp.zeros((P, B), fdtype), alt_coefs, S=S)
     alt_mask = alt_usable & alt_fit[:, None]
 
     # ---------------- standard procedure state ----------------
@@ -650,6 +632,8 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
     ex0, i0 = _first_at_or_after(alive0, jnp.zeros(P, jnp.int32))
     phase0 = jnp.where(is_std & ex0, PHASE_INIT, PHASE_DONE).astype(jnp.int32)
 
+    res.update(vario=vario, is_std=is_std, is_alt=is_alt,
+               alt_mask=alt_mask, procedure=procedure)
     state = dict(
         phase=phase0,
         cur_i=i0.astype(jnp.int32),
@@ -661,238 +645,396 @@ def _detect_core_impl(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
         n_last_fit=jnp.ones(P, jnp.int32),
         first_seg=jnp.ones(P, bool),
         nseg=nseg, bufs=bufs,
-        rounds=jnp.zeros((), jnp.int32),
     )
+    return res, state
+
+
+def _init_block(res, st, *, sensor, W, fdtype, fit):
+    """One chip's INIT-phase round work: initialization-window search, the
+    Tmask IRLS screen, and the stability test.  Runs under a scalar
+    lax.cond — on rounds where no pixel is initializing (most of them:
+    after round 1 the only INIT pixels are post-break restarts) the whole
+    block, including its one-hot window tensors (the loop's dominant HBM
+    term), is skipped outright.  Every output is consumed downstream only
+    under in_init-derived masks, so the skip branch's zeros are inert."""
+    _DET = list(sensor.detection_bands)
+    _TMB = list(sensor.tmask_bands)
+    X, Xt, t, Y = res["X"], res["Xt"], res["t"], res["Y"]
+    alive = st["alive"]
+    in_init = st["phase"] == PHASE_INIT
+    P, B, T = Y.shape
+    ar = jnp.arange(T)[None, :]
+
+    has_i, i = _first_at_or_after(alive, st["cur_i"])
+    t_i = jnp.take(t, i)
+    Acum = jnp.cumsum(alive, -1)
+    rank = Acum - 1                                        # [P,T]
+    A_before = jnp.take_along_axis(Acum, i[:, None], -1)[:, 0] \
+        - jnp.take_along_axis(alive, i[:, None], -1)[:, 0]
+    cnt = Acum - A_before[:, None]
+    okj = alive & (ar >= i[:, None]) & (cnt >= params.MEOW_SIZE) \
+        & (t[None, :] - t_i[:, None] >= params.INIT_DAYS)
+    has_w = has_i & jnp.any(okj, -1)
+    j = jnp.argmax(okj, -1)
+    w_init = alive & (ar >= i[:, None]) & (ar <= j[:, None]) \
+        & (has_w & in_init)[:, None]
+
+    # Tmask screen over the compacted window: the window members are
+    # exactly the alive obs with ranks [rank(i), rank(i)+n_win), so a
+    # rank-indexed selection bounds all IRLS median/Gram work by
+    # W << T.  Member positions come from a one-hot reduce over T
+    # (ranks are unique among alive obs) rather than a rank scatter +
+    # gather — scatters lower to sort + serialized-loop fusions on
+    # TPU (~32 ms/round profiled, the loop body's hottest ops).
+    n_win = jnp.sum(w_init, -1)                            # [P] <= W
+    r_i = A_before                                         # rank of i
+    rel_w = rank - r_i[:, None]                            # [P,T]
+    # (the == against arange(W) already implies 0 <= rel_w < W)
+    oh_w = alive[:, None, :] \
+        & (rel_w[:, None, :] == jnp.arange(W)[None, :, None])  # [P,W,T]
+    valid_w = (jnp.arange(W)[None, :] < n_win[:, None])
+    # Window members selected by one-hot MXU matmuls — exact (each
+    # output is 1.0 x one element; HIGHEST precision keeps f32 inputs
+    # unrounded) and an order of magnitude cheaper than per-lane
+    # take_along_axis gathers, which serialize on TPU (profiled at
+    # ~7 ms/round combined).  Empty slots read 0 and are masked by
+    # valid_w downstream, as the gathered garbage was before.
+    ohf = oh_w.astype(fdtype)                              # [P,W,T]
+    Yw7 = jnp.einsum("pbt,pwt->pbw", Y, ohf,
+                     precision=lax.Precision.HIGHEST)      # [P,7,W]
+    XW = jnp.einsum("pwt,tc->pwc", ohf,
+                    jnp.concatenate([X, Xt], axis=1),
+                    precision=lax.Precision.HIGHEST)       # [P,W,13]
+    Xw8, Xt_w = XW[..., :8], XW[..., 8:]
+    Y2w = Yw7[:, _TMB, :]
+    tmask_fn = _tmask_bad
+    if use_pallas("tmask"):
+        on_tpu = jax.default_backend() == "tpu"
+        if not on_tpu or fdtype == jnp.float32:
+            from firebird_tpu.ccd import pallas_ops
+
+            tmask_fn = functools.partial(pallas_ops.tmask_bad,
+                                         interpret=not on_tpu)
+    bad_w = tmask_fn(Xt_w, Y2w, valid_w.astype(fdtype),
+                     res["vario"][:, _TMB])
+    bad = jnp.any(oh_w & bad_w[:, :, None], axis=1)        # [P,T]
+    tm_removed = jnp.any(bad_w, -1)
+
+    # Stability fit: 4 coefs over the (pre-screen-clean) window.  RMSE
+    # and the endpoint residuals only involve window members (member 0
+    # is i, member n_win-1 is j), so residuals are evaluated on the
+    # compacted window instead of the full series.
+    w_stab = w_init & ~tm_removed[:, None]
+    cm4 = jnp.arange(params.MAX_COEFS)[None, :] < 4
+    cm4 = jnp.broadcast_to(cm4, (P, params.MAX_COEFS))
+    c4 = fit(res, w_stab.astype(fdtype), cm4, False)
+    r_w = Yw7 - jnp.sum(c4[:, :, None, :] * Xw8[:, None, :, :], -1)
+    stab_w = valid_w & ~bad_w
+    n4 = jnp.maximum(jnp.sum(stab_w, -1), 1.0)
+    r4 = jnp.sqrt(jnp.maximum(
+        jnp.sum(r_w * r_w * stab_w[:, None, :], -1) / n4[:, None], 0.0))
+    r_first = r_w[:, :, 0]                        # [P,7]
+    r_last = _onehot_take(r_w, jnp.maximum(n_win - 1, 0)[:, None])
+    span = jnp.take(t, j) - t_i
+    denom = params.STABILITY_FACTOR * jnp.maximum(r4, res["vario"])  # [P,7]
+    slope_day = c4[..., 1] / 365.25
+    band_ok = ((jnp.abs(slope_day * span[:, None]) <= denom)
+               & (jnp.abs(r_first) <= denom)
+               & (jnp.abs(r_last) <= denom))                  # [P,7]
+    stable = jnp.all(band_ok[:, _DET], axis=1)
+
+    init_nowin = in_init & ~has_w
+    init_tm = in_init & has_w & tm_removed
+    init_ok = in_init & has_w & ~tm_removed & stable
+    init_bad = in_init & has_w & ~tm_removed & ~stable
+
+    # Cursor advance for INIT failures; a missing successor parks the
+    # cursor at T (out of range -> no-window -> DONE next round).
+    ex_tm, i_next_tm = _first_at_or_after(alive & ~bad, i)
+    i_next_tm = jnp.where(ex_tm, i_next_tm, T)
+    has_adv, i_adv = _first_at_or_after(alive, i + 1)
+
+    return dict(init_nowin=init_nowin, init_tm=init_tm, init_ok=init_ok,
+                init_bad=init_bad, has_adv=has_adv,
+                i_next_tm=i_next_tm.astype(jnp.int32),
+                i_adv=i_adv.astype(jnp.int32), j=j.astype(jnp.int32),
+                w_stab=w_stab, n_ok=jnp.sum(w_stab, -1).astype(jnp.int32),
+                alive_init=alive & ~bad)
+
+
+def _init_zeros(st):
+    """The skip branch of the INIT cond: inert outputs (every consumer
+    masks on in_init-derived flags, all False when no pixel initializes)."""
+    C, P, T = st["included"].shape
+    zb = jnp.zeros((C, P), bool)
+    zi = jnp.zeros((C, P), jnp.int32)
+    zp = jnp.zeros((C, P, T), bool)
+    return dict(init_nowin=zb, init_tm=zb, init_ok=zb, init_bad=zb,
+                has_adv=zb, i_next_tm=zi, i_adv=zi, j=zi, w_stab=zp,
+                n_ok=zi, alive_init=st["alive"])
+
+
+def _mon_block(res, st, *, sensor, change_thr, outlier_thr):
+    """One chip's MONITOR-phase round work: score all remaining
+    observations against the current model and locate the first event
+    (break / refit / tail) in rank space.  Runs under a scalar lax.cond
+    (skipped on round 1, when every standard pixel is still
+    initializing)."""
+    _DET = list(sensor.detection_bands)
+    X, Y = res["X"], res["Y"]
+    alive, included = st["alive"], st["included"]
+    in_mon = st["phase"] == PHASE_MONITOR
+    rank = jnp.cumsum(alive, -1) - 1                           # [P,T]
+
+    # All event logic runs in rank space on the absolute time axis:
+    # rank[p, t] = index of observation t in pixel p's compacted alive
+    # sequence.  Ranks are monotone in t among alive obs, so rank
+    # comparisons reproduce the compacted-sequence semantics without the
+    # argsort/compaction/scatter round-trip ([P,T] bitonic sorts are the
+    # expensive op on TPU, not the matmuls).
+    pred_d = jnp.einsum("pbc,tc->pbt", st["coefs"][:, _DET, :], X)
+    dden = jnp.maximum(st["rmse"], res["vario"])[:, _DET]      # [P,5]
+    s = jnp.sum(((Y[:, _DET, :] - pred_d) / dden[:, :, None]) ** 2, axis=1)
+
+    chain = _monitor_chain
+    if use_pallas("monitor"):
+        on_tpu = jax.default_backend() == "tpu"
+        # Mosaic cannot lower float64; compiled Pallas is f32-on-TPU
+        # only (same gate as the Lasso CD kernel above).
+        if not on_tpu or s.dtype == jnp.float32:
+            from firebird_tpu.ccd import pallas_ops
+
+            chain = functools.partial(pallas_ops.monitor_chain,
+                                      interpret=not on_tpu)
+    mon = chain(s, alive, included, rank, st["cur_k"],
+                st["n_last_fit"], in_mon,
+                change_thr=change_thr, outlier_thr=outlier_thr)
+
+    inc_abs = mon["inc_q"] & in_mon[:, None]
+    rem_abs = mon["rem_q"] & in_mon[:, None]
+    i32 = lambda a: a.astype(jnp.int32)   # x64 mode promotes the chain's ints
+    return dict(m=i32(mon["m"]), is_tail=mon["is_tail"],
+                is_brk=mon["is_brk"], is_refit=mon["is_refit"],
+                ev_rank=i32(mon["ev_rank"]), pos_ev=i32(mon["pos_ev"]),
+                n_exceed=i32(mon["n_exceed"]), n_rf=i32(mon["n_rf"]),
+                included_mon=included | inc_abs,
+                alive_mon=alive & ~rem_abs)
+
+
+def _mon_zeros(st):
+    """The skip branch of the MONITOR cond: no events, state passes
+    through (every consumer masks on in_mon-derived flags)."""
+    C, P, _ = st["included"].shape
+    zb = jnp.zeros((C, P), bool)
+    zi = jnp.zeros((C, P), jnp.int32)
+    return dict(m=zi, is_tail=zb, is_brk=zb, is_refit=zb, ev_rank=zi,
+                pos_ev=zi, n_exceed=zi, n_rf=zi,
+                included_mon=st["included"], alive_mon=st["alive"])
+
+
+def _close_block(res, st, mon, *, S, fdtype):
+    """One chip's segment-close work: break magnitudes and the segment
+    row write.  Runs under a scalar lax.cond on any(close) — segment
+    closes land on a handful of rounds (the shared tail round plus break
+    rounds), so most rounds skip both the PEEK-run one-hot einsums and
+    the full result-buffer rewrite."""
+    t, X, Y = res["t"], res["X"], res["Y"]
+    alive = st["alive"]
+    P, B, T = Y.shape
+    is_tail, is_brk = mon["is_tail"], mon["is_brk"]
+    ev_rank, pos_ev, m = mon["ev_rank"], mon["pos_ev"], mon["m"]
+    included_mon = mon["included_mon"]
+    rank = jnp.cumsum(alive, -1) - 1
+
+    # Magnitudes: median full-band residual over the PEEK run at the
+    # break.  The run has at most PEEK_SIZE members — locate their
+    # absolute positions by a one-hot reduce over T (same scatter-free
+    # construction as the init window) and take a tiny median instead of
+    # masked medians over the whole [P,T] axis.
+    relk = ev_rank[:, None] + jnp.arange(params.PEEK_SIZE)[None, :]
+    run_ok = relk < m[:, None]                                # [P,PEEK]
+    rel_ev = rank - ev_rank[:, None]                          # [P,T]
+    oh_run = (alive[:, None, :] & (
+        rel_ev[:, None, :]
+        == jnp.arange(params.PEEK_SIZE)[None, :, None])
+    ).astype(fdtype)                                          # [P,K,T]
+    X_run = jnp.einsum("pkt,tc->pkc", oh_run, X,
+                       precision=lax.Precision.HIGHEST)       # [P,K,8]
+    pred_run = jnp.sum(st["coefs"][:, :, None, :]
+                       * X_run[:, None, :, :], -1)            # [P,B,K]
+    Y_run = jnp.einsum("pbt,pkt->pbk", Y, oh_run,
+                       precision=lax.Precision.HIGHEST)
+    resid_run = Y_run - pred_run                              # [P,7,PEEK]
+    mags = _masked_median(
+        resid_run, jnp.broadcast_to(run_ok[:, None, :], resid_run.shape))
+
+    last_inc = T - 1 - jnp.argmax(included_mon[:, ::-1], -1)
+    first_inc = jnp.argmax(included_mon, -1)
+    end_day = jnp.take(t, last_inc)
+    start_day = jnp.take(t, first_inc)
+
+    close = is_tail | is_brk
+    qa_tail = params.CURVE_QA_END \
+        + jnp.where(st["first_seg"], params.CURVE_QA_START, 0)
+    qa_brk = jnp.where(st["first_seg"], params.CURVE_QA_START,
+                       params.CURVE_QA_INSIDE)
+    meta_new = jnp.stack([
+        start_day, end_day,
+        jnp.where(is_brk, jnp.take(t, pos_ev), end_day),
+        jnp.where(is_brk, 1.0,
+                  mon["n_exceed"] / params.PEEK_SIZE).astype(fdtype),
+        jnp.where(is_brk, qa_brk, qa_tail).astype(fdtype),
+        jnp.sum(included_mon, -1).astype(fdtype)], axis=1)
+    mag_new = jnp.where(is_brk[:, None], mags, 0.0)
+    return _write_seg(st["bufs"], st["nseg"], close, meta_new,
+                      st["rmse"], mag_new, st["coefs"], S=S)
+
+
+def _detect_batch_core(Xs, Xts, ts, valids, Ys, qas, *,
+                       wcap: int | None = None, sensor=LANDSAT_ARD,
+                       max_segments: int = MAX_SEGMENTS, dtype=None):
+    """A chip batch: Xs [C,T,8], Xts [C,T,5], ts [C,T], valids [C,T],
+    Ys [C,B,P,T] (wire int16 or float), qas [C,P,T] int32 → ChipSegments
+    with [C, ...] leading axes.
+
+    The event loop runs ONE while_loop over the whole batch (not a
+    vmapped per-chip loop): each round's phase blocks are vmapped over
+    chips *inside* scalar lax.cond gates, so a round where no pixel of
+    any chip is initializing skips the INIT block's one-hot window
+    tensors outright, a round with no close skips the buffer rewrite,
+    and a round with no refit skips the Lasso fit.  Under a vmapped
+    while_loop those conds would degenerate to selects (both branches
+    execute every round for every chip); hoisting the loop above the
+    vmap is what makes them real branches.
+
+    Traced under HIGHEST matmul precision: on TPU the default f32 dot
+    runs reduced-precision passes, which would silently degrade every
+    Gram/prediction below the f32 the oracle-parity envelope was
+    measured at (CPU tests run full f32 and would never catch it).
+
+    ``wcap`` (static) bounds the member count of any initialization
+    window; window_cap() derives a rigorous bound from the batch's date
+    grids (None falls back to the always-correct T).  ``sensor``
+    (static) supplies the band layout.  ``max_segments`` (static) is the
+    result-buffer capacity; n_segments counts every closed segment even
+    past capacity, so a caller can detect overflow (n_segments >
+    max_segments) and re-dispatch with a larger buffer — detect_packed
+    does this automatically."""
+    with jax.default_matmul_precision("highest"):
+        return _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, wcap=wcap,
+                                  sensor=sensor, max_segments=max_segments,
+                                  dtype=dtype)
+
+
+def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
+                       max_segments, dtype):
+    C, B, P, T = Ys.shape
+    S = max_segments
+    W = T if wcap is None else min(wcap, T)
+    fdtype = jnp.dtype(dtype) if dtype is not None else Ys.dtype
+    _DET = list(sensor.detection_bands)
+    change_thr, outlier_thr = chi2_thresholds(len(_DET))
+    on_tpu = jax.default_backend() == "tpu"
+    fit_pallas = use_pallas("fit") and (not on_tpu or fdtype == jnp.float32)
+    fit = functools.partial(_fit_chip, fit_pallas=fit_pallas, on_tpu=on_tpu)
+
+    res, state = jax.vmap(functools.partial(
+        _prologue, sensor=sensor, S=S, fdtype=fdtype, fit=fit))(
+            Xs, Xts, ts, valids, Ys, qas)
+
+    initf = jax.vmap(functools.partial(
+        _init_block, sensor=sensor, W=W, fdtype=fdtype, fit=fit))
+    monf = jax.vmap(functools.partial(
+        _mon_block, sensor=sensor, change_thr=change_thr,
+        outlier_thr=outlier_thr))
+    closef = jax.vmap(functools.partial(_close_block, S=S, fdtype=fdtype))
+    fitf = jax.vmap(lambda r, w, n: fit(r, w, _coefmask_for(n, P)))
 
     max_rounds = 2 * T + 8
 
-    def cond(st):
-        return (st["rounds"] < max_rounds) & jnp.any(st["phase"] != PHASE_DONE)
+    def cond(carry):
+        st, rounds = carry
+        return (rounds < max_rounds) & jnp.any(st["phase"] != PHASE_DONE)
 
-    def body(st):
-        phase, alive = st["phase"], st["alive"]
-        included = st["included"]
+    def body(carry):
+        st, rounds = carry
+        phase = st["phase"]
         in_init = phase == PHASE_INIT
         in_mon = phase == PHASE_MONITOR
 
-        # ================= INIT =================
-        has_i, i = _first_at_or_after(alive, st["cur_i"])
-        t_i = jnp.take(t, i)
-        Acum = jnp.cumsum(alive, -1)
-        rank = Acum - 1                                        # [P,T]
-        A_before = jnp.take_along_axis(Acum, i[:, None], -1)[:, 0] \
-            - jnp.take_along_axis(alive, i[:, None], -1)[:, 0]
-        cnt = Acum - A_before[:, None]
-        okj = alive & (ar >= i[:, None]) & (cnt >= params.MEOW_SIZE) \
-            & (t[None, :] - t_i[:, None] >= params.INIT_DAYS)
-        has_w = has_i & jnp.any(okj, -1)
-        j = jnp.argmax(okj, -1)
-        w_init = alive & (ar >= i[:, None]) & (ar <= j[:, None]) \
-            & (has_w & in_init)[:, None]
+        init = lax.cond(jnp.any(in_init),
+                        lambda: initf(res, st), lambda: _init_zeros(st))
+        mon = lax.cond(jnp.any(in_mon),
+                       lambda: monf(res, st), lambda: _mon_zeros(st))
 
-        # Tmask screen over the compacted window: the window members are
-        # exactly the alive obs with ranks [rank(i), rank(i)+n_win), so a
-        # rank-indexed selection bounds all IRLS median/Gram work by
-        # W << T.  Member positions come from a one-hot reduce over T
-        # (ranks are unique among alive obs) rather than a rank scatter +
-        # gather — scatters lower to sort + serialized-loop fusions on
-        # TPU (~32 ms/round profiled, the loop body's hottest ops).
-        n_win = jnp.sum(w_init, -1)                            # [P] <= W
-        r_i = A_before                                         # rank of i
-        rel_w = rank - r_i[:, None]                            # [P,T]
-        # (the == against arange(W) already implies 0 <= rel_w < W)
-        oh_w = alive[:, None, :] \
-            & (rel_w[:, None, :] == jnp.arange(W)[None, :, None])  # [P,W,T]
-        valid_w = (jnp.arange(W)[None, :] < n_win[:, None])
-        # Window members selected by one-hot MXU matmuls — exact (each
-        # output is 1.0 x one element; HIGHEST precision keeps f32 inputs
-        # unrounded) and an order of magnitude cheaper than per-lane
-        # take_along_axis gathers, which serialize on TPU (profiled at
-        # ~7 ms/round combined).  Empty slots read 0 and are masked by
-        # valid_w downstream, as the gathered garbage was before.
-        ohf = oh_w.astype(fdtype)                              # [P,W,T]
-        Yw7 = jnp.einsum("pbt,pwt->pbw", Y, ohf,
-                         precision=lax.Precision.HIGHEST)      # [P,7,W]
-        XW = jnp.einsum("pwt,tc->pwc", ohf,
-                        jnp.concatenate([X, Xt], axis=1),
-                        precision=lax.Precision.HIGHEST)       # [P,W,13]
-        Xw8, Xt_w = XW[..., :8], XW[..., 8:]
-        Y2w = Yw7[:, _TMB, :]
-        tmask_fn = _tmask_bad
-        if use_pallas("tmask"):
-            on_tpu = jax.default_backend() == "tpu"
-            if not on_tpu or fdtype == jnp.float32:
-                from firebird_tpu.ccd import pallas_ops
+        close = mon["is_tail"] | mon["is_brk"]
+        bufs, nseg = lax.cond(jnp.any(close),
+                              lambda: closef(res, st, mon),
+                              lambda: (st["bufs"], st["nseg"]))
 
-                tmask_fn = functools.partial(pallas_ops.tmask_bad,
-                                             interpret=not on_tpu)
-        bad_w = tmask_fn(Xt_w, Y2w, valid_w.astype(fdtype),
-                         vario[:, _TMB])
-        bad = jnp.any(oh_w & bad_w[:, :, None], axis=1)        # [P,T]
-        tm_removed = jnp.any(bad_w, -1)
-
-        # Stability fit: 4 coefs over the (pre-screen-clean) window.  RMSE
-        # and the endpoint residuals only involve window members (member 0
-        # is i, member n_win-1 is j), so residuals are evaluated on the
-        # compacted window instead of the full series.
-        w_stab = w_init & ~tm_removed[:, None]
-        cm4 = jnp.arange(params.MAX_COEFS)[None, :] < 4
-        cm4 = jnp.broadcast_to(cm4, (P, params.MAX_COEFS))
-        c4 = _fit(w_stab.astype(fdtype), cm4, False)
-        r_w = Yw7 - jnp.sum(c4[:, :, None, :] * Xw8[:, None, :, :], -1)
-        stab_w = valid_w & ~bad_w
-        n4 = jnp.maximum(jnp.sum(stab_w, -1), 1.0)
-        r4 = jnp.sqrt(jnp.maximum(
-            jnp.sum(r_w * r_w * stab_w[:, None, :], -1) / n4[:, None], 0.0))
-        r_first = r_w[:, :, 0]                        # [P,7]
-        r_last = _onehot_take(r_w, jnp.maximum(n_win - 1, 0)[:, None])
-        span = jnp.take(t, j) - t_i
-        denom = params.STABILITY_FACTOR * jnp.maximum(r4, vario)  # [P,7]
-        slope_day = c4[..., 1] / 365.25
-        band_ok = ((jnp.abs(slope_day * span[:, None]) <= denom)
-                   & (jnp.abs(r_first) <= denom)
-                   & (jnp.abs(r_last) <= denom))                  # [P,7]
-        stable = jnp.all(band_ok[:, _DET], axis=1)
-
-        init_nowin = in_init & ~has_w
-        init_tm = in_init & has_w & tm_removed
-        init_ok = in_init & has_w & ~tm_removed & stable
-        init_bad = in_init & has_w & ~tm_removed & ~stable
-
-        # ================= MONITOR fast-forward =================
-        # All event logic runs in rank space on the absolute time axis:
-        # rank[p, t] = index of observation t in pixel p's compacted alive
-        # sequence.  Ranks are monotone in t among alive obs, so rank
-        # comparisons reproduce the compacted-sequence semantics without the
-        # argsort/compaction/scatter round-trip ([P,T] bitonic sorts are the
-        # expensive op on TPU, not the matmuls).
-        pred_d = jnp.einsum("pbc,tc->pbt", st["coefs"][:, _DET, :], X)
-        dden = jnp.maximum(st["rmse"], vario)[:, _DET]            # [P,5]
-        s = jnp.sum(((Y[:, _DET, :] - pred_d) / dden[:, :, None]) ** 2, axis=1)
-
-        chain = _monitor_chain
-        if use_pallas("monitor"):
-            on_tpu = jax.default_backend() == "tpu"
-            # Mosaic cannot lower float64; compiled Pallas is f32-on-TPU
-            # only (same gate as the Lasso CD kernel above).
-            if not on_tpu or s.dtype == jnp.float32:
-                from firebird_tpu.ccd import pallas_ops
-
-                chain = functools.partial(pallas_ops.monitor_chain,
-                                          interpret=not on_tpu)
-        mon = chain(s, alive, included, rank, st["cur_k"],
-                    st["n_last_fit"], in_mon,
-                    change_thr=CHANGE_THRESHOLD,
-                    outlier_thr=OUTLIER_THRESHOLD)
-        m, n_exceed, n_rf = mon["m"], mon["n_exceed"], mon["n_rf"]
-        is_tail, is_brk, is_refit = (mon["is_tail"], mon["is_brk"],
-                                     mon["is_refit"])
-        ev_rank, pos_ev = mon["ev_rank"], mon["pos_ev"]
-
-        inc_abs = mon["inc_q"] & in_mon[:, None]
-        rem_abs = mon["rem_q"] & in_mon[:, None]
-        included_mon = included | inc_abs
-        alive_mon = alive & ~rem_abs
-        # Magnitudes: median full-band residual over the PEEK run at the
-        # break.  The run has at most PEEK_SIZE members — locate their
-        # absolute positions by a one-hot reduce over T (same scatter-free
-        # construction as the window) and take a tiny median instead of
-        # masked medians over the whole [P,T] axis.
-        relk = ev_rank[:, None] + jnp.arange(params.PEEK_SIZE)[None, :]
-        run_ok = relk < m[:, None]                                # [P,PEEK]
-        rel_ev = rank - ev_rank[:, None]                          # [P,T]
-        oh_run = (alive[:, None, :] & (
-            rel_ev[:, None, :]
-            == jnp.arange(params.PEEK_SIZE)[None, :, None])
-        ).astype(fdtype)                                          # [P,K,T]
-        X_run = jnp.einsum("pkt,tc->pkc", oh_run, X,
-                           precision=lax.Precision.HIGHEST)       # [P,K,8]
-        pred_run = jnp.sum(st["coefs"][:, :, None, :]
-                           * X_run[:, None, :, :], -1)            # [P,B,K]
-        Y_run = jnp.einsum("pbt,pkt->pbk", Y, oh_run,
-                           precision=lax.Precision.HIGHEST)
-        resid_run = Y_run - pred_run                              # [P,7,PEEK]
-        mags = _masked_median(
-            resid_run, jnp.broadcast_to(run_ok[:, None, :], resid_run.shape))
-
-        last_inc = T - 1 - jnp.argmax(included_mon[:, ::-1], -1)
-        first_inc = jnp.argmax(included_mon, -1)
-        end_day = jnp.take(t, last_inc)
-        start_day = jnp.take(t, first_inc)
-
-        close = is_tail | is_brk
-        qa_tail = params.CURVE_QA_END \
-            + jnp.where(st["first_seg"], params.CURVE_QA_START, 0)
-        qa_brk = jnp.where(st["first_seg"], params.CURVE_QA_START,
-                           params.CURVE_QA_INSIDE)
-        meta_new = jnp.stack([
-            start_day, end_day,
-            jnp.where(is_brk, jnp.take(t, pos_ev), end_day),
-            jnp.where(is_brk, 1.0, n_exceed / params.PEEK_SIZE).astype(fdtype),
-            jnp.where(is_brk, qa_brk, qa_tail).astype(fdtype),
-            jnp.sum(included_mon, -1).astype(fdtype)], axis=1)
-        mag_new = jnp.where(is_brk[:, None], mags, 0.0)
-        bufs, nseg = write_seg(st["bufs"], st["nseg"], close, meta_new,
-                               st["rmse"], mag_new, st["coefs"])
-
-        # ================= refit / init-ok shared fit =================
-        n_ok = jnp.sum(w_stab, -1)
-        w_full = jnp.where(init_ok[:, None], w_stab,
-                           included_mon & is_refit[:, None])
-        n_full = jnp.where(init_ok, n_ok, n_rf)
-        cfull, rfull = _fit(w_full.astype(fdtype),
-                            _coefmask_for(n_full, P))
+        # Refit / init-ok shared fit (skipped when no pixel needs one).
+        init_ok, is_refit = init["init_ok"], mon["is_refit"]
         do_fit = init_ok | is_refit
+        w_full = jnp.where(init_ok[..., None], init["w_stab"],
+                           mon["included_mon"] & is_refit[..., None])
+        n_full = jnp.where(init_ok, init["n_ok"], mon["n_rf"])
+        cfull, rfull = lax.cond(
+            jnp.any(do_fit),
+            lambda: fitf(res, w_full.astype(fdtype), n_full),
+            lambda: (st["coefs"], st["rmse"]))
 
-        # ================= next state =================
-        # cursor advance for INIT failures; a missing successor parks the
-        # cursor at T (out of range -> no-window -> DONE next round).
-        ex_tm, i_next_tm = _first_at_or_after(alive & ~bad, i)
-        i_next_tm = jnp.where(ex_tm, i_next_tm, T)
-        has_adv, i_adv = _first_at_or_after(alive, i + 1)
-
+        # ================= next state (batched elementwise) =============
+        is_tail, is_brk = mon["is_tail"], mon["is_brk"]
         phase_n = jnp.where(
-            init_nowin | (init_bad & ~has_adv), PHASE_DONE,
+            init["init_nowin"] | (init["init_bad"] & ~init["has_adv"]),
+            PHASE_DONE,
             jnp.where(init_ok, PHASE_MONITOR,
                       jnp.where(is_tail, PHASE_DONE,
                                 jnp.where(is_brk, PHASE_INIT, phase))))
-        cur_i_n = jnp.where(init_tm, i_next_tm,
-                            jnp.where(init_bad & has_adv, i_adv,
-                                      jnp.where(is_brk, pos_ev, st["cur_i"])))
-        cur_k_n = jnp.where(init_ok, j + 1,
-                            jnp.where(is_refit, pos_ev + 1, st["cur_k"]))
-        alive_n = jnp.where(in_init[:, None], alive & ~bad,
-                            jnp.where(in_mon[:, None], alive_mon, alive))
-        included_n = jnp.where(init_ok[:, None], w_stab,
-                               jnp.where(is_brk[:, None], False,
-                                         jnp.where(in_mon[:, None],
-                                                   included_mon, included)))
-        coefs_n = jnp.where(do_fit[:, None, None], cfull, st["coefs"])
-        rmse_n = jnp.where(do_fit[:, None], rfull, st["rmse"])
-        nlast_n = jnp.where(do_fit, n_full.astype(jnp.int32), st["n_last_fit"])
+        cur_i_n = jnp.where(
+            init["init_tm"], init["i_next_tm"],
+            jnp.where(init["init_bad"] & init["has_adv"], init["i_adv"],
+                      jnp.where(is_brk, mon["pos_ev"], st["cur_i"])))
+        cur_k_n = jnp.where(init_ok, init["j"] + 1,
+                            jnp.where(is_refit, mon["pos_ev"] + 1,
+                                      st["cur_k"]))
+        alive_n = jnp.where(in_init[..., None], init["alive_init"],
+                            jnp.where(in_mon[..., None], mon["alive_mon"],
+                                      st["alive"]))
+        included_n = jnp.where(
+            init_ok[..., None], init["w_stab"],
+            jnp.where(is_brk[..., None], False,
+                      jnp.where(in_mon[..., None], mon["included_mon"],
+                                st["included"])))
+        coefs_n = jnp.where(do_fit[..., None, None], cfull, st["coefs"])
+        rmse_n = jnp.where(do_fit[..., None], rfull, st["rmse"])
+        nlast_n = jnp.where(do_fit, n_full.astype(jnp.int32),
+                            st["n_last_fit"])
         first_n = st["first_seg"] & ~is_brk
 
-        return dict(phase=phase_n.astype(jnp.int32),
+        st_n = dict(phase=phase_n.astype(jnp.int32),
                     cur_i=cur_i_n.astype(jnp.int32),
                     cur_k=cur_k_n.astype(jnp.int32),
                     alive=alive_n, included=included_n,
                     coefs=coefs_n, rmse=rmse_n, n_last_fit=nlast_n,
-                    first_seg=first_n, nseg=nseg, bufs=bufs,
-                    rounds=st["rounds"] + 1)
+                    first_seg=first_n, nseg=nseg, bufs=bufs)
+        return (st_n, rounds + 1)
 
-    state = lax.while_loop(cond, body, state)
+    state, rounds = lax.while_loop(cond, body,
+                                   (state, jnp.zeros((), jnp.int32)))
 
     meta_b, rmse_b, mag_b, coef_b = state["bufs"]
-    final_mask = jnp.where(is_std[:, None], state["alive"],
-                           jnp.where(is_alt[:, None], alt_mask, False))
+    final_mask = jnp.where(res["is_std"][..., None], state["alive"],
+                           jnp.where(res["is_alt"][..., None],
+                                     res["alt_mask"], False))
     return ChipSegments(
         n_segments=state["nseg"],
-        seg_meta=meta_b.reshape(P, S, 6),
-        seg_rmse=rmse_b.reshape(P, S, B),
-        seg_mag=mag_b.reshape(P, S, B),
-        seg_coef=coef_b.reshape(P, S, B, params.MAX_COEFS),
-        mask=final_mask, procedure=procedure, rounds=state["rounds"],
-        vario=vario)
+        seg_meta=meta_b.reshape(C, P, S, 6),
+        seg_rmse=rmse_b.reshape(C, P, S, B),
+        seg_mag=mag_b.reshape(C, P, S, B),
+        seg_coef=coef_b.reshape(C, P, S, B, params.MAX_COEFS),
+        mask=final_mask, procedure=res["procedure"],
+        rounds=jnp.broadcast_to(rounds, (C,)), vario=res["vario"])
 
 
 # ---------------------------------------------------------------------------
@@ -909,9 +1051,10 @@ def _detect_batch_wire(Xs, Xts, t, valid, Y_i16, qa_u16, *, dtype,
     widen on device — halves host->device transfer vs shipping float32, and
     the core keeps a wire-dtype resident copy so the Pallas fit path reads
     int16 from HBM (docs/ROOFLINE.md item 1)."""
-    f = functools.partial(_detect_core, wcap=wcap, sensor=sensor,
-                          max_segments=max_segments, dtype=dtype)
-    return jax.vmap(f)(Xs, Xts, t, valid, Y_i16, qa_u16.astype(jnp.int32))
+    return _detect_batch_core(Xs, Xts, t, valid, Y_i16,
+                              qa_u16.astype(jnp.int32), wcap=wcap,
+                              sensor=sensor, max_segments=max_segments,
+                              dtype=dtype)
 
 
 def window_cap(packed) -> int:

@@ -163,17 +163,18 @@ def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor,
     detect_sharded composes them for everyone else."""
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec
-    from firebird_tpu.ccd.kernel import MAX_SEGMENTS, _detect_core
+    from firebird_tpu.ccd.kernel import MAX_SEGMENTS, _detect_batch_core
 
-    core = functools.partial(_detect_core, wcap=wcap, sensor=sensor,
+    core = functools.partial(_detect_batch_core, wcap=wcap, sensor=sensor,
                              max_segments=max_segments or MAX_SEGMENTS,
                              dtype=dtype)
 
     def local_batch(Xs, Xts, t, valid, Y_i16, qa_u16):
         # Wire-dtype spectra pass through: the core widens them itself and
         # keeps an int16 resident copy for the Pallas fit path's HBM reads.
-        return jax.vmap(core)(Xs, Xts, t, valid, Y_i16,
-                              qa_u16.astype(jnp.int32))
+        # The batched core (not vmap of the per-chip core): its phase-gated
+        # lax.conds must stay scalar per shard to skip work.
+        return core(Xs, Xts, t, valid, Y_i16, qa_u16.astype(jnp.int32))
 
     spec = PartitionSpec("data")
     # check_vma=False: the kernel's scan/while carries start from

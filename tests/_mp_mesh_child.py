@@ -14,6 +14,11 @@ read_worst sync (forced by max_segments=1).
 import os
 import sys
 
+# Run by script path (python tests/_mp_mesh_child.py), so sys.path[0] is
+# tests/, not the repo root — put the root first so firebird_tpu imports
+# without requiring the package to be installed.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def main() -> int:
     pid, coord = int(sys.argv[1]), sys.argv[2]
