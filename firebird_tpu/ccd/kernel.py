@@ -56,12 +56,13 @@ def use_pallas(component: str = "lasso") -> bool:
     """Whether `component` runs as its Pallas VMEM-resident kernel.
 
     FIREBIRD_PALLAS is "0"/"" (none), "1" (all), or a comma list of
-    component names ("lasso,monitor,tmask,fit") — bench.py tunes the
-    components independently on hardware, so a kernel that loses on a
-    given toolchain can't drag down the ones that win.  "fit" (the fused
-    Gram+corr+CD+RMSE kernel) supersedes "lasso" (CD loop only) at the
-    fit call sites.  Read at trace time: set it before the first detect
-    call — already-compiled programs keep their path."""
+    component names ("lasso,monitor,tmask,fit,score") — bench.py tunes
+    the components independently on hardware, so a kernel that loses on
+    a given toolchain can't drag down the ones that win.  "fit" (the
+    fused Gram+corr+CD+RMSE kernel) supersedes "lasso" (CD loop only) at
+    the fit call sites; "score" (the score-fused monitor kernel)
+    supersedes "monitor".  Read at trace time: set it before the first
+    detect call — already-compiled programs keep their path."""
     import os
 
     v = os.environ.get("FIREBIRD_PALLAS", "0")
@@ -559,7 +560,10 @@ def _prologue(X, Xt, t, valid, Y, qa, *, sensor, S, fdtype, fit):
     P, B, T = Y.shape
     # Per-row design outer products, shared by every Lasso Gram build.
     XX = (X[:, :, None] * X[:, None, :]).reshape(T, -1)        # [T,64]
-    res = dict(X=X, Xt=Xt, t=t, Y=Y, Yt=Yt_res, XX=XX)
+    # Detection-band wire-dtype slice for the score-fused monitor kernel
+    # (DCE'd from the program when FIREBIRD_PALLAS doesn't enable it).
+    Yd = Yt_res[np.asarray(sensor.detection_bands)]            # [nb,T,P]
+    res = dict(X=X, Xt=Xt, t=t, Y=Y, Yt=Yt_res, Yd=Yd, XX=XX)
 
     # ---------------- QA triage (reference.detect) ----------------
     fill = _qa_bit(qa, params.QA_FILL_BIT) | ~valid[None, :]
@@ -784,7 +788,6 @@ def _mon_block(res, st, *, sensor, change_thr, outlier_thr):
     X, Y = res["X"], res["Y"]
     alive, included = st["alive"], st["included"]
     in_mon = st["phase"] == PHASE_MONITOR
-    rank = jnp.cumsum(alive, -1) - 1                           # [P,T]
 
     # All event logic runs in rank space on the absolute time axis:
     # rank[p, t] = index of observation t in pixel p's compacted alive
@@ -792,23 +795,36 @@ def _mon_block(res, st, *, sensor, change_thr, outlier_thr):
     # comparisons reproduce the compacted-sequence semantics without the
     # argsort/compaction/scatter round-trip ([P,T] bitonic sorts are the
     # expensive op on TPU, not the matmuls).
-    pred_d = jnp.einsum("pbc,tc->pbt", st["coefs"][:, _DET, :], X)
     dden = jnp.maximum(st["rmse"], res["vario"])[:, _DET]      # [P,5]
-    s = jnp.sum(((Y[:, _DET, :] - pred_d) / dden[:, :, None]) ** 2, axis=1)
+    on_tpu = jax.default_backend() == "tpu"
+    # Mosaic cannot lower float64; compiled Pallas is f32-on-TPU only
+    # (same gate as the Lasso CD kernel).
+    f32_ok = not on_tpu or res["X"].dtype == jnp.float32
+    if use_pallas("score") and f32_ok:
+        # Score-fused kernel: predictions, score, and rank derived in
+        # VMEM from the wire-dtype detection-band spectra — skips the
+        # [P,nb,T] prediction einsum and the s/rank plane round-trips.
+        from firebird_tpu.ccd import pallas_ops
 
-    chain = _monitor_chain
-    if use_pallas("monitor"):
-        on_tpu = jax.default_backend() == "tpu"
-        # Mosaic cannot lower float64; compiled Pallas is f32-on-TPU
-        # only (same gate as the Lasso CD kernel above).
-        if not on_tpu or s.dtype == jnp.float32:
+        mon = pallas_ops.monitor_chain_scored(
+            res["Yd"], st["coefs"][:, _DET, :], dden, res["X"], alive,
+            included, st["cur_k"], st["n_last_fit"], in_mon,
+            change_thr=change_thr, outlier_thr=outlier_thr,
+            interpret=not on_tpu)
+    else:
+        pred_d = jnp.einsum("pbc,tc->pbt", st["coefs"][:, _DET, :], X)
+        s = jnp.sum(((Y[:, _DET, :] - pred_d) / dden[:, :, None]) ** 2,
+                    axis=1)
+        rank = jnp.cumsum(alive, -1) - 1                       # [P,T]
+        chain = _monitor_chain
+        if use_pallas("monitor") and f32_ok:
             from firebird_tpu.ccd import pallas_ops
 
             chain = functools.partial(pallas_ops.monitor_chain,
                                       interpret=not on_tpu)
-    mon = chain(s, alive, included, rank, st["cur_k"],
-                st["n_last_fit"], in_mon,
-                change_thr=change_thr, outlier_thr=outlier_thr)
+        mon = chain(s, alive, included, rank, st["cur_k"],
+                    st["n_last_fit"], in_mon,
+                    change_thr=change_thr, outlier_thr=outlier_thr)
 
     inc_abs = mon["inc_q"] & in_mon[:, None]
     rem_abs = mon["rem_q"] & in_mon[:, None]

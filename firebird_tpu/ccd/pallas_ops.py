@@ -296,26 +296,40 @@ def _shift_scan_add(x, T):
     return x
 
 
-def _monitor_block(s_ref, alive_ref, inc_ref, rank_ref, curk_ref, nlast_ref,
-                   inmon_ref, m_ref, istail_ref, isbrk_ref, isrefit_ref,
-                   evrank_ref, posev_ref, nexc_ref, nrf_ref, incq_ref,
-                   remq_ref, *, change_thr, outlier_thr, peek, refit_factor,
-                   T):
-    """One pixel block of kernel._monitor_chain, everything in VMEM.
+def _pad_helpers(pad):
+    """(plane, vec) input builders shared by the monitor wrappers:
+    transpose to [T, P] / [1, P] layout and pad the lane axis."""
+    plane = lambda x, cv=0: jnp.pad(
+        jnp.asarray(x).T, ((0, 0), (0, pad)), constant_values=cv)
+    vec = lambda x, cv=0: jnp.pad(
+        jnp.asarray(x)[None, :], ((0, 0), (0, pad)), constant_values=cv)
+    return plane, vec
+
+
+def _mon_outs_to_dict(outs, P):
+    """Unpack the 10 monitor-kernel outputs (kernel._monitor_chain
+    contract) — shared by both wrappers so the two FIREBIRD_PALLAS paths
+    cannot diverge on the output assembly."""
+    m, istail, isbrk, isrefit, evrank, posev, nexc, nrf, incq, remq = outs
+    cut = lambda x: x[0, :P]
+    cutb = lambda x: x[0, :P] > 0
+    return dict(m=cut(m), is_tail=cutb(istail), is_brk=cutb(isbrk),
+                is_refit=cutb(isrefit), ev_rank=cut(evrank),
+                pos_ev=cut(posev), n_exceed=cut(nexc), n_rf=cut(nrf),
+                inc_q=(incq[:, :P] > 0).T, rem_q=(remq[:, :P] > 0).T)
+
+
+def _monitor_logic(s, alive, included, rank, cur_k, nlast, in_mon, *,
+                   change_thr, outlier_thr, peek, refit_factor, T):
+    """The MONITOR event logic on VMEM-resident planes, shared by the
+    plain and score-fused blocks.
 
     Planes are [T, Pb] (T on sublanes, pixels on lanes); per-pixel vectors
     are [1, Pb].  Mirrors the jnp reference op for op — argmax becomes a
     first-index min-reduce with the same no-hit default (0), and the
     rank/count lookups become one-hot reduces (no gather in Mosaic).
+    Returns the 10 output planes/vectors in kernel._monitor_chain order.
     """
-    s = s_ref[...]
-    alive = alive_ref[...] > 0
-    included = inc_ref[...] > 0
-    rank = rank_ref[...]
-    cur_k = curk_ref[...]
-    nlast = nlast_ref[...]
-    in_mon = inmon_ref[...] > 0
-
     INF = jnp.int32(T + 1)
     ti = lax.broadcasted_iota(jnp.int32, s.shape, 0)          # [T,Pb]
     one = jnp.int32(1)
@@ -368,16 +382,55 @@ def _monitor_block(s_ref, alive_ref, inc_ref, rank_ref, curk_ref, nlast_ref,
     n_rf = at_idx(n_inc, pos_ev)
 
     as_i = lambda b: jnp.where(b, one, 0)
-    m_ref[...] = m
-    istail_ref[...] = as_i(is_tail)
-    isbrk_ref[...] = as_i(is_brk)
-    isrefit_ref[...] = as_i(is_refit)
-    evrank_ref[...] = ev_rank
-    posev_ref[...] = pos_ev
-    nexc_ref[...] = n_exceed
-    nrf_ref[...] = n_rf
-    incq_ref[...] = as_i(inc_q)
-    remq_ref[...] = as_i(rem_q)
+    return (m, as_i(is_tail), as_i(is_brk), as_i(is_refit), ev_rank,
+            pos_ev, n_exceed, n_rf, as_i(inc_q), as_i(rem_q))
+
+
+def _monitor_block(s_ref, alive_ref, inc_ref, rank_ref, curk_ref, nlast_ref,
+                   inmon_ref, *out_refs, change_thr, outlier_thr, peek,
+                   refit_factor, T):
+    """One pixel block of kernel._monitor_chain, everything in VMEM."""
+    outs = _monitor_logic(
+        s_ref[...], alive_ref[...] > 0, inc_ref[...] > 0, rank_ref[...],
+        curk_ref[...], nlast_ref[...], inmon_ref[...] > 0,
+        change_thr=change_thr, outlier_thr=outlier_thr, peek=peek,
+        refit_factor=refit_factor, T=T)
+    for ref, val in zip(out_refs, outs):
+        ref[...] = val
+
+
+def _monitor_scored_block(yd_ref, coef_ref, dden_ref, x_ref, alive_ref,
+                          inc_ref, curk_ref, nlast_ref, inmon_ref,
+                          *out_refs, change_thr, outlier_thr, peek,
+                          refit_factor, T, nb):
+    """Score-fused monitor block: compute the chi2 score plane s — the
+    detection-band predictions against the current model — *inside* VMEM
+    from wire-dtype spectra, then run the shared event logic.
+
+    Replaces the XLA path's [P,nb,T] prediction einsum + [P,T] score and
+    rank materializations (the dominant HBM terms of a steady-state
+    monitor round now that the INIT block is cond-gated): spectra stream
+    once as int16, predictions are one [T,K]x[K,BP] MXU dot per band,
+    and rank is a log-step shift-add over the alive plane.
+    """
+    X = x_ref[...]                                            # [T, K]
+    alive_i = alive_ref[...]                                  # [T, BP] int32
+    alive = alive_i > 0
+    f32 = X.dtype
+
+    s = None
+    for b in range(nb):
+        pred = jnp.dot(X, coef_ref[b], preferred_element_type=f32)
+        r = (yd_ref[b].astype(f32) - pred) / dden_ref[b][None, :]
+        s = r * r if s is None else s + r * r                 # [T, BP]
+
+    rank = _shift_scan_add(jnp.where(alive, jnp.int32(1), 0), T) - 1
+    outs = _monitor_logic(
+        s, alive, inc_ref[...] > 0, rank, curk_ref[...], nlast_ref[...],
+        inmon_ref[...] > 0, change_thr=change_thr,
+        outlier_thr=outlier_thr, peek=peek, refit_factor=refit_factor, T=T)
+    for ref, val in zip(out_refs, outs):
+        ref[...] = val
 
 
 @functools.partial(jax.jit, static_argnames=("change_thr", "outlier_thr",
@@ -394,10 +447,7 @@ def monitor_chain(s, alive, included, rank, cur_k, n_last_fit, in_mon, *,
     BP = mon_block_p(T)
     Pp = -BP * (-P // BP)
     pad = Pp - P
-    plane = lambda x, cv=0: jnp.pad(
-        jnp.asarray(x).T, ((0, 0), (0, pad)), constant_values=cv)
-    vec = lambda x, cv=0: jnp.pad(
-        jnp.asarray(x)[None, :], ((0, 0), (0, pad)), constant_values=cv)
+    plane, vec = _pad_helpers(pad)
 
     i32 = jnp.int32
     args = (plane(s), plane(alive.astype(i32)), plane(included.astype(i32)),
@@ -419,13 +469,75 @@ def monitor_chain(s, alive, included, rank, cur_k, n_last_fit, in_mon, *,
         out_shape=[vshape] * 8 + [pshape] * 2,
         interpret=interpret,
     )(*args)
-    m, istail, isbrk, isrefit, evrank, posev, nexc, nrf, incq, remq = outs
-    cut = lambda x: x[0, :P]
-    cutb = lambda x: x[0, :P] > 0
-    return dict(m=cut(m), is_tail=cutb(istail), is_brk=cutb(isbrk),
-                is_refit=cutb(isrefit), ev_rank=cut(evrank),
-                pos_ev=cut(posev), n_exceed=cut(nexc), n_rf=cut(nrf),
-                inc_q=(incq[:, :P] > 0).T, rem_q=(remq[:, :P] > 0).T)
+    return _mon_outs_to_dict(outs, P)
+
+
+def scored_block_p(T: int, nb: int, y_bytes: int) -> int:
+    """Lane-block width for the score-fused monitor kernel: the monitor
+    planes (~12 [T, BP] f32) plus the [nb, T, BP] wire-dtype spectra
+    block and the live score/pred temporaries."""
+    budget = 10 * 2 ** 20
+    per_lane = max(T, 1) * (14 * 4 + nb * y_bytes)
+    return max(128, min(512, (budget // per_lane) // 128 * 128))
+
+
+@functools.partial(jax.jit, static_argnames=("change_thr", "outlier_thr",
+                                             "interpret"))
+def monitor_chain_scored(Yd, coefs_d, dden, X, alive, included, cur_k,
+                         n_last_fit, in_mon, *, change_thr, outlier_thr,
+                         interpret=False):
+    """Score-fused Pallas twin of kernel._mon_block's score + chain.
+
+    Args:
+        Yd: [nb, T, P] detection-band resident spectra (wire int16 or
+            float32; widened in-register, exact).
+        coefs_d: [P, nb, K] current model coefficients (detection bands).
+        dden: [P, nb] score denominators (max(rmse, vario), detection).
+        X: [T, K] design (chip-shared).
+        alive, included: [P, T] bool planes.
+        cur_k, n_last_fit: [P] int; in_mon: [P] bool.
+    Returns:
+        The kernel._monitor_chain output dict (same contract); rank is
+        derived in-kernel from the alive plane.
+    """
+    nb, T, P = Yd.shape
+    K = X.shape[-1]
+    f32 = X.dtype
+    BP = scored_block_p(T, nb, Yd.dtype.itemsize)
+    Pp = -BP * (-P // BP)
+    pad = Pp - P
+    i32 = jnp.int32
+
+    plane, vec = _pad_helpers(pad)
+    yp = jnp.pad(Yd, ((0, 0), (0, 0), (0, pad)))
+    cf = jnp.pad(coefs_d.transpose(1, 2, 0), ((0, 0), (0, 0), (0, pad)))
+    dd = jnp.pad(dden.T, ((0, 0), (0, pad)), constant_values=1.0)
+
+    kern = functools.partial(
+        _monitor_scored_block, change_thr=float(change_thr),
+        outlier_thr=float(outlier_thr), peek=int(params.PEEK_SIZE),
+        refit_factor=float(params.REFIT_FACTOR), T=T, nb=nb)
+    pspec = pl.BlockSpec((T, BP), lambda i: (0, i))
+    vspec = pl.BlockSpec((1, BP), lambda i: (0, i))
+    outs = pl.pallas_call(
+        kern,
+        grid=(Pp // BP,),
+        in_specs=[
+            pl.BlockSpec((nb, T, BP), lambda i: (0, 0, i)),
+            pl.BlockSpec((nb, K, BP), lambda i: (0, 0, i)),
+            pl.BlockSpec((nb, BP), lambda i: (0, i)),
+            pl.BlockSpec((T, K), lambda i: (0, 0)),
+            pspec, pspec, vspec, vspec, vspec,
+        ],
+        out_specs=[vspec] * 8 + [pspec] * 2,
+        out_shape=[jax.ShapeDtypeStruct((1, Pp), i32)] * 8
+        + [jax.ShapeDtypeStruct((T, Pp), i32)] * 2,
+        interpret=interpret,
+    )(yp, cf.astype(f32), dd.astype(f32), X,
+      plane(alive.astype(i32)), plane(included.astype(i32)),
+      vec(cur_k.astype(i32)), vec(n_last_fit.astype(i32), 1),
+      vec(in_mon.astype(i32)))
+    return _mon_outs_to_dict(outs, P)
 
 
 # ---------------------------------------------------------------------------

@@ -169,6 +169,69 @@ def test_monitor_chain_in_detect_matches_default(monkeypatch):
     np.testing.assert_array_equal(np.asarray(got.mask), np.asarray(ref.mask))
 
 
+def test_monitor_scored_matches_jnp_reference():
+    """pallas_ops.monitor_chain_scored (interpret) reproduces the XLA
+    score + kernel._monitor_chain pipeline on randomized round states,
+    reading wire-dtype int16 detection-band spectra."""
+    from firebird_tpu.ccd import harmonic, pallas_ops
+
+    rng = np.random.default_rng(11)
+    P, T, nb, K = 137, 96, 5, params.MAX_COEFS
+    t = np.sort(rng.integers(729000, 730500, T)).astype(np.float64)
+    X = jnp.asarray(harmonic.design_matrix(t, t[0], K), jnp.float32)
+    for trial in range(3):
+        Yd = rng.integers(0, 8000, (nb, T, P)).astype(np.int16)
+        coefs = jnp.asarray(rng.normal(0, 1, (P, nb, K)) * 100, jnp.float32)
+        dden = jnp.asarray(np.abs(rng.normal(150, 40, (P, nb))) + 1,
+                           jnp.float32)
+        alive = rng.random((P, T)) < 0.8
+        included = jnp.asarray((rng.random((P, T)) < 0.4) & alive)
+        rank = jnp.cumsum(jnp.asarray(alive), -1) - 1
+        cur_k = jnp.asarray(rng.integers(0, T, P), jnp.int32)
+        n_last_fit = jnp.asarray(rng.integers(1, 40, P), jnp.int32)
+        in_mon = jnp.asarray(rng.random(P) < 0.7)
+        alive = jnp.asarray(alive)
+        kw = dict(change_thr=11.07, outlier_thr=15.09)
+
+        # the XLA path: [P,nb,T] prediction einsum -> score -> chain
+        Yp = jnp.asarray(Yd.transpose(2, 0, 1), jnp.float32)   # [P,nb,T]
+        pred = jnp.einsum("pbc,tc->pbt", coefs, X)
+        s = jnp.sum(((Yp - pred) / dden[:, :, None]) ** 2, axis=1)
+        want = kernel._monitor_chain(s, alive, included, rank, cur_k,
+                                     n_last_fit, in_mon, **kw)
+        got = pallas_ops.monitor_chain_scored(
+            jnp.asarray(Yd), coefs, dden, X, alive, included, cur_k,
+            n_last_fit, in_mon, interpret=True, **kw)
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_array_equal(
+                np.asarray(got[k]), np.asarray(want[k]), err_msg=k)
+
+
+def test_score_kernel_in_detect_matches_default(monkeypatch):
+    """FIREBIRD_PALLAS=score routes the monitor score+chain through the
+    score-fused kernel; segment decisions must equal the default path."""
+    from firebird_tpu.ingest import SyntheticSource, pack
+    from firebird_tpu.ingest.packer import PackedChips
+
+    src = SyntheticSource(seed=66, start="1995-01-01", end="1999-01-01",
+                          cloud_frac=0.15)
+    p = pack([src.chip(100, 200)], bucket=32)
+    p = PackedChips(cids=p.cids, dates=p.dates,
+                    spectra=p.spectra[:, :, :64, :], qas=p.qas[:, :64, :],
+                    n_obs=p.n_obs, sensor=p.sensor)
+    ref = kernel.detect_packed(p, dtype=jnp.float32)
+    monkeypatch.setenv("FIREBIRD_PALLAS", "score")
+    monkeypatch.setattr(kernel, "window_cap",
+                        lambda pk, _orig=kernel.window_cap: _orig(pk) + 40)
+    got = kernel.detect_packed(p, dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(got.n_segments),
+                                  np.asarray(ref.n_segments))
+    np.testing.assert_array_equal(np.asarray(got.seg_meta[..., :3]),
+                                  np.asarray(ref.seg_meta[..., :3]))
+    np.testing.assert_array_equal(np.asarray(got.mask), np.asarray(ref.mask))
+
+
 def test_tmask_bad_matches_jnp_reference():
     """pallas_ops.tmask_bad (interpret) reproduces kernel._tmask_bad on
     randomized windows — including degenerate all-masked and constant
