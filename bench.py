@@ -173,13 +173,17 @@ def measure(cpu_only: bool) -> None:
     # ---- closed-form FLOP model -> MFU / roofline (docs/ROOFLINE.md) ----
     from firebird_tpu.ccd import flops as flopsmod
 
+    rc = getattr(seg, "round_counts", None)
+    phase_rounds = (tuple(np.asarray(rc).reshape(-1, 3).mean(0))
+                    if rc is not None else None)
     roofline = flopsmod.bench_detail(
         pixels_per_sec=dev_rate, P=n_pixels,
         T=int(packed.spectra.shape[-1]), W=wcap,
         S=int(np.asarray(seg.seg_meta).shape[-2]),
         rounds=float(np.asarray(seg.rounds).mean()),
         device_kind=jax.devices()[0].device_kind,
-        dtype_bytes=jnp.dtype(fdtype).itemsize, sensor=packed.sensor)
+        dtype_bytes=jnp.dtype(fdtype).itemsize, sensor=packed.sensor,
+        phase_rounds=phase_rounds)
 
     # ---- CPU per-pixel rate (the pyccd stand-in), extrapolated ----
     sample = 12

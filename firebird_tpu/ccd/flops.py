@@ -85,30 +85,38 @@ def _sort_flops(rows: float, n: int) -> float:
 
 
 def round_flops(P: int, T: int, W: int, sensor=LANDSAT_ARD) -> dict:
-    """FLOPs of one event-horizon round over P pixels (kernel.body)."""
+    """FLOPs of one event-horizon round over P pixels (kernel.body).
+
+    Terms are grouped by the cond gate that executes them (kernel
+    _detect_batch_impl): ``init`` only runs on rounds with an
+    initializing pixel, ``close`` on rounds closing a segment, ``refit``
+    on rounds fitting a model, ``monitor`` every round.  ``total`` is
+    the ungated (every-round) sum — the pre-gating upper bound.
+    """
     B = sensor.n_bands
     D = len(sensor.detection_bands)
     nb = len(sensor.tmask_bands)
     init_fit = _lasso_fit_flops(P, T, B, with_rmse=False)   # c4 stability
     init_resid = 2.0 * P * B * W * K + 6.0 * P * B * W      # r_w + rmse4
     tmask = _tmask_flops(P, W, nb)
-    # One-hot window/run selections (the scatter-free MXU formulation):
-    # Yw7 [P,B,T]x[P,W,T], XW [P,W,T]x[T,K+NT], X_run/Y_run over PEEK
-    # (kernel body; these replaced serialized per-lane gathers and now
-    # carry a real share of the round's MXU work).
-    onehot = (2.0 * P * B * W * T                 # Yw7
-              + 2.0 * P * W * T * (K + NT)       # XW
-              + 2.0 * P * params.PEEK_SIZE * T * (K + B))   # X_run + Y_run
+    # One-hot window selections (the scatter-free MXU formulation):
+    # Yw7 [P,B,T]x[P,W,T], XW [P,W,T]x[T,K+NT] (kernel._init_block; these
+    # replaced serialized per-lane gathers).
+    onehot_w = (2.0 * P * B * W * T               # Yw7
+                + 2.0 * P * W * T * (K + NT))     # XW
     monitor = (2.0 * P * D * T * K      # pred_d
-               + 4.0 * P * D * T        # score s
-               + 2.0 * P * B * params.PEEK_SIZE * K          # pred_run
-               + _sort_flops(P * B, params.PEEK_SIZE))       # mags median
+               + 4.0 * P * D * T)       # score s
+    # Segment-close work (kernel._close_block): PEEK-run one-hot
+    # selections + break-magnitude medians.
+    close = (2.0 * P * params.PEEK_SIZE * T * (K + B)        # X_run + Y_run
+             + 2.0 * P * B * params.PEEK_SIZE * K            # pred_run
+             + _sort_flops(P * B, params.PEEK_SIZE))         # mags median
     refit = _lasso_fit_flops(P, T, B, with_rmse=True)       # cfull
+    init = init_fit + init_resid + tmask + onehot_w
     return {"init_fit": init_fit, "init_resid": init_resid,
-            "tmask": tmask, "onehot": onehot, "monitor": monitor,
-            "refit": refit,
-            "total": (init_fit + init_resid + tmask + onehot + monitor
-                      + refit)}
+            "tmask": tmask, "onehot": onehot_w, "monitor": monitor,
+            "close": close, "refit": refit, "init": init,
+            "total": init + monitor + close + refit}
 
 
 def setup_flops(P: int, T: int, sensor=LANDSAT_ARD) -> float:
@@ -124,39 +132,57 @@ def setup_flops(P: int, T: int, sensor=LANDSAT_ARD) -> float:
 
 
 def detect_flops(P: int, T: int, W: int, rounds: float,
-                 sensor=LANDSAT_ARD) -> dict:
-    """Total kernel FLOPs for one dispatch and the per-pixel figure."""
+                 sensor=LANDSAT_ARD,
+                 phase_rounds: tuple | None = None) -> dict:
+    """Total kernel FLOPs for one dispatch and the per-pixel figure.
+
+    ``phase_rounds`` = (init_rounds, fit_rounds, close_rounds) — the
+    measured cond-gate execution counts (ChipSegments.round_counts).
+    None models the ungated loop (every block every round)."""
     r = round_flops(P, T, W, sensor)
-    total = r["total"] * rounds + setup_flops(P, T, sensor)
+    ir, fr, cr = phase_rounds if phase_rounds is not None \
+        else (rounds, rounds, rounds)
+    total = (r["monitor"] * rounds + r["init"] * ir + r["refit"] * fr
+             + r["close"] * cr + setup_flops(P, T, sensor))
     return {"per_round": r, "rounds": rounds, "total": total,
             "per_pixel": total / max(P, 1)}
 
 
 def round_bytes(P: int, T: int, W: int, S: int, dtype_bytes: int,
-                sensor=LANDSAT_ARD) -> float:
-    """Estimated HBM traffic per round (read+write), assuming XLA fuses
-    elementwise chains but materializes the major arrays.
+                sensor=LANDSAT_ARD,
+                rounds: float = 1.0,
+                phase_rounds: tuple | None = None) -> float:
+    """Estimated HBM traffic (read+write) over the event loop, assuming
+    XLA fuses elementwise chains but materializes the major arrays.
 
-    Dominant terms: the spectra Y [P,B,T] are read by the three einsum
-    groups (score, stability residual, Gram corr — fused reads counted
-    once each); the loop state (alive/included [P,T] bools, score-sized
-    temporaries ~10x [P,T], result buffers [P,S,*]) is read and written
-    every round (lax.while_loop carries it through HBM).
+    Per-phase apportionment mirrors the kernel's cond gates
+    (_detect_batch_impl): the score-group spectra read, the [P,T]
+    temporaries, and the carried state move every round; the one-hot
+    window tensors + stability-fit spectra read only on INIT rounds; the
+    refit spectra read on fit rounds; the PEEK-run tensors + result-
+    buffer rewrite on close rounds.  ``phase_rounds`` = (init, fit,
+    close) counts; None models every block every round.
     """
     B = sensor.n_bands
-    y_reads = 3.0 * P * B * T * dtype_bytes
-    pt_temps = 10.0 * P * T * dtype_bytes + 6.0 * P * T      # bools
-    state = 2 * (2.0 * P * T                                  # alive+included
-                 + P * B * K * dtype_bytes                    # coefs
-                 + P * S * (6 + 2 * B + B * K) * dtype_bytes)  # bufs (flat)
-    # One-hot selection tensors: oh_w [P,W,T] bool written+read (bad
-    # reduce) plus its float view read by the two selection matmuls;
-    # oh_run [P,PEEK,T] float written+read.
-    onehot = (3.0 * P * W * T                                # oh_w bool
-              + 3.0 * P * W * T * dtype_bytes               # ohf
-              + 2.0 * P * params.PEEK_SIZE * T * dtype_bytes)
-    window = 2.0 * P * W * (NT + B + NT * NT) * dtype_bytes  # members+XtXt
-    return y_reads + pt_temps + state + onehot + window
+    D = len(sensor.detection_bands)
+    ir, fr, cr = phase_rounds if phase_rounds is not None \
+        else (rounds, rounds, rounds)
+    # every round: score-group read [P,D,T] + ~10 [P,T] temporaries +
+    # carried planes/coefs (bufs counted on close rounds — unchanged
+    # cond pass-through aliases in place).
+    every = (1.0 * P * D * T * dtype_bytes
+             + 10.0 * P * T * dtype_bytes + 6.0 * P * T
+             + 2 * (2.0 * P * T + P * B * K * dtype_bytes))
+    # init rounds: oh_w bool written+read + float view read by the two
+    # selection matmuls + window members/XtXt + the c4 fit's Y read.
+    init = (3.0 * P * W * T
+            + 3.0 * P * W * T * dtype_bytes
+            + 2.0 * P * W * (NT + B + NT * NT) * dtype_bytes
+            + P * B * T * dtype_bytes)
+    fit = P * B * T * dtype_bytes                 # cfull Gram corr Y read
+    close = (2.0 * P * params.PEEK_SIZE * T * dtype_bytes    # oh_run
+             + 2.0 * P * S * (6 + 2 * B + B * K) * dtype_bytes)  # bufs
+    return every * rounds + init * ir + fit * fr + close * cr
 
 
 # ---------------------------------------------------------------------------
@@ -195,10 +221,15 @@ def peak_for(device_kind: str) -> Peak | None:
 
 def bench_detail(pixels_per_sec: float, P: int, T: int, W: int, S: int,
                  rounds: float, device_kind: str, dtype_bytes: int = 4,
-                 sensor=LANDSAT_ARD) -> dict:
-    """The roofline block bench.py embeds in its detail output."""
-    fl = detect_flops(P, T, W, rounds, sensor)
-    by = round_bytes(P, T, W, S, dtype_bytes, sensor) * rounds / max(P, 1)
+                 sensor=LANDSAT_ARD, phase_rounds: tuple | None = None) -> dict:
+    """The roofline block bench.py embeds in its detail output.
+
+    ``phase_rounds`` = measured (init, fit, close) cond-gate counts
+    (ChipSegments.round_counts) — makes the model reflect what the
+    phase-gated loop actually executed instead of the ungated bound."""
+    fl = detect_flops(P, T, W, rounds, sensor, phase_rounds=phase_rounds)
+    by = round_bytes(P, T, W, S, dtype_bytes, sensor, rounds=rounds,
+                     phase_rounds=phase_rounds) / max(P, 1)
     achieved = pixels_per_sec * fl["per_pixel"]
     hbm_rate = pixels_per_sec * by
     out = {
@@ -210,6 +241,10 @@ def bench_detail(pixels_per_sec: float, P: int, T: int, W: int, S: int,
         "rounds": round(float(rounds), 1),
         "device_kind": device_kind,
     }
+    if phase_rounds is not None:
+        out["phase_rounds"] = {"init": round(float(phase_rounds[0]), 1),
+                               "fit": round(float(phase_rounds[1]), 1),
+                               "close": round(float(phase_rounds[2]), 1)}
     pk = peak_for(device_kind)
     if pk is not None:
         out["mfu_pct_vs_f32_peak"] = round(100 * achieved / pk.f32_flops, 2)

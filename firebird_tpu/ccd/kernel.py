@@ -96,12 +96,17 @@ class ChipSegments:
     procedure: jnp.ndarray       # [.., P] int32
     rounds: jnp.ndarray | None = None  # [..] int32 event-loop rounds (diag)
     vario: jnp.ndarray | None = None   # [.., P, 7] variogram (streaming seed)
+    round_counts: jnp.ndarray | None = None
+    # ^ [.., 3] int32: rounds in which the cond-gated INIT / shared-fit /
+    #   segment-close blocks actually executed (diagnostic; feeds the
+    #   measurement-driven roofline model in ccd.flops / bench.py).
 
 
 jax.tree_util.register_pytree_node(
     ChipSegments,
     lambda s: ((s.n_segments, s.seg_meta, s.seg_rmse, s.seg_mag, s.seg_coef,
-                s.mask, s.procedure, s.rounds, s.vario), None),
+                s.mask, s.procedure, s.rounds, s.vario, s.round_counts),
+               None),
     lambda _, c: ChipSegments(*c),
 )
 
@@ -812,7 +817,11 @@ def _mon_block(res, st, *, sensor, change_thr, outlier_thr):
             change_thr=change_thr, outlier_thr=outlier_thr,
             interpret=not on_tpu)
     else:
-        pred_d = jnp.einsum("pbc,tc->pbt", st["coefs"][:, _DET, :], X)
+        # HIGHEST is already the context default (_detect_batch_core);
+        # pinned explicitly so the score matches the Pallas twin's full-f32
+        # dot even if the context ever moves.
+        pred_d = jnp.einsum("pbc,tc->pbt", st["coefs"][:, _DET, :], X,
+                            precision=lax.Precision.HIGHEST)
         s = jnp.sum(((Y[:, _DET, :] - pred_d) / dden[:, :, None]) ** 2,
                     axis=1)
         rank = jnp.cumsum(alive, -1) - 1                       # [P,T]
@@ -969,33 +978,36 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
     max_rounds = 2 * T + 8
 
     def cond(carry):
-        st, rounds = carry
+        st, rounds, _ = carry
         return (rounds < max_rounds) & jnp.any(st["phase"] != PHASE_DONE)
 
     def body(carry):
-        st, rounds = carry
+        st, rounds, counts = carry
         phase = st["phase"]
         in_init = phase == PHASE_INIT
         in_mon = phase == PHASE_MONITOR
 
-        init = lax.cond(jnp.any(in_init),
+        any_init = jnp.any(in_init)
+        init = lax.cond(any_init,
                         lambda: initf(res, st), lambda: _init_zeros(st))
         mon = lax.cond(jnp.any(in_mon),
                        lambda: monf(res, st), lambda: _mon_zeros(st))
 
         close = mon["is_tail"] | mon["is_brk"]
-        bufs, nseg = lax.cond(jnp.any(close),
+        any_close = jnp.any(close)
+        bufs, nseg = lax.cond(any_close,
                               lambda: closef(res, st, mon),
                               lambda: (st["bufs"], st["nseg"]))
 
         # Refit / init-ok shared fit (skipped when no pixel needs one).
         init_ok, is_refit = init["init_ok"], mon["is_refit"]
         do_fit = init_ok | is_refit
+        any_fit = jnp.any(do_fit)
         w_full = jnp.where(init_ok[..., None], init["w_stab"],
                            mon["included_mon"] & is_refit[..., None])
         n_full = jnp.where(init_ok, init["n_ok"], mon["n_rf"])
         cfull, rfull = lax.cond(
-            jnp.any(do_fit),
+            any_fit,
             lambda: fitf(res, w_full.astype(fdtype), n_full),
             lambda: (st["coefs"], st["rmse"]))
 
@@ -1034,10 +1046,13 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
                     alive=alive_n, included=included_n,
                     coefs=coefs_n, rmse=rmse_n, n_last_fit=nlast_n,
                     first_seg=first_n, nseg=nseg, bufs=bufs)
-        return (st_n, rounds + 1)
+        counts_n = counts + jnp.stack(
+            [any_init, any_fit, any_close]).astype(jnp.int32)
+        return (st_n, rounds + 1, counts_n)
 
-    state, rounds = lax.while_loop(cond, body,
-                                   (state, jnp.zeros((), jnp.int32)))
+    state, rounds, counts = lax.while_loop(
+        cond, body, (state, jnp.zeros((), jnp.int32),
+                     jnp.zeros((3,), jnp.int32)))
 
     meta_b, rmse_b, mag_b, coef_b = state["bufs"]
     final_mask = jnp.where(res["is_std"][..., None], state["alive"],
@@ -1050,7 +1065,8 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
         seg_mag=mag_b.reshape(C, P, S, B),
         seg_coef=coef_b.reshape(C, P, S, B, params.MAX_COEFS),
         mask=final_mask, procedure=res["procedure"],
-        rounds=jnp.broadcast_to(rounds, (C,)), vario=res["vario"])
+        rounds=jnp.broadcast_to(rounds, (C,)), vario=res["vario"],
+        round_counts=jnp.broadcast_to(counts, (C, 3)))
 
 
 # ---------------------------------------------------------------------------

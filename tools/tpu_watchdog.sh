@@ -5,7 +5,13 @@
 # TPU user honoring the lock) can never drive the chip concurrently.
 cd /root/repo
 LOCK=/tmp/fb_tpu.lock.d
+# A killed watchdog must not leave the lock behind (future instances
+# would spin on 'sleep 60' forever); also treat a very old lock as stale.
+trap 'rmdir "$LOCK" 2>/dev/null' EXIT INT TERM
 while true; do
+  if [ -d "$LOCK" ] && [ "$(( $(date +%s) - $(stat -c %Y "$LOCK") ))" -gt 7200 ]; then
+    rmdir "$LOCK" 2>/dev/null
+  fi
   if ! mkdir "$LOCK" 2>/dev/null; then sleep 60; continue; fi
   if timeout 240 python - <<'EOF' 2>/dev/null
 import sys, jax, jax.numpy as jnp
