@@ -73,15 +73,16 @@ def measure(cpu_only: bool) -> None:
         import functools as _ft
         import os as _os
 
+        # Probe on one FULL chip: a pixel-sliced probe under-weights the
+        # HBM terms the Pallas kernels exist to cut (per-op floors
+        # dominate small shapes), mispredicting the full-shape winner.
         probe = pack([chips[0]], bucket=64)
         pp = kernel.prep_batch(probe)
-        sl = (slice(None), slice(None), slice(0, 1024), slice(None))
 
         def probe_rate(flag: str) -> float:
             _os.environ["FIREBIRD_PALLAS"] = flag
             jax.clear_caches()
             args = device_args(probe, pp)
-            args = args[:4] + (args[4][sl], args[5][:, :1024, :])
             f = _ft.partial(kernel._detect_batch_wire, dtype=jnp.float32,
                             wcap=kernel.window_cap(probe),
                             sensor=probe.sensor)

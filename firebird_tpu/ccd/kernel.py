@@ -1003,13 +1003,17 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
         init_ok, is_refit = init["init_ok"], mon["is_refit"]
         do_fit = init_ok | is_refit
         any_fit = jnp.any(do_fit)
-        w_full = jnp.where(init_ok[..., None], init["w_stab"],
-                           mon["included_mon"] & is_refit[..., None])
         n_full = jnp.where(init_ok, init["n_ok"], mon["n_rf"])
-        cfull, rfull = lax.cond(
-            any_fit,
-            lambda: fitf(res, w_full.astype(fdtype), n_full),
-            lambda: (st["coefs"], st["rmse"]))
+
+        def _run_fit():
+            # The [C,P,T] fit-window build lives inside the branch so a
+            # no-fit round materializes nothing.
+            w_full = jnp.where(init_ok[..., None], init["w_stab"],
+                               mon["included_mon"] & is_refit[..., None])
+            return fitf(res, w_full.astype(fdtype), n_full)
+
+        cfull, rfull = lax.cond(any_fit, _run_fit,
+                                lambda: (st["coefs"], st["rmse"]))
 
         # ================= next state (batched elementwise) =============
         is_tail, is_brk = mon["is_tail"], mon["is_brk"]

@@ -45,6 +45,22 @@ def run_kernel(p: PackedChips) -> kernel.ChipSegments:
     return fetch(kernel.detect_packed(p, dtype=jnp.float64))
 
 
+def test_round_counts_sane(packed):
+    """The phase-gate counters (ChipSegments.round_counts): the INIT gate
+    opens at least once (round 1) but far fewer times than the round
+    count (steady-state rounds are pure monitor), the fit gate at least
+    once, and no gate exceeds the round total."""
+    small, _, _ = packed
+    seg = run_kernel(small)
+    rounds = int(seg.rounds)
+    ir, fr, cr = (int(x) for x in seg.round_counts)
+    assert 1 <= ir <= rounds
+    assert 1 <= fr <= rounds
+    assert 0 <= cr <= rounds
+    # the gating premise: most rounds skip INIT
+    assert ir < rounds / 2
+
+
 def test_structural_parity(packed):
     small, full, pix = packed
     seg = run_kernel(small)
