@@ -109,7 +109,8 @@ def measure(cpu_only: bool) -> None:
         # a component that loses on this toolchain can't drag down the
         # ones that win (kernel.use_pallas component gating).
         base = safe_rate("0")
-        winners = [c for c in ("lasso", "monitor", "tmask", "fit", "score")
+        winners = [c for c in ("lasso", "monitor", "tmask", "fit", "score",
+                               "init")
                    if safe_rate(c) > base]
         if len(winners) > 1:
             safe_rate(",".join(winners))

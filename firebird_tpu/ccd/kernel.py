@@ -56,13 +56,15 @@ def use_pallas(component: str = "lasso") -> bool:
     """Whether `component` runs as its Pallas VMEM-resident kernel.
 
     FIREBIRD_PALLAS is "0"/"" (none), "1" (all), or a comma list of
-    component names ("lasso,monitor,tmask,fit,score") — bench.py tunes
-    the components independently on hardware, so a kernel that loses on
-    a given toolchain can't drag down the ones that win.  "fit" (the
-    fused Gram+corr+CD+RMSE kernel) supersedes "lasso" (CD loop only) at
-    the fit call sites; "score" (the score-fused monitor kernel)
-    supersedes "monitor".  Read at trace time: set it before the first
-    detect call — already-compiled programs keep their path."""
+    component names ("lasso,monitor,tmask,fit,score,init") — bench.py
+    tunes the components independently on hardware, so a kernel that
+    loses on a given toolchain can't drag down the ones that win.
+    "fit" (the fused Gram+corr+CD+RMSE kernel) supersedes "lasso" (CD
+    loop only) at the fit call sites; "score" (the score-fused monitor
+    kernel) supersedes "monitor"; "init" (the fused INIT-window kernel)
+    supersedes "tmask" inside the init block.  Read at trace time: set
+    it before the first detect call — already-compiled programs keep
+    their path."""
     import os
 
     v = os.environ.get("FIREBIRD_PALLAS", "0")
@@ -673,6 +675,16 @@ def _init_block(res, st, *, sensor, W, fdtype, fit):
     in_init = st["phase"] == PHASE_INIT
     P, B, T = Y.shape
     ar = jnp.arange(T)[None, :]
+
+    if use_pallas("init"):
+        on_tpu = jax.default_backend() == "tpu"
+        # Mosaic is f32-on-TPU only (same gate as the other kernels).
+        if not on_tpu or fdtype == jnp.float32:
+            from firebird_tpu.ccd import pallas_ops
+
+            return pallas_ops.init_window(
+                alive, st["cur_i"], in_init, t, X, Xt, res["Yt"],
+                res["vario"], W=W, sensor=sensor, interpret=not on_tpu)
 
     has_i, i = _first_at_or_after(alive, st["cur_i"])
     t_i = jnp.take(t, i)

@@ -139,26 +139,17 @@ def fit_block_p(T: int, B: int, y_bytes: int) -> int:
     return max(128, min(512, (budget // per_lane) // 128 * 128))
 
 
-def _fit_block(x_ref, xt_ref, xxt_ref, y_ref, w_ref, mask_ref, b_ref, r_ref,
-               *, B, K, iters, alpha, with_rmse):
-    """One pixel block: Gram/corr builds, the full CD loop, and the
-    weighted-window RMSE, all in VMEM.
+def _gram_cd_core(XT, XXT, y_of, wb, mask, *, B, K, iters, alpha):
+    """Gram + corr + CD loop on VMEM-resident planes — the exact
+    kernel._fit_lasso_coefs math (same normalization, update order,
+    unpenalized intercept), shared by the fused fit kernel and the
+    INIT-window kernel.
 
-    x [T,K], xt [K,T], xxt [K*K,T] (chip-shared designs), y [B,T,BP]
-    (wire dtype — int16 widens in-register, exactly), w [T,BP] 0/1,
-    mask [K,BP] -> b [B,K,BP], rmse [B,BP].
-
-    Mirrors kernel._fit_lasso exactly: Gram and corr divided by the
-    window count before the CD loop, same update order, intercept
-    unpenalized, rmse over the same weighted window.
+    XT [K,T], XXT [K*K,T] (chip-shared), ``y_of(b)`` -> [T,BP] f32 band
+    plane, wb [T,BP] 0/1 weights, mask [K,BP].  Returns (beta [B,K,BP],
+    n [1,BP]).
     """
-    X = x_ref[...]
-    XT = xt_ref[...]
-    XXT = xxt_ref[...]
-    wb = w_ref[...]                                           # [T, BP]
-    mask = mask_ref[...]                                      # [K, BP]
     f32 = wb.dtype
-
     n = jnp.maximum(jnp.sum(wb, 0, keepdims=True), 1.0)       # [1, BP]
     G = jnp.dot(XXT, wb, preferred_element_type=f32) / n      # [K*K, BP]
     diag = jnp.maximum(
@@ -166,9 +157,8 @@ def _fit_block(x_ref, xt_ref, xxt_ref, y_ref, w_ref, mask_ref, b_ref, r_ref,
 
     cs = []
     for bb in range(B):
-        Yb = y_ref[bb].astype(f32)                            # [T, BP]
-        cs.append(jnp.dot(XT, Yb * wb, preferred_element_type=f32)[None]
-                  / n[None])
+        cs.append(jnp.dot(XT, y_of(bb) * wb,
+                          preferred_element_type=f32)[None] / n[None])
     c = jnp.concatenate(cs, 0)                                # [B, K, BP]
 
     def one_iter(_, b):
@@ -186,15 +176,36 @@ def _fit_block(x_ref, xt_ref, xxt_ref, y_ref, w_ref, mask_ref, b_ref, r_ref,
             b = jnp.where(sel, bj[:, None, :], b)
         return b
 
-    beta = lax.fori_loop(0, iters, one_iter, jnp.zeros_like(c))
+    return lax.fori_loop(0, iters, one_iter, jnp.zeros_like(c)), n
+
+
+def _fit_block(x_ref, xt_ref, xxt_ref, y_ref, w_ref, mask_ref, b_ref, r_ref,
+               *, B, K, iters, alpha, with_rmse):
+    """One pixel block: Gram/corr builds, the full CD loop, and the
+    weighted-window RMSE, all in VMEM.
+
+    x [T,K], xt [K,T], xxt [K*K,T] (chip-shared designs), y [B,T,BP]
+    (wire dtype — int16 widens in-register, exactly), w [T,BP] 0/1,
+    mask [K,BP] -> b [B,K,BP], rmse [B,BP].
+
+    Mirrors kernel._fit_lasso exactly: Gram and corr divided by the
+    window count before the CD loop, same update order, intercept
+    unpenalized, rmse over the same weighted window.
+    """
+    X = x_ref[...]
+    wb = w_ref[...]                                           # [T, BP]
+    f32 = wb.dtype
+    y_of = lambda bb: y_ref[bb].astype(f32)
+    beta, n = _gram_cd_core(xt_ref[...], xxt_ref[...], y_of, wb,
+                            mask_ref[...], B=B, K=K, iters=iters,
+                            alpha=alpha)
     b_ref[...] = beta
 
     if with_rmse:
         rs = []
         for bb in range(B):
-            Yb = y_ref[bb].astype(f32)
             pred = jnp.dot(X, beta[bb], preferred_element_type=f32)
-            r = Yb - pred
+            r = y_of(bb) - pred
             rs.append(jnp.sqrt(jnp.maximum(
                 jnp.sum(r * r * wb, 0, keepdims=True) / n, 0.0)))
         r_ref[...] = jnp.concatenate(rs, 0)                   # [B, BP]
@@ -541,6 +552,233 @@ def monitor_chain_scored(Yd, coefs_d, dden, X, alive, included, cur_k,
 
 
 # ---------------------------------------------------------------------------
+# Fused INIT-window kernel
+# ---------------------------------------------------------------------------
+
+def init_block_p(T: int, W: int, B: int, y_bytes: int) -> int:
+    """Lane-block width for the INIT kernel: the [B,T,BP] wire spectra,
+    ~8 live [T,BP] planes, and ~50 [W,BP] window/IRLS planes."""
+    budget = 10 * 2 ** 20
+    per_lane = max(T, 1) * (B * y_bytes + 8 * 4) + max(W, 1) * 50 * 4
+    return max(128, min(512, (budget // per_lane) // 128 * 128))
+
+
+def _first_ge(mask, ti, T):
+    """(exists [1,BP], index [1,BP]) of the first True row in mask [T,BP]
+    — argmax semantics (index 0 when none)."""
+    INF = jnp.int32(T + 1)
+    ex = jnp.any(mask, 0, keepdims=True)
+    idx = jnp.min(jnp.where(mask, ti, INF), 0, keepdims=True)
+    return ex, jnp.where(ex, idx, 0)
+
+
+def _init_window_block(alive_ref, curi_ref, inin_ref, t_ref, x_ref, xtr_ref,
+                       xtk_ref, xxt_ref, y_ref, vario_ref,
+                       nowin_ref, tm_ref, ok_ref, bad_flag_ref, hasadv_ref,
+                       inext_ref, iadv_ref, j_ref, nok_ref, wstab_ref,
+                       alive_out_ref, *, T, W, B, K, NT, n_pow, det, tmb,
+                       cd_iters, alpha, tm_iters, huber_k, tmask_const,
+                       meow, init_days, stab_factor):
+    """One pixel block of kernel._init_block, end to end in VMEM.
+
+    Replaces the XLA path's [P,W,T] one-hot window tensors (the peak
+    memory of a dispatch and the dominant bytes of an INIT round) with
+    per-slot one-hot reduces over T — exact: each window slot selects
+    exactly one observation, so the selection sums have a single nonzero
+    term.  The stability c4 fit reuses the fused fit kernel's Gram/CD
+    math over the full T axis (bit-aligned with the 'fit' component);
+    the Tmask IRLS reuses the tmask kernel's core over the compacted
+    window.
+    """
+    i32 = jnp.int32
+    alive = alive_ref[...] > 0                                # [T, BP]
+    cur_i = curi_ref[...]                                     # [1, BP]
+    in_init = inin_ref[...] > 0
+    t_col = t_ref[...]                                        # [T, 1]
+    f32 = t_col.dtype
+    ti = lax.broadcasted_iota(i32, alive.shape, 0)
+
+    def at_t(plane, idx):
+        # plane [T, *] one-hot-selected at row idx [1, BP] -> [1, BP]
+        return jnp.sum(jnp.where(ti == idx, plane, 0), 0, keepdims=True)
+
+    # ---- window search (kernel._init_block: has_i/i/j/w_init) ----
+    has_i, i = _first_ge(alive & (ti >= cur_i), ti, T)
+    t_i = at_t(jnp.broadcast_to(t_col, alive.shape), i)       # [1, BP]
+    one = i32(1)
+    Acum = _shift_scan_add(jnp.where(alive, one, 0), T)       # [T, BP]
+    A_before = at_t(Acum, i) - at_t(jnp.where(alive, one, 0), i)
+    cnt = Acum - A_before
+    okj = alive & (ti >= i) & (cnt >= meow) \
+        & (jnp.broadcast_to(t_col, alive.shape) - t_i >= init_days)
+    has_w_raw, j = _first_ge(okj, ti, T)
+    has_w = has_i & has_w_raw
+    w_init = alive & (ti >= i) & (ti <= j) & (has_w & in_init)
+    n_win = jnp.sum(jnp.where(w_init, one, 0), 0, keepdims=True)
+    rank = Acum - 1
+    rel_w = rank - A_before                                   # [T, BP]
+
+    # ---- window member selection (exact one-hot sums) ----
+    Xcat = jnp.concatenate([x_ref[...], xtr_ref[...]], axis=1)  # [T, K+NT]
+    Yw = [[] for _ in range(B)]
+    Xw = [[] for _ in range(K + NT)]
+    for w in range(W):
+        mf = jnp.where(alive & (rel_w == w), 1.0, 0.0).astype(f32)
+        for b in range(B):
+            Yw[b].append(jnp.sum(y_ref[b].astype(f32) * mf, 0,
+                                 keepdims=True))
+        for c in range(K + NT):
+            Xw[c].append(jnp.sum(Xcat[:, c:c + 1] * mf, 0, keepdims=True))
+    Yw = [jnp.concatenate(v, 0) for v in Yw]                  # B x [W, BP]
+    Xw = [jnp.concatenate(v, 0) for v in Xw]                  # K+NT x [W, BP]
+
+    wi = lax.broadcasted_iota(i32, (W,) + alive.shape[1:], 0)
+    valid_w = (wi < n_win)                                    # [W, BP]
+    vario = vario_ref[...]                                    # [B, BP]
+
+    # ---- Tmask IRLS over the compacted window ----
+    bad_w = _tmask_core([Xw[K + c] for c in range(NT)],
+                        [Yw[b] for b in tmb],
+                        jnp.where(valid_w, 1.0, 0.0).astype(f32),
+                        jnp.concatenate([vario[b][None] for b in tmb], 0),
+                        nt=NT, nb=len(tmb), n_pow=n_pow, iters=tm_iters,
+                        huber_k=huber_k, tmask_const=tmask_const)
+    tm_removed = jnp.any(bad_w, 0, keepdims=True)             # [1, BP]
+    bad_abs = jnp.zeros_like(alive)
+    for w in range(W):
+        bad_abs = bad_abs | (alive & (rel_w == w) & bad_w[w:w + 1])
+
+    # ---- stability: c4 fit (fit-kernel math over T) + window resid ----
+    w_stab = w_init & ~tm_removed                             # [T, BP]
+    cm4 = jnp.where(
+        lax.broadcasted_iota(i32, (K,) + alive.shape[1:], 0) < 4,
+        1.0, 0.0).astype(f32)
+    c4, _ = _gram_cd_core(xtk_ref[...], xxt_ref[...],
+                          lambda b: y_ref[b].astype(f32),
+                          jnp.where(w_stab, 1.0, 0.0).astype(f32), cm4,
+                          B=B, K=K, iters=cd_iters, alpha=alpha)
+    stab_w = valid_w & ~bad_w
+    stab_f = jnp.where(stab_w, 1.0, 0.0).astype(f32)
+    n4 = jnp.maximum(jnp.sum(stab_f, 0, keepdims=True), 1.0)
+    t_j = at_t(jnp.broadcast_to(t_col, alive.shape), j)
+    span = t_j - t_i                                          # [1, BP]
+    last_i = jnp.maximum(n_win - 1, 0)                        # [1, BP]
+    stable = None
+    for b in range(B):
+        pred = None
+        for c in range(K):
+            term = c4[b, c][None, :] * Xw[c]
+            pred = term if pred is None else pred + term      # [W, BP]
+        r_w = Yw[b] - pred
+        r4 = jnp.sqrt(jnp.maximum(
+            jnp.sum(r_w * r_w * stab_f, 0, keepdims=True) / n4, 0.0))
+        denom = stab_factor * jnp.maximum(r4, vario[b][None, :])
+        r_first = r_w[0:1]
+        r_last = jnp.sum(jnp.where(wi == last_i, r_w, 0.0), 0,
+                         keepdims=True)
+        slope_day = c4[b, 1][None, :] / 365.25
+        ok_b = ((jnp.abs(slope_day * span) <= denom)
+                & (jnp.abs(r_first) <= denom)
+                & (jnp.abs(r_last) <= denom))                 # [1, BP]
+        if b in det:
+            stable = ok_b if stable is None else stable & ok_b
+
+    # ---- flags + cursor advance ----
+    init_nowin = in_init & ~has_w
+    init_tm = in_init & has_w & tm_removed
+    init_ok = in_init & has_w & ~tm_removed & stable
+    init_bad = in_init & has_w & ~tm_removed & ~stable
+    ex_tm, i_next = _first_ge((alive & ~bad_abs) & (ti >= i), ti, T)
+    i_next = jnp.where(ex_tm, i_next, T)
+    has_adv, i_adv = _first_ge(alive & (ti >= i + 1), ti, T)
+
+    as_i = lambda b: jnp.where(b, one, 0)
+    nowin_ref[...] = as_i(init_nowin)
+    tm_ref[...] = as_i(init_tm)
+    ok_ref[...] = as_i(init_ok)
+    bad_flag_ref[...] = as_i(init_bad)
+    hasadv_ref[...] = as_i(has_adv)
+    inext_ref[...] = i_next
+    iadv_ref[...] = i_adv
+    j_ref[...] = j
+    nok_ref[...] = jnp.sum(jnp.where(w_stab, one, 0), 0, keepdims=True)
+    wstab_ref[...] = as_i(w_stab)
+    alive_out_ref[...] = as_i(alive & ~bad_abs)
+
+
+@functools.partial(jax.jit, static_argnames=("W", "sensor", "interpret"))
+def init_window(alive, cur_i, in_init, t, X, Xt, Yt, vario, *, W, sensor,
+                interpret=False):
+    """Fused Pallas twin of kernel._init_block (same output contract).
+
+    Args:
+        alive: [P, T] bool; cur_i: [P] int; in_init: [P] bool.
+        t: [T] float ordinal days; X: [T, K]; Xt: [T, NT] designs.
+        Yt: [B, T, P] resident spectra (wire int16 or float32).
+        vario: [P, B] variogram.
+    Returns:
+        kernel._init_block's output dict.
+    """
+    B, T, P = Yt.shape
+    K = X.shape[-1]
+    NT = Xt.shape[-1]
+    f32 = X.dtype
+    det = tuple(sensor.detection_bands)
+    tmb = tuple(sensor.tmask_bands)
+    BP = init_block_p(T, W, B, Yt.dtype.itemsize)
+    Pp = -BP * (-P // BP)
+    pad = Pp - P
+    n_pow = 1 << max(1, (W - 1).bit_length())
+    i32 = jnp.int32
+
+    plane, vec = _pad_helpers(pad)
+    yp = jnp.pad(Yt, ((0, 0), (0, 0), (0, pad)))
+    vp = jnp.pad(vario.T, ((0, 0), (0, pad)), constant_values=1.0)
+    XT = X.T                                                  # [K, T]
+    XXT = (X[:, :, None] * X[:, None, :]).reshape(T, K * K).T  # [K*K, T]
+
+    kern = functools.partial(
+        _init_window_block, T=T, W=W, B=B, K=K, NT=NT, n_pow=n_pow,
+        det=det, tmb=tmb, cd_iters=int(params.LASSO_ITERS),
+        alpha=float(params.LASSO_ALPHA),
+        tm_iters=int(params.TMASK_IRLS_ITERS),
+        huber_k=float(params.HUBER_K),
+        tmask_const=float(params.TMASK_CONST),
+        meow=int(params.MEOW_SIZE), init_days=float(params.INIT_DAYS),
+        stab_factor=float(params.STABILITY_FACTOR))
+    pspec = pl.BlockSpec((T, BP), lambda i: (0, i))
+    vspec = pl.BlockSpec((1, BP), lambda i: (0, i))
+    full = lambda shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+    vshape = jax.ShapeDtypeStruct((1, Pp), i32)
+    pshape = jax.ShapeDtypeStruct((T, Pp), i32)
+    outs = pl.pallas_call(
+        kern,
+        grid=(Pp // BP,),
+        in_specs=[
+            pspec, vspec, vspec,
+            full((T, 1)), full((T, K)), full((T, NT)),
+            full((K, T)), full((K * K, T)),
+            pl.BlockSpec((B, T, BP), lambda i: (0, 0, i)),
+            pl.BlockSpec((B, BP), lambda i: (0, i)),
+        ],
+        out_specs=[vspec] * 9 + [pspec] * 2,
+        out_shape=[vshape] * 9 + [pshape] * 2,
+        interpret=interpret,
+    )(plane(alive.astype(i32)), vec(cur_i.astype(i32)),
+      vec(in_init.astype(i32)), t.astype(f32)[:, None], X, Xt,
+      XT.astype(f32), XXT.astype(f32), yp, vp)
+    (nowin, tm, ok, badf, hasadv, inext, iadv, jj, nok, wstab,
+     alive_out) = outs
+    cut = lambda x: x[0, :P]
+    cutb = lambda x: x[0, :P] > 0
+    return dict(init_nowin=cutb(nowin), init_tm=cutb(tm), init_ok=cutb(ok),
+                init_bad=cutb(badf), has_adv=cutb(hasadv),
+                i_next_tm=cut(inext), i_adv=cut(iadv), j=cut(jj),
+                n_ok=cut(nok), w_stab=(wstab[:, :P] > 0).T,
+                alive_init=(alive_out[:, :P] > 0).T)
+
+
+# ---------------------------------------------------------------------------
 # Tmask IRLS kernel
 # ---------------------------------------------------------------------------
 
@@ -597,22 +835,18 @@ def _median_sublane(r, mask, n_pow):
     return jnp.where(n > 0, 0.5 * (lo + hi), 0.0)             # [1, BP]
 
 
-def _tmask_block(xt_ref, y2_ref, w_ref, vario_ref, bad_ref, *, nt, nb,
-                 n_pow, iters, huber_k, tmask_const):
-    """One pixel block of kernel._tmask_bad, all six IRLS solves in VMEM.
+def _tmask_core(X, Y, wm, vario, *, nt, nb, n_pow, iters, huber_k,
+                tmask_const):
+    """The Tmask IRLS screen on VMEM-resident window planes — shared by
+    the standalone tmask kernel and the INIT-window kernel.
 
-    xt [nt, W, BP], y2 [nb, W, BP], w [W, BP] (0/1), vario [nb, BP]
-    -> bad [W, BP] (int32 0/1).  Mirrors the jnp reference's arithmetic
-    order exactly: XtXt outer products precomputed once, Gram/corr as
-    weight-times-product reduces over W, the unrolled 5x5 Cholesky with
-    its NaN-on-non-PD contract, MAD/Huber iterations with the same
-    masked-median semantics.
+    X: list of nt [W, BP] design columns; Y: list of nb [W, BP] band
+    planes; wm [W, BP] 0/1; vario [nb, BP].  Returns bad [W, BP] bool.
+    Mirrors the jnp reference's arithmetic order exactly: XtXt outer
+    products precomputed once, Gram/corr as weight-times-product reduces
+    over W, the unrolled 5x5 Cholesky with its NaN-on-non-PD contract,
+    MAD/Huber iterations with the same masked-median semantics.
     """
-    X = [xt_ref[c] for c in range(nt)]                        # [W, BP] each
-    Y = [y2_ref[b] for b in range(nb)]
-    wm = w_ref[...]                                           # [W, BP] 0/1
-    vario = vario_ref[...]                                    # [nb, BP]
-
     xx = {}
     for ii in range(nt):
         for jj in range(ii + 1):
@@ -689,6 +923,19 @@ def _tmask_block(xt_ref, y2_ref, w_ref, vario_ref, bad_ref, *, nt, nb,
         r = jnp.abs(Y[b] - pred(betas, b))
         bb = (r > tmask_const * vario[b:b + 1]) & mask
         bad = bb if bad is None else bad | bb
+    return bad
+
+
+def _tmask_block(xt_ref, y2_ref, w_ref, vario_ref, bad_ref, *, nt, nb,
+                 n_pow, iters, huber_k, tmask_const):
+    """One pixel block of kernel._tmask_bad, all six IRLS solves in VMEM
+    (xt [nt,W,BP], y2 [nb,W,BP], w [W,BP] 0/1, vario [nb,BP] -> bad
+    [W,BP] int32 0/1)."""
+    bad = _tmask_core([xt_ref[c] for c in range(nt)],
+                      [y2_ref[b] for b in range(nb)],
+                      w_ref[...], vario_ref[...], nt=nt, nb=nb,
+                      n_pow=n_pow, iters=iters, huber_k=huber_k,
+                      tmask_const=tmask_const)
     bad_ref[...] = jnp.where(bad, jnp.int32(1), 0)
 
 

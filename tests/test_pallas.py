@@ -282,6 +282,82 @@ def test_full_pallas_detect_matches_default(monkeypatch):
                                np.asarray(ref.seg_meta), atol=1e-5)
 
 
+def test_init_window_matches_init_block():
+    """pallas_ops.init_window (interpret) reproduces kernel._init_block
+    on randomized mid-loop round states, reading wire int16 spectra."""
+    import functools
+    from firebird_tpu.ccd import harmonic, pallas_ops
+    from firebird_tpu.ccd.sensor import LANDSAT_ARD
+
+    rng = np.random.default_rng(17)
+    P, B, T, W = 137, 7, 96, 24
+    t = np.float64(np.sort(rng.integers(729000, 730500, T)))
+    X = jnp.asarray(harmonic.design_matrix(t, t[0], params.MAX_COEFS),
+                    jnp.float32)
+    Xt_full = harmonic.design_matrix(t, t[0], params.TMASK_COEFS + 1)
+    Xt = jnp.asarray(np.concatenate([Xt_full[:, :1], Xt_full[:, 2:]], 1),
+                     jnp.float32)
+    Yi = rng.integers(0, 8000, (B, P, T)).astype(np.int16)
+    Y = jnp.asarray(Yi.transpose(1, 0, 2), jnp.float32)       # [P,B,T]
+    Yt = jnp.asarray(Yi.transpose(0, 2, 1))                   # [B,T,P] i16
+    vario = jnp.asarray(np.abs(rng.normal(100, 30, (P, B))) + 1,
+                        jnp.float32)
+    alive = jnp.asarray(rng.random((P, T)) < 0.7)
+    cur_i = jnp.asarray(rng.integers(0, T // 2, P), jnp.int32)
+    phase = jnp.asarray(
+        rng.choice([kernel.PHASE_INIT, kernel.PHASE_MONITOR,
+                    kernel.PHASE_DONE], P, p=[0.6, 0.2, 0.2]), jnp.int32)
+
+    res = dict(X=X, Xt=Xt, t=jnp.asarray(t, jnp.float32), Y=Y, Yt=Yt,
+               XX=(X[:, :, None] * X[:, None, :]).reshape(T, -1),
+               vario=vario)
+    st = dict(alive=alive, cur_i=cur_i, phase=phase)
+    fit = functools.partial(kernel._fit_chip, fit_pallas=False,
+                            on_tpu=False)
+    want = kernel._init_block(res, st, sensor=LANDSAT_ARD, W=W,
+                              fdtype=jnp.float32, fit=fit)
+    got = pallas_ops.init_window(alive, cur_i, phase == kernel.PHASE_INIT,
+                                 res["t"], X, Xt, Yt, vario, W=W,
+                                 sensor=LANDSAT_ARD, interpret=True)
+    assert set(got) == set(want)
+    # integer/boolean outputs must agree exactly; the stability verdict
+    # (init_ok/init_bad) depends on an f32 fit whose Gram accumulation
+    # order differs between the XLA dot and the kernel core, so allow a
+    # tiny borderline disagreement there (none observed on this seed).
+    exact = ["init_nowin", "init_tm", "has_adv", "i_next_tm", "i_adv",
+             "j", "alive_init", "w_stab", "n_ok"]
+    for k in exact:
+        np.testing.assert_array_equal(
+            np.asarray(got[k]), np.asarray(want[k]), err_msg=k)
+    for k in ["init_ok", "init_bad"]:
+        diff = np.mean(np.asarray(got[k]) != np.asarray(want[k]))
+        assert diff <= 0.02, (k, diff)
+
+
+def test_init_kernel_in_detect_matches_default(monkeypatch):
+    """FIREBIRD_PALLAS=init routes the whole INIT block through the fused
+    window kernel; segment decisions must equal the default path."""
+    from firebird_tpu.ingest import SyntheticSource, pack
+    from firebird_tpu.ingest.packer import PackedChips
+
+    src = SyntheticSource(seed=77, start="1995-01-01", end="1999-01-01",
+                          cloud_frac=0.15)
+    p = pack([src.chip(100, 200)], bucket=32)
+    p = PackedChips(cids=p.cids, dates=p.dates,
+                    spectra=p.spectra[:, :, :64, :], qas=p.qas[:, :64, :],
+                    n_obs=p.n_obs, sensor=p.sensor)
+    ref = kernel.detect_packed(p, dtype=jnp.float32)
+    monkeypatch.setenv("FIREBIRD_PALLAS", "init")
+    monkeypatch.setattr(kernel, "window_cap",
+                        lambda pk, _orig=kernel.window_cap: _orig(pk) + 48)
+    got = kernel.detect_packed(p, dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(got.n_segments),
+                                  np.asarray(ref.n_segments))
+    np.testing.assert_array_equal(np.asarray(got.seg_meta[..., :3]),
+                                  np.asarray(ref.seg_meta[..., :3]))
+    np.testing.assert_array_equal(np.asarray(got.mask), np.asarray(ref.mask))
+
+
 def test_use_pallas_component_parsing(monkeypatch):
     for env, lasso, monitor, tmask in [
             ("0", False, False, False), ("", False, False, False),
