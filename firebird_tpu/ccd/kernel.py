@@ -75,6 +75,17 @@ def use_pallas(component: str = "lasso") -> bool:
     return component in {c.strip() for c in v.split(",")}
 
 
+def _wire_resident_only() -> bool:
+    """True when every event-loop consumer of the widened float spectra
+    is routed to a Pallas kernel reading the wire-dtype residents (the
+    init, score, and fit components together) — the prologue then keeps
+    the float view out of ``res`` so XLA frees it after the pre-loop
+    work.  _detect_batch_impl combines this with the f32-on-TPU gate
+    (the float64-on-TPU fallback keeps the float view resident)."""
+    return (use_pallas("init") and use_pallas("score")
+            and use_pallas("fit"))
+
+
 # ---------------------------------------------------------------------------
 # Results container
 # ---------------------------------------------------------------------------
@@ -552,7 +563,8 @@ def _write_seg(bufs, nseg, wmask, meta, rmse_s, mag_s, coef_s, *, S):
     return bufs, nseg + wmask.astype(jnp.int32)
 
 
-def _prologue(X, Xt, t, valid, Y, qa, *, sensor, S, fdtype, fit):
+def _prologue(X, Xt, t, valid, Y, qa, *, sensor, S, fdtype, fit,
+              wire_only=False):
     """One chip's pre-loop work: QA triage, usable sets, the one-shot
     snow/insufficient-clear fit, variogram, and the standard-procedure
     start state.  Returns (res, state): ``res`` holds the loop-invariant
@@ -561,7 +573,10 @@ def _prologue(X, Xt, t, valid, Y, qa, *, sensor, S, fdtype, fit):
     # Resident wire-dtype spectra [B,T,P] for the Pallas consumers (int16
     # reads halve the round loop's dominant HBM term; widening in-register
     # is exact), alongside the widened [P,B,T] float view the XLA paths
-    # read.  Both are materialized once, outside the event loop.
+    # read.  When the init+score+fit Pallas components are all enabled,
+    # the float view leaves ``res`` — the loop then never references it,
+    # XLA frees it after the prologue, and its [P,B,T] residency (~4.7 GB
+    # at the 8-chip bench shape) comes off the loop's working set.
     Yt_res = Y.transpose(0, 2, 1)                              # [B,T,P]
     Y = Y.astype(fdtype).transpose(1, 0, 2)                    # -> [P,B,T]
     P, B, T = Y.shape
@@ -570,7 +585,9 @@ def _prologue(X, Xt, t, valid, Y, qa, *, sensor, S, fdtype, fit):
     # Detection-band wire-dtype slice for the score-fused monitor kernel
     # (DCE'd from the program when FIREBIRD_PALLAS doesn't enable it).
     Yd = Yt_res[np.asarray(sensor.detection_bands)]            # [nb,T,P]
-    res = dict(X=X, Xt=Xt, t=t, Y=Y, Yt=Yt_res, Yd=Yd, XX=XX)
+    res = dict(X=X, Xt=Xt, t=t, Yt=Yt_res, Yd=Yd, XX=XX)
+    if not wire_only:
+        res["Y"] = Y
 
     # ---------------- QA triage (reference.detect) ----------------
     fill = _qa_bit(qa, params.QA_FILL_BIT) | ~valid[None, :]
@@ -670,11 +687,9 @@ def _init_block(res, st, *, sensor, W, fdtype, fit):
     under in_init-derived masks, so the skip branch's zeros are inert."""
     _DET = list(sensor.detection_bands)
     _TMB = list(sensor.tmask_bands)
-    X, Xt, t, Y = res["X"], res["Xt"], res["t"], res["Y"]
+    X, Xt, t = res["X"], res["Xt"], res["t"]
     alive = st["alive"]
     in_init = st["phase"] == PHASE_INIT
-    P, B, T = Y.shape
-    ar = jnp.arange(T)[None, :]
 
     if use_pallas("init"):
         on_tpu = jax.default_backend() == "tpu"
@@ -686,6 +701,9 @@ def _init_block(res, st, *, sensor, W, fdtype, fit):
                 alive, st["cur_i"], in_init, t, X, Xt, res["Yt"],
                 res["vario"], W=W, sensor=sensor, interpret=not on_tpu)
 
+    Y = res["Y"]
+    P, B, T = Y.shape
+    ar = jnp.arange(T)[None, :]
     has_i, i = _first_at_or_after(alive, st["cur_i"])
     t_i = jnp.take(t, i)
     Acum = jnp.cumsum(alive, -1)
@@ -802,7 +820,7 @@ def _mon_block(res, st, *, sensor, change_thr, outlier_thr):
     (skipped on round 1, when every standard pixel is still
     initializing)."""
     _DET = list(sensor.detection_bands)
-    X, Y = res["X"], res["Y"]
+    X = res["X"]
     alive, included = st["alive"], st["included"]
     in_mon = st["phase"] == PHASE_MONITOR
 
@@ -832,6 +850,7 @@ def _mon_block(res, st, *, sensor, change_thr, outlier_thr):
         # HIGHEST is already the context default (_detect_batch_core);
         # pinned explicitly so the score matches the Pallas twin's full-f32
         # dot even if the context ever moves.
+        Y = res["Y"]
         pred_d = jnp.einsum("pbc,tc->pbt", st["coefs"][:, _DET, :], X,
                             precision=lax.Precision.HIGHEST)
         s = jnp.sum(((Y[:, _DET, :] - pred_d) / dden[:, :, None]) ** 2,
@@ -875,9 +894,9 @@ def _close_block(res, st, mon, *, S, fdtype):
     closes land on a handful of rounds (the shared tail round plus break
     rounds), so most rounds skip both the PEEK-run one-hot einsums and
     the full result-buffer rewrite."""
-    t, X, Y = res["t"], res["X"], res["Y"]
+    t, X = res["t"], res["X"]
     alive = st["alive"]
-    P, B, T = Y.shape
+    B, T, P = res["Yt"].shape
     is_tail, is_brk = mon["is_tail"], mon["is_brk"]
     ev_rank, pos_ev, m = mon["ev_rank"], mon["pos_ev"], mon["m"]
     included_mon = mon["included_mon"]
@@ -899,8 +918,15 @@ def _close_block(res, st, mon, *, S, fdtype):
                        precision=lax.Precision.HIGHEST)       # [P,K,8]
     pred_run = jnp.sum(st["coefs"][:, :, None, :]
                        * X_run[:, None, :, :], -1)            # [P,B,K]
-    Y_run = jnp.einsum("pbt,pkt->pbk", Y, oh_run,
-                       precision=lax.Precision.HIGHEST)
+    if "Y" in res:
+        Y_run = jnp.einsum("pbt,pkt->pbk", res["Y"], oh_run,
+                           precision=lax.Precision.HIGHEST)
+    else:
+        # Wire-resident mode: the run members come from the int16 view.
+        # Each (p,b,k) output selects exactly one observation (one-hot
+        # over t), so this contraction is bit-exact vs the float view.
+        Y_run = jnp.einsum("btp,pkt->pbk", res["Yt"].astype(fdtype),
+                           oh_run, precision=lax.Precision.HIGHEST)
     resid_run = Y_run - pred_run                              # [P,7,PEEK]
     mags = _masked_median(
         resid_run, jnp.broadcast_to(run_ok[:, None, :], resid_run.shape))
@@ -972,12 +998,14 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
     _DET = list(sensor.detection_bands)
     change_thr, outlier_thr = chi2_thresholds(len(_DET))
     on_tpu = jax.default_backend() == "tpu"
-    fit_pallas = use_pallas("fit") and (not on_tpu or fdtype == jnp.float32)
+    f32_ok = not on_tpu or fdtype == jnp.float32
+    fit_pallas = use_pallas("fit") and f32_ok
     fit = functools.partial(_fit_chip, fit_pallas=fit_pallas, on_tpu=on_tpu)
+    wire_only = _wire_resident_only() and f32_ok
 
     res, state = jax.vmap(functools.partial(
-        _prologue, sensor=sensor, S=S, fdtype=fdtype, fit=fit))(
-            Xs, Xts, ts, valids, Ys, qas)
+        _prologue, sensor=sensor, S=S, fdtype=fdtype, fit=fit,
+        wire_only=wire_only))(Xs, Xts, ts, valids, Ys, qas)
 
     initf = jax.vmap(functools.partial(
         _init_block, sensor=sensor, W=W, fdtype=fdtype, fit=fit))
@@ -1181,7 +1209,12 @@ def working_set_bytes(T: int, W: int | None = None,
     wire = P * B * T * 2 + P * T * 2
     widened = 2 * P * B * T * dtype_bytes
     pt_temps = 20 * P * T * dtype_bytes
-    onehot = P * W * T * (1 + dtype_bytes)
+    # The [P,W,T] one-hot window tensors exist only on the XLA INIT path;
+    # the fused Pallas INIT kernel (FIREBIRD_PALLAS=init) never
+    # materializes them, so batches can size past that peak.  The kernel
+    # route is f32-only on TPU (Mosaic), so f64 sizing keeps the term.
+    onehot = (0 if use_pallas("init") and dtype_bytes == 4
+              else P * W * T * (1 + dtype_bytes))
     bufs = 2 * P * S * (6 + 2 * B + B * K) * dtype_bytes
     return int(wire + widened + pt_temps + onehot + bufs)
 
