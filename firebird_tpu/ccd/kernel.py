@@ -414,21 +414,30 @@ def _variogram(Y, usable):
     the compacted successive-diff formulation (each usable obs with a
     usable predecessor contributes exactly one pair), so the median is
     bit-identical.
-    """
-    u = jnp.broadcast_to(usable[:, None, :], Y.shape)
 
+    Bands are independent, so the scan + bitonic median run per band
+    under lax.map — the sort's working set is [P,T] instead of [P,B,T],
+    cutting the prologue's peak memory ~B-fold at identical per-element
+    math (one-time cost; wall impact negligible).
+    """
     def op(a, b):
         av, af = a
         bv, bf = b
         return jnp.where(bf, bv, av), af | bf
 
-    v, f = lax.associative_scan(op, (jnp.where(u, Y, 0.0), u), axis=-1)
-    prev_v = jnp.concatenate([jnp.zeros_like(v[..., :1]), v[..., :-1]], -1)
-    prev_f = jnp.concatenate([jnp.zeros_like(f[..., :1]), f[..., :-1]], -1)
-    pair_ok = u & prev_f                        # usable with a predecessor
-    d = jnp.abs(Y - prev_v)                                     # [P,B,T]
+    def one_band(yb):                                          # [P,T]
+        v, f = lax.associative_scan(op, (jnp.where(usable, yb, 0.0),
+                                         usable), axis=-1)
+        prev_v = jnp.concatenate([jnp.zeros_like(v[..., :1]),
+                                  v[..., :-1]], -1)
+        prev_f = jnp.concatenate([jnp.zeros_like(f[..., :1]),
+                                  f[..., :-1]], -1)
+        pair_ok = usable & prev_f               # usable with a predecessor
+        d = jnp.abs(yb - prev_v)
+        return _masked_median(d, pair_ok)                      # [P]
+
+    v = lax.map(one_band, Y.transpose(1, 0, 2)).T              # [P,B]
     m = jnp.sum(usable, -1)                                     # [P]
-    v = _masked_median(d, pair_ok)
     return jnp.where((m >= 2)[:, None], jnp.maximum(v, 1e-6), 1.0)
 
 

@@ -9,6 +9,14 @@ import pytest
 from firebird_tpu.ccd import harmonic, kernel, params, pallas_ops
 
 
+@pytest.fixture(autouse=True)
+def _clear_pallas_env(monkeypatch):
+    """Every parity test's reference run must trace the default XLA path:
+    an ambient FIREBIRD_PALLAS (e.g. from a bench shell) would route both
+    sides through the same kernels and make the comparison vacuous."""
+    monkeypatch.delenv("FIREBIRD_PALLAS", raising=False)
+
+
 def _systems(P=37, B=7, T=60, dtype=jnp.float32, seed=0):
     """Realistic (G, c, diag, mask) built exactly as _fit_lasso_coefs does."""
     rng = np.random.default_rng(seed)
@@ -350,6 +358,33 @@ def test_init_kernel_in_detect_matches_default(monkeypatch):
     monkeypatch.setenv("FIREBIRD_PALLAS", "init")
     monkeypatch.setattr(kernel, "window_cap",
                         lambda pk, _orig=kernel.window_cap: _orig(pk) + 48)
+    got = kernel.detect_packed(p, dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(got.n_segments),
+                                  np.asarray(ref.n_segments))
+    np.testing.assert_array_equal(np.asarray(got.seg_meta[..., :3]),
+                                  np.asarray(ref.seg_meta[..., :3]))
+    np.testing.assert_array_equal(np.asarray(got.mask), np.asarray(ref.mask))
+
+
+def test_full_pallas_sentinel2_matches_default(monkeypatch):
+    """All Pallas components under the 12-band Sentinel-2 sensor layout:
+    the bench's S2 rung runs with the autotuned FIREBIRD_PALLAS set, so
+    every kernel must be sensor-generic (band counts, detection/Tmask
+    subsets, no thermal)."""
+    from firebird_tpu.ccd.sensor import SENTINEL2
+    from firebird_tpu.ingest import SyntheticSource, pack
+    from firebird_tpu.ingest.packer import PackedChips
+
+    src = SyntheticSource(seed=88, start="2019-01-01", end="2021-01-01",
+                          cloud_frac=0.15, sensor=SENTINEL2)
+    p = pack([src.chip(100, 200)], bucket=32)
+    p = PackedChips(cids=p.cids, dates=p.dates,
+                    spectra=p.spectra[:, :, :64, :], qas=p.qas[:, :64, :],
+                    n_obs=p.n_obs, sensor=p.sensor)
+    ref = kernel.detect_packed(p, dtype=jnp.float32)
+    monkeypatch.setenv("FIREBIRD_PALLAS", "1")
+    monkeypatch.setattr(kernel, "window_cap",
+                        lambda pk, _orig=kernel.window_cap: _orig(pk) + 56)
     got = kernel.detect_packed(p, dtype=jnp.float32)
     np.testing.assert_array_equal(np.asarray(got.n_segments),
                                   np.asarray(ref.n_segments))
