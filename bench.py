@@ -114,6 +114,12 @@ def measure(cpu_only: bool) -> None:
                    if safe_rate(c) > base]
         if len(winners) > 1:
             safe_rate(",".join(winners))
+        # Wire-resident-only mode is an interaction the per-component
+        # race can't see: only init+score+fit TOGETHER drop the widened
+        # float spectra from the loop residents.  Race it explicitly
+        # (a winners-combo of exactly those three already recorded it).
+        if "fit,score,init" not in rates:
+            safe_rate("fit,score,init")
         pick = max(rates, key=lambda k: rates[k])
         pallas_detail = {"pallas_autotune": {
             "runs_per_sec": {k: round(v, 3) for k, v in rates.items()},
