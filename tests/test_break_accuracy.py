@@ -79,6 +79,29 @@ def test_break_accuracy_across_seeds():
     assert min(rates) == 1.0, rates
 
 
+def test_pallas_f32_break_agreement_with_float64(monkeypatch):
+    """The full Pallas route (FIREBIRD_PALLAS=1, f32 — the production TPU
+    configuration the bench autotunes toward) must reproduce float64's
+    break decisions on random planted-change pixels, not just the
+    equality fixtures in test_pallas."""
+    packed, t, changed = _packed(6)
+    monkeypatch.setenv("FIREBIRD_PALLAS", "1")
+    # distinct wcap so the Pallas trace gets its own jit cache entry —
+    # the flag is read at trace time and the cache is keyed on static
+    # args only (same pattern as tests/test_pallas.py)
+    monkeypatch.setattr(kernel, "window_cap",
+                        lambda pk, _orig=kernel.window_cap: _orig(pk) + 64)
+    a = kernel.detect_packed(packed, dtype=jnp.float32)
+    monkeypatch.undo()
+    b = kernel.detect_packed(packed, dtype=jnp.float64)
+    na, nb = (np.asarray(s.n_segments)[0] for s in (a, b))
+    ma, mb = (np.asarray(s.seg_meta)[0] for s in (a, b))
+    for p in range(N_PIX):
+        assert na[p] == nb[p], p
+        assert np.array_equal(np.round(ma[p, :na[p], 2]),
+                              np.round(mb[p, :nb[p], 2])), p
+
+
 def test_float32_break_agreement_with_float64():
     """The production dtype (float32) must reproduce float64's break
     decisions — BASELINE.md's secondary metric (break-date agreement) on
