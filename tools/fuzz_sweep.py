@@ -40,8 +40,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                os.pardir, "tests"))
+_root = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+# Repo root first so firebird_tpu imports without an installed package
+# (run by script path, sys.path[0] is tools/), then tests/ for the
+# shared fuzz-grid builders.
+sys.path.insert(0, os.path.join(_root, "tests"))
+sys.path.insert(0, _root)
 
 import numpy as np  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
