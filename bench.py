@@ -79,17 +79,23 @@ def measure(cpu_only: bool) -> None:
         probe = pack([chips[0]], bucket=64)
         pp = kernel.prep_batch(probe)
 
+        # One transfer for all variants: clear_caches() drops compiled
+        # programs, not device arrays, and re-shipping ~82 MB through the
+        # tunnel per variant would dominate the autotune wall time.
+        probe_args = device_args(probe, pp)
+        jax.block_until_ready(probe_args)
+
         def probe_rate(flag: str) -> float:
             _os.environ["FIREBIRD_PALLAS"] = flag
             jax.clear_caches()
-            args = device_args(probe, pp)
             f = _ft.partial(kernel._detect_batch_wire, dtype=jnp.float32,
                             wcap=kernel.window_cap(probe),
                             sensor=probe.sensor)
-            np.asarray(f(*args).n_segments)              # compile + warmup
+            np.asarray(f(*probe_args).n_segments)        # compile + warmup
             t0 = time.time()
             for _ in range(2):
-                np.asarray(f(*args).n_segments)   # device_get: see timed_rate
+                # device_get: see timed_rate
+                np.asarray(f(*probe_args).n_segments)
             return 2.0 / (time.time() - t0)
 
         rates = {}
