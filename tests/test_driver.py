@@ -376,3 +376,20 @@ def test_auto_chips_per_batch_sizes_from_device_memory():
     assert auto_chips_per_batch(cfg, acq, device=FakeDevice(None)) == \
         Config.chips_per_batch
     assert resolve_batching(Config(chips_per_batch=5), acq).chips_per_batch == 5
+
+
+def test_auto_chips_per_batch_grows_with_init_kernel(monkeypatch):
+    """The fused INIT kernel never materializes the [P,W,T] one-hot
+    window peak, so f32 batch sizing packs more chips — while f64 sizing
+    keeps the term (the Mosaic route is f32-on-TPU only)."""
+    from firebird_tpu.ccd import kernel
+    from firebird_tpu.driver.core import auto_chips_per_batch
+
+    cfg = Config(chips_per_batch=0)
+    acq = "1982-01-01/2017-12-31"
+    monkeypatch.delenv("FIREBIRD_PALLAS", raising=False)
+    base = auto_chips_per_batch(cfg, acq, device=FakeDevice(16e9))
+    base_ws64 = kernel.working_set_bytes(512, dtype_bytes=8)
+    monkeypatch.setenv("FIREBIRD_PALLAS", "init")
+    assert auto_chips_per_batch(cfg, acq, device=FakeDevice(16e9)) > base
+    assert kernel.working_set_bytes(512, dtype_bytes=8) == base_ws64
