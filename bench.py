@@ -115,17 +115,35 @@ def measure(cpu_only: bool) -> None:
         # a component that loses on this toolchain can't drag down the
         # ones that win (kernel.use_pallas component gating).
         base = safe_rate("0")
-        winners = [c for c in ("lasso", "monitor", "tmask", "fit", "score",
-                               "init")
+        winners = [c for c in ("lasso", "monitor", "tmask", "fit", "score")
                    if safe_rate(c) > base]
-        if len(winners) > 1:
-            safe_rate(",".join(winners))
+        # 'init' races only together with 'fit': the fused INIT kernel's
+        # internal stability fit uses the Pallas Gram/CD accumulation
+        # order, so an init-without-fit pick would put borderline
+        # init_ok/init_bad decisions on a third mixed path that the
+        # divergence register would have to carry (docs/DIVERGENCE.md).
+        # No mixed config can win because no mixed config is ever raced.
+        if safe_rate("init,fit") > max(base, rates.get("fit", 0.0)):
+            if "fit" not in winners:
+                winners.append("fit")
+            winners.append("init")
+        # Keys are canonicalized (sorted join) so set-equal configs are
+        # never probed twice — use_pallas splits on ',' order-insensitively.
+        combo = ",".join(sorted(winners))
+        if len(winners) > 1 and combo not in rates \
+                and not any(set(k.split(",")) == set(winners) for k in rates):
+            safe_rate(combo)
         # Wire-resident-only mode is an interaction the per-component
         # race can't see: only init+score+fit TOGETHER drop the widened
         # float spectra from the loop residents.  Race it explicitly
         # (a winners-combo of exactly those three already recorded it).
-        if "fit,score,init" not in rates:
-            safe_rate("fit,score,init")
+        if not any(set(k.split(",")) == {"fit", "score", "init"}
+                   for k in rates):
+            safe_rate("fit,init,score")
+        # The whole-loop mega kernel replaces every component at once
+        # (one pallas_call, wire spectra VMEM-resident for the entire
+        # event loop) — race it as its own config.
+        safe_rate("mega")
         pick = max(rates, key=lambda k: rates[k])
         pallas_detail = {"pallas_autotune": {
             "runs_per_sec": {k: round(v, 3) for k, v in rates.items()},
@@ -197,7 +215,13 @@ def measure(cpu_only: bool) -> None:
         rounds=float(np.asarray(seg.rounds).mean()),
         device_kind=jax.devices()[0].device_kind,
         dtype_bytes=jnp.dtype(fdtype).itemsize, sensor=packed.sensor,
-        phase_rounds=phase_rounds)
+        phase_rounds=phase_rounds,
+        # Model the picked FIREBIRD_PALLAS config's actual streams (the
+        # autotune sets the env before the timed run); wire int16 = 2 B.
+        pallas=frozenset(
+            c for c in ("score", "init", "fit", "mega")
+            if kernel.use_pallas(c)),
+        wire_bytes=2)
 
     # ---- CPU per-pixel rate (the pyccd stand-in), extrapolated ----
     sample = 12
@@ -261,6 +285,43 @@ def measure(cpu_only: bool) -> None:
             "sentinel2_obs_per_pixel": int(s2.n_obs[0]),
         }
 
+    # ---- break-dense / gap-dense rung (VERDICT r2 #6) ----
+    # Real tiles break: rounds — and both roofline ceilings — scale with
+    # segment count, so the friendly 1-change headline can't be the only
+    # number.  This rung stacks 3 well-separated step changes on 60% of
+    # the area and drops ~70% of winter acquisitions (seasonal gaps), and
+    # reports its own px/s + measured rounds + mean segments alongside.
+    hard_detail = {}
+    if not small:
+        hard_src = SyntheticSource(
+            seed=23, start="1985-01-01",
+            end="1997-01-01" if cpu_only else "2005-01-01",
+            cloud_frac=0.15, change_frac=0.6, n_changes=3,
+            seasonal_gap_frac=0.7)
+        hard_chips = [hard_src.chip(100 + 3000 * i, 200)
+                      for i in range(1 if cpu_only else n_chips)]
+        hardp = pack(hard_chips, bucket=64)
+        hard_pixels = hardp.n_chips * 10000
+        argsh = device_args(hardp, kernel.prep_batch(hardp))
+        jax.block_until_ready(argsh)
+        runh = functools.partial(kernel._detect_batch_wire, dtype=fdtype,
+                                 wcap=kernel.window_cap(hardp),
+                                 sensor=hardp.sensor)
+        hard_rate, hseg = timed_rate(runh, argsh, hard_pixels,
+                                     1 if cpu_only else 3)
+        hrc = np.asarray(hseg.round_counts).reshape(-1, 3).mean(0)
+        hard_detail = {
+            "breakdense_pixels_per_sec": round(hard_rate, 1),
+            "breakdense_mean_segments": float(
+                np.asarray(hseg.n_segments).mean()),
+            "breakdense_rounds": int(np.asarray(hseg.rounds)[0]),
+            "breakdense_phase_rounds": {
+                "init": round(float(hrc[0]), 1),
+                "fit": round(float(hrc[1]), 1),
+                "close": round(float(hrc[2]), 1)},
+            "breakdense_obs_per_pixel": int(hardp.n_obs[0]),
+        }
+
     # ---- RF inference rate (BASELINE.json config #3) ----
     # Same 500-tree forest on every platform (randomforest.py:38) so the
     # number is comparable across bench runs.
@@ -306,6 +367,7 @@ def measure(cpu_only: bool) -> None:
             **pallas_detail,
             "streaming_pixels_per_sec": round(stream_rate, 1),
             **s2_detail,
+            **hard_detail,
             "rf_inference_segments_per_sec": round(rf_rate, 1),
             # CPU rungs run only when the accelerator probe failed; point
             # at the last committed real-hardware capture so the fallback

@@ -151,9 +151,10 @@ def detect_flops(P: int, T: int, W: int, rounds: float,
 def round_bytes(P: int, T: int, W: int, S: int, dtype_bytes: int,
                 sensor=LANDSAT_ARD,
                 rounds: float = 1.0,
-                phase_rounds: tuple | None = None) -> float:
-    """Estimated HBM traffic (read+write) over the event loop, assuming
-    XLA fuses elementwise chains but materializes the major arrays.
+                phase_rounds: tuple | None = None,
+                pallas: frozenset | set | tuple = (),
+                wire_bytes: int = 2) -> float:
+    """Estimated HBM traffic (read+write) over the event loop.
 
     Per-phase apportionment mirrors the kernel's cond gates
     (_detect_batch_impl): the score-group spectra read, the [P,T]
@@ -162,24 +163,62 @@ def round_bytes(P: int, T: int, W: int, S: int, dtype_bytes: int,
     refit spectra read on fit rounds; the PEEK-run tensors + result-
     buffer rewrite on close rounds.  ``phase_rounds`` = (init, fit,
     close) counts; None models every block every round.
+
+    ``pallas`` names the enabled Pallas components (the bench's picked
+    FIREBIRD_PALLAS config): a component's term is then modeled from its
+    kernel's actual block streams (in/out BlockSpecs — known exactly,
+    unlike the XLA estimate) instead of the XLA path's materializations:
+
+    - 'score': the monitor round streams the [D,T,P] *wire-dtype*
+      spectra once and 4 [T,P] i32 planes (alive/included in, inc/rem_q
+      out); the [P,D,T] prediction einsum, the f32 score plane and the
+      rank planes never exist in HBM (pallas_ops._monitor_scored_block).
+    - 'init': the INIT round streams the [B,T,P] wire spectra + ~3
+      [T,P] i32 planes (alive in/out, w_stab out); the [P,W,T] one-hot
+      tensors and the stability fit's float Y re-read never exist
+      (pallas_ops._init_window_block).
+    - 'fit': the refit streams the [B,T,P] wire spectra + the [T,P]
+      window plane; Gram/corr/CD/RMSE stay in VMEM
+      (pallas_ops._fit_block).
     """
     B = sensor.n_bands
     D = len(sensor.detection_bands)
     ir, fr, cr = phase_rounds if phase_rounds is not None \
         else (rounds, rounds, rounds)
-    # every round: score-group read [P,D,T] + ~10 [P,T] temporaries +
-    # carried planes/coefs (bufs counted on close rounds — unchanged
-    # cond pass-through aliases in place).
-    every = (1.0 * P * D * T * dtype_bytes
-             + 10.0 * P * T * dtype_bytes + 6.0 * P * T
-             + 2 * (2.0 * P * T + P * B * K * dtype_bytes))
-    # init rounds: oh_w bool written+read + float view read by the two
-    # selection matmuls + window members/XtXt + the c4 fit's Y read.
-    init = (3.0 * P * W * T
-            + 3.0 * P * W * T * dtype_bytes
-            + 2.0 * P * W * (NT + B + NT * NT) * dtype_bytes
-            + P * B * T * dtype_bytes)
-    fit = P * B * T * dtype_bytes                 # cfull Gram corr Y read
+    pallas = frozenset(pallas)
+    if "mega" in pallas:
+        # Whole-loop kernel (pallas_ops._detect_mega_block): the event
+        # loop's HBM traffic is ROUND-INDEPENDENT — one [B,T,P] wire
+        # read, the start-state planes in (alive + phase/cursor vectors),
+        # and the result-buffer/final-alive boundary out.  Matches the
+        # mega pallas_call's in/out BlockSpecs term by term.
+        return (P * B * T * wire_bytes          # wire spectra, once
+                + 2 * 4.0 * P * T               # alive0 in + alive out
+                + 8.0 * P                        # i32 state vectors
+                + 2.0 * P * S * (6 + 2 * B + B * K) * dtype_bytes)
+    # carried loop state: alive/included bool planes + coefs, read+written
+    carry = 2 * (2.0 * P * T + P * B * K * dtype_bytes)
+    if "score" in pallas:
+        # wire spectra once + 4 i32 planes through the kernel boundary
+        every = P * D * T * wire_bytes + 16.0 * P * T + carry
+    else:
+        # score-group read [P,D,T] + ~10 [P,T] temporaries (bufs counted
+        # on close rounds — unchanged cond pass-through aliases in place)
+        every = (1.0 * P * D * T * dtype_bytes
+                 + 10.0 * P * T * dtype_bytes + 6.0 * P * T + carry)
+    if "init" in pallas:
+        init = P * B * T * wire_bytes + 12.0 * P * T
+    else:
+        # oh_w bool written+read + float view read by the two selection
+        # matmuls + window members/XtXt + the c4 fit's Y read
+        init = (3.0 * P * W * T
+                + 3.0 * P * W * T * dtype_bytes
+                + 2.0 * P * W * (NT + B + NT * NT) * dtype_bytes
+                + P * B * T * dtype_bytes)
+    if "fit" in pallas:
+        fit = P * B * T * wire_bytes + 5.0 * P * T
+    else:
+        fit = P * B * T * dtype_bytes             # cfull Gram corr Y read
     close = (2.0 * P * params.PEEK_SIZE * T * dtype_bytes    # oh_run
              + 2.0 * P * S * (6 + 2 * B + B * K) * dtype_bytes)  # bufs
     return every * rounds + init * ir + fit * fr + close * cr
@@ -221,15 +260,20 @@ def peak_for(device_kind: str) -> Peak | None:
 
 def bench_detail(pixels_per_sec: float, P: int, T: int, W: int, S: int,
                  rounds: float, device_kind: str, dtype_bytes: int = 4,
-                 sensor=LANDSAT_ARD, phase_rounds: tuple | None = None) -> dict:
+                 sensor=LANDSAT_ARD, phase_rounds: tuple | None = None,
+                 pallas: frozenset | set | tuple = (),
+                 wire_bytes: int = 2) -> dict:
     """The roofline block bench.py embeds in its detail output.
 
     ``phase_rounds`` = measured (init, fit, close) cond-gate counts
     (ChipSegments.round_counts) — makes the model reflect what the
-    phase-gated loop actually executed instead of the ungated bound."""
+    phase-gated loop actually executed instead of the ungated bound.
+    ``pallas`` = the enabled component set (see round_bytes) so the byte
+    model reflects the picked config's actual streams."""
     fl = detect_flops(P, T, W, rounds, sensor, phase_rounds=phase_rounds)
     by = round_bytes(P, T, W, S, dtype_bytes, sensor, rounds=rounds,
-                     phase_rounds=phase_rounds) / max(P, 1)
+                     phase_rounds=phase_rounds, pallas=pallas,
+                     wire_bytes=wire_bytes) / max(P, 1)
     achieved = pixels_per_sec * fl["per_pixel"]
     hbm_rate = pixels_per_sec * by
     out = {
@@ -245,6 +289,8 @@ def bench_detail(pixels_per_sec: float, P: int, T: int, W: int, S: int,
         out["phase_rounds"] = {"init": round(float(phase_rounds[0]), 1),
                                "fit": round(float(phase_rounds[1]), 1),
                                "close": round(float(phase_rounds[2]), 1)}
+    if pallas:
+        out["pallas_modeled"] = sorted(pallas)
     pk = peak_for(device_kind)
     if pk is not None:
         out["mfu_pct_vs_f32_peak"] = round(100 * achieved / pk.f32_flops, 2)

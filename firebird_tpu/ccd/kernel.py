@@ -62,16 +62,19 @@ def use_pallas(component: str = "lasso") -> bool:
     "fit" (the fused Gram+corr+CD+RMSE kernel) supersedes "lasso" (CD
     loop only) at the fit call sites; "score" (the score-fused monitor
     kernel) supersedes "monitor"; "init" (the fused INIT-window kernel)
-    supersedes "tmask" inside the init block.  Read at trace time: set
-    it before the first detect call — already-compiled programs keep
-    their path."""
+    supersedes "tmask" inside the init block; "mega" (the whole-loop
+    kernel) supersedes ALL of them and must be named explicitly — "1"
+    means every per-component kernel, not the mega route, so existing
+    "all-on" configs keep their meaning.  Read at trace time: set it
+    before the first detect call — already-compiled programs keep their
+    path."""
     import os
 
     v = os.environ.get("FIREBIRD_PALLAS", "0")
     if v in ("", "0"):
         return False
     if v == "1":
-        return True
+        return component != "mega"
     return component in {c.strip() for c in v.split(",")}
 
 
@@ -403,7 +406,16 @@ def _dedup_first(cand, same_prev):
     return keep.T
 
 
-def _variogram(Y, usable):
+def _variogram_adjusted() -> bool:
+    """Whether the ADJUSTED variogram rule is active (FIREBIRD_VARIOGRAM
+    = 'adjusted'; default 'plain').  Read at trace time, like
+    use_pallas — set before the first detect call."""
+    import os
+
+    return os.environ.get("FIREBIRD_VARIOGRAM", "plain") == "adjusted"
+
+
+def _variogram(Y, usable, t=None, adjusted=False):
     """[P,B] median |successive difference| over usable obs, floor 1e-6.
 
     Successive usable values pair up via an associative last-valid scan
@@ -415,6 +427,13 @@ def _variogram(Y, usable):
     usable predecessor contributes exactly one pair), so the median is
     bit-identical.
 
+    ``adjusted=True`` (with ``t`` [T]) applies the reconstructed
+    lcmap-pyccd adjusted_variogram rule (reference.variogram,
+    docs/DIVERGENCE.md #1): keep only pairs more than VARIOGRAM_GAP_DAYS
+    apart; per pixel, if no pair clears the gap, fall back to the plain
+    set.  The selection is date-driven and shared across bands, as in
+    pyccd.
+
     Bands are independent, so the scan + bitonic median run per band
     under lax.map — the sort's working set is [P,T] instead of [P,B,T],
     cutting the prologue's peak memory ~B-fold at identical per-element
@@ -425,6 +444,21 @@ def _variogram(Y, usable):
         bv, bf = b
         return jnp.where(bf, bv, av), af | bf
 
+    pair_sel = None
+    if adjusted:
+        tb = jnp.broadcast_to(t[None, :], usable.shape)
+        tv, tf = lax.associative_scan(op, (jnp.where(usable, tb, 0.0),
+                                           usable), axis=-1)
+        prev_t = jnp.concatenate([jnp.zeros_like(tv[..., :1]),
+                                  tv[..., :-1]], -1)
+        prev_tf = jnp.concatenate([jnp.zeros_like(tf[..., :1]),
+                                   tf[..., :-1]], -1)
+        gap_ok = (tb - prev_t) > params.VARIOGRAM_GAP_DAYS
+        base_ok = usable & prev_tf
+        sel = base_ok & gap_ok
+        # pyccd's fallback: no qualifying pair -> plain successive diffs
+        pair_sel = jnp.where(jnp.any(sel, -1, keepdims=True), sel, base_ok)
+
     def one_band(yb):                                          # [P,T]
         v, f = lax.associative_scan(op, (jnp.where(usable, yb, 0.0),
                                          usable), axis=-1)
@@ -433,6 +467,8 @@ def _variogram(Y, usable):
         prev_f = jnp.concatenate([jnp.zeros_like(f[..., :1]),
                                   f[..., :-1]], -1)
         pair_ok = usable & prev_f               # usable with a predecessor
+        if pair_sel is not None:
+            pair_ok = pair_sel
         d = jnp.abs(yb - prev_v)
         return _masked_median(d, pair_ok)                      # [P]
 
@@ -665,7 +701,7 @@ def _prologue(X, Xt, t, valid, Y, qa, *, sensor, S, fdtype, fit,
     # ---------------- standard procedure state ----------------
     is_std = procedure == PROC_STANDARD
     alive0 = usable_std & is_std[:, None]
-    vario = _variogram(Y, alive0)
+    vario = _variogram(Y, alive0, t=t, adjusted=_variogram_adjusted())
     ex0, i0 = _first_at_or_after(alive0, jnp.zeros(P, jnp.int32))
     phase0 = jnp.where(is_std & ex0, PHASE_INIT, PHASE_DONE).astype(jnp.int32)
 
@@ -686,7 +722,7 @@ def _prologue(X, Xt, t, valid, Y, qa, *, sensor, S, fdtype, fit,
     return res, state
 
 
-def _init_block(res, st, *, sensor, W, fdtype, fit):
+def _init_block(res, st, *, sensor, W, fdtype, fit, f32_ok):
     """One chip's INIT-phase round work: initialization-window search, the
     Tmask IRLS screen, and the stability test.  Runs under a scalar
     lax.cond — on rounds where no pixel is initializing (most of them:
@@ -700,15 +736,15 @@ def _init_block(res, st, *, sensor, W, fdtype, fit):
     alive = st["alive"]
     in_init = st["phase"] == PHASE_INIT
 
-    if use_pallas("init"):
+    if use_pallas("init") and f32_ok:
+        # f32_ok: the shared Mosaic gate from _detect_batch_impl
+        # (f32-on-TPU only — Mosaic cannot lower float64).
         on_tpu = jax.default_backend() == "tpu"
-        # Mosaic is f32-on-TPU only (same gate as the other kernels).
-        if not on_tpu or fdtype == jnp.float32:
-            from firebird_tpu.ccd import pallas_ops
+        from firebird_tpu.ccd import pallas_ops
 
-            return pallas_ops.init_window(
-                alive, st["cur_i"], in_init, t, X, Xt, res["Yt"],
-                res["vario"], W=W, sensor=sensor, interpret=not on_tpu)
+        return pallas_ops.init_window(
+            alive, st["cur_i"], in_init, t, X, Xt, res["Yt"],
+            res["vario"], W=W, sensor=sensor, interpret=not on_tpu)
 
     Y = res["Y"]
     P, B, T = Y.shape
@@ -756,13 +792,12 @@ def _init_block(res, st, *, sensor, W, fdtype, fit):
     Xw8, Xt_w = XW[..., :8], XW[..., 8:]
     Y2w = Yw7[:, _TMB, :]
     tmask_fn = _tmask_bad
-    if use_pallas("tmask"):
+    if use_pallas("tmask") and f32_ok:
         on_tpu = jax.default_backend() == "tpu"
-        if not on_tpu or fdtype == jnp.float32:
-            from firebird_tpu.ccd import pallas_ops
+        from firebird_tpu.ccd import pallas_ops
 
-            tmask_fn = functools.partial(pallas_ops.tmask_bad,
-                                         interpret=not on_tpu)
+        tmask_fn = functools.partial(pallas_ops.tmask_bad,
+                                     interpret=not on_tpu)
     bad_w = tmask_fn(Xt_w, Y2w, valid_w.astype(fdtype),
                      res["vario"][:, _TMB])
     bad = jnp.any(oh_w & bad_w[:, :, None], axis=1)        # [P,T]
@@ -822,7 +857,7 @@ def _init_zeros(st):
                 n_ok=zi, alive_init=st["alive"])
 
 
-def _mon_block(res, st, *, sensor, change_thr, outlier_thr):
+def _mon_block(res, st, *, sensor, change_thr, outlier_thr, f32_ok):
     """One chip's MONITOR-phase round work: score all remaining
     observations against the current model and locate the first event
     (break / refit / tail) in rank space.  Runs under a scalar lax.cond
@@ -841,9 +876,10 @@ def _mon_block(res, st, *, sensor, change_thr, outlier_thr):
     # expensive op on TPU, not the matmuls).
     dden = jnp.maximum(st["rmse"], res["vario"])[:, _DET]      # [P,5]
     on_tpu = jax.default_backend() == "tpu"
-    # Mosaic cannot lower float64; compiled Pallas is f32-on-TPU only
-    # (same gate as the Lasso CD kernel).
-    f32_ok = not on_tpu or res["X"].dtype == jnp.float32
+    # f32_ok (Mosaic cannot lower float64; compiled Pallas is f32-on-TPU
+    # only) is computed ONCE from fdtype in _detect_batch_impl and shared
+    # with the wire-resident gate, so the monitor can never fall down the
+    # XLA path while res["Y"] was dropped by wire-only mode.
     if use_pallas("score") and f32_ok:
         # Score-fused kernel: predictions, score, and rank derived in
         # VMEM from the wire-dtype detection-band spectra — skips the
@@ -1016,11 +1052,35 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
         _prologue, sensor=sensor, S=S, fdtype=fdtype, fit=fit,
         wire_only=wire_only))(Xs, Xts, ts, valids, Ys, qas)
 
+    if use_pallas("mega") and f32_ok:
+        # Whole-loop mega kernel: the entire event loop in one
+        # pallas_call, wire spectra VMEM-resident, each block exiting as
+        # soon as its own pixels finish (pallas_ops._detect_mega_block).
+        from firebird_tpu.ccd import pallas_ops
+
+        out = pallas_ops.detect_mega(
+            res["Yt"], state["phase"], state["cur_i"], state["alive"],
+            state["nseg"], state["bufs"], res["t"], res["X"], res["Xt"],
+            res["vario"], W=W, S=S, sensor=sensor,
+            phases=(PHASE_INIT, PHASE_MONITOR, PHASE_DONE),
+            change_thr=float(change_thr), outlier_thr=float(outlier_thr),
+            interpret=not on_tpu)
+        final_mask = jnp.where(
+            res["is_std"][..., None], out["alive"],
+            jnp.where(res["is_alt"][..., None], res["alt_mask"], False))
+        return ChipSegments(
+            n_segments=out["nseg"], seg_meta=out["meta"],
+            seg_rmse=out["rmse"], seg_mag=out["mag"],
+            seg_coef=out["coef"], mask=final_mask,
+            procedure=res["procedure"], rounds=out["rounds"],
+            vario=res["vario"], round_counts=out["counts"])
+
     initf = jax.vmap(functools.partial(
-        _init_block, sensor=sensor, W=W, fdtype=fdtype, fit=fit))
+        _init_block, sensor=sensor, W=W, fdtype=fdtype, fit=fit,
+        f32_ok=f32_ok))
     monf = jax.vmap(functools.partial(
         _mon_block, sensor=sensor, change_thr=change_thr,
-        outlier_thr=outlier_thr))
+        outlier_thr=outlier_thr, f32_ok=f32_ok))
     closef = jax.vmap(functools.partial(_close_block, S=S, fdtype=fdtype))
     fitf = jax.vmap(lambda r, w, n: fit(r, w, _coefmask_for(n, P)))
 

@@ -410,6 +410,31 @@ def _monitor_block(s_ref, alive_ref, inc_ref, rank_ref, curk_ref, nlast_ref,
         ref[...] = val
 
 
+def _mon_scored_logic(yd_of, coefs_d, dden, X, alive, included, cur_k,
+                      nlast, in_mon, *, change_thr, outlier_thr, peek,
+                      refit_factor, T, nb):
+    """Score + shared event logic on VMEM-resident planes — used by the
+    scored monitor block and the whole-loop mega kernel.
+
+    ``yd_of(b)`` -> [T,BP] detection-band plane (wire dtype), coefs_d
+    [nb,K,BP], dden [nb,BP], X [T,K], alive/included [T,BP] bool, cur_k/
+    nlast [1,BP] i32, in_mon [1,BP] bool.  Returns the 10 outputs of
+    kernel._monitor_chain order (i32 planes/vectors).
+    """
+    f32 = X.dtype
+    s = None
+    for b in range(nb):
+        pred = jnp.dot(X, coefs_d[b], preferred_element_type=f32)
+        r = (yd_of(b).astype(f32) - pred) / dden[b][None, :]
+        s = r * r if s is None else s + r * r                 # [T, BP]
+
+    rank = _shift_scan_add(jnp.where(alive, jnp.int32(1), 0), T) - 1
+    return _monitor_logic(
+        s, alive, included, rank, cur_k, nlast, in_mon,
+        change_thr=change_thr, outlier_thr=outlier_thr, peek=peek,
+        refit_factor=refit_factor, T=T)
+
+
 def _monitor_scored_block(yd_ref, coef_ref, dden_ref, x_ref, alive_ref,
                           inc_ref, curk_ref, nlast_ref, inmon_ref,
                           *out_refs, change_thr, outlier_thr, peek,
@@ -424,22 +449,12 @@ def _monitor_scored_block(yd_ref, coef_ref, dden_ref, x_ref, alive_ref,
     once as int16, predictions are one [T,K]x[K,BP] MXU dot per band,
     and rank is a log-step shift-add over the alive plane.
     """
-    X = x_ref[...]                                            # [T, K]
-    alive_i = alive_ref[...]                                  # [T, BP] int32
-    alive = alive_i > 0
-    f32 = X.dtype
-
-    s = None
-    for b in range(nb):
-        pred = jnp.dot(X, coef_ref[b], preferred_element_type=f32)
-        r = (yd_ref[b].astype(f32) - pred) / dden_ref[b][None, :]
-        s = r * r if s is None else s + r * r                 # [T, BP]
-
-    rank = _shift_scan_add(jnp.where(alive, jnp.int32(1), 0), T) - 1
-    outs = _monitor_logic(
-        s, alive, inc_ref[...] > 0, rank, curk_ref[...], nlast_ref[...],
-        inmon_ref[...] > 0, change_thr=change_thr,
-        outlier_thr=outlier_thr, peek=peek, refit_factor=refit_factor, T=T)
+    outs = _mon_scored_logic(
+        lambda b: yd_ref[b], coef_ref[...], dden_ref[...], x_ref[...],
+        alive_ref[...] > 0, inc_ref[...] > 0, curk_ref[...],
+        nlast_ref[...], inmon_ref[...] > 0, change_thr=change_thr,
+        outlier_thr=outlier_thr, peek=peek, refit_factor=refit_factor,
+        T=T, nb=nb)
     for ref, val in zip(out_refs, outs):
         ref[...] = val
 
@@ -572,14 +587,12 @@ def _first_ge(mask, ti, T):
     return ex, jnp.where(ex, idx, 0)
 
 
-def _init_window_block(alive_ref, curi_ref, inin_ref, t_ref, x_ref, xtr_ref,
-                       xtk_ref, xxt_ref, y_ref, vario_ref,
-                       nowin_ref, tm_ref, ok_ref, bad_flag_ref, hasadv_ref,
-                       inext_ref, iadv_ref, j_ref, nok_ref, wstab_ref,
-                       alive_out_ref, *, T, W, B, K, NT, n_pow, det, tmb,
-                       cd_iters, alpha, tm_iters, huber_k, tmask_const,
-                       meow, init_days, stab_factor):
-    """One pixel block of kernel._init_block, end to end in VMEM.
+def _init_logic(alive, cur_i, in_init, t_col, X, Xtr, XTK, XXT, y_of,
+                vario, *, T, W, B, K, NT, n_pow, det, tmb, cd_iters,
+                alpha, tm_iters, huber_k, tmask_const, meow, init_days,
+                stab_factor):
+    """The INIT-phase round work on VMEM-resident planes — shared by the
+    standalone init_window kernel and the whole-loop mega kernel.
 
     Replaces the XLA path's [P,W,T] one-hot window tensors (the peak
     memory of a dispatch and the dominant bytes of an INIT round) with
@@ -589,12 +602,13 @@ def _init_window_block(alive_ref, curi_ref, inin_ref, t_ref, x_ref, xtr_ref,
     math over the full T axis (bit-aligned with the 'fit' component);
     the Tmask IRLS reuses the tmask kernel's core over the compacted
     window.
+
+    alive [T,BP] bool, cur_i [1,BP] i32, in_init [1,BP] bool,
+    t_col [T,1] f32, X [T,K], Xtr [T,NT], XTK [K,T], XXT [K*K,T],
+    ``y_of(b)`` -> [T,BP] wire-dtype band plane, vario [B,BP].
+    Returns a dict of value planes (bools stay bool).
     """
     i32 = jnp.int32
-    alive = alive_ref[...] > 0                                # [T, BP]
-    cur_i = curi_ref[...]                                     # [1, BP]
-    in_init = inin_ref[...] > 0
-    t_col = t_ref[...]                                        # [T, 1]
     f32 = t_col.dtype
     ti = lax.broadcasted_iota(i32, alive.shape, 0)
 
@@ -619,14 +633,14 @@ def _init_window_block(alive_ref, curi_ref, inin_ref, t_ref, x_ref, xtr_ref,
     rel_w = rank - A_before                                   # [T, BP]
 
     # ---- window member selection (exact one-hot sums) ----
-    Xcat = jnp.concatenate([x_ref[...], xtr_ref[...]], axis=1)  # [T, K+NT]
+    Xcat = jnp.concatenate([X, Xtr], axis=1)                  # [T, K+NT]
+    Yf = [y_of(b) for b in range(B)]                          # B x [T, BP]
     Yw = [[] for _ in range(B)]
     Xw = [[] for _ in range(K + NT)]
     for w in range(W):
         mf = jnp.where(alive & (rel_w == w), 1.0, 0.0).astype(f32)
         for b in range(B):
-            Yw[b].append(jnp.sum(y_ref[b].astype(f32) * mf, 0,
-                                 keepdims=True))
+            Yw[b].append(jnp.sum(Yf[b] * mf, 0, keepdims=True))
         for c in range(K + NT):
             Xw[c].append(jnp.sum(Xcat[:, c:c + 1] * mf, 0, keepdims=True))
     Yw = [jnp.concatenate(v, 0) for v in Yw]                  # B x [W, BP]
@@ -634,7 +648,6 @@ def _init_window_block(alive_ref, curi_ref, inin_ref, t_ref, x_ref, xtr_ref,
 
     wi = lax.broadcasted_iota(i32, (W,) + alive.shape[1:], 0)
     valid_w = (wi < n_win)                                    # [W, BP]
-    vario = vario_ref[...]                                    # [B, BP]
 
     # ---- Tmask IRLS over the compacted window ----
     bad_w = _tmask_core([Xw[K + c] for c in range(NT)],
@@ -653,8 +666,7 @@ def _init_window_block(alive_ref, curi_ref, inin_ref, t_ref, x_ref, xtr_ref,
     cm4 = jnp.where(
         lax.broadcasted_iota(i32, (K,) + alive.shape[1:], 0) < 4,
         1.0, 0.0).astype(f32)
-    c4, _ = _gram_cd_core(xtk_ref[...], xxt_ref[...],
-                          lambda b: y_ref[b].astype(f32),
+    c4, _ = _gram_cd_core(XTK, XXT, lambda b: Yf[b],
                           jnp.where(w_stab, 1.0, 0.0).astype(f32), cm4,
                           B=B, K=K, iters=cd_iters, alpha=alpha)
     stab_w = valid_w & ~bad_w
@@ -692,18 +704,39 @@ def _init_window_block(alive_ref, curi_ref, inin_ref, t_ref, x_ref, xtr_ref,
     i_next = jnp.where(ex_tm, i_next, T)
     has_adv, i_adv = _first_ge(alive & (ti >= i + 1), ti, T)
 
+    return dict(init_nowin=init_nowin, init_tm=init_tm, init_ok=init_ok,
+                init_bad=init_bad, has_adv=has_adv, i_next_tm=i_next,
+                i_adv=i_adv, j=j,
+                n_ok=jnp.sum(jnp.where(w_stab, one, 0), 0, keepdims=True),
+                w_stab=w_stab, alive_init=alive & ~bad_abs)
+
+
+def _init_window_block(alive_ref, curi_ref, inin_ref, t_ref, x_ref, xtr_ref,
+                       xtk_ref, xxt_ref, y_ref, vario_ref,
+                       nowin_ref, tm_ref, ok_ref, bad_flag_ref, hasadv_ref,
+                       inext_ref, iadv_ref, j_ref, nok_ref, wstab_ref,
+                       alive_out_ref, **statics):
+    """One pixel block of kernel._init_block: ref boundary around
+    _init_logic (the standalone 'init' component's pallas_call body)."""
+    t_col = t_ref[...]
+    f32 = t_col.dtype
+    out = _init_logic(
+        alive_ref[...] > 0, curi_ref[...], inin_ref[...] > 0, t_col,
+        x_ref[...], xtr_ref[...], xtk_ref[...], xxt_ref[...],
+        lambda b: y_ref[b].astype(f32), vario_ref[...], **statics)
+    one = jnp.int32(1)
     as_i = lambda b: jnp.where(b, one, 0)
-    nowin_ref[...] = as_i(init_nowin)
-    tm_ref[...] = as_i(init_tm)
-    ok_ref[...] = as_i(init_ok)
-    bad_flag_ref[...] = as_i(init_bad)
-    hasadv_ref[...] = as_i(has_adv)
-    inext_ref[...] = i_next
-    iadv_ref[...] = i_adv
-    j_ref[...] = j
-    nok_ref[...] = jnp.sum(jnp.where(w_stab, one, 0), 0, keepdims=True)
-    wstab_ref[...] = as_i(w_stab)
-    alive_out_ref[...] = as_i(alive & ~bad_abs)
+    nowin_ref[...] = as_i(out["init_nowin"])
+    tm_ref[...] = as_i(out["init_tm"])
+    ok_ref[...] = as_i(out["init_ok"])
+    bad_flag_ref[...] = as_i(out["init_bad"])
+    hasadv_ref[...] = as_i(out["has_adv"])
+    inext_ref[...] = out["i_next_tm"]
+    iadv_ref[...] = out["i_adv"]
+    j_ref[...] = out["j"]
+    nok_ref[...] = out["n_ok"]
+    wstab_ref[...] = as_i(out["w_stab"])
+    alive_out_ref[...] = as_i(out["alive_init"])
 
 
 @functools.partial(jax.jit, static_argnames=("W", "sensor", "interpret"))
@@ -979,3 +1012,467 @@ def tmask_bad(Xtw, Y2, w, vario2, *, interpret=False):
         interpret=interpret,
     )(xt, y2, wp, vp)
     return (out[:, :P] > 0).T
+
+
+# ---------------------------------------------------------------------------
+# Whole-loop mega kernel: the entire event-horizon loop in one pallas_call
+# ---------------------------------------------------------------------------
+
+def mega_block_p(T: int, W: int, B: int, S: int, y_bytes: int) -> int:
+    """Lane-block width for the mega kernel: the [B,T,BP] wire spectra and
+    their widened f32 twins, ~24 live [T,BP] planes (state + monitor/init
+    temporaries), the [W,BP] window/IRLS planes, and the [S,*,BP] result
+    buffers all live in VMEM for the whole event loop."""
+    budget = 10 * 2 ** 20
+    per_lane = (max(T, 1) * (B * y_bytes + B * 4 + 24 * 4)
+                + max(W, 1) * 60 * 4
+                + max(S, 1) * (6 + 2 * B + B * 8) * 4 + 2048)
+    return max(128, min(512, (budget // per_lane) // 128 * 128))
+
+
+def _close_logic(y_of, X, t_col, coefs, rmse, alive, included_mon,
+                 m, is_tail, is_brk, ev_rank, pos_ev, n_exceed, first_seg,
+                 nseg, meta_b, rmses_b, mags_b, coefs_b, *,
+                 T, B, K, S, peek, n_pow_peek,
+                 qa_start, qa_inside, qa_end):
+    """Segment-close work on VMEM-resident planes (kernel._close_block +
+    _write_seg): break magnitudes over the PEEK run (one-hot member
+    selection + bitonic median), the 6-column meta row, and the one-hot
+    append into the [S,*,BP] result buffers at each closing pixel's nseg.
+
+    coefs [B,K,BP], rmse [B,BP], alive/included_mon [T,BP] bool,
+    m/ev_rank/pos_ev/n_exceed [1,BP] i32, is_tail/is_brk/first_seg
+    [1,BP] bool, nseg [1,BP] i32, buffers meta_b [S,6,BP],
+    rmses_b/mags_b [S,B,BP], coefs_b [S,B*K,BP].
+    Returns the updated (meta_b, rmses_b, mags_b, coefs_b, nseg).
+    """
+    i32 = jnp.int32
+    f32 = X.dtype
+    one = i32(1)
+    close = is_tail | is_brk                                   # [1,BP]
+    ti = lax.broadcasted_iota(i32, alive.shape, 0)             # [T,BP]
+    rank = _shift_scan_add(jnp.where(alive, one, 0), T) - 1
+    rel_ev = rank - ev_rank                                    # [T,BP]
+    t_plane = jnp.broadcast_to(t_col, alive.shape)
+
+    def at_t(plane, idx):
+        return jnp.sum(jnp.where(ti == idx, plane, 0), 0, keepdims=True)
+
+    # PEEK-run member selection: one-hot over T per run slot (each slot
+    # holds at most one observation — the same scatter-free construction
+    # as the INIT window; kernel._close_block's oh_run einsums).
+    Yf = [y_of(b) for b in range(B)]
+    xsel = [[None] * K for _ in range(peek)]
+    ysel = [[None] * B for _ in range(peek)]
+    for k in range(peek):
+        mf = jnp.where(alive & (rel_ev == k), 1.0, 0.0).astype(f32)
+        for c in range(K):
+            xsel[k][c] = jnp.sum(X[:, c:c + 1] * mf, 0, keepdims=True)
+        for b in range(B):
+            ysel[k][b] = jnp.sum(Yf[b] * mf, 0, keepdims=True)
+
+    ki = lax.broadcasted_iota(i32, (peek,) + alive.shape[1:], 0)
+    run_ok = (ev_rank + ki) < m                                # [peek,BP]
+    mags = []
+    for b in range(B):
+        rows = []
+        for k in range(peek):
+            pred_k = None
+            for c in range(K):
+                term = coefs[b, c][None, :] * xsel[k][c]
+                pred_k = term if pred_k is None else pred_k + term
+            rows.append(ysel[k][b] - pred_k)
+        resid = jnp.concatenate(rows, 0)                       # [peek,BP]
+        mags.append(_median_sublane(resid, run_ok, n_pow_peek))
+    mags = jnp.concatenate(mags, 0)                            # [B,BP]
+
+    # Segment meta (kernel._close_block meta_new) — argmax semantics for
+    # the none-included edge (first->0, last->T-1) mirror the jnp path.
+    any_inc = jnp.any(included_mon, 0, keepdims=True)
+    INF = i32(T + 1)
+    first_inc = jnp.where(
+        any_inc,
+        jnp.min(jnp.where(included_mon, ti, INF), 0, keepdims=True), 0)
+    last_inc = jnp.where(
+        any_inc,
+        jnp.max(jnp.where(included_mon, ti, -1), 0, keepdims=True), T - 1)
+    start_day = at_t(t_plane, first_inc)
+    end_day = at_t(t_plane, last_inc)
+    break_day = jnp.where(is_brk, at_t(t_plane, pos_ev), end_day)
+    chprob = jnp.where(is_brk, 1.0, n_exceed.astype(f32) / float(peek))
+    qa_tail = qa_end + jnp.where(first_seg, qa_start, 0)
+    qa_brk = jnp.where(first_seg, qa_start, qa_inside)
+    qa = jnp.where(is_brk, qa_brk, qa_tail).astype(f32)
+    n_obs = jnp.sum(jnp.where(included_mon, one, 0), 0,
+                    keepdims=True).astype(f32)
+    meta_new = jnp.concatenate(
+        [start_day, end_day, break_day, chprob, qa, n_obs], 0)  # [6,BP]
+    mag_new = jnp.where(is_brk, mags, 0.0)                      # [B,BP]
+    coef_new = jnp.concatenate([coefs[b] for b in range(B)], 0)  # [B*K,BP]
+
+    # One-hot append at nseg (kernel._write_seg): rows past capacity are
+    # never selected (iota < S), but nseg still counts — the overflow
+    # contract detect_packed's capacity_retry relies on.
+    si = lax.broadcasted_iota(i32, (S, 1) + alive.shape[1:], 0)
+    sel = (si == nseg[None]) & close[None]                      # [S,1,BP]
+    meta_b = jnp.where(sel, meta_new[None], meta_b)
+    rmses_b = jnp.where(sel, rmse[None], rmses_b)
+    mags_b = jnp.where(sel, mag_new[None], mags_b)
+    coefs_b = jnp.where(sel, coef_new[None], coefs_b)
+    nseg = nseg + jnp.where(close, one, 0)
+    return meta_b, rmses_b, mags_b, coefs_b, nseg
+
+
+def _detect_mega_block(phase0_ref, curi0_ref, nseg0_ref, alive0_ref,
+                       t_ref, x_ref, xtr_ref, xtk_ref, xxt_ref, y_ref,
+                       vario_ref, meta0_ref, rmses0_ref, mags0_ref,
+                       coefs0_ref,
+                       meta_ref, rmses_ref, mags_ref, coefs_ref, nseg_ref,
+                       alive_ref, rounds_ref, counts_ref, *,
+                       T, W, B, K, NT, S, n_pow_w, det, tmb,
+                       change_thr, outlier_thr, max_rounds,
+                       cd_iters, alpha, tm_iters, huber_k, tmask_const,
+                       meow, init_days, stab_factor, peek, refit_factor,
+                       num_obs_factor, mid_coefs,
+                       qa_start, qa_inside, qa_end,
+                       ph_init, ph_mon, ph_done):
+    """One pixel block's ENTIRE event-horizon loop in VMEM.
+
+    The [B,T,BP] wire spectra are read from HBM exactly once per pixel;
+    every round's INIT window search, Tmask screen, stability fit,
+    monitor scoring/event chain, Lasso refit, and segment write runs on
+    VMEM residents inside a single lax.while_loop, with the same
+    block-level cond gates as the XLA loop (kernel._detect_batch_impl) —
+    a block whose pixels are all monitoring skips the INIT work
+    outright, and each block exits as soon as its own pixels are DONE
+    (no batch-wide lockstep).  Composes the shared per-phase logic
+    (_init_logic, _mon_scored_logic, _gram_cd_core, _close_logic), so
+    the arithmetic is bit-aligned with the per-component kernels.
+    """
+    i32 = jnp.int32
+    X = x_ref[0]                                               # [T,K]
+    Xtr = xtr_ref[0]                                           # [T,NT]
+    XTK = xtk_ref[0]                                           # [K,T]
+    XXT = xxt_ref[0]                                           # [K*K,T]
+    t_col = t_ref[0]                                           # [T,1]
+    f32 = X.dtype
+    vario = vario_ref[0]                                       # [B,BP]
+    BP = vario.shape[-1]
+    det_l = list(det)
+    nb = len(det_l)
+    y_of = lambda b: y_ref[0, b].astype(f32)
+    one = i32(1)
+    as_i = lambda v: jnp.where(v, one, 0)
+
+    carry0 = (phase0_ref[0], curi0_ref[0], jnp.zeros((1, BP), i32),
+              jnp.ones((1, BP), i32),              # n_last_fit
+              jnp.ones((1, BP), i32),              # first_seg
+              nseg0_ref[0],
+              alive0_ref[0],                       # [T,BP] i32
+              jnp.zeros((T, BP), i32),             # included
+              jnp.zeros((B, K, BP), f32),          # coefs
+              jnp.ones((B, BP), f32),              # rmse
+              meta0_ref[0], rmses0_ref[0], mags0_ref[0], coefs0_ref[0],
+              jnp.zeros((), i32),                  # rounds
+              jnp.zeros((), i32), jnp.zeros((), i32), jnp.zeros((), i32))
+
+    def cond(c):
+        return (c[14] < max_rounds) & jnp.any(c[0] != ph_done)
+
+    def body(c):
+        (phase, cur_i, cur_k, nlast, first_i, nseg, alive_i, inc_i,
+         coefs, rmse, meta_b, rmses_b, mags_b, coefs_b, rounds,
+         cnt_i, cnt_f, cnt_c) = c
+        alive = alive_i > 0
+        included = inc_i > 0
+        first_seg = first_i > 0
+        in_init = phase == ph_init                             # [1,BP]
+        in_mon = phase == ph_mon
+
+        # ---- INIT block (skipped when no pixel of the block inits) ----
+        any_init = jnp.any(in_init)
+
+        def run_init():
+            o = _init_logic(alive, cur_i, in_init, t_col, X, Xtr, XTK,
+                            XXT, y_of, vario, T=T, W=W, B=B, K=K, NT=NT,
+                            n_pow=n_pow_w, det=det, tmb=tmb,
+                            cd_iters=cd_iters, alpha=alpha,
+                            tm_iters=tm_iters, huber_k=huber_k,
+                            tmask_const=tmask_const, meow=meow,
+                            init_days=init_days, stab_factor=stab_factor)
+            # .astype(i32): x64 mode promotes integer sums to i64, which
+            # would mismatch the skip branch's i32 zeros.
+            return (as_i(o["init_nowin"]), as_i(o["init_tm"]),
+                    as_i(o["init_ok"]), as_i(o["init_bad"]),
+                    as_i(o["has_adv"]), o["i_next_tm"].astype(i32),
+                    o["i_adv"].astype(i32), o["j"].astype(i32),
+                    o["n_ok"].astype(i32), as_i(o["w_stab"]),
+                    as_i(o["alive_init"]))
+
+        def zero_init():
+            zv = jnp.zeros((1, BP), i32)
+            return (zv, zv, zv, zv, zv, zv, zv, zv, zv,
+                    jnp.zeros((T, BP), i32), alive_i)
+
+        (i_nowin, i_tm, i_ok, i_bad, i_hasadv, i_next, i_adv, i_j,
+         i_nok, i_wstab, i_alive) = lax.cond(any_init, run_init, zero_init)
+        init_ok = i_ok > 0
+
+        # ---- MONITOR block ----
+        any_mon = jnp.any(in_mon)
+        dden = jnp.concatenate(
+            [jnp.maximum(rmse[b], vario[b])[None] for b in det_l], 0)
+        coefs_d = jnp.concatenate([coefs[b][None] for b in det_l], 0)
+
+        def run_mon():
+            outs = _mon_scored_logic(
+                lambda b: y_ref[0, det_l[b]], coefs_d, dden, X, alive,
+                included, cur_k, nlast, in_mon, change_thr=change_thr,
+                outlier_thr=outlier_thr, peek=peek,
+                refit_factor=refit_factor, T=T, nb=nb)
+            return tuple(v.astype(i32) for v in outs)
+
+        def zero_mon():
+            zv = jnp.zeros((1, BP), i32)
+            zp = jnp.zeros((T, BP), i32)
+            return (zv, zv, zv, zv, zv, zv, zv, zv, zp, zp)
+
+        (m, is_tail_i, is_brk_i, is_refit_i, ev_rank, pos_ev, n_exceed,
+         n_rf, inc_q_i, rem_q_i) = lax.cond(any_mon, run_mon, zero_mon)
+        is_tail = is_tail_i > 0
+        is_brk = is_brk_i > 0
+        is_refit = is_refit_i > 0
+        inc_abs = (inc_q_i > 0) & in_mon
+        rem_abs = (rem_q_i > 0) & in_mon
+        included_mon = included | inc_abs
+        alive_mon = alive & ~rem_abs
+
+        # ---- CLOSE block ----
+        close = is_tail | is_brk
+        any_close = jnp.any(close)
+
+        def run_close():
+            return _close_logic(
+                y_of, X, t_col, coefs, rmse, alive, included_mon, m,
+                is_tail, is_brk, ev_rank, pos_ev, n_exceed, first_seg,
+                nseg, meta_b, rmses_b, mags_b, coefs_b, T=T, B=B, K=K,
+                S=S, peek=peek,
+                n_pow_peek=1 << max(1, (peek - 1).bit_length()),
+                qa_start=qa_start, qa_inside=qa_inside, qa_end=qa_end)
+
+        def keep_close():
+            return meta_b, rmses_b, mags_b, coefs_b, nseg
+
+        meta_n, rmses_n, mags_n, coefs_bn, nseg_n = lax.cond(
+            any_close, run_close, keep_close)
+
+        # ---- shared Lasso fit (init-ok + refit) ----
+        do_fit = init_ok | is_refit
+        any_fit = jnp.any(do_fit)
+        n_full = jnp.where(init_ok, i_nok, n_rf)               # [1,BP]
+
+        def run_fit():
+            w_full = jnp.where(init_ok, i_wstab > 0,
+                               included_mon & is_refit)
+            wf = jnp.where(w_full, 1.0, 0.0).astype(f32)
+            nc = jnp.where(
+                n_full >= K * num_obs_factor, K,
+                jnp.where(n_full >= mid_coefs * num_obs_factor,
+                          mid_coefs, 4))
+            cm = jnp.where(
+                lax.broadcasted_iota(i32, (K, BP), 0) < nc,
+                1.0, 0.0).astype(f32)
+            beta, n = _gram_cd_core(XTK, XXT, y_of, wf, cm, B=B, K=K,
+                                    iters=cd_iters, alpha=alpha)
+            rs = []
+            for b in range(B):
+                pred = jnp.dot(X, beta[b], preferred_element_type=f32)
+                r = y_of(b) - pred
+                rs.append(jnp.sqrt(jnp.maximum(
+                    jnp.sum(r * r * wf, 0, keepdims=True) / n, 0.0)))
+            return beta, jnp.concatenate(rs, 0)
+
+        def keep_fit():
+            return coefs, rmse
+
+        cfull, rfull = lax.cond(any_fit, run_fit, keep_fit)
+
+        # ---- next state (kernel._detect_batch_impl body) ----
+        phase_n = jnp.where(
+            (i_nowin > 0) | ((i_bad > 0) & ~(i_hasadv > 0)), ph_done,
+            jnp.where(init_ok, ph_mon,
+                      jnp.where(is_tail, ph_done,
+                                jnp.where(is_brk, ph_init, phase))))
+        cur_i_n = jnp.where(
+            i_tm > 0, i_next,
+            jnp.where((i_bad > 0) & (i_hasadv > 0), i_adv,
+                      jnp.where(is_brk, pos_ev, cur_i)))
+        cur_k_n = jnp.where(init_ok, i_j + 1,
+                            jnp.where(is_refit, pos_ev + 1, cur_k))
+        alive_n = jnp.where(in_init, i_alive > 0,
+                            jnp.where(in_mon, alive_mon, alive))
+        included_n = jnp.where(
+            init_ok, i_wstab > 0,
+            jnp.where(is_brk, False,
+                      jnp.where(in_mon, included_mon, included)))
+        coefs_n = jnp.where(do_fit[None], cfull, coefs)
+        rmse_n = jnp.where(do_fit, rfull, rmse)
+        nlast_n = jnp.where(do_fit, n_full, nlast)
+        first_n = first_seg & ~is_brk
+
+        return (phase_n, cur_i_n, cur_k_n, nlast_n, as_i(first_n),
+                nseg_n, as_i(alive_n), as_i(included_n), coefs_n, rmse_n,
+                meta_n, rmses_n, mags_n, coefs_bn, rounds + 1,
+                cnt_i + jnp.where(any_init, 1, 0),
+                cnt_f + jnp.where(any_fit, 1, 0),
+                cnt_c + jnp.where(any_close, 1, 0))
+
+    fin = lax.while_loop(cond, body, carry0)
+    (_, _, _, _, _, nseg, alive_f, _, _, _, meta_b, rmses_b, mags_b,
+     coefs_b, rounds, cnt_i, cnt_f, cnt_c) = fin
+    meta_ref[0] = meta_b
+    rmses_ref[0] = rmses_b
+    mags_ref[0] = mags_b
+    coefs_ref[0] = coefs_b
+    nseg_ref[0] = nseg
+    alive_ref[0] = alive_f
+    rounds_ref[0] = jnp.full((1, BP), rounds, i32)
+    counts_ref[0] = jnp.concatenate(
+        [jnp.full((1, BP), cnt_i, i32), jnp.full((1, BP), cnt_f, i32),
+         jnp.full((1, BP), cnt_c, i32)], 0)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "W", "S", "sensor", "phases", "change_thr", "outlier_thr",
+    "interpret"))
+def detect_mega(Yt, phase0, cur_i0, alive0, nseg0, bufs0, t, X, Xt, vario,
+                *, W, S, sensor, phases, change_thr, outlier_thr,
+                interpret=False):
+    """The whole event-horizon loop as ONE pallas_call (the 'mega'
+    component): grid over (chip, pixel-block), each block running its own
+    while_loop with the wire spectra VMEM-resident — HBM traffic for the
+    entire loop is one [B,T,P] wire read + the state/buffer boundary,
+    ~B*T*wire_bytes per pixel instead of per-round re-streams.
+
+    Args (C chips, batched leading axis):
+        Yt: [C,B,T,P] resident spectra (wire int16 or float32).
+        phase0, cur_i0, nseg0: [C,P] i32 start state (kernel._prologue).
+        alive0: [C,P,T] bool.
+        bufs0: (meta [C,P,S*6], rmse [C,P,S*B], mag [C,P,S*B],
+                coef [C,P,S*B*K]) flat result buffers (may hold the
+                prologue's alt-procedure rows).
+        t: [C,T]; X: [C,T,K]; Xt: [C,T,NT]; vario: [C,P,B].
+        phases: (PHASE_INIT, PHASE_MONITOR, PHASE_DONE) static ints.
+    Returns:
+        dict(meta [C,P,S,6], rmse [C,P,S,B], mag [C,P,S,B],
+             coef [C,P,S,B,K], nseg [C,P], rounds [C], counts [C,3]).
+    """
+    C, B, T, P = Yt.shape
+    K = X.shape[-1]
+    NT = Xt.shape[-1]
+    f32 = X.dtype
+    i32 = jnp.int32
+    det = tuple(sensor.detection_bands)
+    tmb = tuple(sensor.tmask_bands)
+    ph_init, ph_mon, ph_done = phases
+    BP = mega_block_p(T, W, B, S, Yt.dtype.itemsize)
+    Pp = -BP * (-P // BP)
+    pad = Pp - P
+    nblk = Pp // BP
+
+    def padP(a, cv=0):
+        return jnp.pad(a, ((0, 0),) * (a.ndim - 1) + ((0, pad),),
+                       constant_values=cv)
+
+    meta0, rmse0, mag0, coef0 = bufs0
+    args = (
+        padP(phase0[:, None, :].astype(i32), ph_done),         # [C,1,Pp]
+        padP(cur_i0[:, None, :].astype(i32)),
+        padP(nseg0[:, None, :].astype(i32)),
+        padP(alive0.transpose(0, 2, 1).astype(i32)),           # [C,T,Pp]
+        t.astype(f32)[:, :, None],                             # [C,T,1]
+        X, Xt,
+        X.transpose(0, 2, 1),                                  # [C,K,T]
+        (X[..., :, None] * X[..., None, :])
+        .reshape(C, T, K * K).transpose(0, 2, 1),              # [C,K*K,T]
+        padP(Yt),                                              # [C,B,T,Pp]
+        padP(vario.transpose(0, 2, 1).astype(f32), 1.0),       # [C,B,Pp]
+        padP(meta0.reshape(C, P, S, 6).transpose(0, 2, 3, 1)),  # [C,S,6,Pp]
+        padP(rmse0.reshape(C, P, S, B).transpose(0, 2, 3, 1)),
+        padP(mag0.reshape(C, P, S, B).transpose(0, 2, 3, 1)),
+        padP(coef0.reshape(C, P, S, B * K).transpose(0, 2, 3, 1)),
+    )
+
+    def bmap(shape):
+        # per-(chip, block) input: trailing axis is the pixel axis
+        nlead = len(shape) - 1
+        return pl.BlockSpec(
+            (1,) + shape,
+            lambda c, i, _n=nlead: (c,) + (0,) * _n + (i,))
+
+    def cmap(shape):
+        # chip-shared input (designs): no pixel axis
+        return pl.BlockSpec(
+            (1,) + shape,
+            lambda c, i, _n=len(shape): (c,) + (0,) * _n)
+
+    kern = functools.partial(
+        _detect_mega_block, T=T, W=W, B=B, K=K, NT=NT, S=S,
+        n_pow_w=1 << max(1, (W - 1).bit_length()), det=det, tmb=tmb,
+        change_thr=float(change_thr), outlier_thr=float(outlier_thr),
+        max_rounds=2 * T + 8,
+        cd_iters=int(params.LASSO_ITERS), alpha=float(params.LASSO_ALPHA),
+        tm_iters=int(params.TMASK_IRLS_ITERS),
+        huber_k=float(params.HUBER_K),
+        tmask_const=float(params.TMASK_CONST),
+        meow=int(params.MEOW_SIZE), init_days=float(params.INIT_DAYS),
+        stab_factor=float(params.STABILITY_FACTOR),
+        peek=int(params.PEEK_SIZE),
+        refit_factor=float(params.REFIT_FACTOR),
+        num_obs_factor=int(params.NUM_OBS_FACTOR),
+        mid_coefs=int(params.MID_COEFS),
+        qa_start=int(params.CURVE_QA_START),
+        qa_inside=int(params.CURVE_QA_INSIDE),
+        qa_end=int(params.CURVE_QA_END),
+        ph_init=int(ph_init), ph_mon=int(ph_mon), ph_done=int(ph_done))
+
+    outs = pl.pallas_call(
+        kern,
+        grid=(C, nblk),
+        in_specs=[
+            bmap((1, BP)), bmap((1, BP)), bmap((1, BP)), bmap((T, BP)),
+            cmap((T, 1)), cmap((T, K)), cmap((T, NT)), cmap((K, T)),
+            cmap((K * K, T)),
+            bmap((B, T, BP)), bmap((B, BP)),
+            bmap((S, 6, BP)), bmap((S, B, BP)), bmap((S, B, BP)),
+            bmap((S, B * K, BP)),
+        ],
+        out_specs=[
+            bmap((S, 6, BP)), bmap((S, B, BP)), bmap((S, B, BP)),
+            bmap((S, B * K, BP)), bmap((1, BP)), bmap((T, BP)),
+            bmap((1, BP)), bmap((3, BP)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((C, S, 6, Pp), f32),
+            jax.ShapeDtypeStruct((C, S, B, Pp), f32),
+            jax.ShapeDtypeStruct((C, S, B, Pp), f32),
+            jax.ShapeDtypeStruct((C, S, B * K, Pp), f32),
+            jax.ShapeDtypeStruct((C, 1, Pp), i32),
+            jax.ShapeDtypeStruct((C, T, Pp), i32),
+            jax.ShapeDtypeStruct((C, 1, Pp), i32),
+            jax.ShapeDtypeStruct((C, 3, Pp), i32),
+        ],
+        interpret=interpret,
+    )(*args)
+    meta, rmses, mags, coefsb, nseg, alive_f, rounds, counts = outs
+    return dict(
+        meta=meta[..., :P].transpose(0, 3, 1, 2),
+        rmse=rmses[..., :P].transpose(0, 3, 1, 2),
+        mag=mags[..., :P].transpose(0, 3, 1, 2),
+        coef=coefsb[..., :P].transpose(0, 3, 1, 2)
+        .reshape(C, P, S, B, K),
+        nseg=nseg[:, 0, :P],
+        alive=(alive_f[..., :P] > 0).transpose(0, 2, 1),
+        rounds=jnp.max(rounds[:, 0, :], axis=-1),
+        counts=jnp.max(counts, axis=-1),
+    )

@@ -111,6 +111,13 @@ LASSO_ITERS = 50
 # TMASK_CONST * max(variogram, rmse) in any Tmask band.
 TMASK_COEFS = 5           # [1, cos wt, sin wt, cos 2wt, sin 2wt]
 TMASK_CONST = 4.89
+
+# Minimum date gap for a successive-difference pair to enter the ADJUSTED
+# variogram (lcmap-pyccd's adjusted_variogram rule, reconstructed — the
+# pinned package at reference setup.py:32 is unreachable offline; see
+# docs/DIVERGENCE.md #1).  Near-coincident multi-sensor acquisitions
+# (combined L7+L8 archives) otherwise crater the madogram denominator.
+VARIOGRAM_GAP_DAYS = 30.0
 TMASK_IRLS_ITERS = 5
 HUBER_K = 1.345
 

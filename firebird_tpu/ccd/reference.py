@@ -107,11 +107,29 @@ def num_coefs(n_obs: int) -> int:
     return params.MIN_COEFS
 
 
-def variogram(t: np.ndarray, Y: np.ndarray) -> np.ndarray:
-    """Per-band median absolute successive difference, floored at 1e-6."""
+def variogram(t: np.ndarray, Y: np.ndarray,
+              adjusted: bool = False) -> np.ndarray:
+    """Per-band median absolute successive difference, floored at 1e-6.
+
+    ``adjusted=True`` applies the lcmap-pyccd ``adjusted_variogram`` rule
+    (reconstructed from the public lcmap-pyccd package the reference pins
+    at setup.py:32; the pinned source itself is unreachable offline —
+    docs/DIVERGENCE.md #1): restrict the successive-difference set to
+    pairs more than VARIOGRAM_GAP_DAYS apart, so dense multi-sensor
+    archives with near-coincident acquisitions (the 'ncompare' case: L7+L8
+    pairs days apart whose tiny |diffs| crater the madogram and inflate
+    false breaks) measure seasonal-scale variation instead.  When no pair
+    clears the gap, the plain madogram is used.  The pair-selection is
+    date-driven and shared by all bands, as in pyccd.
+    """
     if t.shape[0] < 2:
         return np.ones(Y.shape[0], dtype=np.float64)
-    v = np.median(np.abs(np.diff(Y.astype(np.float64), axis=1)), axis=1)
+    d = np.abs(np.diff(Y.astype(np.float64), axis=1))
+    if adjusted:
+        sel = np.diff(t.astype(np.float64)) > params.VARIOGRAM_GAP_DAYS
+        if np.any(sel):
+            d = d[:, sel]
+    v = np.median(d, axis=1)
     return np.maximum(v, 1e-6)
 
 
@@ -193,7 +211,7 @@ def _segment_record(model: _Model, *,
 # ---------------------------------------------------------------------------
 
 def _standard_procedure(t: np.ndarray, Y: np.ndarray, usable: np.ndarray,
-                        sensor=LANDSAT_ARD):
+                        sensor=LANDSAT_ARD, adjusted_variogram=False):
     """Run CCDC over sorted obs.
 
     Args:
@@ -210,7 +228,8 @@ def _standard_procedure(t: np.ndarray, Y: np.ndarray, usable: np.ndarray,
         len(sensor.detection_bands))
     alive = usable.copy()
     idx_all = np.flatnonzero(usable)
-    vario = variogram(t[idx_all], Y[:, idx_all])
+    vario = variogram(t[idx_all], Y[:, idx_all],
+                      adjusted=adjusted_variogram)
     # Global design anchor: the series' first observation — shared by all
     # pixels of a chip, so the TPU kernel can precompute one design matrix.
     anchor = float(t[0]) if t.shape[0] else 0.0
@@ -352,7 +371,7 @@ def _single_model_procedure(t, Y, usable, curve_qa, sensor=LANDSAT_ARD):
 # ---------------------------------------------------------------------------
 
 def detect(dates, blues, greens, reds, nirs, swir1s, swir2s, thermals, qas,
-           **ignored) -> dict:
+           adjusted_variogram=False, **ignored) -> dict:
     """Run CCDC on one pixel's time series.
 
     Same keyword contract as pyccd's ccd.detect (driven at
@@ -360,14 +379,18 @@ def detect(dates, blues, greens, reds, nirs, swir1s, swir2s, thermals, qas,
     reference data plane delivers them newest-first); the processing mask in
     the result aligns with the *input* order, as the reference persists it
     next to the input dates (ccdc/pixel.py:14-21).
+
+    ``adjusted_variogram`` switches the change/Tmask denominator floor to
+    the reconstructed pyccd adjusted-variogram rule (docs/DIVERGENCE.md #1).
     """
     Y_in = np.stack([np.asarray(b, dtype=np.float64)
                      for b in (blues, greens, reds, nirs, swir1s, swir2s,
                                thermals)])
-    return detect_sensor(dates, Y_in, qas, LANDSAT_ARD)
+    return detect_sensor(dates, Y_in, qas, LANDSAT_ARD,
+                         adjusted_variogram=adjusted_variogram)
 
 
-def detect_sensor(dates, spectra, qas, sensor) -> dict:
+def detect_sensor(dates, spectra, qas, sensor, adjusted_variogram=False) -> dict:
     """Sensor-generic oracle: ``spectra`` is [B, T] in the sensor's band
     order.  Same algorithm and result contract as :func:`detect`; the
     sensor supplies band roles and the chi2 thresholds' degrees of
@@ -400,7 +423,8 @@ def detect_sensor(dates, spectra, qas, sensor) -> dict:
     rng_ok = in_range(Y, sensor)
     if clear_pct >= params.CLEAR_PCT_THRESHOLD:
         usable = dedup_first(t, clear & rng_ok)
-        models, mask = _standard_procedure(t, Y, usable, sensor)
+        models, mask = _standard_procedure(
+            t, Y, usable, sensor, adjusted_variogram=adjusted_variogram)
         procedure = "standard"
     elif snow_pct > params.SNOW_PCT_THRESHOLD:
         usable = dedup_first(t, (clear | snow) & rng_ok)

@@ -55,7 +55,8 @@ class SyntheticSource:
 
     def __init__(self, seed: int = 0, *, start="1995-01-01", end="2005-01-01",
                  cadence_days: int = 16, change_frac: float = 0.25,
-                 cloud_frac: float = 0.15, sensor=None):
+                 cloud_frac: float = 0.15, sensor=None, n_changes: int = 1,
+                 seasonal_gap_frac: float = 0.0):
         from firebird_tpu.ccd.sensor import LANDSAT_ARD
 
         self.seed = seed
@@ -64,6 +65,12 @@ class SyntheticSource:
         self.change_frac = change_frac
         self.cloud_frac = cloud_frac
         self.sensor = sensor or LANDSAT_ARD
+        # Break-dense / gap-dense knobs (the bench's hard rung): several
+        # well-separated step changes per affected patch, and winter
+        # acquisitions dropped with the given probability (seasonal gaps
+        # — the case pyccd's adjusted variogram exists for).
+        self.n_changes = n_changes
+        self.seasonal_gap_frac = seasonal_gap_frac
 
     def _rng(self, cx: int, cy: int, salt: int = 0) -> np.random.Generator:
         return np.random.default_rng(
@@ -94,28 +101,46 @@ class SyntheticSource:
                 0, noise_scale, size=(T, csd, csd)).astype(np.float32)
             spectra[b] = np.clip(series, -32768, 32767).astype(np.int16)
 
-        # Step change in a patch, at a chip-specific date in the middle half.
+        # Step changes in a patch, at chip-specific dates.  n_changes > 1
+        # spaces the change dates evenly through the middle of the archive
+        # (each segment must still span INIT_DAYS with MEOW_SIZE obs to
+        # re-initialize, so breaks land >= ~2 years apart for the default
+        # grids).
         if self.change_frac > 0:
             side = max(1, int(csd * np.sqrt(self.change_frac)))
             r0 = int(rng.integers(0, csd - side + 1))
             c0 = int(rng.integers(0, csd - side + 1))
-            k = int(rng.integers(T // 4, 3 * T // 4))
-            delta = rng.uniform(500, 1000)
-            # Keep shifted values inside the valid data ranges (params
-            # OPTICAL/THERMAL): a negative step would push a band whose
-            # seasonal low (mean - amplitude, minus level/noise spread)
-            # sits near delta below OPTICAL_MIN, and in_range() would then
-            # discard the whole post-change observation.
-            sign = np.where(rng.random(B) < 0.5, -1.0, 1.0)
-            seasonal_low = means - amps
-            sign = np.where(seasonal_low < delta + 300, 1.0, sign)
-            for b in range(B):
-                spectra[b, k:, r0:r0 + side, c0:c0 + side] = np.clip(
-                    spectra[b, k:, r0:r0 + side, c0:c0 + side]
-                    + np.int16(sign[b] * delta), -32768, 32767)
+            nch = max(1, int(self.n_changes))
+            lo, hi = T // 6, 5 * T // 6
+            ks = (lo + (np.arange(nch) + rng.uniform(0.2, 0.8, nch))
+                  * (hi - lo) / nch).astype(int) if nch > 1 \
+                else np.array([int(rng.integers(T // 4, 3 * T // 4))])
+            cum = np.zeros(B)
+            for k in ks:
+                delta = rng.uniform(500, 1000)
+                # Keep shifted values inside the valid data ranges (params
+                # OPTICAL/THERMAL): a negative step is only allowed when
+                # the band's seasonal low PLUS the offset accumulated by
+                # earlier changes still clears the range floor — otherwise
+                # in_range() would discard every post-change observation.
+                # (cum starts at 0, so the first change reduces to the
+                # original single-change guard.)
+                sign = np.where(rng.random(B) < 0.5, -1.0, 1.0)
+                seasonal_low = means - amps
+                sign = np.where(seasonal_low + cum < delta + 300, 1.0, sign)
+                for b in range(B):
+                    spectra[b, k:, r0:r0 + side, c0:c0 + side] = np.clip(
+                        spectra[b, k:, r0:r0 + side, c0:c0 + side]
+                        + np.int16(sign[b] * delta), -32768, 32767)
+                cum += sign * delta
 
         qas = np.full((T, csd, csd), synthetic.QA_CLEAR, np.uint16)
         cloudy = rng.random(T) < self.cloud_frac
+        if self.seasonal_gap_frac > 0:
+            doy = np.mod(t.astype(np.float64), 365.25)
+            winter = (doy < 75) | (doy > 320)
+            cloudy = cloudy | (winter
+                               & (rng.random(T) < self.seasonal_gap_frac))
         qas[cloudy] = synthetic.QA_CLOUD
 
         t, spectra, qas = _slice_acquired(t, spectra, qas, acquired)

@@ -323,7 +323,7 @@ def test_init_window_matches_init_block():
     fit = functools.partial(kernel._fit_chip, fit_pallas=False,
                             on_tpu=False)
     want = kernel._init_block(res, st, sensor=LANDSAT_ARD, W=W,
-                              fdtype=jnp.float32, fit=fit)
+                              fdtype=jnp.float32, fit=fit, f32_ok=True)
     got = pallas_ops.init_window(alive, cur_i, phase == kernel.PHASE_INIT,
                                  res["t"], X, Xt, Yt, vario, W=W,
                                  sensor=LANDSAT_ARD, interpret=True)
@@ -458,3 +458,75 @@ def test_fit_kernel_in_detect_matches_default(monkeypatch):
     np.testing.assert_array_equal(np.asarray(got.seg_meta[..., :3]),
                                   np.asarray(ref.seg_meta[..., :3]))
     np.testing.assert_array_equal(np.asarray(got.mask), np.asarray(ref.mask))
+
+
+def test_detect_mega_matches_batch_core(monkeypatch):
+    """FIREBIRD_PALLAS=mega routes the ENTIRE event loop through the
+    whole-loop kernel (one pallas_call, VMEM-resident spectra, per-block
+    while_loop) and reproduces the default XLA loop's decisions on a
+    break/spike/QA-mixed workload spanning multiple pixel blocks."""
+    from firebird_tpu.ccd import synthetic
+    from firebird_tpu.ccd.sensor import LANDSAT_ARD
+    from firebird_tpu.ccd import pallas_ops
+
+    # Force 2 pixel blocks so block-boundary/padding paths execute
+    # (production BP would be >= the whole test chip).
+    monkeypatch.setattr(pallas_ops, "mega_block_p",
+                        lambda *a, **k: 128)
+
+    rng = np.random.default_rng(31)
+    C, B, P, T = 2, 7, 200, 72
+    t = np.stack([np.sort(rng.integers(724000, 724000 + 9000, T)).astype(
+        np.float64) for _ in range(C)])
+    X = np.stack([harmonic.design_matrix(t[c], t[c, 0], params.MAX_COEFS)
+                  for c in range(C)])
+    Xt_full = np.stack([harmonic.design_matrix(t[c], t[c, 0],
+                                               params.TMASK_COEFS + 1)
+                        for c in range(C)])
+    Xt = np.concatenate([Xt_full[:, :, :1], Xt_full[:, :, 2:]], -1)
+    valid = np.ones((C, T), bool)
+    Y = (rng.integers(400, 3000, (C, 1, P, 1))
+         + rng.normal(0, 50, (C, B, P, T)))
+    # step changes on half the pixels (break + re-init path), spikes on
+    # a few (Tmask/outlier path)
+    for c in range(C):
+        for p_ in range(0, P, 2):
+            cpos = rng.integers(T // 3, 2 * T // 3)
+            Y[c, :, p_, cpos:] += rng.choice([-1.0, 1.0]) * rng.uniform(
+                400, 1200)
+        for p_ in range(0, P, 7):
+            s = rng.integers(0, T - 1)
+            Y[c, :, p_, s] += 2500
+    Y = Y.astype(np.int16)
+    qa = np.full((C, P, T), 1 << params.QA_CLEAR_BIT, np.int32)
+    # some cloudy/fill lanes -> alt procedures + padded-lane inertness
+    qa[:, P - 8:, ::2] = 1 << params.QA_CLOUD_BIT
+    qa[:, P - 3:, :] = 1 << params.QA_FILL_BIT
+
+    args = (jnp.asarray(X, jnp.float32), jnp.asarray(Xt, jnp.float32),
+            jnp.asarray(t, jnp.float32), jnp.asarray(valid),
+            jnp.asarray(Y), jnp.asarray(qa))
+
+    ref = kernel._detect_batch_core(*args, wcap=24, dtype=jnp.float32)
+    rn = np.asarray(ref.n_segments)
+
+    monkeypatch.setenv("FIREBIRD_PALLAS", "mega")
+    jax.clear_caches()
+    try:
+        got = kernel._detect_batch_core(*args, wcap=24, dtype=jnp.float32)
+        gn = np.asarray(got.n_segments)
+    finally:
+        jax.clear_caches()
+
+    # Decision-level agreement: segment counts and masks exact; the tiny
+    # tolerated fraction covers borderline init_ok flips from the Pallas
+    # Gram/CD accumulation order (same envelope as the init kernel test).
+    assert np.mean(rn != gn) <= 0.02, np.mean(rn != gn)
+    same = rn == gn
+    np.testing.assert_array_equal(
+        np.asarray(got.mask)[same], np.asarray(ref.mask)[same])
+    m_r, m_g = np.asarray(ref.seg_meta), np.asarray(got.seg_meta)
+    agree = np.isclose(m_r, m_g, atol=2e-4).all(-1).all(-1)[same].mean()
+    assert agree >= 0.98, agree
+    np.testing.assert_allclose(
+        np.asarray(got.vario), np.asarray(ref.vario), rtol=1e-6)

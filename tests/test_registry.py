@@ -19,7 +19,8 @@ from firebird_tpu.ingest import ChipmunkSource
 from firebird_tpu.ingest.registry import Registry
 from firebird_tpu.ingest.sources import ARD_UBIDS, AUX_UBIDS
 
-REF_REGISTRY = Path("/root/reference/test/data/registry_response.json")
+REF_REGISTRY = Path(__file__).parent / "data" / "recorded" \
+    / "registry_response.json"
 
 
 def _lower(ubids):

@@ -1,0 +1,133 @@
+"""Adjusted-variogram mode (docs/DIVERGENCE.md #1): the reconstructed
+lcmap-pyccd ``adjusted_variogram`` rule — successive-difference pairs
+restricted to >VARIOGRAM_GAP_DAYS apart, plain-madogram fallback —
+implemented identically in the f64 oracle (reference.variogram) and the
+batched kernel (kernel._variogram), selectable via FIREBIRD_VARIOGRAM.
+
+The reference pins lcmap-pyccd 2018.03.12 (setup.py:32) whose source is
+unreachable offline; the rule here is reconstructed from the public
+package's algorithm (the 'ncompare' dense multi-sensor correction).
+These tests pin oracle<->kernel agreement in BOTH modes and the rule's
+expected direction, so whichever mode ships, the two implementations
+cannot drift apart.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from firebird_tpu.ccd import kernel, params, synthetic
+from firebird_tpu.ccd.reference import variogram as oracle_variogram
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    monkeypatch.delenv("FIREBIRD_VARIOGRAM", raising=False)
+    monkeypatch.delenv("FIREBIRD_PALLAS", raising=False)
+
+
+def _series(seed, P=23, B=7, T=90, dup_frac=0.3):
+    """Random masked series on a dense grid with near-coincident pairs
+    (the case where adjusted != plain)."""
+    rng = np.random.default_rng(seed)
+    t = np.sort(rng.integers(726000, 726000 + 16 * T, T)).astype(np.float64)
+    dups = t[rng.random(T) < dup_frac] + rng.integers(1, 9)
+    t = np.sort(np.concatenate([t, dups]))[:T]
+    Y = rng.normal(1200, 300, (P, B, T))
+    usable = rng.random((P, T)) < 0.8
+    usable[:, :2] = True
+    return t, Y, usable
+
+
+@pytest.mark.parametrize("adjusted", [False, True])
+def test_kernel_matches_oracle_variogram(adjusted):
+    """kernel._variogram == reference.variogram per pixel (f64), both
+    modes, on dup-heavy grids where the pair selections differ."""
+    t, Y, usable = _series(11)
+    got = np.asarray(kernel._variogram(
+        jnp.asarray(Y), jnp.asarray(usable), t=jnp.asarray(t),
+        adjusted=adjusted))
+    for p in range(Y.shape[0]):
+        idx = np.flatnonzero(usable[p])
+        want = oracle_variogram(t[idx], Y[p][:, idx], adjusted=adjusted)
+        np.testing.assert_allclose(got[p], want, rtol=1e-12, atol=1e-12,
+                                   err_msg=f"pixel {p} adjusted={adjusted}")
+
+
+def test_adjusted_excludes_near_coincident_pairs():
+    """On a grid whose only small |diff| pairs are the near-coincident
+    duplicates, the adjusted variogram must exceed the plain one (the
+    rule exists to stop L7+L8-style pairs cratering the denominator)."""
+    rng = np.random.default_rng(7)
+    T = 80
+    base = np.sort(rng.integers(726000, 726000 + 16 * T, T // 2)).astype(
+        np.float64)
+    t = np.sort(np.concatenate([base, base + 2.0]))      # every obs paired
+    # seasonal-scale signal: big diffs across >30d gaps, tiny across 2d
+    Y = 1000.0 + 400.0 * np.sin(2 * np.pi * t / 365.25)
+    Y = np.tile(Y, (1, 7, 1)).reshape(1, 7, t.shape[0])
+    usable = np.ones((1, t.shape[0]), bool)
+    plain = np.asarray(kernel._variogram(
+        jnp.asarray(Y), jnp.asarray(usable), t=jnp.asarray(t),
+        adjusted=False))[0]
+    adj = np.asarray(kernel._variogram(
+        jnp.asarray(Y), jnp.asarray(usable), t=jnp.asarray(t),
+        adjusted=True))[0]
+    assert np.all(adj > plain)
+    # and the oracle agrees on the direction
+    o_plain = oracle_variogram(t, Y[0], adjusted=False)
+    o_adj = oracle_variogram(t, Y[0], adjusted=True)
+    assert np.all(o_adj > o_plain)
+
+
+def test_adjusted_fallback_when_no_wide_pairs():
+    """A burst archive (every gap < VARIOGRAM_GAP_DAYS) falls back to the
+    plain pair set in both implementations."""
+    rng = np.random.default_rng(3)
+    T = 40
+    t = np.cumsum(rng.integers(1, 20, T)).astype(np.float64) + 726000
+    assert np.all(np.diff(t) <= params.VARIOGRAM_GAP_DAYS)
+    Y = rng.normal(1500, 250, (5, 7, T))
+    usable = np.ones((5, T), bool)
+    a = np.asarray(kernel._variogram(jnp.asarray(Y), jnp.asarray(usable),
+                                     t=jnp.asarray(t), adjusted=True))
+    p = np.asarray(kernel._variogram(jnp.asarray(Y), jnp.asarray(usable),
+                                     t=jnp.asarray(t), adjusted=False))
+    np.testing.assert_array_equal(a, p)
+    np.testing.assert_allclose(
+        oracle_variogram(t, Y[0], adjusted=True),
+        oracle_variogram(t, Y[0], adjusted=False), rtol=0, atol=0)
+
+
+def test_detect_decision_parity_adjusted_mode(monkeypatch):
+    """End-to-end: FIREBIRD_VARIOGRAM=adjusted routes the kernel's
+    prologue through the adjusted rule and the detector still reproduces
+    the oracle (same mode) decision-for-decision on a dup-heavy grid."""
+    from firebird_tpu.ccd.reference import detect_sensor
+    from firebird_tpu.ccd.sensor import LANDSAT_ARD
+    from tests.test_fuzz_parity import (_assert_structural, _dates,
+                                        _fuzz_pixel, _pack_pixels,
+                                        _unwrap_chip)
+
+    rng = np.random.default_rng(55)
+    t = _dates("1996-01-01", "2003-01-01", 8, 0.1, 0.35, rng)
+    n_px = 16
+    pixels = [_fuzz_pixel(t, rng) for _ in range(n_px)]
+    p = _pack_pixels(t, [Y for Y, _ in pixels], [q for _, q in pixels])
+
+    monkeypatch.setenv("FIREBIRD_VARIOGRAM", "adjusted")
+    jax.clear_caches()     # the mode is read at trace time
+    try:
+        seg = _unwrap_chip(kernel.detect_packed(p, dtype=jnp.float64))
+    finally:
+        jax.clear_caches()  # don't leak adjusted-mode traces to other tests
+    dates = p.dates[0][: int(p.n_obs[0])]
+    T = dates.shape[0]
+    for i in range(n_px):
+        o = detect_sensor(dates, np.asarray(p.spectra[0, :, i, :T],
+                                            np.float64),
+                          p.qas[0, i, :T], LANDSAT_ARD,
+                          adjusted_variogram=True)
+        k = kernel.segments_to_records(seg, dates, i)
+        _assert_structural(o, k, i)

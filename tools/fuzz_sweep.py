@@ -97,9 +97,16 @@ def pyccd_oracle():
 
 
 def run_grid(seed: int, sensor, n_pixels: int,
-             compare_f32: bool, oracle=detect_sensor) -> int | None:
+             compare_f32: bool, oracle=detect_sensor,
+             mode_diff: bool = False) -> int | None:
     """One grid's divergence count, or None when the grid is skipped
-    (fewer than 4 surviving dates)."""
+    (fewer than 4 surviving dates).
+
+    ``mode_diff=True`` replaces the oracle assert with a plain-vs-
+    adjusted variogram decision diff of the KERNEL (docs/DIVERGENCE.md
+    #1): both modes run over the same pixels and the count of pixels
+    whose structural record changes is reported (a size-of-surface
+    measurement, not a failure)."""
     landsat = sensor.name == "landsat-ard"
     starts = LANDSAT_STARTS if landsat else RECENT_STARTS
     r = np.random.default_rng(seed)
@@ -124,6 +131,31 @@ def run_grid(seed: int, sensor, n_pixels: int,
               for i in range(n_pixels)]
     p = F._pack_pixels(t, [Y for Y, _ in pixels], [q for _, q in pixels],
                        sensor=sensor)
+    if mode_diff:
+        recs = {}
+        for mode in ("plain", "adjusted"):
+            os.environ["FIREBIRD_VARIOGRAM"] = mode
+            jax.clear_caches()          # the mode is read at trace time
+            s = F._unwrap_chip(kernel.detect_packed(p, dtype=jnp.float64))
+            d = p.dates[0][: int(p.n_obs[0])]
+            recs[mode] = [kernel.segments_to_records(s, d, i, sensor=sensor)
+                          for i in range(n_pixels)]
+        os.environ.pop("FIREBIRD_VARIOGRAM", None)
+        jax.clear_caches()
+        diffs = 0
+        for i in range(n_pixels):
+            a, b = recs["plain"][i], recs["adjusted"][i]
+            am, bm = a["change_models"], b["change_models"]
+            if (len(am) != len(bm)
+                    or a["processing_mask"] != b["processing_mask"]
+                    or any(x["break_day"] != y["break_day"]
+                           or x["start_day"] != y["start_day"]
+                           or x["end_day"] != y["end_day"]
+                           for x, y in zip(am, bm))):
+                diffs += 1
+        print(f"grid seed={seed} T={p.dates.shape[1]} mode-diff "
+              f"{diffs}/{n_pixels} pixels", flush=True)
+        return diffs
     seg = F._unwrap_chip(kernel.detect_packed(p, dtype=jnp.float64))
     s32 = (F._unwrap_chip(kernel.detect_packed(p, dtype=jnp.float32))
            if compare_f32 else None)
@@ -168,6 +200,13 @@ def main() -> int:
                     choices=("reference", "pyccd"),
                     help="reference: in-tree float64 oracle; pyccd: the "
                          "real lcmap-pyccd package (docs/DIVERGENCE.md)")
+    ap.add_argument("--variogram", default="plain",
+                    choices=("plain", "adjusted"),
+                    help="variogram rule for BOTH kernel and oracle "
+                         "(docs/DIVERGENCE.md #1)")
+    ap.add_argument("--mode-diff", action="store_true",
+                    help="no oracle: diff the kernel's plain vs adjusted "
+                         "variogram decisions and count changed pixels")
     args = ap.parse_args()
     lo, hi = (int(v) for v in args.seeds.split(":"))
     sensor = SENSORS[args.sensor]
@@ -175,17 +214,27 @@ def main() -> int:
         ap.error("--oracle pyccd supports landsat-ard only "
                  "(pyccd's detect takes the 7 fixed band keywords)")
     oracle = detect_sensor if args.oracle == "reference" else pyccd_oracle()
+    if args.variogram == "adjusted" and not args.mode_diff:
+        import functools
+
+        os.environ["FIREBIRD_VARIOGRAM"] = "adjusted"
+        if args.oracle == "reference":
+            oracle = functools.partial(detect_sensor,
+                                       adjusted_variogram=True)
     total_bad = swept = 0
     for seed in range(lo, hi):
-        bad = run_grid(seed, sensor, args.pixels, args.compare_f32, oracle)
+        bad = run_grid(seed, sensor, args.pixels, args.compare_f32, oracle,
+                       mode_diff=args.mode_diff)
         if bad is None:
             continue
         swept += 1
         total_bad += bad
-    print(f"SWEEP COMPLETE: {total_bad} divergences over {swept} grids "
+    kind = "mode-diff pixels" if args.mode_diff else "divergences"
+    print(f"SWEEP COMPLETE: {total_bad} {kind} over {swept} grids "
           f"x {args.pixels} px ({swept * args.pixels} pixels, "
-          f"sensor={sensor.name}, {hi - lo - swept} grids skipped)")
-    return 1 if total_bad else 0
+          f"sensor={sensor.name}, variogram={args.variogram}, "
+          f"{hi - lo - swept} grids skipped)")
+    return 1 if (total_bad and not args.mode_diff) else 0
 
 
 if __name__ == "__main__":

@@ -22,7 +22,10 @@ import sys
 import time
 
 X, Y = 542000, 1650000            # tile h=20 v=11
-ACQUIRED = "1998-01-01/1998-12-31"
+# Full ARD archive (VERDICT r2 #3: the r2 soak's 1-year window could not
+# initialize a model — MEOW_SIZE obs over INIT_DAYS — so every row was a
+# sentinel; this window closes real segments on every standard pixel).
+ACQUIRED = "1985-01-01/2017-12-31"
 
 
 def store_chips(pattern: str) -> int:
@@ -44,6 +47,10 @@ def main() -> int:
     n_chips = int(argv[argv.index("--chips") + 1]) if "--chips" in argv else 2500
     kill_at = float(argv[argv.index("--kill-at") + 1]) \
         if "--kill-at" in argv else 0.35
+    acquired = argv[argv.index("--acquired") + 1] \
+        if "--acquired" in argv else ACQUIRED
+    out = argv[argv.index("--out") + 1] if "--out" in argv \
+        else "docs/SOAK_r03.json"
 
     workdir = "/tmp/fb_soak"
     subprocess.run(["rm", "-rf", workdir], check=True)
@@ -58,9 +65,9 @@ def main() -> int:
                FIREBIRD_CHIPS_PER_BATCH="16",
                JAX_COMPILATION_CACHE_DIR=os.path.abspath(".cache/jax"))
     cmd = [sys.executable, "-m", "firebird_tpu.cli", "changedetection",
-           "-x", str(X), "-y", str(Y), "-a", ACQUIRED, "-n", str(n_chips)]
+           "-x", str(X), "-y", str(Y), "-a", acquired, "-n", str(n_chips)]
     pattern = f"{workdir}/soak*.db"
-    report = {"chips": n_chips, "acquired": ACQUIRED, "kill_at": kill_at}
+    report = {"chips": n_chips, "acquired": acquired, "kill_at": kill_at}
 
     # ---- phase A: run until ~kill_at, then crash it ----
     t0 = time.time()
@@ -104,12 +111,21 @@ def main() -> int:
     report["segment_rows"] = con.execute(
         "SELECT COUNT(*) FROM segment").fetchone()[0]
     report["store_mb"] = round(os.path.getsize(db) / 1e6, 1)
+    # Closed (non-sentinel) segments: sday is NULL only on sentinel rows
+    # (format.py: pixels with no model contribute one sentinel row).
+    report["closed_segment_rows"] = con.execute(
+        "SELECT COUNT(*) FROM segment WHERE sday IS NOT NULL"
+        " AND sday != ''").fetchone()[0]
     con.close()
+    pixels = n_chips * 10000
+    wall = report["phaseA_sec"] + report["phaseB_sec"]
+    report["e2e_pixels_per_sec"] = round(pixels / max(wall, 1e-9), 1)
     report["ok"] = (rc == 0 and report["segment_chips"] == n_chips
-                    and report["pixel_rows"] == n_chips * 10000)
+                    and report["pixel_rows"] == pixels
+                    and report["closed_segment_rows"] > 0)
 
     os.makedirs("docs", exist_ok=True)
-    with open("docs/SOAK_r02.json", "w") as f:
+    with open(out, "w") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report, indent=1), flush=True)
     return 0 if report["ok"] else 1

@@ -6,13 +6,18 @@
 cd /root/repo
 LOCK=/tmp/fb_tpu.lock.d
 # A killed watchdog must not leave the lock behind (future instances
-# would spin on 'sleep 60' forever); also treat a very old lock as stale.
-trap 'rmdir "$LOCK" 2>/dev/null' EXIT INT TERM
+# would spin on 'sleep 60' forever) — but only if it HOLDS the lock:
+# killing an instance that is merely waiting must not delete a lock held
+# by another process (that would defeat the mutual exclusion).  Also
+# treat a very old lock as stale.
+HAVE_LOCK=
+trap '[ -n "$HAVE_LOCK" ] && rmdir "$LOCK" 2>/dev/null' EXIT INT TERM
 while true; do
   if [ -d "$LOCK" ] && [ "$(( $(date +%s) - $(stat -c %Y "$LOCK") ))" -gt 7200 ]; then
     rmdir "$LOCK" 2>/dev/null
   fi
   if ! mkdir "$LOCK" 2>/dev/null; then sleep 60; continue; fi
+  HAVE_LOCK=1
   if timeout 240 python - <<'EOF' 2>/dev/null
 import sys, jax, jax.numpy as jnp
 d = jax.devices()[0]
@@ -32,10 +37,10 @@ EOF
     cat "$out" >> bench_tpu_new.log
     echo "$(date -Is) bench child exited rc=$rc" >> bench_tpu_new.log
     ok=$(grep -c '^{' "$out"); rm -f "$out"
-    rmdir "$LOCK"
+    HAVE_LOCK=; rmdir "$LOCK"
     if [ "$ok" -gt 0 ]; then exit 0; fi
   else
-    rmdir "$LOCK"
+    HAVE_LOCK=; rmdir "$LOCK"
   fi
   sleep 200
 done
