@@ -108,6 +108,13 @@ def measure(cpu_only: bool) -> None:
             except Exception as e:
                 rates[flag] = 0.0
                 errors[flag] = repr(e)[:160]
+            # Partial evidence on stderr after every probe: if a later
+            # variant hangs past the watchdog's kill budget (first Mosaic
+            # compile of the big kernels through the tunnel), the child's
+            # log still shows every rate measured so far.
+            print(f"[autotune] {flag}: {rates[flag]:.3f} runs/s"
+                  + (f" (error: {errors[flag]})" if flag in errors else ""),
+                  file=sys.stderr, flush=True)
             return rates[flag]
 
         # Per-component tuning: each Pallas kernel races the default

@@ -81,12 +81,15 @@ def use_pallas(component: str = "lasso") -> bool:
 def _wire_resident_only() -> bool:
     """True when every event-loop consumer of the widened float spectra
     is routed to a Pallas kernel reading the wire-dtype residents (the
-    init, score, and fit components together) — the prologue then keeps
-    the float view out of ``res`` so XLA frees it after the pre-loop
-    work.  _detect_batch_impl combines this with the f32-on-TPU gate
-    (the float64-on-TPU fallback keeps the float view resident)."""
-    return (use_pallas("init") and use_pallas("score")
-            and use_pallas("fit"))
+    init, score, and fit components together — or the whole-loop mega
+    kernel, which reads only the wire residents by construction) — the
+    prologue then keeps the float view out of ``res`` so XLA frees it
+    after the pre-loop work.  _detect_batch_impl combines this with the
+    f32-on-TPU gate (the float64-on-TPU fallback keeps the float view
+    resident)."""
+    return use_pallas("mega") or (use_pallas("init")
+                                  and use_pallas("score")
+                                  and use_pallas("fit"))
 
 
 # ---------------------------------------------------------------------------
@@ -1044,7 +1047,10 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
     change_thr, outlier_thr = chi2_thresholds(len(_DET))
     on_tpu = jax.default_backend() == "tpu"
     f32_ok = not on_tpu or fdtype == jnp.float32
-    fit_pallas = use_pallas("fit") and f32_ok
+    # mega implies the Pallas fit kernel for the prologue's one-shot
+    # fits: wire-resident mode drops the float view the XLA fit reads,
+    # and the in-loop fits use the same Gram/CD order anyway.
+    fit_pallas = (use_pallas("fit") or use_pallas("mega")) and f32_ok
     fit = functools.partial(_fit_chip, fit_pallas=fit_pallas, on_tpu=on_tpu)
     wire_only = _wire_resident_only() and f32_ok
 
