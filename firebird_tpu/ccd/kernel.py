@@ -1282,15 +1282,20 @@ def working_set_bytes(T: int, W: int | None = None,
     P, B, K = sensor.pixels, sensor.n_bands, params.MAX_COEFS
     W = W or min(T, 48)
     wire = P * B * T * 2 + P * T * 2
+    bufs = 2 * P * S * (6 + 2 * B + B * K) * dtype_bytes
     widened = 2 * P * B * T * dtype_bytes
     pt_temps = 20 * P * T * dtype_bytes
     # The [P,W,T] one-hot window tensors exist only on the XLA INIT path;
-    # the fused Pallas INIT kernel (FIREBIRD_PALLAS=init) never
-    # materializes them, so batches can size past that peak.  The kernel
+    # the fused Pallas INIT kernel (FIREBIRD_PALLAS=init) and the
+    # whole-loop mega kernel never materialize them, so batches can size
+    # past that peak.  The widened-view and temporary terms stay even for
+    # mega: the PROLOGUE (triage/variogram/alt fit) runs identically in
+    # every config and its [P,B,T]-scale float peak is the sizing
+    # constraint regardless of how lean the loop itself is.  The kernel
     # route is f32-only on TPU (Mosaic), so f64 sizing keeps the term.
-    onehot = (0 if use_pallas("init") and dtype_bytes == 4
+    onehot = (0 if (use_pallas("init") or use_pallas("mega"))
+              and dtype_bytes == 4
               else P * W * T * (1 + dtype_bytes))
-    bufs = 2 * P * S * (6 + 2 * B + B * K) * dtype_bytes
     return int(wire + widened + pt_temps + onehot + bufs)
 
 

@@ -1173,11 +1173,15 @@ def _detect_mega_block(phase0_ref, curi0_ref, nseg0_ref, alive0_ref,
               jnp.zeros((B, K, BP), f32),          # coefs
               jnp.ones((B, BP), f32),              # rmse
               meta0_ref[0], rmses0_ref[0], mags0_ref[0], coefs0_ref[0],
-              jnp.zeros((), i32),                  # rounds
-              jnp.zeros((), i32), jnp.zeros((), i32), jnp.zeros((), i32))
+              # round/gate counters as [1,1] planes, not 0-d scalars —
+              # scalar while-carries are unproven under Mosaic; tiny
+              # vectors lower like every other carry here.
+              jnp.zeros((1, 1), i32),              # rounds
+              jnp.zeros((1, 1), i32), jnp.zeros((1, 1), i32),
+              jnp.zeros((1, 1), i32))
 
     def cond(c):
-        return (c[14] < max_rounds) & jnp.any(c[0] != ph_done)
+        return (c[14][0, 0] < max_rounds) & jnp.any(c[0] != ph_done)
 
     def body(c):
         (phase, cur_i, cur_k, nlast, first_i, nseg, alive_i, inc_i,
@@ -1323,9 +1327,9 @@ def _detect_mega_block(phase0_ref, curi0_ref, nseg0_ref, alive0_ref,
         return (phase_n, cur_i_n, cur_k_n, nlast_n, as_i(first_n),
                 nseg_n, as_i(alive_n), as_i(included_n), coefs_n, rmse_n,
                 meta_n, rmses_n, mags_n, coefs_bn, rounds + 1,
-                cnt_i + jnp.where(any_init, 1, 0),
-                cnt_f + jnp.where(any_fit, 1, 0),
-                cnt_c + jnp.where(any_close, 1, 0))
+                cnt_i + jnp.where(any_init, 1, 0).astype(i32),
+                cnt_f + jnp.where(any_fit, 1, 0).astype(i32),
+                cnt_c + jnp.where(any_close, 1, 0).astype(i32))
 
     fin = lax.while_loop(cond, body, carry0)
     (_, _, _, _, _, nseg, alive_f, _, _, _, meta_b, rmses_b, mags_b,
@@ -1336,10 +1340,10 @@ def _detect_mega_block(phase0_ref, curi0_ref, nseg0_ref, alive0_ref,
     coefs_ref[0] = coefs_b
     nseg_ref[0] = nseg
     alive_ref[0] = alive_f
-    rounds_ref[0] = jnp.full((1, BP), rounds, i32)
+    rounds_ref[0] = jnp.broadcast_to(rounds, (1, BP))
     counts_ref[0] = jnp.concatenate(
-        [jnp.full((1, BP), cnt_i, i32), jnp.full((1, BP), cnt_f, i32),
-         jnp.full((1, BP), cnt_c, i32)], 0)
+        [jnp.broadcast_to(cnt_i, (1, BP)), jnp.broadcast_to(cnt_f, (1, BP)),
+         jnp.broadcast_to(cnt_c, (1, BP))], 0)
 
 
 @functools.partial(jax.jit, static_argnames=(

@@ -393,3 +393,25 @@ def test_auto_chips_per_batch_grows_with_init_kernel(monkeypatch):
     monkeypatch.setenv("FIREBIRD_PALLAS", "init")
     assert auto_chips_per_batch(cfg, acq, device=FakeDevice(16e9)) > base
     assert kernel.working_set_bytes(512, dtype_bytes=8) == base_ws64
+
+
+def test_auto_chips_per_batch_grows_with_mega(monkeypatch):
+    """The whole-loop mega kernel skips the [P,W,T] one-hot peak like the
+    init config, so f32 batch sizing grows vs the XLA path — but NOT past
+    the init config: the prologue's [P,B,T]-scale float peak runs
+    identically in every config and stays the sizing constraint."""
+    from firebird_tpu.ccd import kernel
+    from firebird_tpu.driver.core import auto_chips_per_batch
+
+    cfg = Config(chips_per_batch=0)
+    acq = "1982-01-01/2017-12-31"
+    monkeypatch.delenv("FIREBIRD_PALLAS", raising=False)
+    base = auto_chips_per_batch(cfg, acq, device=FakeDevice(16e9))
+    base_ws64 = kernel.working_set_bytes(512, dtype_bytes=8)
+    monkeypatch.setenv("FIREBIRD_PALLAS", "init")
+    with_init = auto_chips_per_batch(cfg, acq, device=FakeDevice(16e9))
+    monkeypatch.setenv("FIREBIRD_PALLAS", "mega")
+    with_mega = auto_chips_per_batch(cfg, acq, device=FakeDevice(16e9))
+    assert with_mega > base
+    assert with_mega == with_init
+    assert kernel.working_set_bytes(512, dtype_bytes=8) == base_ws64
