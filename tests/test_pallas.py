@@ -530,3 +530,63 @@ def test_detect_mega_matches_batch_core(monkeypatch):
     assert agree >= 0.98, agree
     np.testing.assert_allclose(
         np.asarray(got.vario), np.asarray(ref.vario), rtol=1e-6)
+
+
+def test_detect_mega_sentinel2_and_capacity(monkeypatch):
+    """Band-layout genericity + the overflow contract on the mega route:
+    the 12-band Sentinel-2 kernel (different detection/tmask sets, no
+    thermal) reproduces the XLA loop, and a deliberately tiny
+    max_segments still COUNTS every close (writes past capacity drop) so
+    detect_packed's capacity retry can see the overflow."""
+    from firebird_tpu.ccd import synthetic
+    from firebird_tpu.ccd.sensor import SENTINEL2
+
+    rng = np.random.default_rng(13)
+    C, P, T = 1, 96, 64
+    B = SENTINEL2.n_bands
+    t = np.stack([np.sort(rng.integers(737000, 737000 + 5500, T)).astype(
+        np.float64) for _ in range(C)])
+    X = np.stack([harmonic.design_matrix(t[c], t[c, 0], params.MAX_COEFS)
+                  for c in range(C)])
+    Xt_full = np.stack([harmonic.design_matrix(t[c], t[c, 0],
+                                               params.TMASK_COEFS + 1)
+                        for c in range(C)])
+    Xt = np.concatenate([Xt_full[:, :, :1], Xt_full[:, :, 2:]], -1)
+    valid = np.ones((C, T), bool)
+    Y = (rng.integers(400, 3000, (C, 1, P, 1))
+         + rng.normal(0, 50, (C, B, P, T)))
+    for p_ in range(0, P, 2):       # a step change on half the pixels
+        cpos = rng.integers(T // 3, 2 * T // 3)
+        Y[0, :, p_, cpos:] += rng.uniform(400, 1200)
+    Y = Y.astype(np.int16)
+    qa = np.full((C, P, T), 1 << params.QA_CLEAR_BIT, np.int32)
+
+    args = (jnp.asarray(X, jnp.float32), jnp.asarray(Xt, jnp.float32),
+            jnp.asarray(t, jnp.float32), jnp.asarray(valid),
+            jnp.asarray(Y), jnp.asarray(qa))
+    kw = dict(wcap=24, dtype=jnp.float32, sensor=SENTINEL2)
+
+    ref = kernel._detect_batch_core(*args, **kw)
+    rn = np.asarray(ref.n_segments)
+
+    monkeypatch.setenv("FIREBIRD_PALLAS", "mega")
+    jax.clear_caches()
+    try:
+        got = kernel._detect_batch_core(*args, **kw)
+        gn = np.asarray(got.n_segments)
+        # capacity 1: closes past the first must still be COUNTED even
+        # though their rows drop (the overflow-retry contract)
+        tiny = kernel._detect_batch_core(*args, max_segments=1, **kw)
+        tn = np.asarray(tiny.n_segments)
+    finally:
+        jax.clear_caches()
+
+    assert np.mean(rn != gn) <= 0.02, np.mean(rn != gn)
+    same = rn == gn
+    m_r, m_g = np.asarray(ref.seg_meta), np.asarray(got.seg_meta)
+    agree = np.isclose(m_r, m_g, atol=2e-4).all(-1).all(-1)[same].mean()
+    assert agree >= 0.98, agree
+    np.testing.assert_array_equal(tn, gn)          # counts don't saturate
+    # the one in-capacity row equals the full run's first row
+    np.testing.assert_allclose(
+        np.asarray(tiny.seg_meta)[:, :, 0], m_g[:, :, 0], atol=1e-6)
