@@ -85,6 +85,8 @@ def measure(cpu_only: bool) -> None:
         probe_args = device_args(probe, pp)
         jax.block_until_ready(probe_args)
 
+        probe_outs = {}
+
         def probe_rate(flag: str) -> float:
             _os.environ["FIREBIRD_PALLAS"] = flag
             jax.clear_caches()
@@ -95,8 +97,16 @@ def measure(cpu_only: bool) -> None:
             t0 = time.time()
             for _ in range(2):
                 # device_get: see timed_rate
-                np.asarray(f(*probe_args).n_segments)
-            return 2.0 / (time.time() - t0)
+                seg_p = f(*probe_args)
+                np.asarray(seg_p.n_segments)
+            dt = time.time() - t0
+            # Keep each config's decisions: every probe runs the same
+            # chip, so diffing against the '0' baseline afterwards is
+            # free COMPILED-mode parity evidence (the CPU tests cover
+            # interpret mode only — Mosaic is a different lowering).
+            probe_outs[flag] = (np.asarray(seg_p.n_segments),
+                               np.asarray(seg_p.seg_meta))
+            return 2.0 / dt
 
         rates = {}
 
@@ -152,9 +162,28 @@ def measure(cpu_only: bool) -> None:
         # event loop) — race it as its own config.
         safe_rate("mega")
         pick = max(rates, key=lambda k: rates[k])
+        # Compiled-mode parity: decision agreement of every raced config
+        # vs the XLA baseline on the probe chip (Mosaic lowering, real
+        # hardware — the evidence the interpret-mode CPU suite can't
+        # give).  nseg_agree is the fraction of pixels with identical
+        # segment counts; meta_agree the fraction whose 6-column rows all
+        # match to 2e-4 (the established cross-path envelope).
+        parity = {}
+        if "0" in probe_outs:
+            n0, m0 = probe_outs["0"]
+            for flag, (n1, m1) in probe_outs.items():
+                if flag == "0":
+                    continue
+                parity[flag] = {
+                    "nseg_agree": round(float((n0 == n1).mean()), 4),
+                    "meta_agree": round(float(
+                        np.isclose(m0, m1, atol=2e-4)
+                        .all(-1).all(-1).mean()), 4)}
         pallas_detail = {"pallas_autotune": {
             "runs_per_sec": {k: round(v, 3) for k, v in rates.items()},
-            "picked": pick, **({"errors": errors} if errors else {})}}
+            "picked": pick,
+            **({"probe_parity_vs_xla": parity} if parity else {}),
+            **({"errors": errors} if errors else {})}}
         _os.environ["FIREBIRD_PALLAS"] = pick
         jax.clear_caches()
 
