@@ -411,7 +411,7 @@ def measure(cpu_only: bool) -> None:
             **({} if jax.devices()[0].platform != "cpu" else
                {"note": "CPU fallback (TPU tunnel down at bench time); "
                         "last real-TPU capture: "
-                        "docs/BENCH_tpu_evidence_r02.json"}),
+                        "docs/BENCH_tpu_evidence_r03.json"}),
         },
     }
     print(json.dumps(out))
