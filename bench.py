@@ -112,7 +112,19 @@ def measure(cpu_only: bool) -> None:
 
         errors = {}
 
+        # Autotune deadline: the race is worth at most half the child's
+        # budget (FIREBIRD_BENCH_BUDGET seconds, default 45 min) — a
+        # slow-tunnel Mosaic compile must degrade to fewer raced configs,
+        # never to a killed child with no JSON line at all.
+        deadline = time.time() + 0.5 * float(
+            _os.environ.get("FIREBIRD_BENCH_BUDGET", "2700"))
+
         def safe_rate(flag: str) -> float:
+            if time.time() > deadline and rates:
+                errors[flag] = "skipped: autotune deadline"
+                print(f"[autotune] {flag}: skipped (deadline)",
+                      file=sys.stderr, flush=True)
+                return 0.0
             try:
                 rates[flag] = probe_rate(flag)
             except Exception as e:
@@ -521,6 +533,9 @@ def main() -> int:
         env.setdefault("JAX_COMPILATION_CACHE_DIR",
                        os.path.join(here, ".cache", "jax"))
         env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+        # The child halves this for its autotune deadline, so a slow
+        # tunnel degrades to fewer raced configs instead of a timeout.
+        env.setdefault("FIREBIRD_BENCH_BUDGET", str(timeout))
         if args and "--small" not in args:
             # CPU fallback: virtual 8-device mesh exercises the sharded
             # production path; the minimal --small attempt stays truly

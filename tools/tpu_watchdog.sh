@@ -32,6 +32,7 @@ EOF
     out=$(mktemp /tmp/fb_bench.XXXX.log)
     JAX_COMPILATION_CACHE_DIR=/root/repo/.cache/jax \
     JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
+    FIREBIRD_BENCH_BUDGET=5400 \
     timeout 5400 python bench.py --child > "$out" 2>&1
     rc=$?
     cat "$out" >> bench_tpu_new.log
