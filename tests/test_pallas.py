@@ -590,3 +590,35 @@ def test_detect_mega_sentinel2_and_capacity(monkeypatch):
     # the one in-capacity row equals the full run's first row
     np.testing.assert_allclose(
         np.asarray(tiny.seg_meta)[:, :, 0], m_g[:, :, 0], atol=1e-6)
+
+
+def test_mega_inside_sharded_detect(monkeypatch):
+    """The sharded production path (shard_map over the mesh) composes
+    with the whole-loop mega kernel: each shard runs its own
+    single-device pallas_call (grid over its chip shard x pixel blocks),
+    so no SPMD partitioning rule is needed.  f32: the mega route is
+    gated f32-only, so an f64 dispatch would silently fall back to the
+    XLA loop and make this test vacuous."""
+    from firebird_tpu.ingest import SyntheticSource, pack
+    from firebird_tpu.ingest.packer import PackedChips
+    from firebird_tpu.parallel import make_mesh
+    from firebird_tpu.parallel.mesh import detect_sharded
+
+    src = SyntheticSource(seed=21, start="1995-01-01", end="1998-01-01",
+                          cloud_frac=0.1)
+    p = pack([src.chip(100 + 3000 * i, 200) for i in range(2)], bucket=32)
+    p = PackedChips(cids=p.cids, dates=p.dates,
+                    spectra=p.spectra[:, :, :48, :], qas=p.qas[:, :48, :],
+                    n_obs=p.n_obs, sensor=p.sensor)
+    mesh = make_mesh(n_devices=2)
+    ref = detect_sharded(p, mesh, dtype=jnp.float32)
+    monkeypatch.setenv("FIREBIRD_PALLAS", "mega")
+    jax.clear_caches()
+    try:
+        got = detect_sharded(p, mesh, dtype=jnp.float32)
+    finally:
+        jax.clear_caches()
+    np.testing.assert_array_equal(np.asarray(got.n_segments),
+                                  np.asarray(ref.n_segments))
+    np.testing.assert_allclose(np.asarray(got.seg_meta),
+                               np.asarray(ref.seg_meta), atol=2e-4)
