@@ -199,6 +199,13 @@ def measure(cpu_only: bool) -> None:
         _os.environ["FIREBIRD_PALLAS"] = pick
         jax.clear_caches()
 
+    def _mega_fits_shape(pk, wcap_, seg_) -> bool:
+        from firebird_tpu.ccd import pallas_ops
+
+        return pallas_ops.mega_fits(
+            int(pk.spectra.shape[-1]), wcap_, pk.sensor.n_bands,
+            int(np.asarray(seg_.seg_meta).shape[-2]), 2)
+
     def timed_rate(run_fn, run_args, pixels, n_runs):
         """Steady-state pixels/sec: compile+warmup run, then timed runs.
 
@@ -266,9 +273,13 @@ def measure(cpu_only: bool) -> None:
         phase_rounds=phase_rounds,
         # Model the picked FIREBIRD_PALLAS config's actual streams (the
         # autotune sets the env before the timed run); wire int16 = 2 B.
+        # 'mega' is modeled only when this dispatch shape passes the
+        # VMEM guard — a refused mega runs the XLA loop, and modeling
+        # one-pass traffic for it would overstate the ceiling ~100x.
         pallas=frozenset(
             c for c in ("score", "init", "fit", "mega")
-            if kernel.use_pallas(c)),
+            if kernel.use_pallas(c)
+            and (c != "mega" or _mega_fits_shape(packed, wcap, seg))),
         wire_bytes=2)
 
     # ---- CPU per-pixel rate (the pyccd stand-in), extrapolated ----

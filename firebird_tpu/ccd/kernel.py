@@ -81,15 +81,15 @@ def use_pallas(component: str = "lasso") -> bool:
 def _wire_resident_only() -> bool:
     """True when every event-loop consumer of the widened float spectra
     is routed to a Pallas kernel reading the wire-dtype residents (the
-    init, score, and fit components together — or the whole-loop mega
-    kernel, which reads only the wire residents by construction) — the
-    prologue then keeps the float view out of ``res`` so XLA frees it
-    after the pre-loop work.  _detect_batch_impl combines this with the
-    f32-on-TPU gate (the float64-on-TPU fallback keeps the float view
-    resident)."""
-    return use_pallas("mega") or (use_pallas("init")
-                                  and use_pallas("score")
-                                  and use_pallas("fit"))
+    init, score, and fit components together) — the prologue then keeps
+    the float view out of ``res`` so XLA frees it after the pre-loop
+    work.  _detect_batch_impl combines this with the f32-on-TPU gate
+    (the float64-on-TPU fallback keeps the float view resident) and
+    independently with the mega route (which reads only the wire residents by
+    construction, but only when mega_fits accepts the shape — a refused
+    mega must fall back to a loop that still has its float view)."""
+    return (use_pallas("init") and use_pallas("score")
+            and use_pallas("fit"))
 
 
 # ---------------------------------------------------------------------------
@@ -1047,23 +1047,32 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
     change_thr, outlier_thr = chi2_thresholds(len(_DET))
     on_tpu = jax.default_backend() == "tpu"
     f32_ok = not on_tpu or fdtype == jnp.float32
-    # mega implies the Pallas fit kernel for the prologue's one-shot
-    # fits: wire-resident mode drops the float view the XLA fit reads,
-    # and the in-loop fits use the same Gram/CD order anyway.
-    fit_pallas = (use_pallas("fit") or use_pallas("mega")) and f32_ok
+    # The mega decision is made ONCE, up front, because it shapes the
+    # prologue: mega implies wire-resident mode (drops the float view)
+    # and the Pallas fit kernel for the one-shot alt fits — but a mega
+    # REFUSED by the VMEM guard must leave both decisions to the
+    # per-component flags, or the XLA fallback loop would read a float
+    # view the prologue never kept.
+    mega = False
+    if use_pallas("mega") and f32_ok:
+        from firebird_tpu.ccd import pallas_ops
+
+        mega = pallas_ops.mega_fits(T, W, B, S, Ys.dtype.itemsize)
+    fit_pallas = (use_pallas("fit") or mega) and f32_ok
     fit = functools.partial(_fit_chip, fit_pallas=fit_pallas, on_tpu=on_tpu)
-    wire_only = _wire_resident_only() and f32_ok
+    wire_only = (mega or _wire_resident_only()) and f32_ok
 
     res, state = jax.vmap(functools.partial(
         _prologue, sensor=sensor, S=S, fdtype=fdtype, fit=fit,
         wire_only=wire_only))(Xs, Xts, ts, valids, Ys, qas)
 
-    if use_pallas("mega") and f32_ok:
+    if mega:
         # Whole-loop mega kernel: the entire event loop in one
         # pallas_call, wire spectra VMEM-resident, each block exiting as
         # soon as its own pixels finish (pallas_ops._detect_mega_block).
-        from firebird_tpu.ccd import pallas_ops
-
+        # mega_fits guarded the 128-lane VMEM floor above: an oversized
+        # T falls down the XLA loop below instead of a Mosaic OOM.
+        # (pallas_ops is already bound in scope by the guard import.)
         out = pallas_ops.detect_mega(
             res["Yt"], state["phase"], state["cur_i"], state["alive"],
             state["nseg"], state["bufs"], res["t"], res["X"], res["Xt"],
@@ -1288,12 +1297,23 @@ def working_set_bytes(T: int, W: int | None = None,
     # The [P,W,T] one-hot window tensors exist only on the XLA INIT path;
     # the fused Pallas INIT kernel (FIREBIRD_PALLAS=init) and the
     # whole-loop mega kernel never materialize them, so batches can size
-    # past that peak.  The widened-view and temporary terms stay even for
-    # mega: the PROLOGUE (triage/variogram/alt fit) runs identically in
-    # every config and its [P,B,T]-scale float peak is the sizing
-    # constraint regardless of how lean the loop itself is.  The kernel
-    # route is f32-only on TPU (Mosaic), so f64 sizing keeps the term.
-    onehot = (0 if (use_pallas("init") or use_pallas("mega"))
+    # past that peak — but mega earns the exemption only on shapes
+    # mega_fits ACCEPTS: a refused mega falls back to the XLA init path
+    # and its one-hot peak (kernel._detect_batch_impl), which the batch
+    # sizing must then have budgeted.  The widened-view and temporary
+    # terms stay even for mega: the PROLOGUE (triage/variogram/alt fit)
+    # runs identically in every config and its [P,B,T]-scale float peak
+    # is the sizing constraint regardless of how lean the loop itself
+    # is.  The kernel route is f32-only on TPU (Mosaic), so f64 sizing
+    # keeps the term.
+    def _mega_applies() -> bool:
+        if not use_pallas("mega"):
+            return False
+        from firebird_tpu.ccd import pallas_ops
+
+        return pallas_ops.mega_fits(T, W, B, S, 2)
+
+    onehot = (0 if (use_pallas("init") or _mega_applies())
               and dtype_bytes == 4
               else P * W * T * (1 + dtype_bytes))
     return int(wire + widened + pt_temps + onehot + bufs)

@@ -1027,16 +1027,48 @@ def tmask_bad(Xtw, Y2, w, vario2, *, interpret=False):
 # Whole-loop mega kernel: the entire event-horizon loop in one pallas_call
 # ---------------------------------------------------------------------------
 
+def _mega_per_lane_bytes(T: int, W: int, B: int, S: int,
+                         y_bytes: int) -> int:
+    """Estimated VMEM bytes per lane for the mega block: the [B,T,BP]
+    wire spectra and their widened f32 twins, ~24 live [T,BP] planes
+    (state + monitor/init temporaries), the [W,BP] window/IRLS planes,
+    and the [S,*,BP] result buffers."""
+    return (max(T, 1) * (B * y_bytes + B * 4 + 24 * 4)
+            + max(W, 1) * 60 * 4
+            + max(S, 1) * (6 + 2 * B + B * 8) * 4 + 2048)
+
+
 def mega_block_p(T: int, W: int, B: int, S: int, y_bytes: int) -> int:
-    """Lane-block width for the mega kernel: the [B,T,BP] wire spectra and
-    their widened f32 twins, ~24 live [T,BP] planes (state + monitor/init
-    temporaries), the [W,BP] window/IRLS planes, and the [S,*,BP] result
-    buffers all live in VMEM for the whole event loop."""
+    """Lane-block width for the mega kernel (see _mega_per_lane_bytes)."""
     budget = 10 * 2 ** 20
-    per_lane = (max(T, 1) * (B * y_bytes + B * 4 + 24 * 4)
-                + max(W, 1) * 60 * 4
-                + max(S, 1) * (6 + 2 * B + B * 8) * 4 + 2048)
+    per_lane = _mega_per_lane_bytes(T, W, B, S, y_bytes)
     return max(128, min(512, (budget // per_lane) // 128 * 128))
+
+
+def mega_fits(T: int, W: int, B: int, S: int, y_bytes: int) -> bool:
+    """Whether the mega block fits VMEM at the minimum 128-lane width.
+
+    The lane floor is the TPU vector width — a narrower block cannot
+    exist, so when 128 lanes of PEAK working set exceed ~14 MB of the
+    ~16 MB VMEM (leaving room for the pipeline's double-buffered input
+    blocks), the mega route must be refused and the dispatch fall back
+    to the XLA loop (kernel._detect_batch_impl does this).
+
+    The peak model is TIGHTER than _mega_per_lane_bytes' width-sizing
+    budget (which deliberately over-provisions so wider blocks never
+    thrash): at any instant the block holds the wire spectra, at most
+    one widened f32 band set (the per-phase logics widen inside their
+    branches), ~12 live [T,BP] f32 planes (state + the deepest branch's
+    temporaries), the [W,BP] IRLS planes, and the result buffers.  The
+    full-archive bucketed shapes (T<=768) fit; multi-decade unbucketed
+    T~1800 archives are refused.  An estimate wrong in the tight
+    direction surfaces as a Mosaic OOM at compile, which the bench
+    autotune's safe_rate catches — the guard exists so PRODUCTION
+    dispatches never hit that path."""
+    peak_per_lane = (max(T, 1) * (B * y_bytes + B * 4 + 12 * 4)
+                     + max(W, 1) * 60 * 4
+                     + max(S, 1) * (6 + 2 * B + B * 8) * 4 + 2048)
+    return 128 * peak_per_lane <= 14 * 2 ** 20
 
 
 def _close_logic(y_of, X, t_col, coefs, rmse, alive, included_mon,
