@@ -409,15 +409,6 @@ def _dedup_first(cand, same_prev):
     return keep.T
 
 
-def _variogram_adjusted() -> bool:
-    """Whether the ADJUSTED variogram rule is active (FIREBIRD_VARIOGRAM
-    = 'adjusted'; default 'plain').  Read at trace time, like
-    use_pallas — set before the first detect call."""
-    import os
-
-    return os.environ.get("FIREBIRD_VARIOGRAM", "plain") == "adjusted"
-
-
 def _variogram(Y, usable, t=None, adjusted=False):
     """[P,B] median |successive difference| over usable obs, floor 1e-6.
 
@@ -704,7 +695,10 @@ def _prologue(X, Xt, t, valid, Y, qa, *, sensor, S, fdtype, fit,
     # ---------------- standard procedure state ----------------
     is_std = procedure == PROC_STANDARD
     alive0 = usable_std & is_std[:, None]
-    vario = _variogram(Y, alive0, t=t, adjusted=_variogram_adjusted())
+    # Mode read at trace time, like use_pallas — set FIREBIRD_VARIOGRAM
+    # before the first detect call (one compiled fn per mode).
+    vario = _variogram(Y, alive0, t=t,
+                       adjusted=params.variogram_adjusted_default())
     ex0, i0 = _first_at_or_after(alive0, jnp.zeros(P, jnp.int32))
     phase0 = jnp.where(is_std & ex0, PHASE_INIT, PHASE_DONE).astype(jnp.int32)
 

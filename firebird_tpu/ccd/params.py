@@ -121,6 +121,24 @@ VARIOGRAM_GAP_DAYS = 30.0
 TMASK_IRLS_ITERS = 5
 HUBER_K = 1.345
 
+
+def variogram_adjusted_default() -> bool:
+    """Whether the ADJUSTED variogram rule is active (FIREBIRD_VARIOGRAM;
+    default 'adjusted').
+
+    The default follows the reconstruction's own conclusion
+    (docs/DIVERGENCE.md #1): the reference pins the *ncompare* release of
+    lcmap-pyccd (setup.py:32) — the combined-L7+L8 line whose raison
+    d'être is exactly the near-coincident-pair correction the adjusted
+    rule implements — so the pinned algorithm is taken to run adjusted.
+    ``FIREBIRD_VARIOGRAM=plain`` restores the plain madogram; both modes
+    hold the full kernel<->oracle parity envelope.  Read at trace time —
+    set before the first detect call (one compiled fn per mode).
+    """
+    import os
+
+    return os.environ.get("FIREBIRD_VARIOGRAM", "adjusted") == "adjusted"
+
 # ---------------------------------------------------------------------------
 # Curve QA flags (segment provenance), pyccd-style bit values.
 # ---------------------------------------------------------------------------

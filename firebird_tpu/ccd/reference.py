@@ -211,7 +211,7 @@ def _segment_record(model: _Model, *,
 # ---------------------------------------------------------------------------
 
 def _standard_procedure(t: np.ndarray, Y: np.ndarray, usable: np.ndarray,
-                        sensor=LANDSAT_ARD, adjusted_variogram=False):
+                        sensor=LANDSAT_ARD, adjusted_variogram=None):
     """Run CCDC over sorted obs.
 
     Args:
@@ -226,6 +226,8 @@ def _standard_procedure(t: np.ndarray, Y: np.ndarray, usable: np.ndarray,
     """
     CHANGE_THRESHOLD, OUTLIER_THRESHOLD = chi2_thresholds(
         len(sensor.detection_bands))
+    if adjusted_variogram is None:
+        adjusted_variogram = params.variogram_adjusted_default()
     alive = usable.copy()
     idx_all = np.flatnonzero(usable)
     vario = variogram(t[idx_all], Y[:, idx_all],
@@ -371,7 +373,7 @@ def _single_model_procedure(t, Y, usable, curve_qa, sensor=LANDSAT_ARD):
 # ---------------------------------------------------------------------------
 
 def detect(dates, blues, greens, reds, nirs, swir1s, swir2s, thermals, qas,
-           adjusted_variogram=False, **ignored) -> dict:
+           adjusted_variogram=None, **ignored) -> dict:
     """Run CCDC on one pixel's time series.
 
     Same keyword contract as pyccd's ccd.detect (driven at
@@ -381,7 +383,10 @@ def detect(dates, blues, greens, reds, nirs, swir1s, swir2s, thermals, qas,
     next to the input dates (ccdc/pixel.py:14-21).
 
     ``adjusted_variogram`` switches the change/Tmask denominator floor to
-    the reconstructed pyccd adjusted-variogram rule (docs/DIVERGENCE.md #1).
+    the reconstructed pyccd adjusted-variogram rule (docs/DIVERGENCE.md #1);
+    ``None`` (the default) follows FIREBIRD_VARIOGRAM exactly as the kernel
+    does (params.variogram_adjusted_default), so oracle and kernel can
+    never disagree on the mode by default.
     """
     Y_in = np.stack([np.asarray(b, dtype=np.float64)
                      for b in (blues, greens, reds, nirs, swir1s, swir2s,
@@ -390,7 +395,7 @@ def detect(dates, blues, greens, reds, nirs, swir1s, swir2s, thermals, qas,
                          adjusted_variogram=adjusted_variogram)
 
 
-def detect_sensor(dates, spectra, qas, sensor, adjusted_variogram=False) -> dict:
+def detect_sensor(dates, spectra, qas, sensor, adjusted_variogram=None) -> dict:
     """Sensor-generic oracle: ``spectra`` is [B, T] in the sensor's band
     order.  Same algorithm and result contract as :func:`detect`; the
     sensor supplies band roles and the chi2 thresholds' degrees of

@@ -200,10 +200,12 @@ def main() -> int:
                     choices=("reference", "pyccd"),
                     help="reference: in-tree float64 oracle; pyccd: the "
                          "real lcmap-pyccd package (docs/DIVERGENCE.md)")
-    ap.add_argument("--variogram", default="plain",
+    ap.add_argument("--variogram", default="adjusted",
                     choices=("plain", "adjusted"),
                     help="variogram rule for BOTH kernel and oracle "
-                         "(docs/DIVERGENCE.md #1)")
+                         "(docs/DIVERGENCE.md #1; default matches the "
+                         "production default, params."
+                         "variogram_adjusted_default)")
     ap.add_argument("--mode-diff", action="store_true",
                     help="no oracle: diff the kernel's plain vs adjusted "
                          "variogram decisions and count changed pixels")
@@ -214,13 +216,17 @@ def main() -> int:
         ap.error("--oracle pyccd supports landsat-ard only "
                  "(pyccd's detect takes the 7 fixed band keywords)")
     oracle = detect_sensor if args.oracle == "reference" else pyccd_oracle()
-    if args.variogram == "adjusted" and not args.mode_diff:
+    if not args.mode_diff:
+        # Pin BOTH sides to the chosen mode explicitly — never rely on
+        # the ambient default (the kernel reads FIREBIRD_VARIOGRAM at
+        # trace time, the oracle resolves None from the same helper).
         import functools
 
-        os.environ["FIREBIRD_VARIOGRAM"] = "adjusted"
+        os.environ["FIREBIRD_VARIOGRAM"] = args.variogram
         if args.oracle == "reference":
-            oracle = functools.partial(detect_sensor,
-                                       adjusted_variogram=True)
+            oracle = functools.partial(
+                detect_sensor,
+                adjusted_variogram=args.variogram == "adjusted")
     total_bad = swept = 0
     for seed in range(lo, hi):
         bad = run_grid(seed, sensor, args.pixels, args.compare_f32, oracle,
