@@ -518,16 +518,34 @@ def test_detect_mega_matches_batch_core(monkeypatch):
     finally:
         jax.clear_caches()
 
-    # Decision-level agreement: segment counts and masks exact; the tiny
-    # tolerated fraction covers borderline init_ok flips from the Pallas
-    # Gram/CD accumulation order (same envelope as the init kernel test).
-    assert np.mean(rn != gn) <= 0.02, np.mean(rn != gn)
-    same = rn == gn
-    np.testing.assert_array_equal(
-        np.asarray(got.mask)[same], np.asarray(ref.mask)[same])
+    # DECISION-EXACT agreement, no tolerated fraction (VERDICT r3 #3):
+    # mega composes the same values-based _init_logic/_mon_scored_logic/
+    # _gram_cd_core/_close_logic blocks as the XLA loop, and measured
+    # agreement on this fixture is bit-exact across ALL seg_meta fields
+    # in both variogram modes (tools/mega_diag.py) — the old >=98%/2e-4
+    # envelope was stale conservatism from the pre-shared-logic kernel.
+    # Segment counts, processing masks, and the day-valued decisions
+    # (sday/eday/bday) plus curqa/nobs must be EQUAL on every pixel;
+    # float diagnostics (chprob col 3, rmse, mag) get a tight envelope
+    # so the pin survives a platform whose compiled accumulation order
+    # differs in the last ulp without weakening any decision.
+    np.testing.assert_array_equal(gn, rn)
+    np.testing.assert_array_equal(np.asarray(got.mask), np.asarray(ref.mask))
     m_r, m_g = np.asarray(ref.seg_meta), np.asarray(got.seg_meta)
-    agree = np.isclose(m_r, m_g, atol=2e-4).all(-1).all(-1)[same].mean()
-    assert agree >= 0.98, agree
+    np.testing.assert_array_equal(m_g[..., [0, 1, 2, 4, 5]],
+                                  m_r[..., [0, 1, 2, 4, 5]])   # days/qa/nobs
+    np.testing.assert_allclose(m_g[..., 3], m_r[..., 3], atol=1e-5)  # chprob
+    # rmse is a float diagnostic, not a decision: the two routes reduce
+    # the residual sums in different orders (measured max rel diff
+    # 1.8e-5 under conftest x64; decisions above are still exact).
+    np.testing.assert_allclose(np.asarray(got.seg_rmse),
+                               np.asarray(ref.seg_rmse), rtol=1e-4)
+    # mag is a median over scored residuals: an ulp input difference can
+    # flip WHICH element lands in the median slot when two are nearly
+    # equal, so the output jumps by the inter-element gap (measured: 7 of
+    # 28000 elements, max 5.6e-3 on noise-scale values), not an ulp.
+    np.testing.assert_allclose(np.asarray(got.seg_mag),
+                               np.asarray(ref.seg_mag), rtol=5e-3, atol=1e-2)
     np.testing.assert_allclose(
         np.asarray(got.vario), np.asarray(ref.vario), rtol=1e-6)
 

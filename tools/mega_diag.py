@@ -1,0 +1,102 @@
+"""Diagnose mega <-> XLA-loop disagreements on the break-dense fixture.
+
+Reproduces tests/test_pallas.py::test_detect_mega_matches_batch_core's
+workload, reports every pixel whose structural record differs between
+the two routes, and for each prints the per-segment day-valued decisions
+side by side — the raw material for pinning the mechanism
+(docs/DIVERGENCE.md, VERDICT r3 #3).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+
+from firebird_tpu.ccd import harmonic, kernel, params  # noqa: E402
+from firebird_tpu.ccd import pallas_ops  # noqa: E402
+
+
+def fixture():
+    rng = np.random.default_rng(31)
+    C, B, P, T = 2, 7, 200, 72
+    t = np.stack([np.sort(rng.integers(724000, 724000 + 9000, T)).astype(
+        np.float64) for _ in range(C)])
+    X = np.stack([harmonic.design_matrix(t[c], t[c, 0], params.MAX_COEFS)
+                  for c in range(C)])
+    Xt_full = np.stack([harmonic.design_matrix(t[c], t[c, 0],
+                                               params.TMASK_COEFS + 1)
+                        for c in range(C)])
+    Xt = np.concatenate([Xt_full[:, :, :1], Xt_full[:, :, 2:]], -1)
+    valid = np.ones((C, T), bool)
+    Y = (rng.integers(400, 3000, (C, 1, P, 1))
+         + rng.normal(0, 50, (C, B, P, T)))
+    for c in range(C):
+        for p_ in range(0, P, 2):
+            cpos = rng.integers(T // 3, 2 * T // 3)
+            Y[c, :, p_, cpos:] += rng.choice([-1.0, 1.0]) * rng.uniform(
+                400, 1200)
+        for p_ in range(0, P, 7):
+            s = rng.integers(0, T - 1)
+            Y[c, :, p_, s] += 2500
+    Y = Y.astype(np.int16)
+    qa = np.full((C, P, T), 1 << params.QA_CLEAR_BIT, np.int32)
+    qa[:, P - 8:, ::2] = 1 << params.QA_CLOUD_BIT
+    qa[:, P - 3:, :] = 1 << params.QA_FILL_BIT
+    return (jnp.asarray(X, jnp.float32), jnp.asarray(Xt, jnp.float32),
+            jnp.asarray(t, jnp.float32), jnp.asarray(valid),
+            jnp.asarray(Y), jnp.asarray(qa))
+
+
+def main():
+    pallas_ops.mega_block_p = lambda *a, **k: 128   # 2 pixel blocks
+    args = fixture()
+
+    os.environ.pop("FIREBIRD_PALLAS", None)
+    jax.clear_caches()
+    ref = kernel._detect_batch_core(*args, wcap=24, dtype=jnp.float32)
+    ref = jax.tree.map(np.asarray, ref)
+
+    os.environ["FIREBIRD_PALLAS"] = "mega"
+    jax.clear_caches()
+    got = kernel._detect_batch_core(*args, wcap=24, dtype=jnp.float32)
+    got = jax.tree.map(np.asarray, got)
+    os.environ.pop("FIREBIRD_PALLAS", None)
+
+    rn, gn = ref.n_segments, got.n_segments
+    C, P = rn.shape
+    print(f"n_segments disagreement: {int((rn != gn).sum())}/{C * P} pixels")
+    META = ["sday", "eday", "bday", "chprob", "curqa", "nobs"]
+    for c in range(C):
+        for p in range(P):
+            a, b = ref.seg_meta[c, p], got.seg_meta[c, p]
+            n_a, n_b = int(rn[c, p]), int(gn[c, p])
+            S = max(n_a, n_b)
+            day_diff = not np.array_equal(a[:S, [0, 1, 2]], b[:S, [0, 1, 2]])
+            mask_diff = not np.array_equal(ref.mask[c, p], got.mask[c, p])
+            if n_a != n_b or day_diff or mask_diff:
+                print(f"\npixel c={c} p={p}: n_seg xla={n_a} mega={n_b} "
+                      f"mask_diff={mask_diff} "
+                      f"mask_hamming={int((ref.mask[c, p] != got.mask[c, p]).sum())}")
+                for s in range(S):
+                    row = " ".join(
+                        f"{META[i]}: {a[s, i]:.1f}|{b[s, i]:.1f}"
+                        for i in range(6))
+                    print(f"  seg{s}: {row}")
+    # float-envelope check on agreeing rows
+    same = rn == gn
+    close = np.isclose(ref.seg_meta, got.seg_meta, atol=2e-4)
+    frac = close.all(-1).all(-1)[same].mean()
+    print(f"\nagreeing rows within 2e-4: {frac:.4f}")
+    exact = (ref.seg_meta[same] == got.seg_meta[same]).all(-1).all(-1).mean()
+    print(f"agreeing rows bit-exact meta: {exact:.4f}")
+
+
+if __name__ == "__main__":
+    main()
