@@ -173,27 +173,59 @@ def measure(cpu_only: bool) -> None:
         # (one pallas_call, wire spectra VMEM-resident for the entire
         # event loop) — race it as its own config.
         safe_rate("mega")
-        pick = max(rates, key=lambda k: rates[k])
         # Compiled-mode parity: decision agreement of every raced config
         # vs the XLA baseline on the probe chip (Mosaic lowering, real
         # hardware — the evidence the interpret-mode CPU suite can't
         # give).  nseg_agree is the fraction of pixels with identical
-        # segment counts; meta_agree the fraction whose 6-column rows all
-        # match to 2e-4 (the established cross-path envelope).
+        # segment counts; decision_agree additionally requires the
+        # day-valued/qa/nobs meta columns (0,1,2,4,5) equal on every
+        # segment row; meta_agree keeps the historical 2e-4 envelope for
+        # cross-round comparability.
         parity = {}
+        decision_exact = {}
         if "0" in probe_outs:
             n0, m0 = probe_outs["0"]
             for flag, (n1, m1) in probe_outs.items():
                 if flag == "0":
                     continue
+                dec = ((n0 == n1)
+                       & (m0[..., [0, 1, 2, 4, 5]]
+                          == m1[..., [0, 1, 2, 4, 5]]).all(-1).all(-1))
+                # Gate on the exact predicate, never the display-rounded
+                # fraction (a rounded mean hides single-pixel flips once
+                # the probe exceeds 10k pixels).
+                decision_exact[flag] = bool(dec.all())
                 parity[flag] = {
                     "nseg_agree": round(float((n0 == n1).mean()), 4),
+                    "decision_agree": round(float(dec.mean()), 4),
                     "meta_agree": round(float(
                         np.isclose(m0, m1, atol=2e-4)
                         .all(-1).all(-1).mean()), 4)}
+        # The pick is decision-gated (docs/DIVERGENCE.md, mega row): a
+        # config that flips ANY pixel's structural decisions vs the XLA
+        # baseline on real hardware is demoted — speed never buys back a
+        # broken bit-identical contract.  (CPU interpret-mode tests pin
+        # the same equality; this is the compiled-Mosaic enforcement.)
+        # Error-skipped configs are NOT "demoted" (they have no parity
+        # entry because they never ran) — they're already excluded by
+        # their 0.0 rate and recorded under errors.  If the baseline
+        # probe itself errored there is no parity evidence at all: fall
+        # back to the fastest measured config and say so, rather than
+        # pinning the bench to the one config that demonstrably failed.
+        if decision_exact:
+            eligible = [k for k in rates
+                        if k == "0" or decision_exact.get(k, False)]
+            demoted = sorted(k for k, ok in decision_exact.items() if not ok)
+        else:
+            eligible = [k for k in rates if k not in errors] or list(rates)
+            demoted = []
+        pick = max(eligible, key=lambda k: rates[k])
         pallas_detail = {"pallas_autotune": {
             "runs_per_sec": {k: round(v, 3) for k, v in rates.items()},
             "picked": pick,
+            **({"decision_demoted": demoted} if demoted else {}),
+            **({"parity_unavailable": True}
+               if not decision_exact and len(rates) > 1 else {}),
             **({"probe_parity_vs_xla": parity} if parity else {}),
             **({"errors": errors} if errors else {})}}
         _os.environ["FIREBIRD_PALLAS"] = pick
