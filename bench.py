@@ -22,6 +22,71 @@ import sys
 import time
 
 
+def autotune_parity(probe_outs):
+    """Compiled-mode parity of each raced Pallas config vs the '0' XLA
+    baseline on the probe chip (Mosaic lowering, real hardware — the
+    evidence the interpret-mode CPU suite can't give).
+
+    ``probe_outs`` maps config flag -> (n_segments [C,P], seg_meta
+    [C,P,S,6]) host arrays.  Returns ``(parity, decision_exact)``:
+    parity[flag] reports nseg_agree (fraction of pixels with identical
+    segment counts), decision_agree (additionally requiring the
+    day-valued/qa/nobs meta columns 0,1,2,4,5 equal on every segment
+    row), and meta_agree (the historical 2e-4 envelope, kept for
+    cross-round comparability).  decision_exact[flag] is the EXACT
+    all-pixels predicate — the gate must never use the display-rounded
+    fraction, which hides single-pixel flips once the probe exceeds
+    10k pixels.
+    """
+    import numpy as np
+
+    parity, decision_exact = {}, {}
+    if "0" not in probe_outs:
+        return parity, decision_exact
+    n0, m0 = probe_outs["0"]
+    for flag, (n1, m1) in probe_outs.items():
+        if flag == "0":
+            continue
+        dec = ((n0 == n1)
+               & (m0[..., [0, 1, 2, 4, 5]]
+                  == m1[..., [0, 1, 2, 4, 5]]).all(-1).all(-1))
+        decision_exact[flag] = bool(dec.all())
+        parity[flag] = {
+            "nseg_agree": round(float((n0 == n1).mean()), 4),
+            "decision_agree": round(float(dec.mean()), 4),
+            "meta_agree": round(float(
+                np.isclose(m0, m1, atol=2e-4)
+                .all(-1).all(-1).mean()), 4)}
+    return parity, decision_exact
+
+
+def autotune_pick(rates, errors, decision_exact):
+    """Decision-gated autotune pick (docs/DIVERGENCE.md, mega row): a
+    config that flips ANY pixel's structural decisions vs the XLA
+    baseline on real hardware is demoted — speed never buys back a
+    broken bit-identical contract.  (CPU interpret-mode tests pin the
+    same equality; this is the compiled-Mosaic enforcement.)
+
+    Error-skipped configs are NOT "demoted" (they have no parity entry
+    because they never ran) — in the decision-gated branch they drop out
+    simply because they have no ``decision_exact`` entry; ``errors`` is
+    consulted only in the no-parity fallback.  If the baseline probe itself errored
+    there is no parity evidence at all: fall back to the fastest
+    measured config and flag parity_unavailable, rather than pinning the
+    bench to the one config that demonstrably failed.
+
+    Returns ``(pick, demoted, parity_unavailable)``.
+    """
+    if decision_exact:
+        eligible = [k for k in rates
+                    if k == "0" or decision_exact.get(k, False)]
+        demoted = sorted(k for k, ok in decision_exact.items() if not ok)
+        return max(eligible, key=lambda k: rates[k]), demoted, False
+    eligible = [k for k in rates if k not in errors] or list(rates)
+    return (max(eligible, key=lambda k: rates[k]), [],
+            len(rates) > 1)
+
+
 def measure(cpu_only: bool) -> None:
     if cpu_only:
         import jax
@@ -144,6 +209,14 @@ def measure(cpu_only: bool) -> None:
         # a component that loses on this toolchain can't drag down the
         # ones that win (kernel.use_pallas component gating).
         base = safe_rate("0")
+        # The whole-loop mega kernel replaces every component at once
+        # (one pallas_call, wire spectra VMEM-resident for the entire
+        # event loop).  Race it FIRST after the baseline: it is the
+        # highest-upside candidate (the only round-count-independent
+        # bytes/pixel route, docs/ROOFLINE.md), and a slow-tunnel session
+        # that hits the autotune deadline must have measured it rather
+        # than spent the whole budget on per-component rungs.
+        safe_rate("mega")
         winners = [c for c in ("lasso", "monitor", "tmask", "fit", "score")
                    if safe_rate(c) > base]
         # 'init' races only together with 'fit': the fused INIT kernel's
@@ -169,63 +242,14 @@ def measure(cpu_only: bool) -> None:
         if not any(set(k.split(",")) == {"fit", "score", "init"}
                    for k in rates):
             safe_rate("fit,init,score")
-        # The whole-loop mega kernel replaces every component at once
-        # (one pallas_call, wire spectra VMEM-resident for the entire
-        # event loop) — race it as its own config.
-        safe_rate("mega")
-        # Compiled-mode parity: decision agreement of every raced config
-        # vs the XLA baseline on the probe chip (Mosaic lowering, real
-        # hardware — the evidence the interpret-mode CPU suite can't
-        # give).  nseg_agree is the fraction of pixels with identical
-        # segment counts; decision_agree additionally requires the
-        # day-valued/qa/nobs meta columns (0,1,2,4,5) equal on every
-        # segment row; meta_agree keeps the historical 2e-4 envelope for
-        # cross-round comparability.
-        parity = {}
-        decision_exact = {}
-        if "0" in probe_outs:
-            n0, m0 = probe_outs["0"]
-            for flag, (n1, m1) in probe_outs.items():
-                if flag == "0":
-                    continue
-                dec = ((n0 == n1)
-                       & (m0[..., [0, 1, 2, 4, 5]]
-                          == m1[..., [0, 1, 2, 4, 5]]).all(-1).all(-1))
-                # Gate on the exact predicate, never the display-rounded
-                # fraction (a rounded mean hides single-pixel flips once
-                # the probe exceeds 10k pixels).
-                decision_exact[flag] = bool(dec.all())
-                parity[flag] = {
-                    "nseg_agree": round(float((n0 == n1).mean()), 4),
-                    "decision_agree": round(float(dec.mean()), 4),
-                    "meta_agree": round(float(
-                        np.isclose(m0, m1, atol=2e-4)
-                        .all(-1).all(-1).mean()), 4)}
-        # The pick is decision-gated (docs/DIVERGENCE.md, mega row): a
-        # config that flips ANY pixel's structural decisions vs the XLA
-        # baseline on real hardware is demoted — speed never buys back a
-        # broken bit-identical contract.  (CPU interpret-mode tests pin
-        # the same equality; this is the compiled-Mosaic enforcement.)
-        # Error-skipped configs are NOT "demoted" (they have no parity
-        # entry because they never ran) — they're already excluded by
-        # their 0.0 rate and recorded under errors.  If the baseline
-        # probe itself errored there is no parity evidence at all: fall
-        # back to the fastest measured config and say so, rather than
-        # pinning the bench to the one config that demonstrably failed.
-        if decision_exact:
-            eligible = [k for k in rates
-                        if k == "0" or decision_exact.get(k, False)]
-            demoted = sorted(k for k, ok in decision_exact.items() if not ok)
-        else:
-            eligible = [k for k in rates if k not in errors] or list(rates)
-            demoted = []
-        pick = max(eligible, key=lambda k: rates[k])
+        parity, decision_exact = autotune_parity(probe_outs)
+        pick, demoted, parity_unavailable = autotune_pick(
+            rates, errors, decision_exact)
         pallas_detail = {"pallas_autotune": {
             "runs_per_sec": {k: round(v, 3) for k, v in rates.items()},
             "picked": pick,
             **({"decision_demoted": demoted} if demoted else {}),
-            **({"parity_unavailable": True}
-               if not decision_exact and len(rates) > 1 else {}),
+            **({"parity_unavailable": True} if parity_unavailable else {}),
             **({"probe_parity_vs_xla": parity} if parity else {}),
             **({"errors": errors} if errors else {})}}
         _os.environ["FIREBIRD_PALLAS"] = pick

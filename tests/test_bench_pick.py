@@ -1,0 +1,105 @@
+"""The autotune pick policy (bench.autotune_parity / autotune_pick) is
+decision-gated: a Pallas config that flips any pixel's structural
+decisions vs the XLA baseline is demoted regardless of speed
+(docs/DIVERGENCE.md #1 mega row; VERDICT r3 #3 enforcement side).
+
+Pure-function tests — the TPU-only autotune block in bench.measure
+composes exactly these, so the policy is provable without hardware.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import autotune_parity, autotune_pick  # noqa: E402
+
+
+def _outs(n, meta):
+    return np.asarray(n), np.asarray(meta)
+
+
+def _probe(n_pixels=4, flip_day=None, flip_nseg=None, jitter_chprob=False):
+    """Baseline-shaped probe output [1, P] / [1, P, 2, 6] with optional
+    single-pixel decision flips or a float-only chprob jitter."""
+    n = np.full((1, n_pixels), 2, np.int32)
+    meta = np.tile(np.arange(12, dtype=np.float32).reshape(1, 1, 2, 6),
+                   (1, n_pixels, 1, 1))
+    if flip_nseg is not None:
+        n = n.copy()
+        n[0, flip_nseg] = 1
+    if flip_day is not None:
+        meta = meta.copy()
+        meta[0, flip_day, 0, 2] += 1.0          # bday column
+    if jitter_chprob:
+        meta = meta.copy()
+        meta[..., 3] += 1e-5                    # col 3 is NOT decision-gated
+    return _outs(n, meta)
+
+
+def test_parity_exact_and_flips():
+    outs = {"0": _probe(), "mega": _probe(),
+            "score": _probe(flip_day=1),
+            "fit": _probe(flip_nseg=2),
+            "monitor": _probe(jitter_chprob=True)}
+    parity, exact = autotune_parity(outs)
+    assert exact == {"mega": True, "score": False, "fit": False,
+                     "monitor": True}
+    assert parity["score"]["decision_agree"] == 0.75
+    assert parity["fit"]["nseg_agree"] == 0.75
+    # chprob jitter is invisible to the decision gate but visible to the
+    # 2e-4 meta envelope only if it exceeds atol (1e-5 doesn't)
+    assert parity["monitor"]["decision_agree"] == 1.0
+    assert parity["monitor"]["meta_agree"] == 1.0
+
+
+def test_single_pixel_flip_gates_even_when_fraction_rounds_to_one():
+    """The gate must use the exact predicate: one flipped pixel in 20001
+    rounds to decision_agree == 1.0 but still demotes."""
+    outs = {"0": _probe(n_pixels=20001), "mega": _probe(n_pixels=20001,
+                                                       flip_day=7)}
+    parity, exact = autotune_parity(outs)
+    assert parity["mega"]["decision_agree"] == 1.0   # display rounds up
+    assert exact["mega"] is False                    # gate does not
+    pick, demoted, unavailable = autotune_pick(
+        {"0": 1.0, "mega": 9.9}, {}, exact)
+    assert pick == "0" and demoted == ["mega"] and not unavailable
+
+
+def test_fastest_clean_config_wins():
+    exact = {"mega": True, "score": True, "fit": False}
+    pick, demoted, unavailable = autotune_pick(
+        {"0": 1.0, "mega": 3.0, "score": 2.0, "fit": 5.0}, {}, exact)
+    assert pick == "mega"
+    assert demoted == ["fit"]
+    assert not unavailable
+
+
+def test_errored_config_excluded_but_not_demoted():
+    # 'tmask' errored: rate 0.0, no parity entry -> neither picked nor
+    # listed as a decision divergence (it never produced decisions).
+    exact = {"mega": True}
+    pick, demoted, _ = autotune_pick(
+        {"0": 1.0, "mega": 2.0, "tmask": 0.0},
+        {"tmask": "RuntimeError('Mosaic')"}, exact)
+    assert pick == "mega"
+    assert demoted == []
+
+
+def test_baseline_error_falls_back_to_fastest_measured():
+    # '0' probe errored -> no parity evidence at all; the fastest config
+    # that actually ran wins and the artifact says parity_unavailable.
+    pick, demoted, unavailable = autotune_pick(
+        {"0": 0.0, "mega": 2.0, "score": 1.0},
+        {"0": "RuntimeError('tunnel hiccup')"}, {})
+    assert pick == "mega"
+    assert demoted == []
+    assert unavailable
+
+
+def test_everything_errored_still_returns_a_pick():
+    pick, _, _ = autotune_pick(
+        {"0": 0.0}, {"0": "RuntimeError"}, {})
+    assert pick == "0"
