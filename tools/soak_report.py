@@ -1,0 +1,87 @@
+"""Snapshot the rolling soak store into docs/SOAK_r{N}.json.
+
+The full-tile soak runs as rolling `--resume` extensions of one sqlite
+store across rounds (tools/soak_tile.py documents the kill+resume
+phases; this tool records the store's current state plus the latest
+extension run's counters so each round's artifact reflects the actual
+scale reached).
+
+Usage: python tools/soak_report.py --round 4 [--store GLOB] [--log PATH]
+                                   [--note TEXT]
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sqlite3
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, required=True)
+    ap.add_argument("--store", default="/tmp/fb_soak/soak*.db")
+    ap.add_argument("--log", default="/tmp/fb_soak/phaseD.log",
+                    help="latest extension run's driver log (counters)")
+    ap.add_argument("--note", default=None)
+    ap.add_argument("--base", default=None,
+                    help="previous round's SOAK json to carry forward "
+                         "(default docs/SOAK_r{N-1}.json if present)")
+    args = ap.parse_args()
+
+    here = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+    rep = {"target_chips": 2500, "acquired": "1985-01-01/2017-12-31"}
+
+    if args.base and not os.path.exists(args.base):
+        print(f"--base {args.base} does not exist", file=sys.stderr)
+        return 1
+    base = args.base or os.path.join(here, "docs",
+                                     f"SOAK_r{args.round - 1:02d}.json")
+    if os.path.exists(base):
+        rep["previous_round"] = {"file": os.path.basename(base)}
+        try:
+            prev = json.load(open(base))
+            ext = prev.get("phaseC_extension", prev)
+            rep["previous_round"]["chips_total"] = ext.get(
+                "chips_total", prev.get("segment_chips"))
+        except (OSError, ValueError) as e:
+            rep["previous_round"]["error"] = repr(e)
+
+    dbs = sorted(glob.glob(args.store))
+    if len(dbs) != 1:
+        # Like soak_tile.py's `[db] = glob.glob(...)`: a stray backup
+        # next to the live store must be an error, not a silent pick.
+        print(f"expected exactly one store for {args.store}, found "
+              f"{dbs or 'none'}", file=sys.stderr)
+        return 1
+    from soak_tile import store_stats
+    rep.update(store_stats(dbs[0]))
+    rep["pct_of_tile"] = round(100.0 * rep["chips_total"] / 2500, 1)
+
+    if os.path.exists(args.log):
+        log = open(args.log).read()
+        m = re.findall(r"resume: \d+ chips already stored.*?\d+ to do", log)
+        if m:
+            # last one: a kill+resume within the same log must report the
+            # latest run's state, like the counters below
+            rep["extension_resume_line"] = m[-1]
+        done = re.findall(r"change-detection complete: (\{.*\})", log)
+        if done:
+            rep["extension_counters"] = done[-1]
+        prog = re.findall(r"chunk \S+ done", log)
+        if prog:
+            rep["extension_chunks_done"] = len(prog)
+    if args.note:
+        rep["note"] = args.note
+
+    out = os.path.join(here, "docs", f"SOAK_r{args.round:02d}.json")
+    with open(out, "w") as f:
+        json.dump(rep, f, indent=1)
+    print(json.dumps(rep, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

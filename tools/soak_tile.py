@@ -28,6 +28,30 @@ X, Y = 542000, 1650000            # tile h=20 v=11
 ACQUIRED = "1985-01-01/2017-12-31"
 
 
+def store_stats(db: str) -> dict:
+    """Canonical row counts + size for a soak store — the one place the
+    chip/pixel/segment/closed-segment queries live (soak_report.py reads
+    the same stats for the round artifacts)."""
+    con = sqlite3.connect(f"file:{db}?mode=ro", uri=True)
+    st = {
+        "chips_total": con.execute(
+            "SELECT COUNT(DISTINCT cx || ',' || cy) FROM segment"
+        ).fetchone()[0],
+        "pixel_rows": con.execute(
+            "SELECT COUNT(*) FROM pixel").fetchone()[0],
+        "segment_rows": con.execute(
+            "SELECT COUNT(*) FROM segment").fetchone()[0],
+        # Closed (non-sentinel) segments: sday is NULL only on sentinel
+        # rows (format.py: pixels with no model contribute one sentinel).
+        "closed_segment_rows": con.execute(
+            "SELECT COUNT(*) FROM segment WHERE sday IS NOT NULL"
+            " AND sday != ''").fetchone()[0],
+    }
+    con.close()
+    st["store_mb"] = round(os.path.getsize(db) / 1e6, 1)
+    return st
+
+
 def store_chips(pattern: str) -> int:
     dbs = glob.glob(pattern)
     if not dbs:
@@ -103,20 +127,10 @@ def main() -> int:
 
     # ---- verification ----
     [db] = glob.glob(pattern)
-    con = sqlite3.connect(f"file:{db}?mode=ro", uri=True)
-    report["segment_chips"] = con.execute(
-        "SELECT COUNT(DISTINCT cx || ',' || cy) FROM segment").fetchone()[0]
-    report["pixel_rows"] = con.execute(
-        "SELECT COUNT(*) FROM pixel").fetchone()[0]
-    report["segment_rows"] = con.execute(
-        "SELECT COUNT(*) FROM segment").fetchone()[0]
-    report["store_mb"] = round(os.path.getsize(db) / 1e6, 1)
-    # Closed (non-sentinel) segments: sday is NULL only on sentinel rows
-    # (format.py: pixels with no model contribute one sentinel row).
-    report["closed_segment_rows"] = con.execute(
-        "SELECT COUNT(*) FROM segment WHERE sday IS NOT NULL"
-        " AND sday != ''").fetchone()[0]
-    con.close()
+    st = store_stats(db)
+    report["segment_chips"] = st["chips_total"]   # historical key name
+    report.update({k: st[k] for k in ("pixel_rows", "segment_rows",
+                                      "store_mb", "closed_segment_rows")})
     pixels = n_chips * 10000
     wall = report["phaseA_sec"] + report["phaseB_sec"]
     report["e2e_pixels_per_sec"] = round(pixels / max(wall, 1e-9), 1)
