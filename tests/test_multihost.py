@@ -15,6 +15,7 @@ import socket
 import sqlite3
 import subprocess
 import sys
+import time
 
 from firebird_tpu import grid
 
@@ -27,31 +28,50 @@ def _free_port() -> int:
     return port
 
 
-def _run_children(tmp_path, tag, cmd_for, env_for, n=2, timeout=900):
+def _run_children(tmp_path, tag, cmd_for, env_for, n=2, timeout=1800):
     """Launch n child processes, wait, return their outputs.
 
     One log file per child, not pipes: draining piped children
     sequentially can deadlock if the undrained one fills its pipe buffer
     while the other waits in a distributed barrier.  Asserts exit code 0
     for every child (with its output in the failure message).
+
+    The timeout covers a COLD persistent cache: the mesh child's
+    capacity retry compiles the sharded program at several capacities,
+    and on a fresh host (or after a host change invalidates the cache —
+    XLA rejects entries whose machine features mismatch) each is a cold
+    multi-minute compile; 900s was measured to be too tight for the
+    2-process lockstep in that state (round 4).  A timeout failure
+    carries every child's log tail so the hang point is diagnosable.
     """
     procs, logs = [], []
+    timed_out = None
     try:
         for i in range(n):
             logs.append(open(tmp_path / f"{tag}{i}.log", "w+"))
             procs.append(subprocess.Popen(
                 cmd_for(i), env=env_for(i), stdout=logs[-1],
                 stderr=subprocess.STDOUT, text=True))
+        deadline = time.monotonic() + timeout   # shared, not per-child
         for p in procs:
-            p.wait(timeout=timeout)
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 1.0))
+            except subprocess.TimeoutExpired as e:
+                timed_out = e
+                break
     finally:
         for p in procs:
             p.kill()
-    outs = []
-    for f in logs:
-        f.seek(0)
-        outs.append(f.read())
-        f.close()
+        outs = []
+        for f in logs:
+            f.seek(0)
+            outs.append(f.read())
+            f.close()
+    if timed_out is not None:
+        tails = "\n".join(f"--- child {i} tail ---\n{o[-2000:]}"
+                          for i, o in enumerate(outs))
+        raise AssertionError(
+            f"children not done after {timeout}s\n{tails}") from timed_out
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-3000:]
     return outs
