@@ -56,9 +56,10 @@ def main() -> int:
         print(f"expected exactly one store for {args.store}, found "
               f"{dbs or 'none'}", file=sys.stderr)
         return 1
-    from soak_tile import store_stats
+    from soak_tile import recorded_mode, store_stats
     rep.update(store_stats(dbs[0]))
     rep["pct_of_tile"] = round(100.0 * rep["chips_total"] / 2500, 1)
+    rep["variogram"] = recorded_mode(os.path.dirname(dbs[0]))
 
     if os.path.exists(args.log):
         log = open(args.log).read()
