@@ -21,6 +21,18 @@ import subprocess
 import sys
 import time
 
+# Pinned baseline denominator (VERDICT r4 weak #5: the live-measured CPU
+# reference rate moved 34% between capture hosts, making vs_baseline
+# incomparable across rounds).  This is the canonical measured rate of
+# the per-pixel reference implementation — the NumPy oracle standing in
+# for pinned lcmap-pyccd's ccd.detect — captured in round 2 on the real
+# TPU harness host (BASELINE.md "Pinned denominator").  All vs_baseline
+# figures are computed against THIS constant; the live host's measured
+# rate is still reported alongside (cpu_ref_pixels_per_sec_per_core_live)
+# so drift stays visible without moving the yardstick.
+PINNED_CPU_REF_PIXELS_PER_SEC_PER_CORE = 4.88
+PINNED_BASELINE_2000_CORES = PINNED_CPU_REF_PIXELS_PER_SEC_PER_CORE * 2000.0
+
 
 def autotune_parity(probe_outs):
     """Compiled-mode parity of each raced Pallas config vs the '0' XLA
@@ -83,8 +95,11 @@ def autotune_pick(rates, errors, decision_exact):
         demoted = sorted(k for k, ok in decision_exact.items() if not ok)
         return max(eligible, key=lambda k: rates[k]), demoted, False
     eligible = [k for k in rates if k not in errors] or list(rates)
-    return (max(eligible, key=lambda k: rates[k]), [],
-            len(rates) > 1)
+    # parity_unavailable means the BASELINE probe produced no decisions
+    # to compare against ('0' errored) — not merely that every non-
+    # baseline config errored while the baseline itself ran and won
+    # (there the errors dict already tells the whole story).
+    return (max(eligible, key=lambda k: rates[k]), [], "0" in errors)
 
 
 def measure(cpu_only: bool) -> None:
@@ -193,8 +208,14 @@ def measure(cpu_only: bool) -> None:
             try:
                 rates[flag] = probe_rate(flag)
             except Exception as e:
+                import re as _re
                 rates[flag] = 0.0
-                errors[flag] = repr(e)[:160]
+                # Keep enough of the error to diagnose a Mosaic compile
+                # failure from the artifact alone (160 chars lost the
+                # actual error behind the remote-compile banner), minus
+                # ANSI color codes from the remote compiler's log lines.
+                errors[flag] = _re.sub(
+                    r"\x1b\[[0-9;]*m", "", repr(e))[:1200]
             # Partial evidence on stderr after every probe: if a later
             # variant hangs past the watchdog's kill budget (first Mosaic
             # compile of the big kernels through the tunnel), the child's
@@ -454,7 +475,7 @@ def measure(cpu_only: bool) -> None:
         np.asarray(model.raw_predict(Xq))
     rf_rate = Xq.shape[0] * rf_runs / (time.time() - t0)
 
-    baseline_2000_cores = cpu_rate * 2000.0
+    baseline_2000_cores = PINNED_BASELINE_2000_CORES
     out = {
         "metric": "ccdc_pixels_per_sec",
         "value": round(dev_rate, 1),
@@ -476,7 +497,9 @@ def measure(cpu_only: bool) -> None:
             "timing_sane": bool(
                 dev_rate <= 1.2 * roofline["compute_bound_pixels_per_sec"])
             if "compute_bound_pixels_per_sec" in roofline else None,
-            "cpu_ref_pixels_per_sec_per_core": round(cpu_rate, 2),
+            "cpu_ref_pixels_per_sec_per_core":
+                PINNED_CPU_REF_PIXELS_PER_SEC_PER_CORE,
+            "cpu_ref_pixels_per_sec_per_core_live": round(cpu_rate, 2),
             "baseline_2000_core_pixels_per_sec": round(baseline_2000_cores, 1),
             "mean_segments": float(np.asarray(seg.n_segments).mean()),
             **pallas_detail,

@@ -146,8 +146,14 @@ def _gram_cd_core(XT, XXT, y_of, wb, mask, *, B, K, iters, alpha):
     INIT-window kernel.
 
     XT [K,T], XXT [K*K,T] (chip-shared), ``y_of(b)`` -> [T,BP] f32 band
-    plane, wb [T,BP] 0/1 weights, mask [K,BP].  Returns (beta [B,K,BP],
-    n [1,BP]).
+    plane, wb [T,BP] 0/1 weights.  ``mask`` is either a [K,BP] runtime
+    array (per-pixel coefficient counts, the fit kernel) or a python
+    tuple of K static bools (the INIT stability fit's fixed 4-coef
+    model) — a STATIC mask must never be materialized as a constant
+    array: Mosaic's ApplyVectorLayoutPass dies on the folded
+    sublane-slice pattern ("Check failed: limits[i] <= dim(i) (4 vs.
+    1)", real-v5e remote compiler, bisected r5).  Returns
+    (beta [B,K,BP], n [1,BP]).
     """
     f32 = wb.dtype
     n = jnp.maximum(jnp.sum(wb, 0, keepdims=True), 1.0)       # [1, BP]
@@ -155,28 +161,40 @@ def _gram_cd_core(XT, XXT, y_of, wb, mask, *, B, K, iters, alpha):
     diag = jnp.maximum(
         jnp.concatenate([G[j * K + j][None] for j in range(K)], 0), 1e-12)
 
-    cs = []
-    for bb in range(B):
-        cs.append(jnp.dot(XT, y_of(bb) * wb,
-                          preferred_element_type=f32)[None] / n[None])
-    c = jnp.concatenate(cs, 0)                                # [B, K, BP]
+    cs = [jnp.dot(XT, y_of(bb) * wb, preferred_element_type=f32) / n
+          for bb in range(B)]                                 # B x [K, BP]
 
-    def one_iter(_, b):
+    # Mosaic legality (real-v5e remote compiler, r5): when this core is
+    # inlined into the INIT/mega programs, any 3D [B,K,BP] op whose
+    # lowering touches the tiled sublane (K) axis — vector.extract
+    # c[:, j], one-hot selects over K, and axis-1 reductions — dies in
+    # ApplyVectorLayoutPass ("Check failed: limits[i] <= dim(i)"; the
+    # standalone fit program happened to survive the same graph).  So
+    # the CD state lives as a python list of K 2D [B,BP] column planes:
+    # the Gauss-Seidel update reads rows via strided slices, the
+    # column write is a free trace-time list rebind, and the iteration
+    # loop is python-unrolled (no scf.for region for the pass to walk).
+    c_cols = [jnp.concatenate([cs[bb][j:j + 1] for bb in range(B)], 0)
+              for j in range(K)]                              # K x [B, BP]
+    G_rows = [[G[j * K + k:j * K + k + 1] for k in range(K)]
+              for j in range(K)]                              # [1, BP] each
+    b_cols = [jnp.zeros_like(c_cols[0]) for _ in range(K)]
+    for _ in range(iters):
         for j in range(K):
-            Gj = G[j * K:(j + 1) * K]                         # [K, BP]
-            rho = (c[:, j] - jnp.sum(Gj[None, :, :] * b, axis=1)
-                   + diag[j][None, :] * b[:, j])
+            acc = G_rows[j][0] * b_cols[0]
+            for k in range(1, K):
+                acc = acc + G_rows[j][k] * b_cols[k]
+            rho = c_cols[j] - acc + diag[j:j + 1] * b_cols[j]
             if j == 0:
-                bj = rho / diag[0][None, :]
+                bj = rho / diag[0:1]
             else:
                 bj = (jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - alpha, 0.0)
-                      / diag[j][None, :])
-            bj = jnp.where(mask[j][None, :] > 0, bj, 0.0)
-            sel = lax.broadcasted_iota(jnp.int32, (1, K, 1), 1) == j
-            b = jnp.where(sel, bj[:, None, :], b)
-        return b
-
-    return lax.fori_loop(0, iters, one_iter, jnp.zeros_like(c)), n
+                      / diag[j:j + 1])
+            b_cols[j] = jnp.where(mask[j:j + 1] > 0, bj, 0.0)
+    beta = jnp.concatenate(
+        [jnp.concatenate([b_cols[j][bb:bb + 1] for j in range(K)],
+                         0)[None] for bb in range(B)], 0)     # [B, K, BP]
+    return beta, n
 
 
 def _fit_block(x_ref, xt_ref, xxt_ref, y_ref, w_ref, mask_ref, b_ref, r_ref,
@@ -684,11 +702,16 @@ def _init_logic(alive, cur_i, in_init, t_col, X, Xtr, XTK, XXT, y_of,
     t_j = at_t(jnp.broadcast_to(t_col, alive.shape), j)
     span = t_j - t_i                                          # [1, BP]
     last_i = jnp.maximum(n_win - 1, 0)                        # [1, BP]
+    # Coefficient rows via strided slices (c4[b, c:c+1]), never the
+    # multi-index extract c4[b, c]: a vector.extract whose second index
+    # lands in the tiled sublane dim of a 3D vector crashes Mosaic's
+    # vector layout pass (Check failed: limits[i] <= dim(i), real v5e
+    # remote compiler, r5).  Same rule applies in _close_logic.
     stable = None
     for b in range(B):
         pred = None
         for c in range(K):
-            term = c4[b, c][None, :] * Xw[c]
+            term = c4[b, c:c + 1] * Xw[c]
             pred = term if pred is None else pred + term      # [W, BP]
         r_w = Yw[b] - pred
         r4 = jnp.sqrt(jnp.maximum(
@@ -697,7 +720,7 @@ def _init_logic(alive, cur_i, in_init, t_col, X, Xtr, XTK, XXT, y_of,
         r_first = r_w[0:1]
         r_last = jnp.sum(jnp.where(wi == last_i, r_w, 0.0), 0,
                          keepdims=True)
-        slope_day = c4[b, 1][None, :] / 365.25
+        slope_day = c4[b, 1:2] / 365.25
         ok_b = ((jnp.abs(slope_day * span) <= denom)
                 & (jnp.abs(r_first) <= denom)
                 & (jnp.abs(r_last) <= denom))                 # [1, BP]
@@ -1120,7 +1143,7 @@ def _close_logic(y_of, X, t_col, coefs, rmse, alive, included_mon,
         for k in range(peek):
             pred_k = None
             for c in range(K):
-                term = coefs[b, c][None, :] * xsel[k][c]
+                term = coefs[b, c:c + 1] * xsel[k][c]
                 pred_k = term if pred_k is None else pred_k + term
             rows.append(ysel[k][b] - pred_k)
         resid = jnp.concatenate(rows, 0)                       # [peek,BP]
@@ -1317,9 +1340,14 @@ def _detect_mega_block(phase0_ref, curi0_ref, nseg0_ref, alive0_ref,
         n_full = jnp.where(init_ok, i_nok, n_rf)               # [1,BP]
 
         def run_fit():
-            w_full = jnp.where(init_ok, i_wstab > 0,
-                               included_mon & is_refit)
-            wf = jnp.where(w_full, 1.0, 0.0).astype(f32)
+            # One f32 select, not a bool-valued one: select_n on i1
+            # operands lowers to an i8->i1 arith.trunci that Mosaic
+            # rejects ("Unsupported target bitwidth for truncation",
+            # seen on the real v5e remote compiler, r5).
+            wf = jnp.where(init_ok,
+                           jnp.where(i_wstab > 0, 1.0, 0.0),
+                           jnp.where(included_mon & is_refit, 1.0, 0.0)
+                           ).astype(f32)
             nc = jnp.where(
                 n_full >= K * num_obs_factor, K,
                 jnp.where(n_full >= mid_coefs * num_obs_factor,
@@ -1354,12 +1382,16 @@ def _detect_mega_block(phase0_ref, curi0_ref, nseg0_ref, alive0_ref,
                       jnp.where(is_brk, pos_ev, cur_i)))
         cur_k_n = jnp.where(init_ok, i_j + 1,
                             jnp.where(is_refit, pos_ev + 1, cur_k))
-        alive_n = jnp.where(in_init, i_alive > 0,
-                            jnp.where(in_mon, alive_mon, alive))
-        included_n = jnp.where(
-            init_ok, i_wstab > 0,
-            jnp.where(is_brk, False,
-                      jnp.where(in_mon, included_mon, included)))
+        # Logical forms, not bool-valued selects: an i1-result select_n
+        # lowers to an i8->i1 trunci Mosaic rejects (same mechanism as
+        # run_fit's wf above).
+        alive_n = ((in_init & (i_alive > 0))
+                   | (~in_init & in_mon & alive_mon)
+                   | (~in_init & ~in_mon & alive))
+        included_n = ((init_ok & (i_wstab > 0))
+                      | (~init_ok & ~is_brk
+                         & ((in_mon & included_mon)
+                            | (~in_mon & included))))
         coefs_n = jnp.where(do_fit[None], cfull, coefs)
         rmse_n = jnp.where(do_fit, rfull, rmse)
         nlast_n = jnp.where(do_fit, n_full, nlast)

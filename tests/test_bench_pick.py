@@ -99,6 +99,18 @@ def test_baseline_error_falls_back_to_fastest_measured():
     assert unavailable
 
 
+def test_baseline_ok_all_others_errored_is_not_parity_unavailable():
+    # The baseline ran and wins by default; the evidence gap is fully
+    # described by the errors dict, so parity_unavailable must NOT be
+    # set (it is reserved for 'the baseline probe itself errored').
+    pick, demoted, unavailable = autotune_pick(
+        {"0": 1.0, "mega": 0.0, "score": 0.0},
+        {"mega": "RuntimeError", "score": "RuntimeError"}, {})
+    assert pick == "0"
+    assert demoted == []
+    assert not unavailable
+
+
 def test_everything_errored_still_returns_a_pick():
     pick, _, _ = autotune_pick(
         {"0": 0.0}, {"0": "RuntimeError"}, {})

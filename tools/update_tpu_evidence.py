@@ -17,6 +17,7 @@ import sys
 HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
 sys.path.insert(0, HERE)
 
+from bench import PINNED_BASELINE_2000_CORES  # noqa: E402
 from bench import scan_tpu_captures  # noqa: E402
 
 
@@ -29,6 +30,11 @@ def main() -> int:
     if best is None:
         print("no real-TPU capture found in the logs; nothing written")
         return 1
+    # Cross-round comparability (BASELINE.md "Pinned denominator"): old
+    # captures computed vs_baseline against the live host's measured CPU
+    # rate; restate every capture against the pinned constant too.
+    best["vs_baseline_pinned"] = round(
+        best["value"] / PINNED_BASELINE_2000_CORES, 3)
     best["evidence"] = {
         "source_log": src,
         "generated_by": "tools/update_tpu_evidence.py",
