@@ -9,7 +9,7 @@ X ?= 542000
 Y ?= 1650000
 ACQUIRED ?= 1982-01-01/2017-12-31
 
-.PHONY: install test bench image db-up db-schema db-test db-down \
+.PHONY: install test bench obs-smoke image db-up db-schema db-test db-down \
         changedetection classification clean
 
 install:
@@ -20,6 +20,12 @@ test:
 
 bench:
 	python bench.py
+
+# End-to-end telemetry check: synthetic-source driver run with the span
+# tracer on, validating the emitted Chrome-trace JSON and obs_report.json
+# against the schema + stage-key contract (docs/OBSERVABILITY.md).
+obs-smoke:
+	python tools/obs_smoke.py
 
 image:
 	docker build -f deploy/Dockerfile -t firebird .

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -1313,6 +1314,30 @@ def working_set_bytes(T: int, W: int | None = None,
     return int(wire + widened + pt_temps + onehot + bufs)
 
 
+def record_first_call(key: tuple, fn):
+    """First-call capture per compiled shape (jit compiles synchronously
+    inside the first dispatch; warm-cache enqueues are sub-ms, so the
+    first-call wall time IS the trace+compile time to within noise).
+
+    Shared by the single-device (detect_packed) and sharded
+    (parallel.mesh.detect_sharded) dispatch paths.  Seen-keys live on the
+    metrics registry — run-scoped, not process-scoped — so every run's
+    obs_report records a kernel_first_call_seconds entry per shape it
+    dispatched, even when the jit cache was already warm."""
+    from firebird_tpu.obs import metrics, tracing
+
+    reg = metrics.get_registry()
+    if not reg.once(("kernel_dispatch",) + tuple(key)):
+        return fn()
+    t0 = time.perf_counter()
+    with tracing.span("first_dispatch", key=str(key)):
+        out = fn()
+    reg.histogram("kernel_first_call_seconds").observe(
+        time.perf_counter() - t0)
+    reg.counter("kernel_dispatch_shapes").inc()
+    return out
+
+
 def capacity_bound(packed) -> int:
     """An upper bound on segments any pixel of the batch can close:
     closed segments have disjoint included-observation sets of at least
@@ -1367,7 +1392,10 @@ def detect_packed(packed, dtype=jnp.float32,
             jnp.asarray(packed.spectra), jnp.asarray(packed.qas))
     kw = dict(dtype=jnp.dtype(dtype), wcap=window_cap(packed),
               sensor=getattr(packed, "sensor", LANDSAT_ARD))
-    dispatch = lambda S: _detect_batch_wire(*args, max_segments=S, **kw)
+    dispatch = lambda S: record_first_call(
+        ("single", packed.spectra.shape, str(kw["dtype"]), kw["wcap"],
+         kw["sensor"].name, S),
+        lambda: _detect_batch_wire(*args, max_segments=S, **kw))
     if not check_capacity:
         return dispatch(max(max_segments, 1))
     return capacity_retry(dispatch,

@@ -146,14 +146,14 @@ def _gram_cd_core(XT, XXT, y_of, wb, mask, *, B, K, iters, alpha):
     INIT-window kernel.
 
     XT [K,T], XXT [K*K,T] (chip-shared), ``y_of(b)`` -> [T,BP] f32 band
-    plane, wb [T,BP] 0/1 weights.  ``mask`` is either a [K,BP] runtime
-    array (per-pixel coefficient counts, the fit kernel) or a python
-    tuple of K static bools (the INIT stability fit's fixed 4-coef
-    model) — a STATIC mask must never be materialized as a constant
-    array: Mosaic's ApplyVectorLayoutPass dies on the folded
-    sublane-slice pattern ("Check failed: limits[i] <= dim(i) (4 vs.
-    1)", real-v5e remote compiler, bisected r5).  Returns
-    (beta [B,K,BP], n [1,BP]).
+    plane, wb [T,BP] 0/1 weights.  ``mask`` is always a [K,BP] runtime
+    array of allowed-coefficient 0/1 flags — per-pixel counts at the fit
+    call sites, and the INIT stability fit's fixed 4-coef model as an
+    iota-built comparison (cm4 in _init_logic).  Even a fixed model
+    must arrive that way, never as a constant-folded array literal:
+    Mosaic's ApplyVectorLayoutPass dies on the folded sublane-slice
+    pattern ("Check failed: limits[i] <= dim(i) (4 vs. 1)", real-v5e
+    remote compiler, bisected r5).  Returns (beta [B,K,BP], n [1,BP]).
     """
     f32 = wb.dtype
     n = jnp.maximum(jnp.sum(wb, 0, keepdims=True), 1.0)       # [1, BP]
@@ -425,7 +425,9 @@ def _monitor_block(s_ref, alive_ref, inc_ref, rank_ref, curk_ref, nlast_ref,
         change_thr=change_thr, outlier_thr=outlier_thr, peek=peek,
         refit_factor=refit_factor, T=T)
     for ref, val in zip(out_refs, outs):
-        ref[...] = val
+        # x64 mode promotes index arithmetic to int64; ref stores don't
+        # auto-cast in interpret mode, so land at the ref's dtype.
+        ref[...] = val.astype(ref.dtype)
 
 
 def _mon_scored_logic(yd_of, coefs_d, dden, X, alive, included, cur_k,
@@ -474,7 +476,7 @@ def _monitor_scored_block(yd_ref, coef_ref, dden_ref, x_ref, alive_ref,
         outlier_thr=outlier_thr, peek=peek, refit_factor=refit_factor,
         T=T, nb=nb)
     for ref, val in zip(out_refs, outs):
-        ref[...] = val
+        ref[...] = val.astype(ref.dtype)   # see _monitor_block
 
 
 @functools.partial(jax.jit, static_argnames=("change_thr", "outlier_thr",
@@ -763,10 +765,11 @@ def _init_window_block(alive_ref, curi_ref, inin_ref, t_ref, x_ref, xtr_ref,
     ok_ref[...] = as_i(out["init_ok"])
     bad_flag_ref[...] = as_i(out["init_bad"])
     hasadv_ref[...] = as_i(out["has_adv"])
-    inext_ref[...] = out["i_next_tm"]
-    iadv_ref[...] = out["i_adv"]
-    j_ref[...] = out["j"]
-    nok_ref[...] = out["n_ok"]
+    # index arithmetic promotes to int64 under x64: land at ref dtype
+    inext_ref[...] = out["i_next_tm"].astype(inext_ref.dtype)
+    iadv_ref[...] = out["i_adv"].astype(iadv_ref.dtype)
+    j_ref[...] = out["j"].astype(j_ref.dtype)
+    nok_ref[...] = out["n_ok"].astype(nok_ref.dtype)
     wstab_ref[...] = as_i(out["w_stab"])
     alive_out_ref[...] = as_i(out["alive_init"])
 

@@ -50,8 +50,14 @@ def entrypoint():
 @click.option("--resume", "-r", is_flag=True, default=False,
               help="skip chips whose segments are already stored (assumes "
                    "the same acquired range as the stored run)")
-def changedetection(x, y, acquired, number, chunk_size, resume):
+@click.option("--trace", "-t", default=None,
+              help="host span tracer output (Chrome-trace JSON, opens in "
+                   "Perfetto): '1' writes trace.json next to the store, a "
+                   "path writes there; overrides FIREBIRD_TRACE — see "
+                   "docs/OBSERVABILITY.md")
+def changedetection(x, y, acquired, number, chunk_size, resume, trace):
     """Run change detection for a tile and save results to the store."""
+    from firebird_tpu.config import Config
     from firebird_tpu.driver import core
     from firebird_tpu.parallel import init_distributed
 
@@ -65,6 +71,7 @@ def changedetection(x, y, acquired, number, chunk_size, resume):
         x=x, y=y,
         acquired=acquired or dates.default_acquired(),
         number=number, chunk_size=chunk_size, resume=resume,
+        cfg=Config.from_env(trace=trace) if trace is not None else None,
     )
 
 
@@ -129,16 +136,21 @@ def save(bounds, product_names, product_dates, acquired, clip):
 @click.option("--y", "-y", required=True, type=float)
 @click.option("--acquired", "-a", required=False, default=None)
 @click.option("--number", "-n", required=False, default=2500, type=int)
-def stream(x, y, acquired, number):
+@click.option("--trace", "-t", default=None,
+              help="host span tracer output (see changedetection --trace)")
+def stream(x, y, acquired, number, trace):
     """Streaming incremental change detection (no reference equivalent —
     its only mode is full reruns, ccdc/pyccd.py:171-183).  First run per
     chip bootstraps batch detection and a state checkpoint; later runs
     apply only new acquisitions and re-test change probability."""
+    from firebird_tpu.config import Config
     from firebird_tpu.driver import stream as sdrv
     from firebird_tpu.parallel import init_distributed
 
     init_distributed()
-    return sdrv.stream(x=x, y=y, acquired=acquired, number=number)
+    return sdrv.stream(
+        x=x, y=y, acquired=acquired, number=number,
+        cfg=Config.from_env(trace=trace) if trace is not None else None)
 
 
 @entrypoint.command()

@@ -93,6 +93,17 @@ class Config:
     # directory (the tracing subsystem the reference lacked, SURVEY.md §5).
     profile_dir: str = ""
 
+    # Host-side span tracer (firebird_tpu.obs.tracing): ""/"0" off; "1"
+    # writes Chrome-trace JSON next to the store; a path writes there.  This is
+    # the HOST pipeline trace (fetch/pack/dispatch/drain overlap) —
+    # complementary to profile_dir's XLA/device trace.
+    trace: str = ""
+
+    # Per-run obs_report.json (firebird_tpu.obs.report): "" auto (written
+    # next to the store for file-backed backends, skipped for 'memory');
+    # "0" never; a path always writes there.
+    obs_report: str = ""
+
     # Streaming-state checkpoint directory (driver/stream.py); empty means
     # '<store_path>.stream' next to the store.
     stream_dir: str = ""
@@ -147,6 +158,8 @@ class Config:
             writer_threads=int(e.get("FIREBIRD_WRITER_THREADS",
                                      cls.writer_threads)),
             profile_dir=e.get("FIREBIRD_PROFILE_DIR", cls.profile_dir),
+            trace=e.get("FIREBIRD_TRACE", cls.trace),
+            obs_report=e.get("FIREBIRD_OBS_REPORT", cls.obs_report),
             stream_dir=e.get("FIREBIRD_STREAM_DIR", cls.stream_dir),
         )
         kw.update(overrides)

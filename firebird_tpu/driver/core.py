@@ -29,6 +29,9 @@ from firebird_tpu.ccd import kernel
 from firebird_tpu.config import Config
 from firebird_tpu.ingest import ChipmunkSource, FileSource, SyntheticSource, pack
 from firebird_tpu.obs import Counters, logger
+from firebird_tpu.obs import metrics as obs_metrics
+from firebird_tpu.obs import report as obs_report
+from firebird_tpu.obs import tracing
 from firebird_tpu.store import AsyncWriter, open_store
 from firebird_tpu.utils import dates as dt
 from firebird_tpu.utils.fn import partition_all, take
@@ -161,6 +164,7 @@ def _with_retries(cfg: Config, log, what: str, fn):
         except Exception as e:
             if attempt == cfg.fetch_retries:
                 raise
+            obs_metrics.counter("fetch_retries").inc()
             delay = min(2.0 ** attempt, 30.0)
             log.warning("%s failed (attempt %d: %s: %s), retrying in %.0fs",
                         what, attempt + 1, type(e).__name__, e, delay)
@@ -278,27 +282,31 @@ def drain_batch(seg, packed, n_real, *, writer, counters, dtype=None,
     here through the same (sharded-aware) dispatch with the capacity
     check on — rare enough that the synchronous re-run does not matter."""
     cap = seg.seg_meta.shape[-2]                   # [.., P, S, 6] -> S
-    worst = int(np.asarray(seg.n_segments).max())
-    if worst > cap:
-        logger("pyccd").info(
-            "segment capacity %d overflowed on drain (deepest pixel "
-            "closed %d); recomputing the batch", cap, worst)
-        seg, _ = detect_batch(packed, dtype or seg.seg_meta.dtype,
-                              sharding, pad_to=pad_to,
-                              check_capacity=True,
-                              max_segments=min(2 * cap,
-                                               kernel.capacity_bound(packed)))
-    for c in range(n_real):
-        one = kernel.chip_slice(seg, c, to_host=True)
-        frames = ccdformat.chip_frames(packed, c, one)
-        cid = (int(packed.cids[c][0]), int(packed.cids[c][1]))
-        for table in ("chip", "pixel", "segment"):
-            # keyed: one chip's frames drain in order, so the segment
-            # frame lands last (the resume invariant)
-            writer.write(table, frames[table], key=cid)
-        counters.add("chips")
-        counters.add("pixels", one.n_segments.shape[0])
-        counters.add("segments", int(one.n_segments.sum()))
+    with tracing.span("drain", chips=n_real), obs_metrics.timer() as tm:
+        worst = int(np.asarray(seg.n_segments).max())
+        if worst > cap:
+            logger("pyccd").info(
+                "segment capacity %d overflowed on drain (deepest pixel "
+                "closed %d); recomputing the batch", cap, worst)
+            obs_metrics.counter("capacity_redispatches").inc()
+            seg, _ = detect_batch(packed, dtype or seg.seg_meta.dtype,
+                                  sharding, pad_to=pad_to,
+                                  check_capacity=True,
+                                  max_segments=min(
+                                      2 * cap,
+                                      kernel.capacity_bound(packed)))
+        for c in range(n_real):
+            one = kernel.chip_slice(seg, c, to_host=True)
+            frames = ccdformat.chip_frames(packed, c, one)
+            cid = (int(packed.cids[c][0]), int(packed.cids[c][1]))
+            for table in ("chip", "pixel", "segment"):
+                # keyed: one chip's frames drain in order, so the segment
+                # frame lands last (the resume invariant)
+                writer.write(table, frames[table], key=cid)
+            counters.add("chips")
+            counters.add("pixels", one.n_segments.shape[0])
+            counters.add("segments", int(one.n_segments.sum()))
+    obs_metrics.histogram("pipeline_drain_seconds").observe(tm.elapsed)
 
 
 def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
@@ -328,11 +336,19 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
             cf.ThreadPoolExecutor(max_workers=1) as drain_ex:
 
         def fetch_one(xy):
-            return _with_retries(cfg, log, f"chip ({xy[0]},{xy[1]}) fetch",
-                                 lambda: source.chip(xy[0], xy[1], acquired))
+            with obs_metrics.timer() as tm:
+                chip = _with_retries(
+                    cfg, log, f"chip ({xy[0]},{xy[1]}) fetch",
+                    lambda: source.chip(xy[0], xy[1], acquired))
+            obs_metrics.histogram("ingest_chip_seconds").observe(tm.elapsed)
+            return chip
 
         def fetch_batch(bids):
-            return list(chips_ex.map(fetch_one, bids))
+            with tracing.span("fetch", chips=len(bids)), \
+                    obs_metrics.timer() as tm:
+                chips = list(chips_ex.map(fetch_one, bids))
+            obs_metrics.histogram("pipeline_fetch_seconds").observe(tm.elapsed)
+            return chips
 
         nxt = prefetch_ex.submit(fetch_batch, batches[0]) if batches else None
         drains: list[cf.Future] = []
@@ -340,9 +356,21 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
             chips = nxt.result()
             nxt = (prefetch_ex.submit(fetch_batch, batches[i + 1])
                    if i + 1 < len(batches) else None)
-            packed = pack(chips, bucket=cfg.obs_bucket, max_obs=cfg.max_obs)
-            seg, n_real = detect_batch(packed, dtype, cfg.device_sharding,
-                                       pad_to=pad_to)
+            with tracing.span("pack", chips=len(chips)), \
+                    obs_metrics.timer() as tm:
+                packed = pack(chips, bucket=cfg.obs_bucket,
+                              max_obs=cfg.max_obs)
+            obs_metrics.histogram("pipeline_pack_seconds").observe(tm.elapsed)
+            # The dispatch span measures enqueue time, not device compute
+            # (check_capacity=False keeps it async); compute shows up as
+            # the gap before the matching drain span closes.
+            with tracing.span("dispatch", chips=packed.n_chips), \
+                    obs_metrics.timer() as tm:
+                seg, n_real = detect_batch(packed, dtype,
+                                           cfg.device_sharding,
+                                           pad_to=pad_to)
+            obs_metrics.histogram(
+                "pipeline_dispatch_seconds").observe(tm.elapsed)
             drains.append(drain_ex.submit(
                 drain_batch, seg, packed, n_real, writer=writer,
                 counters=counters, dtype=dtype,
@@ -378,6 +406,11 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
     cfg = resolve_batching(cfg, acquired)
     log = logger("change-detection")
     counters = Counters()
+    # Run-scoped telemetry: a fresh registry so the report reflects THIS
+    # run.  (The span tracer starts below, right before the try/finally
+    # that guarantees its stop — a setup failure here must not leak an
+    # active process-global tracer into later runs.)
+    obs_metrics.reset_registry()
 
     source = source or make_source(cfg)
     store = store or open_store(cfg.store_backend, cfg.store_path,
@@ -414,6 +447,7 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
     else:
         prof = contextlib.nullcontext()
 
+    tracer = tracing.start() if tracing.wants_trace(cfg.trace) else None
     done: list = []
     try:
         with prof:
@@ -428,12 +462,23 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
                 except Exception as e:
                     # Chunk-level failure isolation (core.py:115-124): log
                     # and move on; idempotent writes make the rerun cheap.
+                    obs_metrics.counter("chunk_failures").inc()
                     log.error("chunk failed (%d chips): %s", len(chunk), e)
                     traceback.print_exc()
     finally:
         writer.close()
         snap = counters.snapshot()
         log.info("change-detection complete: %s", snap)
+        if tracer is not None:
+            tracing.stop()
+        paths = obs_report.finish_run(
+            cfg, tracer=tracer, run_counters=snap,
+            run=dict(kind="changedetection", tile_h=tile["h"],
+                     tile_v=tile["v"], acquired=acquired,
+                     chips=len(cids), chunks=len(chunks),
+                     resumed=len(skipped)))
+        if paths:
+            log.info("observability artifacts: %s", paths)
 
     return tuple(skipped) + tuple(done)
 

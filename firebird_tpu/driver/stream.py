@@ -39,6 +39,9 @@ from firebird_tpu.config import Config
 from firebird_tpu.driver import core as dcore
 from firebird_tpu.ingest import pack
 from firebird_tpu.obs import logger
+from firebird_tpu.obs import metrics as obs_metrics
+from firebird_tpu.obs import report as obs_report
+from firebird_tpu.obs import tracing
 from firebird_tpu.store import AsyncWriter, open_store
 from firebird_tpu.utils import dates as dt
 from firebird_tpu.utils.fn import partition_all, take
@@ -156,6 +159,9 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
     acquired = acquired or dt.default_acquired()
     cfg = dcore.resolve_batching(cfg, acquired)
     log = logger("stream")
+    # Run-scoped telemetry, same contract as the batch driver (tracer
+    # starts below, just before the try/finally that stops it).
+    obs_metrics.reset_registry()
     source = source or dcore.make_source(cfg)
     store = store or open_store(cfg.store_backend, cfg.store_path,
                                 cfg.keyspace())
@@ -188,6 +194,7 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
     hi_iso = acquired.split("/")[1]
     boot = [c for c in cids if not os.path.exists(_state_path(sdir, c))]
     upd = [c for c in cids if os.path.exists(_state_path(sdir, c))]
+    tracer = tracing.start() if tracing.wants_trace(cfg.trace) else None
     try:
         # --- bootstrap: batched, chip axis sharded over local devices ---
         # Same two data-parallel levels as the batch driver: host_shard
@@ -200,8 +207,12 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
         with cf.ThreadPoolExecutor(
                 max_workers=max(cfg.input_parallelism, 1)) as ex:
             for bids in batches:
-                fetched = list(ex.map(lambda c: fetch_chip(c, acquired),
-                                      bids))
+                with tracing.span("fetch", chips=len(bids)), \
+                        obs_metrics.timer() as tm:
+                    fetched = list(ex.map(lambda c: fetch_chip(c, acquired),
+                                          bids))
+                obs_metrics.histogram(
+                    "pipeline_fetch_seconds").observe(tm.elapsed)
                 keep = [(cid, ch) for cid, ch in zip(bids, fetched)
                         if ch is not None]
                 for cid, ch in zip(bids, fetched):
@@ -210,28 +221,41 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                                     "skipping", cid[0], cid[1], acquired)
                 if not keep:
                     continue
-                p = pack([ch for _, ch in keep], bucket=cfg.obs_bucket,
-                         max_obs=cfg.max_obs)
-                seg, n_real = dcore.detect_batch(
-                    p, jnp.float32, cfg.device_sharding, pad_to=pad_to,
-                    check_capacity=True)
-                for c in range(n_real):
-                    cid = keep[c][0]
-                    frames = ccdformat.chip_frames(
-                        p, c, kernel.chip_slice(seg, c, to_host=True))
-                    for table in ("chip", "pixel", "segment"):
-                        writer.write(table, frames[table], key=tuple(cid))
-                    one = kernel.chip_slice(seg, c)
-                    st = incremental.StreamState.from_chip(one)
-                    sday, curqa = _tail_identity(one)
-                    T = int(p.n_obs[c])
-                    side = dict(sday=sday, curqa=curqa,
-                                anchor=np.float64(p.dates[c][0]),
-                                horizon=np.float64(p.dates[c][T - 1]))
-                    summary["bootstrapped"] += 1
-                    save_state(_state_path(sdir, cid), st, side)
-                    summary["pixels_need_batch"] += int(
-                        np.asarray(st.needs_batch).sum())
+                with tracing.span("pack", chips=len(keep)), \
+                        obs_metrics.timer() as tm:
+                    p = pack([ch for _, ch in keep], bucket=cfg.obs_bucket,
+                             max_obs=cfg.max_obs)
+                obs_metrics.histogram(
+                    "pipeline_pack_seconds").observe(tm.elapsed)
+                with tracing.span("dispatch", chips=p.n_chips), \
+                        obs_metrics.timer() as tm:
+                    seg, n_real = dcore.detect_batch(
+                        p, jnp.float32, cfg.device_sharding, pad_to=pad_to,
+                        check_capacity=True)
+                obs_metrics.histogram(
+                    "pipeline_dispatch_seconds").observe(tm.elapsed)
+                with tracing.span("drain", chips=n_real), \
+                        obs_metrics.timer() as tm:
+                    for c in range(n_real):
+                        cid = keep[c][0]
+                        frames = ccdformat.chip_frames(
+                            p, c, kernel.chip_slice(seg, c, to_host=True))
+                        for table in ("chip", "pixel", "segment"):
+                            writer.write(table, frames[table],
+                                         key=tuple(cid))
+                        one = kernel.chip_slice(seg, c)
+                        st = incremental.StreamState.from_chip(one)
+                        sday, curqa = _tail_identity(one)
+                        T = int(p.n_obs[c])
+                        side = dict(sday=sday, curqa=curqa,
+                                    anchor=np.float64(p.dates[c][0]),
+                                    horizon=np.float64(p.dates[c][T - 1]))
+                        summary["bootstrapped"] += 1
+                        save_state(_state_path(sdir, cid), st, side)
+                        summary["pixels_need_batch"] += int(
+                            np.asarray(st.needs_batch).sum())
+                obs_metrics.histogram(
+                    "pipeline_drain_seconds").observe(tm.elapsed)
 
         # --- update: apply only acquisitions past each chip's horizon ---
         for cid in upd:
@@ -239,9 +263,14 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
             st, side = load_state(path)
             horizon = float(side["horizon"])
             # fetch only the delta past the horizon — the whole point
-            # of the hot path is not re-ingesting the archive
-            p = (fetch_packed(cid, f"{dt.to_iso(int(horizon) + 1)}/{hi_iso}")
-                 if horizon < dt.to_ordinal(hi_iso) else None)
+            # of the hot path is not re-ingesting the archive (span only
+            # around a real fetch: an up-to-date chip records nothing)
+            if horizon < dt.to_ordinal(hi_iso):
+                with tracing.span("fetch", chip=tuple(cid), delta=True):
+                    p = fetch_packed(
+                        cid, f"{dt.to_iso(int(horizon) + 1)}/{hi_iso}")
+            else:
+                p = None
             if p is not None:
                 T = int(p.n_obs[0])
                 t = p.dates[0][:T].astype(np.float64)
@@ -257,15 +286,29 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                                           float(t[ti]), sensor=p.sensor)
                 if new_idx.size:
                     side = dict(side, horizon=np.float64(t[-1]))
-                    writer.write("segment", publish_frame(p, st, side),
-                                 key=tuple(cid))
+                    with tracing.span("publish", chip=tuple(cid)), \
+                            obs_metrics.timer() as tm:
+                        writer.write("segment", publish_frame(p, st, side),
+                                     key=tuple(cid))
+                        save_state(path, st, side)
+                    obs_metrics.histogram(
+                        "stream_publish_seconds").observe(tm.elapsed)
                     summary["updated"] += 1
                     summary["obs_applied"] += int(new_idx.size)
-                    save_state(path, st, side)
             summary["pixels_need_batch"] += int(
                 np.asarray(st.needs_batch).sum())
         writer.flush()
     finally:
         writer.close()
+        for k, v in summary.items():
+            obs_metrics.gauge(f"stream_{k}").set(v)
+        if tracer is not None:
+            tracing.stop()
+        paths = obs_report.finish_run(
+            cfg, tracer=tracer,
+            run=dict(kind="stream", tile_h=tile["h"], tile_v=tile["v"],
+                     acquired=acquired, chips=len(cids), **summary))
+        if paths:
+            log.info("observability artifacts: %s", paths)
     log.info("stream complete: %s", summary)
     return summary

@@ -23,6 +23,7 @@ from firebird_tpu import native
 from firebird_tpu.ccd import harmonic, params, synthetic
 from firebird_tpu.ingest.packer import CHIP_SIDE, ChipData
 from firebird_tpu.obs import logger
+from firebird_tpu.obs import metrics as obs_metrics
 from firebird_tpu.utils import dates as dt
 
 log = logger("timeseries")
@@ -419,7 +420,17 @@ class ChipmunkSource:
     def _chips(self, ubid: str, x: int, y: int, acquired: str) -> list:
         q = urllib.parse.urlencode(
             {"ubid": ubid, "x": x, "y": y, "acquired": acquired})
-        return self.http_get(f"{self.url}/chips?{q}") or []
+        with obs_metrics.timer() as tm:
+            recs = self.http_get(f"{self.url}/chips?{q}") or []
+        obs_metrics.histogram("ingest_http_seconds").observe(tm.elapsed)
+        obs_metrics.counter("ingest_http_requests").inc()
+        # Decoded payload size (base64 is 4/3 of the raster bytes) — the
+        # only honest bytes-in figure available above the socket layer,
+        # since http_get returns parsed JSON.
+        obs_metrics.counter("ingest_bytes_in").inc(
+            sum(len(r.get("data", "")) for r in recs
+                if isinstance(r, dict)) * 3 // 4)
+        return recs
 
     def _band_series(self, ubids, cx, cy, acquired, dtypes,
                      side) -> dict[int, np.ndarray]:

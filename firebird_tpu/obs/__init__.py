@@ -1,0 +1,136 @@
+"""Observability: logging, span tracing, metrics, and per-run reports.
+
+The reference logs exclusively through JVM log4j over the py4j bridge
+(ccdc/__init__.py:60-76 "the jvm is what is actually doing all the logging"),
+with per-subsystem categories configured in resources/log4j.properties:48-53
+(`ids`, `change-detection`, `random-forest-training`,
+`random-forest-classification`, `timeseries`, `pyccd`), and publishes no
+metrics at all (SURVEY.md §5).
+
+Here there is no JVM: plain Python logging with the same category names and
+an ISO8601 stderr format mirroring log4j.properties:20-24, plus the
+telemetry layer the reference lacks:
+
+- :mod:`firebird_tpu.obs.tracing` — a low-overhead span tracer
+  (``span("fetch", chip=cid)``) exporting Chrome-trace/Perfetto JSON, so a
+  tile run's fetch/pack/dispatch/drain overlap is visually inspectable
+  alongside the ``profile_dir`` XLA trace.
+- :mod:`firebird_tpu.obs.metrics` — counters, gauges, and fixed-bucket
+  latency histograms (p50/p95/p99) with Prometheus text exposition and a
+  JSON snapshot.
+- :mod:`firebird_tpu.obs.report` — the per-run ``obs_report.json`` artifact
+  (metrics snapshot + span summary) the driver and tools emit.
+
+Env vars: FIREBIRD_LOG_LEVEL / FIREBIRD_LOG_LEVELS (logging),
+FIREBIRD_TRACE (span tracer output), FIREBIRD_METRICS (0 disables metric
+recording), FIREBIRD_OBS_REPORT (report path override; 0 disables).  See
+docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+
+from firebird_tpu.obs.metrics import (Counters, Gauge, Histogram,
+                                      MetricsRegistry, counter, gauge,
+                                      get_registry, histogram,
+                                      metrics_enabled, timer)
+from firebird_tpu.obs.report import (build_report, validate_driver_artifacts,
+                                     validate_report, validate_trace,
+                                     write_report)
+from firebird_tpu.obs.tracing import Tracer, span
+
+# Per-subsystem categories, mirroring resources/log4j.properties:48-53
+# (plus the streaming driver's own category, no reference analogue).
+CATEGORIES = (
+    "ids",
+    "change-detection",
+    "random-forest-training",
+    "random-forest-classification",
+    "timeseries",
+    "pyccd",
+)
+
+_configured = False
+_lock = threading.Lock()
+
+
+def configure(level: int | None = None) -> None:
+    """Install the ISO8601 stderr handler once (idempotent).
+
+    Levels mirror the reference's per-subsystem log4j categories
+    (log4j.properties:48-53): FIREBIRD_LOG_LEVEL sets the root, and
+    FIREBIRD_LOG_LEVELS="pyccd=DEBUG,timeseries=WARNING" overrides
+    individual categories.
+    """
+    import os
+
+    global _configured
+    with _lock:
+        if _configured:
+            return
+        root = logging.getLogger("firebird")
+        if not root.handlers:      # never stack duplicate handlers
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(
+                logging.Formatter(
+                    fmt="%(asctime)s %(levelname)s %(name)s: %(message)s",
+                    datefmt="%Y-%m-%dT%H:%M:%S",
+                )
+            )
+            root.addHandler(handler)
+        if level is None:
+            level = _parse_level(os.environ.get("FIREBIRD_LOG_LEVEL", "INFO"),
+                                 logging.INFO)
+        root.setLevel(level)
+        root.propagate = False
+        for spec in os.environ.get("FIREBIRD_LOG_LEVELS", "").split(","):
+            if "=" in spec:
+                name, _, lv = spec.partition("=")
+                logging.getLogger(f"firebird.{name.strip()}").setLevel(
+                    _parse_level(lv, logging.INFO))
+        _configured = True
+
+
+def _level_names() -> dict[str, int]:
+    """Level-name map; logging.getLevelNamesMapping is 3.11+, so older
+    interpreters fall back to the stdlib's stable name set."""
+    get_map = getattr(logging, "getLevelNamesMapping", None)
+    if get_map is not None:
+        return dict(get_map())
+    return {"CRITICAL": logging.CRITICAL, "FATAL": logging.FATAL,
+            "ERROR": logging.ERROR, "WARN": logging.WARNING,
+            "WARNING": logging.WARNING, "INFO": logging.INFO,
+            "DEBUG": logging.DEBUG, "NOTSET": logging.NOTSET}
+
+
+def _parse_level(name: str, default: int) -> int:
+    """Level name -> int; log4j's TRACE maps to DEBUG; unknown names fall
+    back to the default with a stderr warning instead of silently lying
+    about (or crashing on) the requested level."""
+    n = name.strip().upper()
+    levels = _level_names()
+    levels["TRACE"] = logging.DEBUG
+    if n in levels:
+        return levels[n]
+    print(f"firebird: unknown log level {name!r}, using "
+          f"{logging.getLevelName(default)}", file=sys.stderr)
+    return default
+
+
+def logger(name: str) -> logging.Logger:
+    """Get a per-subsystem logger (replaces ccdc.logger(ctx, name))."""
+    configure()
+    return logging.getLogger(f"firebird.{name}")
+
+
+__all__ = [
+    "CATEGORIES", "configure", "logger",
+    "Counters", "Gauge", "Histogram", "MetricsRegistry", "timer",
+    "counter", "gauge", "histogram", "get_registry", "metrics_enabled",
+    "Tracer", "span",
+    "build_report", "write_report", "validate_report", "validate_trace",
+    "validate_driver_artifacts",
+]

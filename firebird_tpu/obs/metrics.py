@@ -1,0 +1,312 @@
+"""Metrics: counters, gauges, and fixed-bucket latency histograms.
+
+The reference has no metrics system at all (SURVEY.md §5 — log4j lines are
+its only signal).  This registry closes the gap with the three Prometheus
+metric kinds, a text exposition (``prometheus()``) scrapable from a file or
+pushed by an operator wrapper, and a JSON snapshot embedded in the per-run
+``obs_report.json`` artifact (firebird_tpu.obs.report).
+
+Instrumentation calls the module-level helpers (``counter("chips").inc()``,
+``histogram("store_write_seconds").observe(dt)``) against a process-global
+default registry — the pipeline stages live in different threads and
+modules, and threading a registry handle through every seam would dwarf the
+instrumentation itself.  FIREBIRD_METRICS=0 turns every recording call into
+a no-op (the acceptance bar: disabled telemetry must cost <2% throughput;
+all instrumented sites are per-batch/per-request, never per-pixel).
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+import time
+
+# Fixed latency buckets (seconds): spans sub-millisecond packs up to
+# multi-minute XLA compiles.  Fixed — not adaptive — so percentiles are
+# comparable across runs and the exposition is a stable schema.
+LATENCY_BUCKETS_SEC = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+
+def metrics_enabled() -> bool:
+    """FIREBIRD_METRICS gate: unset/1 on, 0/empty off.  Read per call so
+    tests (and the bench overhead check) can flip it without reimports."""
+    return os.environ.get("FIREBIRD_METRICS", "1") not in ("0", "")
+
+
+class Counter:
+    """Monotonic named counter."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        if not metrics_enabled():
+            return
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-written value (queue depths, capacities)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        if not metrics_enabled():
+            return
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        if not metrics_enabled():
+            return
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with interpolated percentiles.
+
+    Cumulative-bucket exposition matches Prometheus; ``quantile`` linearly
+    interpolates inside the containing bucket (the overflow bucket reports
+    the observed max — better than +Inf for a report meant to be read).
+    """
+
+    def __init__(self, name: str, buckets=LATENCY_BUCKETS_SEC):
+        self.name = name
+        self.buckets = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)   # last = overflow
+        self._sum = 0.0
+        self._count = 0
+        self._min = float("inf")
+        self._max = float("-inf")
+
+    def observe(self, v: float) -> None:
+        if not metrics_enabled():
+            return
+        v = float(v)
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            self._min = min(self._min, v)
+            self._max = max(self._max, v)
+
+    def quantile(self, q: float) -> float | None:
+        with self._lock:
+            counts, total = list(self._counts), self._count
+            lo_obs, hi_obs = self._min, self._max
+        if total == 0:
+            return None
+        target = q * total
+        seen = 0.0
+        for i, c in enumerate(counts):
+            if seen + c >= target and c > 0:
+                lo = self.buckets[i - 1] if i > 0 else min(lo_obs, self.buckets[0])
+                hi = self.buckets[i] if i < len(self.buckets) else hi_obs
+                frac = (target - seen) / c
+                est = lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+                # clamp to the observed range: bucket interpolation must
+                # not report a percentile beyond any recorded value
+                return min(max(est, lo_obs), hi_obs)
+            seen += c
+        return hi_obs
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            if self._count == 0:
+                return {"count": 0}
+            out = {"count": self._count, "sum": self._sum,
+                   "mean": self._sum / self._count,
+                   "min": self._min, "max": self._max}
+        out.update({"p50": self.quantile(0.50), "p95": self.quantile(0.95),
+                    "p99": self.quantile(0.99)})
+        return out
+
+    def cumulative_buckets(self) -> list[tuple[str, int]]:
+        """[(le_label, cumulative_count), ...] ending with '+Inf'."""
+        with self._lock:
+            counts = list(self._counts)
+        out, cum = [], 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            out.append((format(b, "g"), cum))
+        out.append(("+Inf", cum + counts[-1]))
+        return out
+
+
+def _prom_name(name: str) -> str:
+    import re
+
+    return "firebird_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+class MetricsRegistry:
+    """Named metric registry: get-or-create accessors, Prometheus text
+    exposition, and a JSON-ready snapshot."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+        self._once: set = set()
+        self._t0 = time.monotonic()
+
+    def once(self, key) -> bool:
+        """True exactly the first time ``key`` is seen on this registry —
+        first-call capture (e.g. per-shape kernel compile time) scoped to
+        the registry's lifetime, so every run's report records its own."""
+        with self._lock:
+            if key in self._once:
+                return False
+            self._once.add(key)
+            return True
+
+    def _get(self, store: dict, name: str, factory):
+        with self._lock:
+            m = store.get(name)
+            if m is None:
+                m = store[name] = factory(name)
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(self._counters, name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(self._gauges, name, Gauge)
+
+    def histogram(self, name: str,
+                  buckets=LATENCY_BUCKETS_SEC) -> Histogram:
+        return self._get(self._histograms, name,
+                         lambda n: Histogram(n, buckets))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._histograms)
+        return {
+            "elapsed_sec": time.monotonic() - self._t0,
+            "counters": {n: c.value for n, c in sorted(counters.items())},
+            "gauges": {n: g.value for n, g in sorted(gauges.items())},
+            "histograms": {n: h.snapshot() for n, h in sorted(hists.items())},
+        }
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            hists = sorted(self._histograms.items())
+        lines = []
+        for name, c in counters:
+            p = _prom_name(name)
+            if not p.endswith("_total"):
+                p += "_total"
+            lines += [f"# TYPE {p} counter", f"{p} {c.value}"]
+        for name, g in gauges:
+            p = _prom_name(name)
+            lines += [f"# TYPE {p} gauge", f"{p} {format(g.value, 'g')}"]
+        for name, h in hists:
+            p = _prom_name(name)
+            lines.append(f"# TYPE {p} histogram")
+            for le, cum in h.cumulative_buckets():
+                lines.append(f'{p}_bucket{{le="{le}"}} {cum}')
+            snap = h.snapshot()
+            lines.append(f"{p}_sum {format(snap.get('sum', 0.0), 'g')}")
+            lines.append(f"{p}_count {snap['count']}")
+        return "\n".join(lines) + "\n"
+
+
+_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _registry
+
+
+def reset_registry() -> MetricsRegistry:
+    """Swap in a fresh default registry (test isolation; a run-scoped
+    report should not carry a previous run's latencies)."""
+    global _registry
+    _registry = MetricsRegistry()
+    return _registry
+
+
+def counter(name: str) -> Counter:
+    return _registry.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _registry.gauge(name)
+
+
+def histogram(name: str, buckets=LATENCY_BUCKETS_SEC) -> Histogram:
+    return _registry.histogram(name, buckets)
+
+
+class Counters:
+    """Thread-safe run-scoped throughput counters (the original flat
+    counter set; the driver logs its snapshot at run end).  Typical keys:
+    chips, pixels, segments, bytes_in, bytes_out."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+        self._t0 = time.monotonic()
+
+    def add(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + n
+
+    def get(self, key: str) -> int:
+        with self._lock:
+            return self._counts.get(key, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            elapsed = time.monotonic() - self._t0
+            out = dict(self._counts)
+        out["elapsed_sec"] = elapsed
+        for k in list(out):
+            if k != "elapsed_sec" and elapsed > 0:
+                out[f"{k}_per_sec"] = out[k] / elapsed
+        return out
+
+
+class timer:
+    """Context manager measuring wall time in seconds (``.elapsed``)."""
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        self.elapsed = 0.0
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.monotonic() - self._t0
+        return False

@@ -1,0 +1,167 @@
+"""Per-run report artifact: obs_report.json build, write, and validation.
+
+One JSON document per pipeline run — metrics snapshot (counters, gauges,
+latency histograms), span summary table, and run identity — written next
+to the results store so soak/bench tooling can fold it into round
+artifacts (tools/soak_report.py, bench.py) and operators can diff runs
+without scraping logs.  ``validate_report``/``validate_trace`` are the
+shared schema checks used by ``make obs-smoke`` and the test suite.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+
+SCHEMA = "firebird-obs-report/1"
+
+# Stage keys a driver run is expected to populate (the obs-smoke contract):
+# ingest, kernel, and store latencies.  Kept here — not in the smoke tool —
+# so the driver tests and the Makefile target assert the same contract.
+DRIVER_STAGE_HISTOGRAMS = (
+    "ingest_chip_seconds",
+    "pipeline_fetch_seconds",
+    "pipeline_pack_seconds",
+    "pipeline_dispatch_seconds",
+    "pipeline_drain_seconds",
+    "store_write_seconds",
+    "store_flush_seconds",
+    "kernel_first_call_seconds",
+)
+DRIVER_SPAN_NAMES = ("fetch", "pack", "dispatch", "drain")
+
+
+def build_report(*, registry=None, tracer=None, run: dict | None = None,
+                 run_counters: dict | None = None) -> dict:
+    """Assemble the report dict from live objects (no I/O)."""
+    from firebird_tpu.obs import metrics as m
+
+    reg = registry if registry is not None else m.get_registry()
+    rep = {
+        "schema": SCHEMA,
+        "generated_at": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+        "run": run or {},
+        "metrics": reg.snapshot(),
+        "spans": tracer.summary() if tracer is not None else {},
+    }
+    if run_counters:
+        rep["run_counters"] = run_counters
+    return rep
+
+
+def write_report(path: str, **kw) -> dict:
+    """build_report + atomic write (tmp+rename); returns the report."""
+    rep = build_report(**kw)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rep, f, indent=1)
+    os.replace(tmp, path)
+    return rep
+
+
+def validate_report(rep: dict) -> None:
+    """Raise ValueError unless ``rep`` is a structurally valid report."""
+    if not isinstance(rep, dict):
+        raise ValueError("report is not a JSON object")
+    if rep.get("schema") != SCHEMA:
+        raise ValueError(f"report schema {rep.get('schema')!r} != {SCHEMA!r}")
+    met = rep.get("metrics")
+    if not isinstance(met, dict):
+        raise ValueError("report has no metrics snapshot")
+    for kind in ("counters", "gauges", "histograms"):
+        if not isinstance(met.get(kind), dict):
+            raise ValueError(f"metrics snapshot missing {kind!r}")
+    for name, h in met["histograms"].items():
+        if not isinstance(h, dict) or "count" not in h:
+            raise ValueError(f"histogram {name!r} snapshot malformed")
+        if h["count"] > 0 and not all(k in h for k in ("p50", "p95", "p99")):
+            raise ValueError(f"histogram {name!r} missing percentiles")
+    if not isinstance(rep.get("spans"), dict):
+        raise ValueError("report has no span summary")
+
+
+def validate_trace(trace: dict) -> None:
+    """Raise ValueError unless ``trace`` is valid Chrome-trace JSON (the
+    subset Perfetto's JSON importer requires)."""
+    if not isinstance(trace, dict) \
+            or not isinstance(trace.get("traceEvents"), list):
+        raise ValueError("trace is not {'traceEvents': [...]} JSON")
+    for ev in trace["traceEvents"]:
+        if not isinstance(ev, dict) or "ph" not in ev or "name" not in ev:
+            raise ValueError(f"malformed trace event: {ev!r}")
+        if ev["ph"] == "X" and not ("ts" in ev and "dur" in ev):
+            raise ValueError(f"complete event missing ts/dur: {ev!r}")
+
+
+def validate_driver_artifacts(trace: dict, rep: dict) -> None:
+    """The full obs-smoke contract over a driver run's two artifacts —
+    schema validity plus the stage-key coverage — shared by ``make
+    obs-smoke`` (tools/obs_smoke.py) and the driver smoke test so the
+    contract cannot drift between them.  Raises ValueError."""
+    validate_trace(trace)
+    names = {e.get("name") for e in trace["traceEvents"]}
+    missing = [n for n in DRIVER_SPAN_NAMES if n not in names]
+    if missing:
+        raise ValueError(f"trace missing span names {missing}")
+    validate_report(rep)
+    hists = rep["metrics"]["histograms"]
+    missing = [k for k in DRIVER_STAGE_HISTOGRAMS
+               if k not in hists or hists[k]["count"] < 1]
+    if missing:
+        raise ValueError(f"report missing stage histograms {missing}")
+
+
+def default_report_path(store_path: str) -> str:
+    """obs_report.json next to the results store."""
+    return os.path.join(os.path.dirname(os.path.abspath(store_path)),
+                        "obs_report.json")
+
+
+def run_report_path(cfg) -> str | None:
+    """Where a driver run's report goes, or None to skip.
+
+    cfg.obs_report: "0" never; a path always; "" auto — next to the store
+    for file-backed backends, skipped for 'memory' (tests and embedded
+    uses must not litter the CWD with artifacts nobody asked for).
+    """
+    if cfg.obs_report == "0":
+        return None
+    if cfg.obs_report:
+        return cfg.obs_report
+    if cfg.store_backend == "memory":
+        return None
+    return default_report_path(cfg.store_path)
+
+
+def finish_run(cfg, *, tracer=None, run: dict | None = None,
+               run_counters: dict | None = None) -> dict:
+    """End-of-run artifact emission shared by the batch and streaming
+    drivers: save the tracer's Chrome trace (when one ran) and write
+    obs_report.json per cfg.obs_report policy.  Returns {artifact: path}
+    for the paths actually written.  Never raises — a failed telemetry
+    write must not fail a run whose results already landed."""
+    from firebird_tpu.obs import logger, tracing
+
+    log = logger("change-detection")
+    out = {}
+    # Independent try blocks: an unwritable trace path must not also
+    # drop the report (or vice versa) when its own path is writable.
+    try:
+        if tracer is not None:
+            out["trace"] = tracer.save(
+                tracing.resolve_path(cfg.trace or "1", cfg.store_path))
+    except OSError as e:
+        log.error("trace write failed: %s", e)
+    try:
+        path = run_report_path(cfg)
+        if path is not None:
+            write_report(path, tracer=tracer, run=run,
+                         run_counters=run_counters)
+            out["report"] = path
+    except OSError as e:
+        log.error("obs report write failed: %s", e)
+    return out

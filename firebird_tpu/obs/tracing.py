@@ -1,0 +1,189 @@
+"""Span tracer: nested, thread-aware, Chrome-trace/Perfetto JSON export.
+
+``span("fetch", chip=cid)`` wraps any pipeline stage; spans nest naturally
+(Chrome's trace viewer stacks complete events by interval containment per
+thread), and each OS thread renders as its own track, so the driver's
+prefetch/pack/dispatch/drain overlap is visually inspectable — the
+host-orchestration counterpart of the XLA trace ``profile_dir`` captures
+(driver/core.py).
+
+Disabled cost is one module-attribute read and a ``None`` check per span:
+no tracer installed means ``span()`` returns a shared no-op context
+manager and records nothing.  Enable per run with FIREBIRD_TRACE (see
+resolve_path) or programmatically via ``start()``/``stop()``.
+
+Export is the Chrome trace-event JSON format (``{"traceEvents": [...]}``,
+"X" complete events with microsecond timestamps) — loads directly in
+Perfetto (ui.perfetto.dev) and chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+class _NullSpan:
+    """Shared no-op span: tracing disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "_name", "_args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args: dict):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer._record(self._name, self._t0,
+                             time.perf_counter() - self._t0, self._args)
+        return False
+
+
+class Tracer:
+    """Collects complete ("X") trace events; thread-safe.
+
+    Timestamps are microseconds relative to the tracer's epoch; OS thread
+    idents map to small sequential tids with ``thread_name`` metadata so
+    Perfetto tracks are readable (MainThread, ThreadPoolExecutor-0_0, ...).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        # tids assign through a threading.local, NOT by OS thread ident:
+        # CPython reuses idents after a thread exits (the driver spins up
+        # fresh executors per chunk), which would put a later thread's
+        # spans on a dead thread's track under its stale name.
+        self._local = threading.local()
+        self._n_tids = 0
+        self._epoch = time.perf_counter()
+
+    def _tid(self) -> int:
+        tid = getattr(self._local, "tid", None)
+        if tid is None:
+            tid = self._local.tid = self._n_tids
+            self._n_tids += 1
+            self._events.append({
+                "name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+                "args": {"name": threading.current_thread().name}})
+        return tid
+
+    def _record(self, name: str, t0: float, dur: float, args: dict) -> None:
+        ev = {"name": name, "ph": "X", "pid": 0,
+              "ts": (t0 - self._epoch) * 1e6, "dur": dur * 1e6}
+        if args:
+            ev["args"] = {k: (v if isinstance(v, (int, float, bool))
+                              else str(v)) for k, v in args.items()}
+        with self._lock:
+            ev["tid"] = self._tid()
+            self._events.append(ev)
+
+    def span(self, name: str, **args) -> _Span:
+        return _Span(self, name, args)
+
+    def to_chrome_trace(self) -> dict:
+        with self._lock:
+            events = list(self._events)
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"producer": "firebird_tpu.obs.tracing"}}
+
+    def save(self, path: str) -> str:
+        """Write the Chrome-trace JSON (atomic tmp+rename)."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        os.replace(tmp, path)
+        return path
+
+    def summary(self) -> dict:
+        """Per-span-name aggregate: count and total/mean/max milliseconds
+        (the obs_report.json span table)."""
+        with self._lock:
+            events = [e for e in self._events if e.get("ph") == "X"]
+        out: dict[str, dict] = {}
+        for e in events:
+            s = out.setdefault(e["name"],
+                               {"count": 0, "total_ms": 0.0, "max_ms": 0.0})
+            ms = e["dur"] / 1e3
+            s["count"] += 1
+            s["total_ms"] += ms
+            s["max_ms"] = max(s["max_ms"], ms)
+        for s in out.values():
+            s["mean_ms"] = s["total_ms"] / s["count"]
+            for k in ("total_ms", "max_ms", "mean_ms"):
+                s[k] = round(s[k], 3)
+        return out
+
+
+_active: Tracer | None = None
+
+
+def active() -> Tracer | None:
+    return _active
+
+
+def start(tracer: Tracer | None = None) -> Tracer:
+    """Install ``tracer`` (or a fresh one) as the process-global span sink
+    and return it.  Spans from any thread land in the active tracer."""
+    global _active
+    _active = tracer or Tracer()
+    return _active
+
+
+def stop() -> Tracer | None:
+    """Uninstall and return the active tracer (None if none installed)."""
+    global _active
+    t, _active = _active, None
+    return t
+
+
+def span(name: str, **args):
+    """A span against the active tracer; a shared no-op when disabled."""
+    t = _active
+    if t is None:
+        return _NULL_SPAN
+    return _Span(t, name, args)
+
+
+def wants_trace(trace: str) -> bool:
+    """FIREBIRD_TRACE gate: ""/"0" off (matching the 0-disables
+    convention of FIREBIRD_METRICS and FIREBIRD_OBS_REPORT), anything
+    else on."""
+    return trace not in ("", "0")
+
+
+def resolve_path(trace: str, store_path: str,
+                 default_name: str = "trace.json") -> str:
+    """Resolve the FIREBIRD_TRACE value to an output file.
+
+    "1" (just "turn it on") writes ``<store dir>/<default_name>`` next to
+    the store; a directory path appends ``default_name``; anything else is
+    the literal output file.
+    """
+    if trace == "1":
+        return os.path.join(
+            os.path.dirname(os.path.abspath(store_path)), default_name)
+    if os.path.isdir(trace) or trace.endswith(os.sep):
+        return os.path.join(trace, default_name)
+    return trace

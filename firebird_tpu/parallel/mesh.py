@@ -19,10 +19,34 @@ and jax.distributed handles DCN bring-up (parallel.dist).
 from __future__ import annotations
 
 import functools
+import itertools
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Monotonic sequence for KV-store scalar allgathers (_kv_global_max):
+# processes call in lockstep, so the per-process counters agree.
+_kv_seq = itertools.count()
+
+
+def _kv_global_max(v: int) -> int:
+    """Cross-process max of a host scalar through jax.distributed's
+    coordination-service KV store — the fallback where jitted
+    multiprocess collectives are unavailable (jax<0.5 raises
+    "Multiprocess computations aren't implemented on the CPU backend"
+    inside multihost_utils.process_allgather)."""
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError("jax.distributed is not initialized")
+    seq = next(_kv_seq)
+    client.key_value_set(f"fb/gmax/{seq}/{jax.process_index()}",
+                         str(int(v)))
+    return max(int(client.blocking_key_value_get(
+        f"fb/gmax/{seq}/{j}", 120_000))
+        for j in range(jax.process_count()))
 
 
 def make_mesh(devices=None, n_devices: int | None = None) -> Mesh:
@@ -128,15 +152,32 @@ def detect_sharded(packed, mesh: Mesh, dtype=None,
         if not multiproc:
             return v
         from jax.experimental import multihost_utils
-        return int(np.max(np.asarray(
-            multihost_utils.process_allgather(np.array([v])))))
+        try:
+            return int(np.max(np.asarray(
+                multihost_utils.process_allgather(np.array([v])))))
+        except Exception as e:
+            # ONLY the jax<0.5 CPU backend's deterministic "Multiprocess
+            # computations aren't implemented" falls back to the KV
+            # store; a transient allgather failure must re-raise — if
+            # some processes fell back while others succeeded, the
+            # lockstep _kv_seq counters would skew and every later
+            # fallback would read the wrong sequence's keys.
+            if "Multiprocess computations aren't implemented" not in str(e):
+                raise
+            return _kv_global_max(v)
 
     wcap = global_max(window_cap(packed))
     args = shard_packed(packed, mesh, dtype)
 
     def dispatch(S):
-        return sharded_detect_fn(mesh, jnp.dtype(dtype), wcap,
-                                 packed.sensor, max_segments=S)(*args)
+        from firebird_tpu.ccd.kernel import record_first_call
+
+        fn = sharded_detect_fn(mesh, jnp.dtype(dtype), wcap,
+                               packed.sensor, max_segments=S)
+        return record_first_call(
+            ("sharded", packed.spectra.shape, str(jnp.dtype(dtype)), wcap,
+             packed.sensor.name, S, len(mesh.devices.flat)),
+            lambda: fn(*args))
 
     def read_worst(seg):
         # Every process must agree on the retry, so max-reduce the local
@@ -177,9 +218,18 @@ def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor,
         return core(Xs, Xts, t, valid, Y_i16, qa_u16.astype(jnp.int32))
 
     spec = PartitionSpec("data")
-    # check_vma=False: the kernel's scan/while carries start from
-    # shard-constant zeros, which the varying-axes checker would demand
-    # explicit pcasts for; the collective-freedom claim is structural
-    # (nothing in _detect_core mentions the mesh axis at all).
-    return jax.jit(jax.shard_map(local_batch, mesh=mesh, in_specs=(spec,) * 6,
-                                 out_specs=spec, check_vma=False))
+    # check_vma=False (check_rep=False pre-0.5 jax): the kernel's
+    # scan/while carries start from shard-constant zeros, which the
+    # varying-axes checker would demand explicit pcasts for; the
+    # collective-freedom claim is structural (nothing in _detect_core
+    # mentions the mesh axis at all).
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        wrapped = sm(local_batch, mesh=mesh, in_specs=(spec,) * 6,
+                     out_specs=spec, check_vma=False)
+    else:  # jax < 0.5: experimental module, check_rep spelling
+        from jax.experimental.shard_map import shard_map as sm_exp
+
+        wrapped = sm_exp(local_batch, mesh=mesh, in_specs=(spec,) * 6,
+                         out_specs=spec, check_rep=False)
+    return jax.jit(wrapped)

@@ -23,6 +23,8 @@ import queue
 import threading
 
 from firebird_tpu.obs import logger
+from firebird_tpu.obs import metrics as obs_metrics
+from firebird_tpu.obs import tracing
 
 log = logger("change-detection")
 
@@ -49,14 +51,20 @@ class AsyncWriter:
             table, frame = item
             try:
                 if self._error is None:
-                    self.store.write(table, frame)
+                    with tracing.span("store_write", table=table), \
+                            obs_metrics.timer() as tm:
+                        self.store.write(table, frame)
+                    obs_metrics.histogram(
+                        "store_write_seconds").observe(tm.elapsed)
             except BaseException as e:  # incl. KeyboardInterrupt: a dead
                 # worker with un-acked items would hang flush() forever
                 log.error("async write to %s failed: %s", table, e)
+                obs_metrics.counter("store_write_errors").inc()
                 self._error = e if isinstance(e, Exception) \
                     else RuntimeError(f"writer interrupted: {e!r}")
             finally:
                 q.task_done()
+                self._update_depth()
 
     def _pop_error(self) -> Exception | None:
         err, self._error = self._error, None
@@ -66,6 +74,14 @@ class AsyncWriter:
         if not all(t.is_alive() for t in self._threads):
             raise RuntimeError("async writer thread is dead")
 
+    def _update_depth(self) -> None:
+        # Egress backpressure signal: total frames queued across workers.
+        # Gate BEFORE the qsize sweep — each qsize takes that queue's
+        # mutex, and the per-frame cost must vanish when metrics are off.
+        if obs_metrics.metrics_enabled():
+            obs_metrics.gauge("store_queue_depth").set(
+                sum(q.qsize() for q in self._qs))
+
     def write(self, table: str, frame: dict, key=None) -> None:
         """Queue a frame.  Frames sharing ``key`` keep submission order."""
         err = self._pop_error()
@@ -74,11 +90,14 @@ class AsyncWriter:
         self._check_alive()
         i = (hash(key) if key is not None else next(self._rr)) % len(self._qs)
         self._qs[i].put((table, frame))
+        self._update_depth()
 
     def flush(self) -> None:
         self._check_alive()
-        for q in self._qs:
-            q.join()
+        with tracing.span("store_flush"), obs_metrics.timer() as tm:
+            for q in self._qs:
+                q.join()
+        obs_metrics.histogram("store_flush_seconds").observe(tm.elapsed)
         err = self._pop_error()
         if err is not None:
             raise err

@@ -5,6 +5,7 @@ and the accuracy-test class the reference lacks (SURVEY.md §4)."""
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from firebird_tpu.ccd import kernel, synthetic
 from firebird_tpu.ingest.packer import PackedChips
@@ -79,6 +80,7 @@ def test_break_accuracy_across_seeds():
     assert min(rates) == 1.0, rates
 
 
+@pytest.mark.slow  # ~30-60s interpret-mode run; tier-1 (-m 'not slow') budget keeps the faster per-kernel parity rungs instead
 def test_pallas_f32_break_agreement_with_float64(monkeypatch):
     """The full Pallas route (FIREBIRD_PALLAS=1, f32 — the production TPU
     configuration the bench autotunes toward) must reproduce float64's

@@ -17,6 +17,8 @@ import subprocess
 import sys
 import time
 
+import pytest
+
 from firebird_tpu import grid
 
 
@@ -126,9 +128,20 @@ def test_global_mesh_two_procs_two_devices(tmp_path):
     coord = f"127.0.0.1:{_free_port()}"
     child = os.path.join(os.path.dirname(__file__), "_mp_mesh_child.py")
     env = dict(os.environ, XLA_FLAGS="")
-    outs = _run_children(
-        tmp_path, "mesh",
-        lambda i: [sys.executable, child, str(i), coord], lambda i: env)
+    try:
+        outs = _run_children(
+            tmp_path, "mesh",
+            lambda i: [sys.executable, child, str(i), coord], lambda i: env)
+    except AssertionError as e:
+        if "Multiprocess computations aren't implemented" in str(e):
+            # jax<0.5's CPU backend cannot compile cross-process SPMD
+            # programs at all (XlaRuntimeError at backend_compile) — the
+            # path under test only exists on real multi-host accelerator
+            # backends there.  Any other child failure still fails.
+            pytest.skip("CPU backend lacks multiprocess SPMD compile "
+                        "(jax<0.5); global-mesh path needs real "
+                        "multi-host hardware on this toolchain")
+        raise
     for i, out in enumerate(outs):
         assert f"CHILD_OK {i}" in out
     # the two cadences really did disagree on the local window cap —

@@ -1,10 +1,22 @@
 """Observability: per-subsystem logger categories and level config
-(log4j.properties:48-53 parity) + throughput counters."""
+(log4j.properties:48-53 parity), throughput counters, and the telemetry
+layer (span tracer, metrics registry, per-run report artifacts)."""
 
+import json
 import logging
+import threading
+
+import pytest
 
 from firebird_tpu import obs
+from firebird_tpu.obs import metrics as obs_metrics
+from firebird_tpu.obs import report as obs_report
+from firebird_tpu.obs import tracing
 
+
+# ---------------------------------------------------------------------------
+# Logging (the original obs.py surface, now the package __init__)
+# ---------------------------------------------------------------------------
 
 def test_categories_mirror_reference():
     assert set(obs.CATEGORIES) == {
@@ -39,3 +51,214 @@ def test_counters_snapshot_rates():
     snap = c.snapshot()
     assert snap["chips"] == 1 and snap["pixels"] == 10000
     assert "pixels_per_sec" in snap and snap["elapsed_sec"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# Span tracer
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_export_roundtrip():
+    t = tracing.start()
+    try:
+        with tracing.span("fetch", chip=(1, 2)):
+            with tracing.span("pack", chips=3):
+                pass
+    finally:
+        assert tracing.stop() is t
+    trace = json.loads(json.dumps(t.to_chrome_trace()))   # wire round-trip
+    obs_report.validate_trace(trace)
+    evs = {e["name"]: e for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert set(evs) == {"fetch", "pack"}
+    # nesting: the child interval is contained in the parent's, same track
+    f, p = evs["fetch"], evs["pack"]
+    assert f["tid"] == p["tid"]
+    assert f["ts"] <= p["ts"]
+    assert p["ts"] + p["dur"] <= f["ts"] + f["dur"] + 1e-3
+    # args survive export; non-scalar values stringify
+    assert p["args"]["chips"] == 3
+    assert f["args"]["chip"] == "(1, 2)"
+    # summary table aggregates per name
+    s = t.summary()
+    assert s["fetch"]["count"] == 1 and s["fetch"]["max_ms"] >= 0
+
+
+def test_spans_are_thread_aware():
+    t = tracing.start()
+    try:
+        def work():
+            with tracing.span("worker"):
+                pass
+        th = threading.Thread(target=work, name="obs-test-worker")
+        with tracing.span("main"):
+            th.start()
+            th.join()
+    finally:
+        tracing.stop()
+    trace = t.to_chrome_trace()
+    tids = {e["name"]: e["tid"] for e in trace["traceEvents"]
+            if e["ph"] == "X"}
+    assert tids["main"] != tids["worker"]
+    meta = {e["args"]["name"] for e in trace["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "obs-test-worker" in meta
+
+
+def test_span_noop_when_disabled():
+    assert tracing.active() is None
+    with tracing.span("fetch") as s:           # records nowhere, raises never
+        assert s is tracing._NULL_SPAN
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+def test_histogram_percentiles():
+    h = obs_metrics.Histogram("t_seconds")
+    for ms in range(1, 101):                   # 1..100 ms, uniform
+        h.observe(ms / 1000.0)
+    snap = h.snapshot()
+    assert snap["count"] == 100
+    assert snap["sum"] == pytest.approx(5.05, rel=1e-6)
+    assert snap["min"] == 0.001 and snap["max"] == 0.1
+    # fixed-bucket interpolation: tolerance is the containing bucket width
+    assert snap["p50"] == pytest.approx(0.050, abs=0.015)
+    assert snap["p95"] == pytest.approx(0.095, abs=0.01)
+    # percentiles never exceed the observed range
+    assert snap["min"] <= snap["p99"] <= snap["max"]
+
+
+def test_histogram_empty_and_overflow():
+    h = obs_metrics.Histogram("t_seconds")
+    assert h.snapshot() == {"count": 0}
+    assert h.quantile(0.5) is None
+    h.observe(1e6)                             # beyond the last bucket
+    assert h.quantile(0.5) == 1e6              # overflow reports observed max
+
+
+def test_prometheus_exposition_format():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("chips").inc(5)
+    reg.gauge("store_queue_depth").set(3)
+    h = reg.histogram("pipeline_fetch_seconds")
+    h.observe(0.002)
+    h.observe(0.2)
+    text = reg.prometheus()
+    assert "# TYPE firebird_chips_total counter" in text
+    assert "firebird_chips_total 5" in text
+    assert "# TYPE firebird_store_queue_depth gauge" in text
+    assert "firebird_store_queue_depth 3" in text
+    assert "# TYPE firebird_pipeline_fetch_seconds histogram" in text
+    assert 'firebird_pipeline_fetch_seconds_bucket{le="+Inf"} 2' in text
+    assert "firebird_pipeline_fetch_seconds_count 2" in text
+    # cumulative buckets are monotonic
+    cums = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+            if line.startswith("firebird_pipeline_fetch_seconds_bucket")]
+    assert cums == sorted(cums)
+
+
+def test_counter_thread_safety():
+    reg = obs_metrics.MetricsRegistry()
+    c = reg.counter("hits")
+    n_threads, n_incs = 8, 2000
+
+    def work():
+        for _ in range(n_incs):
+            c.inc()
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * n_incs
+
+
+def test_metrics_env_gate(monkeypatch):
+    reg = obs_metrics.MetricsRegistry()
+    monkeypatch.setenv("FIREBIRD_METRICS", "0")
+    reg.counter("c").inc()
+    reg.gauge("g").set(9)
+    reg.histogram("h").observe(1.0)
+    snap = reg.snapshot()
+    assert snap["counters"]["c"] == 0
+    assert snap["gauges"]["g"] == 0.0
+    assert snap["histograms"]["h"] == {"count": 0}
+    monkeypatch.delenv("FIREBIRD_METRICS")
+    reg.counter("c").inc()
+    assert reg.counter("c").value == 1
+
+
+def test_registry_once_is_per_registry():
+    reg = obs_metrics.reset_registry()
+    assert reg.once(("shape", 1)) and not reg.once(("shape", 1))
+    assert obs_metrics.reset_registry().once(("shape", 1))
+
+
+# ---------------------------------------------------------------------------
+# Report artifact + driver smoke
+# ---------------------------------------------------------------------------
+
+def test_report_build_and_validate(tmp_path):
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("chips").inc(2)
+    reg.histogram("pipeline_fetch_seconds").observe(0.01)
+    t = tracing.Tracer()
+    with t.span("fetch"):
+        pass
+    path = str(tmp_path / "obs_report.json")
+    rep = obs_report.write_report(path, registry=reg, tracer=t,
+                                  run={"kind": "test"},
+                                  run_counters={"chips": 2})
+    obs_report.validate_report(json.load(open(path)))
+    assert rep["run"]["kind"] == "test"
+    assert rep["spans"]["fetch"]["count"] == 1
+    with pytest.raises(ValueError):
+        obs_report.validate_report({"schema": "bogus"})
+    with pytest.raises(ValueError):
+        obs_report.validate_trace({"traceEvents": [{"ph": "X"}]})
+
+
+@pytest.mark.slow
+def test_driver_run_emits_report_and_trace(tmp_path):
+    """End-to-end: a synthetic changedetection run with tracing on writes
+    obs_report.json (all driver stage keys populated) and a valid Chrome
+    trace containing the fetch/pack/dispatch/drain spans."""
+    from firebird_tpu.config import Config
+    from firebird_tpu.driver import core
+    from firebird_tpu.ingest import SyntheticSource
+
+    # Same shape/dtype as test_driver.py so the jit cache entry is shared.
+    cfg = Config(store_backend="sqlite",
+                 store_path=str(tmp_path / "fb.db"),
+                 source_backend="synthetic", chips_per_batch=1,
+                 dtype="float64", device_sharding="off", fetch_retries=0,
+                 trace=str(tmp_path / "trace.json"))
+    src = SyntheticSource(seed=9, start="1995-01-01", end="1998-01-01",
+                          cloud_frac=0.1)
+    done = core.changedetection(x=100, y=200,
+                                acquired="1995-01-01/1997-06-01",
+                                number=2, chunk_size=2, cfg=cfg, source=src)
+    assert len(done) == 2
+
+    trace = json.load(open(tmp_path / "trace.json"))
+    rep = json.load(open(tmp_path / "obs_report.json"))
+    # the shared obs-smoke contract (same check `make obs-smoke` runs)
+    obs_report.validate_driver_artifacts(trace, rep)
+    assert rep["run"]["kind"] == "changedetection"
+    assert rep["run_counters"]["chips"] == 2
+    # spans surfaced in the summary table too
+    assert rep["spans"]["dispatch"]["count"] >= 1
+
+
+def test_memory_store_run_writes_no_report(tmp_path, monkeypatch):
+    """Auto mode must not litter artifacts for memory-backed (test) runs."""
+    from firebird_tpu.config import Config
+
+    monkeypatch.chdir(tmp_path)
+    cfg = Config(store_backend="memory", source_backend="synthetic")
+    assert obs_report.run_report_path(cfg) is None
+    cfg = Config(store_backend="memory", obs_report=str(tmp_path / "r.json"))
+    assert obs_report.run_report_path(cfg) == str(tmp_path / "r.json")
+    cfg = Config(store_backend="sqlite", store_path="x/fb.db",
+                 obs_report="0")
+    assert obs_report.run_report_path(cfg) is None

@@ -64,6 +64,7 @@ def test_pallas_cd_under_vmap():
                                **_TOL[jnp.dtype(jnp.float64)])
 
 
+@pytest.mark.slow  # ~30-60s interpret-mode run; tier-1 (-m 'not slow') budget keeps the faster per-kernel parity rungs instead
 def test_pallas_flag_routes_full_detect(monkeypatch):
     """FIREBIRD_PALLAS=1 routes the whole chip detector through the Pallas
     CD loop with results matching the default path."""
@@ -153,6 +154,7 @@ def test_monitor_chain_matches_jnp_reference():
                 np.asarray(got[k]), np.asarray(want[k]), err_msg=k)
 
 
+@pytest.mark.slow  # ~30-60s interpret-mode run; tier-1 (-m 'not slow') budget keeps the faster per-kernel parity rungs instead
 def test_monitor_chain_in_detect_matches_default(monkeypatch):
     """FIREBIRD_PALLAS=1 routes the monitor chain (and the CD loop)
     through Pallas; full-detect results must equal the default path."""
@@ -342,6 +344,7 @@ def test_init_window_matches_init_block():
         assert diff <= 0.02, (k, diff)
 
 
+@pytest.mark.slow  # ~30-60s interpret-mode run; tier-1 (-m 'not slow') budget keeps the faster per-kernel parity rungs instead
 def test_init_kernel_in_detect_matches_default(monkeypatch):
     """FIREBIRD_PALLAS=init routes the whole INIT block through the fused
     window kernel; segment decisions must equal the default path."""
@@ -366,6 +369,7 @@ def test_init_kernel_in_detect_matches_default(monkeypatch):
     np.testing.assert_array_equal(np.asarray(got.mask), np.asarray(ref.mask))
 
 
+@pytest.mark.slow  # ~30-60s interpret-mode run; tier-1 (-m 'not slow') budget keeps the faster per-kernel parity rungs instead
 def test_full_pallas_sentinel2_matches_default(monkeypatch):
     """All Pallas components under the 12-band Sentinel-2 sensor layout:
     the bench's S2 rung runs with the autotuned FIREBIRD_PALLAS set, so
@@ -610,6 +614,7 @@ def test_detect_mega_sentinel2_and_capacity(monkeypatch):
         np.asarray(tiny.seg_meta)[:, :, 0], m_g[:, :, 0], atol=1e-6)
 
 
+@pytest.mark.slow  # ~30-60s interpret-mode run; tier-1 (-m 'not slow') budget keeps the faster per-kernel parity rungs instead
 def test_mega_inside_sharded_detect(monkeypatch):
     """The sharded production path (shard_map over the mesh) composes
     with the whole-loop mega kernel: each shard runs its own

@@ -7,7 +7,12 @@ generate as whole meters (every LCMAP grid/chip coordinate is integral),
 keeping floor-snap properties exact rather than float-boundary flaky.
 """
 
-from hypothesis import given, strategies as st
+import pytest
+
+# Not in the baked container image (no network installs); skip cleanly
+# instead of erroring the whole module at collection.
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, strategies as st  # noqa: E402
 
 from firebird_tpu import grid
 from firebird_tpu.utils import dates as dt

@@ -1,0 +1,63 @@
+"""Observability smoke test (``make obs-smoke``).
+
+Runs the synthetic-source driver end to end with the span tracer on,
+then validates the two emitted artifacts against the shared schema
+checks (firebird_tpu.obs.report): the Chrome-trace JSON must parse, pass
+``validate_trace``, and contain the four pipeline span names; the
+obs_report.json must pass ``validate_report`` and carry every
+DRIVER_STAGE_HISTOGRAMS stage key.  Exits non-zero on any violation —
+the CI-greppable proof that the telemetry layer still wires through
+every pipeline stage.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, HERE)
+
+
+def main() -> int:
+    from firebird_tpu.config import Config
+    from firebird_tpu.driver import core
+    from firebird_tpu.ingest import SyntheticSource
+    from firebird_tpu.obs import report as obs_report
+
+    with tempfile.TemporaryDirectory(prefix="fb_obs_smoke_") as tmp:
+        cfg = Config(store_backend="sqlite",
+                     store_path=os.path.join(tmp, "smoke.db"),
+                     source_backend="synthetic", chips_per_batch=1,
+                     device_sharding="off", fetch_retries=0, trace="1")
+        src = SyntheticSource(seed=9, start="1995-01-01", end="1998-01-01",
+                              cloud_frac=0.1)
+        done = core.changedetection(x=100, y=200,
+                                    acquired="1995-01-01/1997-06-01",
+                                    number=2, chunk_size=2, cfg=cfg,
+                                    source=src)
+        if len(done) != 2:
+            print(f"obs-smoke: driver processed {len(done)}/2 chips",
+                  file=sys.stderr)
+            return 1
+
+        trace = json.load(open(os.path.join(tmp, "trace.json")))
+        rep = json.load(open(os.path.join(tmp, "obs_report.json")))
+        try:
+            # The one shared contract (also asserted by the driver smoke
+            # test): schema validity + span/stage-key coverage.
+            obs_report.validate_driver_artifacts(trace, rep)
+        except ValueError as e:
+            print(f"obs-smoke: {e}", file=sys.stderr)
+            return 1
+        print("obs-smoke OK: "
+              f"{len(trace['traceEvents'])} trace events, "
+              f"{len(rep['metrics']['histograms'])} stage histograms, "
+              f"counters {rep['metrics']['counters']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,6 +61,16 @@ def main() -> int:
     rep["pct_of_tile"] = round(100.0 * rep["chips_total"] / 2500, 1)
     rep["variogram"] = recorded_mode(os.path.dirname(dbs[0]))
 
+    # Fold the driver's per-run telemetry artifact (written next to the
+    # store by changedetection — firebird_tpu.obs.report) so the round
+    # artifact carries stage latencies, not just totals.
+    obs_path = os.path.join(os.path.dirname(dbs[0]), "obs_report.json")
+    if os.path.exists(obs_path):
+        try:
+            rep["obs_report"] = json.load(open(obs_path))
+        except (OSError, ValueError) as e:
+            rep["obs_report"] = {"error": repr(e)}
+
     if os.path.exists(args.log):
         log = open(args.log).read()
         m = re.findall(r"resume: \d+ chips already stored.*?\d+ to do", log)

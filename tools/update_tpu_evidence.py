@@ -35,6 +35,20 @@ def main() -> int:
     # rate; restate every capture against the pinned constant too.
     best["vs_baseline_pinned"] = round(
         best["value"] / PINNED_BASELINE_2000_CORES, 3)
+    # Normalize legacy capture key semantics (ADVICE r5 low #2): pre-pin
+    # captures put the LIVE host rate under cpu_ref_pixels_per_sec_per_core
+    # (post-pin output keeps the pinned constant there and the live rate
+    # under *_live) and computed the headline vs_baseline from it.  Detect
+    # the vintage by the missing *_live key; rename so every key means one
+    # thing across rounds.
+    det = best.get("detail")
+    if isinstance(det, dict) \
+            and "cpu_ref_pixels_per_sec_per_core_live" not in det \
+            and "cpu_ref_pixels_per_sec_per_core" in det:
+        det["cpu_ref_pixels_per_sec_per_core_live"] = det.pop(
+            "cpu_ref_pixels_per_sec_per_core")
+        if "vs_baseline" in best:
+            best["vs_baseline_legacy"] = best.pop("vs_baseline")
     best["evidence"] = {
         "source_log": src,
         "generated_by": "tools/update_tpu_evidence.py",
@@ -48,7 +62,7 @@ def main() -> int:
     with open(out, "w") as f:
         json.dump(best, f, indent=1)
     print(f"{out}: {best['value']} {best.get('unit', '')} "
-          f"(vs_baseline {best.get('vs_baseline')}) from {src}")
+          f"(vs_baseline_pinned {best['vs_baseline_pinned']}) from {src}")
     return 0
 
 
