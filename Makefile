@@ -22,8 +22,10 @@ bench:
 	python bench.py
 
 # End-to-end telemetry check: synthetic-source driver run with the span
-# tracer on, validating the emitted Chrome-trace JSON and obs_report.json
-# against the schema + stage-key contract (docs/OBSERVABILITY.md).
+# tracer on AND the ops endpoint bound to an ephemeral port — polls
+# /healthz /readyz /metrics /progress while batches are in flight, then
+# validates the emitted Chrome-trace JSON and obs_report.json against
+# the schema + stage-key contract (docs/OBSERVABILITY.md).
 obs-smoke:
 	python tools/obs_smoke.py
 

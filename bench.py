@@ -102,6 +102,32 @@ def autotune_pick(rates, errors, decision_exact):
     return (max(eligible, key=lambda k: rates[k]), [], "0" in errors)
 
 
+def _fleet_obs_fold() -> dict:
+    """{"fleet_obs_report": ...} for the rolling soak directory when a
+    driver run left a report there — the merged fleet view under
+    multi-host runs, a single process's report otherwise.  Empty dict
+    (not an error) when no soak run exists on this host."""
+    import os
+
+    soak_dir = os.environ.get("FIREBIRD_SOAK_DIR", "/tmp/fb_soak")
+    try:
+        from firebird_tpu.obs.report import load_fleet_report
+
+        rep = load_fleet_report(soak_dir)
+    except Exception:
+        return {}
+    if rep is None:
+        return {}
+    # The full document would dwarf the bench artifact; keep the
+    # operator-relevant identity + scale block.
+    return {"fleet_obs_report": {
+        "run": rep.get("run", {}),
+        "fleet": rep.get("fleet"),
+        "counters": rep.get("metrics", {}).get("counters", {}),
+        "run_counters": rep.get("run_counters", {}),
+    }}
+
+
 def measure(cpu_only: bool) -> None:
     if cpu_only:
         import jax
@@ -513,6 +539,10 @@ def measure(cpu_only: bool) -> None:
             # Per-run telemetry fold (obs_report schema's metrics half):
             # first-call/compile latencies recorded by timed_rate above.
             "obs": obs_metrics.get_registry().snapshot(),
+            # Fleet view of the rolling soak run when one exists on this
+            # host: prefer the merged multi-host obs_report over any
+            # single process's shard (obs.report.load_fleet_report).
+            **_fleet_obs_fold(),
             "streaming_pixels_per_sec": round(stream_rate, 1),
             **s2_detail,
             **hard_detail,

@@ -55,7 +55,13 @@ def entrypoint():
                    "Perfetto): '1' writes trace.json next to the store, a "
                    "path writes there; overrides FIREBIRD_TRACE — see "
                    "docs/OBSERVABILITY.md")
-def changedetection(x, y, acquired, number, chunk_size, resume, trace):
+@click.option("--ops-port", default=None, type=int,
+              help="serve the live ops endpoints (/healthz /readyz "
+                   "/metrics /progress /report) on this port for the "
+                   "duration of the run; overrides FIREBIRD_OPS_PORT — "
+                   "off (no port bound) when neither is set")
+def changedetection(x, y, acquired, number, chunk_size, resume, trace,
+                    ops_port):
     """Run change detection for a tile and save results to the store."""
     from firebird_tpu.config import Config
     from firebird_tpu.driver import core
@@ -67,11 +73,13 @@ def changedetection(x, y, acquired, number, chunk_size, resume, trace):
     # not host-sharded, and initialize() blocks until every process
     # joins, so it must not run from the group callback.
     init_distributed()
+    overrides = {k: v for k, v in
+                 (("trace", trace), ("ops_port", ops_port)) if v is not None}
     return core.changedetection(
         x=x, y=y,
         acquired=acquired or dates.default_acquired(),
         number=number, chunk_size=chunk_size, resume=resume,
-        cfg=Config.from_env(trace=trace) if trace is not None else None,
+        cfg=Config.from_env(**overrides) if overrides else None,
     )
 
 
@@ -138,7 +146,10 @@ def save(bounds, product_names, product_dates, acquired, clip):
 @click.option("--number", "-n", required=False, default=2500, type=int)
 @click.option("--trace", "-t", default=None,
               help="host span tracer output (see changedetection --trace)")
-def stream(x, y, acquired, number, trace):
+@click.option("--ops-port", default=None, type=int,
+              help="live ops endpoints for the run (see changedetection "
+                   "--ops-port)")
+def stream(x, y, acquired, number, trace, ops_port):
     """Streaming incremental change detection (no reference equivalent —
     its only mode is full reruns, ccdc/pyccd.py:171-183).  First run per
     chip bootstraps batch detection and a state checkpoint; later runs
@@ -148,9 +159,11 @@ def stream(x, y, acquired, number, trace):
     from firebird_tpu.parallel import init_distributed
 
     init_distributed()
+    overrides = {k: v for k, v in
+                 (("trace", trace), ("ops_port", ops_port)) if v is not None}
     return sdrv.stream(
         x=x, y=y, acquired=acquired, number=number,
-        cfg=Config.from_env(trace=trace) if trace is not None else None)
+        cfg=Config.from_env(**overrides) if overrides else None)
 
 
 @entrypoint.command()

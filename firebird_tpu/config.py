@@ -108,6 +108,16 @@ class Config:
     # '<store_path>.stream' next to the store.
     stream_dir: str = ""
 
+    # Embedded HTTP ops endpoint (obs/server.py): /healthz /readyz
+    # /metrics /progress /report.  0 (the default) binds NO port — the
+    # surface only exists when FIREBIRD_OPS_PORT / --ops-port asks for it.
+    ops_port: int = 0
+
+    # Stall watchdog deadline in seconds (obs/watchdog.py): no batch
+    # completing within it flips /healthz to 503 and increments
+    # watchdog_stall_total.  <= 0 disables the watchdog.
+    stall_sec: float = 0.0
+
     # Framework version (reference: version.txt read in keyspace()).
     version: str = _VERSION
 
@@ -127,6 +137,9 @@ class Config:
         if self.fetch_retries < 0:
             raise ValueError("FIREBIRD_FETCH_RETRIES must be >= 0, got "
                              f"{self.fetch_retries}")
+        if not 0 <= self.ops_port <= 65535:
+            raise ValueError("FIREBIRD_OPS_PORT must be 0 (off) or a valid "
+                             f"TCP port, got {self.ops_port}")
 
     @classmethod
     def from_env(cls, env: dict | None = None, **overrides) -> "Config":
@@ -161,6 +174,8 @@ class Config:
             trace=e.get("FIREBIRD_TRACE", cls.trace),
             obs_report=e.get("FIREBIRD_OBS_REPORT", cls.obs_report),
             stream_dir=e.get("FIREBIRD_STREAM_DIR", cls.stream_dir),
+            ops_port=int(e.get("FIREBIRD_OPS_PORT", cls.ops_port)),
+            stall_sec=float(e.get("FIREBIRD_STALL_SEC", cls.stall_sec)),
         )
         kw.update(overrides)
         return cls(**kw)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 import contextlib
+import itertools
 import time
 import traceback
 
@@ -28,10 +29,12 @@ from firebird_tpu.ccd import format as ccdformat
 from firebird_tpu.ccd import kernel
 from firebird_tpu.config import Config
 from firebird_tpu.ingest import ChipmunkSource, FileSource, SyntheticSource, pack
-from firebird_tpu.obs import Counters, logger
+from firebird_tpu.obs import Counters, jsonlog, logger
 from firebird_tpu.obs import metrics as obs_metrics
 from firebird_tpu.obs import report as obs_report
+from firebird_tpu.obs import server as obs_server
 from firebird_tpu.obs import tracing
+from firebird_tpu.obs import watchdog as obs_watchdog
 from firebird_tpu.store import AsyncWriter, open_store
 from firebird_tpu.utils import dates as dt
 from firebird_tpu.utils.fn import partition_all, take
@@ -40,6 +43,134 @@ from firebird_tpu.utils.fn import partition_all, take
 # 4096 days, which would corrupt segment dates; bf16 belongs inside matmul
 # precision hints, not the date-carrying compute dtype.
 _DTYPES = {"float32": jnp.float32, "float64": jnp.float64}
+
+
+def _process_index() -> int:
+    """JAX process index for run identity; 0 when no backend is up."""
+    import jax
+
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+# Lockstep sequence for run-id broadcast keys: every process of an SPMD
+# fleet runs the same program, so the per-process counters agree (the
+# same idiom as parallel.mesh._kv_seq).
+_run_id_seq = itertools.count()
+
+
+def fleet_run_id() -> str:
+    """One run id for the WHOLE fleet launch.
+
+    Single-process: a fresh id.  Multi-process: process 0 mints it and
+    broadcasts through the jax.distributed coordination-service KV store,
+    so every host's JSON log lines, report shard, and /progress payload
+    carry the SAME id — the cross-host log join is one grep, not an
+    out-of-band host table."""
+    rid = jsonlog.new_run_id()
+    try:
+        import jax
+
+        if jax.process_count() <= 1:
+            return rid
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            return rid
+        seq = next(_run_id_seq)
+        if jax.process_index() == 0:
+            client.key_value_set(f"fb/run_id/{seq}", rid)
+            return rid
+        return client.blocking_key_value_get(f"fb/run_id/{seq}", 60_000)
+    except Exception:
+        return rid           # a broken broadcast degrades to per-host ids
+
+
+def _mesh_ready() -> bool:
+    """The /readyz mesh half: True when no distributed mesh is expected
+    (no coordinator configured), or when jax.distributed is actually up.
+    An operator who exported JAX_COORDINATOR_ADDRESS but whose bring-up
+    failed keeps /readyz at 503 instead of lying."""
+    import os
+
+    if not os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        return True
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
+def record_topology_metrics() -> None:
+    """(Re-)record the fleet topology gauges on the CURRENT registry.
+
+    init_distributed sets them at bring-up, but the drivers reset the
+    registry per run — so every run re-records them here or /metrics and
+    the fleet report would silently lose the topology."""
+    import jax
+
+    try:
+        obs_metrics.gauge(
+            "mesh_processes",
+            help="jax.distributed process count").set(jax.process_count())
+        obs_metrics.gauge(
+            "mesh_global_devices",
+            help="global device count").set(len(jax.devices()))
+    except Exception:
+        pass                   # no backend yet: nothing to record
+
+
+def start_ops(cfg: Config, run_id: str, kind: str, *, chips_total: int,
+              counters, run_block: dict):
+    """Bring up the run's live ops surface (shared by both drivers).
+
+    Registers the run context for JSON logs, clears stale report shards
+    from a previous run in a reused artifact directory, starts the stall
+    watchdog when ``cfg.stall_sec`` asks for one, publishes a
+    :class:`~firebird_tpu.obs.server.RunStatus` for the module-level
+    progress hooks, and binds the HTTP endpoint ONLY when
+    ``cfg.ops_port`` is set — the default run binds no port.  Returns
+    (status, server, watchdog); tear down with :func:`stop_ops`.  If the
+    port bind fails, everything already started is torn down before the
+    error propagates — a half-up ops surface must not outlive the raise.
+    """
+    jsonlog.set_run_context(run_id=run_id, process_index=_process_index())
+    obs_report.clear_stale_artifacts(cfg)
+    record_topology_metrics()
+    watchdog = None
+    server = None
+    try:
+        if cfg.stall_sec > 0:
+            watchdog = obs_watchdog.Watchdog(cfg.stall_sec).start()
+        status = obs_server.set_status(obs_server.RunStatus(
+            run_id, kind, chips_total=chips_total, counters=counters,
+            watchdog=watchdog, run=run_block, mesh_up=_mesh_ready()))
+        if cfg.ops_port > 0:
+            server = obs_server.start_ops_server(cfg.ops_port, status)
+    except Exception:
+        stop_ops(server, watchdog)
+        raise
+    return status, server, watchdog
+
+
+def stop_ops(server, watchdog) -> None:
+    """Tear down :func:`start_ops` state; never raises — ops teardown
+    must not mask a run's real outcome."""
+    try:
+        if server is not None:
+            server.close()
+        if watchdog is not None:
+            watchdog.stop()
+    except Exception as e:
+        logger("change-detection").error("ops teardown failed: %s", e)
+    finally:
+        obs_server.clear_status()
+        jsonlog.clear_run_context()
 
 
 def make_source(cfg: Config, kind: str | None = None):
@@ -307,6 +438,9 @@ def drain_batch(seg, packed, n_real, *, writer, counters, dtype=None,
             counters.add("pixels", one.n_segments.shape[0])
             counters.add("segments", int(one.n_segments.sum()))
     obs_metrics.histogram("pipeline_drain_seconds").observe(tm.elapsed)
+    # Forward-progress beat: a drained batch is the watchdog's liveness
+    # unit and /progress's batches_done tick (no-op when no run registered).
+    obs_server.batch_done(n_real)
 
 
 def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
@@ -353,9 +487,11 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
         nxt = prefetch_ex.submit(fetch_batch, batches[0]) if batches else None
         drains: list[cf.Future] = []
         for i in range(len(batches)):
+            obs_server.set_stage("fetch")
             chips = nxt.result()
             nxt = (prefetch_ex.submit(fetch_batch, batches[i + 1])
                    if i + 1 < len(batches) else None)
+            obs_server.set_stage("pack")
             with tracing.span("pack", chips=len(chips)), \
                     obs_metrics.timer() as tm:
                 packed = pack(chips, bucket=cfg.obs_bucket,
@@ -364,6 +500,7 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
             # The dispatch span measures enqueue time, not device compute
             # (check_capacity=False keeps it async); compute shows up as
             # the gap before the matching drain span closes.
+            obs_server.set_stage("dispatch")
             with tracing.span("dispatch", chips=packed.n_chips), \
                     obs_metrics.timer() as tm:
                 seg, n_real = detect_batch(packed, dtype,
@@ -371,6 +508,9 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
                                            pad_to=pad_to)
             obs_metrics.histogram(
                 "pipeline_dispatch_seconds").observe(tm.elapsed)
+            # /readyz flips here: mesh up + first batch dispatched means
+            # compile/bring-up are behind us and the run is steady-state.
+            obs_server.batch_dispatched()
             drains.append(drain_ex.submit(
                 drain_batch, seg, packed, n_real, writer=writer,
                 counters=counters, dtype=dtype,
@@ -406,6 +546,13 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
     cfg = resolve_batching(cfg, acquired)
     log = logger("change-detection")
     counters = Counters()
+    # Run identity: ONE id (broadcast fleet-wide) correlates every
+    # host's JSON log lines, spans, /progress payloads, and report
+    # shards.  Context is set immediately — the setup log lines (tile
+    # geometry, resume accounting) must already carry the id; start_ops
+    # re-sets it with the process index once the backend is up.
+    run_id = fleet_run_id()
+    jsonlog.set_run_context(run_id=run_id)
     # Run-scoped telemetry: a fresh registry so the report reflects THIS
     # run.  (The span tracer starts below, right before the try/finally
     # that guarantees its stop — a setup failure here must not leak an
@@ -437,6 +584,17 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
     log.info("tile h=%s v=%s: %d chips in %d chunks (acquired %s)",
              tile["h"], tile["v"], len(cids), len(chunks), acquired)
 
+    # Live ops surface: run context for JSON logs, /progress status,
+    # optional watchdog + HTTP endpoint (no port bound unless asked).
+    run_block = dict(kind="changedetection", run_id=run_id,
+                     host=jsonlog.HOST, process_id=_process_index(),
+                     tile_h=tile["h"], tile_v=tile["v"], acquired=acquired,
+                     chips=len(cids), chunks=len(chunks),
+                     resumed=len(skipped))
+    _, ops_srv, watchdog = start_ops(
+        cfg, run_id, "changedetection", chips_total=len(cids),
+        counters=counters, run_block=run_block)
+
     # Opt-in tracing (cfg.profile_dir): the whole run captures a JAX
     # profiler trace viewable in TensorBoard/Perfetto — the tracing
     # subsystem the reference lacked (SURVEY.md §5).
@@ -447,8 +605,12 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
     else:
         prof = contextlib.nullcontext()
 
-    tracer = tracing.start() if tracing.wants_trace(cfg.trace) else None
+    tracer = tracing.start(run_id=run_id) \
+        if tracing.wants_trace(cfg.trace) else None
     done: list = []
+    # Rate clock starts at the first productive moment, not Counters()
+    # construction — setup/backend idle must not deflate *_per_sec.
+    counters.start()
     try:
         with prof:
             for chunk in chunks:
@@ -457,6 +619,7 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
                         chunk, source=source, writer=writer,
                         acquired=acquired, cfg=cfg, counters=counters,
                         log=log)
+                    obs_server.set_stage("flush")
                     writer.flush()  # a chunk counts once its rows landed
                     done.extend(processed)
                 except Exception as e:
@@ -466,19 +629,20 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
                     log.error("chunk failed (%d chips): %s", len(chunk), e)
                     traceback.print_exc()
     finally:
+        obs_server.set_stage("finalize")
         writer.close()
         snap = counters.snapshot()
         log.info("change-detection complete: %s", snap)
         if tracer is not None:
             tracing.stop()
         paths = obs_report.finish_run(
-            cfg, tracer=tracer, run_counters=snap,
-            run=dict(kind="changedetection", tile_h=tile["h"],
-                     tile_v=tile["v"], acquired=acquired,
-                     chips=len(cids), chunks=len(chunks),
-                     resumed=len(skipped)))
+            cfg, tracer=tracer, run_counters=snap, run=run_block)
         if paths:
             log.info("observability artifacts: %s", paths)
+        # Server goes down LAST so /progress and /report serve the final
+        # state for as long as the process allows.
+        obs_server.set_stage("done")
+        stop_ops(ops_srv, watchdog)
 
     return tuple(skipped) + tuple(done)
 

@@ -38,9 +38,10 @@ from firebird_tpu.ccd.sensor import LANDSAT_ARD
 from firebird_tpu.config import Config
 from firebird_tpu.driver import core as dcore
 from firebird_tpu.ingest import pack
-from firebird_tpu.obs import logger
+from firebird_tpu.obs import jsonlog, logger
 from firebird_tpu.obs import metrics as obs_metrics
 from firebird_tpu.obs import report as obs_report
+from firebird_tpu.obs import server as obs_server
 from firebird_tpu.obs import tracing
 from firebird_tpu.store import AsyncWriter, open_store
 from firebird_tpu.utils import dates as dt
@@ -159,8 +160,11 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
     acquired = acquired or dt.default_acquired()
     cfg = dcore.resolve_batching(cfg, acquired)
     log = logger("stream")
-    # Run-scoped telemetry, same contract as the batch driver (tracer
-    # starts below, just before the try/finally that stops it).
+    # Run identity + run-scoped telemetry, same contract as the batch
+    # driver (tracer starts below, just before the try/finally that
+    # stops it).
+    run_id = dcore.fleet_run_id()            # one id for the whole fleet
+    jsonlog.set_run_context(run_id=run_id)   # setup log lines carry it too
     obs_metrics.reset_registry()
     source = source or dcore.make_source(cfg)
     store = store or open_store(cfg.store_backend, cfg.store_path,
@@ -194,7 +198,19 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
     hi_iso = acquired.split("/")[1]
     boot = [c for c in cids if not os.path.exists(_state_path(sdir, c))]
     upd = [c for c in cids if os.path.exists(_state_path(sdir, c))]
-    tracer = tracing.start() if tracing.wants_trace(cfg.trace) else None
+    run_block = dict(kind="stream", run_id=run_id, host=jsonlog.HOST,
+                     process_id=dcore._process_index(), tile_h=tile["h"],
+                     tile_v=tile["v"], acquired=acquired, chips=len(cids))
+    # The stream's progress unit is a chip (bootstrapped or updated), so
+    # /progress tracks chips over the tile and every bootstrap batch /
+    # update publish beats the watchdog.
+    counters = obs_metrics.Counters()
+    _, ops_srv, wd = dcore.start_ops(
+        cfg, run_id, "stream", chips_total=len(cids), counters=counters,
+        run_block=run_block)
+    tracer = tracing.start(run_id=run_id) \
+        if tracing.wants_trace(cfg.trace) else None
+    counters.start()   # rate clock from first productive work, not setup
     try:
         # --- bootstrap: batched, chip axis sharded over local devices ---
         # Same two data-parallel levels as the batch driver: host_shard
@@ -204,6 +220,7 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
         # batch detection is where the device time goes.
         batches = list(partition_all(max(cfg.chips_per_batch, 1), boot))
         pad_to = cfg.chips_per_batch if len(batches) > 1 else None
+        obs_server.set_stage("bootstrap")
         with cf.ThreadPoolExecutor(
                 max_workers=max(cfg.input_parallelism, 1)) as ex:
             for bids in batches:
@@ -234,6 +251,7 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                         check_capacity=True)
                 obs_metrics.histogram(
                     "pipeline_dispatch_seconds").observe(tm.elapsed)
+                obs_server.batch_dispatched()
                 with tracing.span("drain", chips=n_real), \
                         obs_metrics.timer() as tm:
                     for c in range(n_real):
@@ -251,13 +269,16 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                                     anchor=np.float64(p.dates[c][0]),
                                     horizon=np.float64(p.dates[c][T - 1]))
                         summary["bootstrapped"] += 1
+                        counters.add("chips")
                         save_state(_state_path(sdir, cid), st, side)
                         summary["pixels_need_batch"] += int(
                             np.asarray(st.needs_batch).sum())
                 obs_metrics.histogram(
                     "pipeline_drain_seconds").observe(tm.elapsed)
+                obs_server.batch_done(n_real)
 
         # --- update: apply only acquisitions past each chip's horizon ---
+        obs_server.set_stage("update")
         for cid in upd:
             path = _state_path(sdir, cid)
             st, side = load_state(path)
@@ -297,18 +318,25 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                     summary["obs_applied"] += int(new_idx.size)
             summary["pixels_need_batch"] += int(
                 np.asarray(st.needs_batch).sum())
+            counters.add("chips")
+            # Per-chip progress beat: updates are host-cheap, so the
+            # watchdog's liveness unit here is a processed chip.
+            obs_server.batch_done(1)
+        obs_server.set_stage("flush")
         writer.flush()
     finally:
+        obs_server.set_stage("finalize")
         writer.close()
         for k, v in summary.items():
             obs_metrics.gauge(f"stream_{k}").set(v)
         if tracer is not None:
             tracing.stop()
         paths = obs_report.finish_run(
-            cfg, tracer=tracer,
-            run=dict(kind="stream", tile_h=tile["h"], tile_v=tile["v"],
-                     acquired=acquired, chips=len(cids), **summary))
+            cfg, tracer=tracer, run_counters=counters.snapshot(),
+            run=dict(run_block, **summary))
         if paths:
             log.info("observability artifacts: %s", paths)
+        obs_server.set_stage("done")
+        dcore.stop_ops(ops_srv, wd)
     log.info("stream complete: %s", summary)
     return summary

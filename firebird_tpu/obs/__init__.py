@@ -19,12 +19,21 @@ telemetry layer the reference lacks:
   latency histograms (p50/p95/p99) with Prometheus text exposition and a
   JSON snapshot.
 - :mod:`firebird_tpu.obs.report` — the per-run ``obs_report.json`` artifact
-  (metrics snapshot + span summary) the driver and tools emit.
+  (metrics snapshot + span summary) the driver and tools emit, with
+  per-host shards + a merged fleet report under multi-host SPMD.
+- :mod:`firebird_tpu.obs.server` — the embedded HTTP ops endpoint
+  (``/healthz /readyz /metrics /progress /report``), off by default.
+- :mod:`firebird_tpu.obs.watchdog` — stall detection over driver batch
+  beats; flips ``/healthz`` to 503 and counts ``watchdog_stall_total``.
+- :mod:`firebird_tpu.obs.jsonlog` — run-correlated structured JSON log
+  lines (``FIREBIRD_LOG_FORMAT=json``) carrying run_id/host/process_id.
 
 Env vars: FIREBIRD_LOG_LEVEL / FIREBIRD_LOG_LEVELS (logging),
-FIREBIRD_TRACE (span tracer output), FIREBIRD_METRICS (0 disables metric
-recording), FIREBIRD_OBS_REPORT (report path override; 0 disables).  See
-docs/OBSERVABILITY.md.
+FIREBIRD_LOG_FORMAT (json opts into structured lines), FIREBIRD_TRACE
+(span tracer output), FIREBIRD_METRICS (0 disables metric recording),
+FIREBIRD_OBS_REPORT (report path override; 0 disables), FIREBIRD_OPS_PORT
+(ops endpoint; unset = no port bound), FIREBIRD_STALL_SEC (watchdog
+deadline; unset = off).  See docs/OBSERVABILITY.md.
 """
 
 from __future__ import annotations
@@ -33,6 +42,7 @@ import logging
 import sys
 import threading
 
+from firebird_tpu.obs import jsonlog
 from firebird_tpu.obs.metrics import (Counters, Gauge, Histogram,
                                       MetricsRegistry, counter, gauge,
                                       get_registry, histogram,
@@ -73,14 +83,20 @@ def configure(level: int | None = None) -> None:
             return
         root = logging.getLogger("firebird")
         if not root.handlers:      # never stack duplicate handlers
-            handler = logging.StreamHandler(sys.stderr)
-            handler.setFormatter(
-                logging.Formatter(
-                    fmt="%(asctime)s %(levelname)s %(name)s: %(message)s",
-                    datefmt="%Y-%m-%dT%H:%M:%S",
-                )
-            )
-            root.addHandler(handler)
+            root.addHandler(logging.StreamHandler(sys.stderr))
+        # (Re)apply the format choice on every configure pass so flipping
+        # FIREBIRD_LOG_FORMAT between runs (tests reset _configured) takes
+        # effect on the existing handler rather than requiring a fresh
+        # process.  json: one object per line with run_id/host/process_id
+        # (obs/jsonlog.py); default: the log4j-parity ISO8601 line.
+        if jsonlog.wants_json():
+            fmt: logging.Formatter = jsonlog.JsonFormatter()
+        else:
+            fmt = logging.Formatter(
+                fmt="%(asctime)s %(levelname)s %(name)s: %(message)s",
+                datefmt="%Y-%m-%dT%H:%M:%S")
+        for handler in root.handlers:
+            handler.setFormatter(fmt)
         if level is None:
             level = _parse_level(os.environ.get("FIREBIRD_LOG_LEVEL", "INFO"),
                                  logging.INFO)
@@ -127,7 +143,7 @@ def logger(name: str) -> logging.Logger:
 
 
 __all__ = [
-    "CATEGORIES", "configure", "logger",
+    "CATEGORIES", "configure", "logger", "jsonlog",
     "Counters", "Gauge", "Histogram", "MetricsRegistry", "timer",
     "counter", "gauge", "histogram", "get_registry", "metrics_enabled",
     "Tracer", "span",

@@ -1,0 +1,90 @@
+"""Run-correlated structured logging: JSON lines + the run context.
+
+The reference's log4j lines carry a category and a timestamp and nothing
+else; joining a fleet's logs meant grepping hostnames out of Spark UI
+screenshots.  Here every run mints a ``run_id`` (driver/core.py,
+driver/stream.py) and registers it — with the JAX process index — in a
+process-global run context, and the opt-in JSON formatter
+(``FIREBIRD_LOG_FORMAT=json``, applied by ``obs.configure``) stamps every
+log line with ``run_id`` / ``host`` / ``process_id`` / ``pid`` so a
+multi-host SPMD run's interleaved logs are join-able by run and
+attributable to a host without any out-of-band bookkeeping.
+
+The same context feeds the ops server's ``/progress`` payload and the
+report ``run`` block, so one identifier correlates logs, live endpoints,
+and the post-hoc artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+
+HOST = socket.gethostname()
+
+_lock = threading.Lock()
+_context: dict = {"run_id": None, "process_index": None}
+
+
+def new_run_id() -> str:
+    """Mint a run id: coarse wall-clock prefix (sortable across a fleet)
+    plus random suffix (collision-safe when hosts start in the same
+    second)."""
+    return f"{int(time.time()):x}-{os.urandom(4).hex()}"
+
+
+def set_run_context(run_id: str | None = None,
+                    process_index: int | None = None) -> None:
+    """Install the current run's identity; every JSON log line and the
+    ops endpoints read it.  Passing None leaves a field unchanged."""
+    with _lock:
+        if run_id is not None:
+            _context["run_id"] = run_id
+        if process_index is not None:
+            _context["process_index"] = int(process_index)
+
+
+def clear_run_context() -> None:
+    with _lock:
+        _context["run_id"] = None
+        _context["process_index"] = None
+
+
+def get_run_context() -> dict:
+    with _lock:
+        return dict(_context)
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line: ts/level/logger/message plus the run
+    correlation fields.  Values are whatever ``json.dumps`` can carry;
+    anything else stringifies rather than crashing the log path."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        ctx = get_run_context()
+        out = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S",
+                                time.localtime(record.created))
+                  + f".{int(record.msecs):03d}",
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+            "host": HOST,
+            "pid": record.process,
+            "run_id": ctx["run_id"],
+            "process_id": ctx["process_index"],
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+def wants_json(env: dict | None = None) -> bool:
+    """FIREBIRD_LOG_FORMAT gate: 'json' (case-insensitive) opts in; empty
+    or 'text' keeps the ISO8601 line format."""
+    e = os.environ if env is None else env
+    return e.get("FIREBIRD_LOG_FORMAT", "").strip().lower() == "json"

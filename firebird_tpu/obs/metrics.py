@@ -40,8 +40,9 @@ def metrics_enabled() -> bool:
 class Counter:
     """Monotonic named counter."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, help: str | None = None):
         self.name = name
+        self.help = help
         self._lock = threading.Lock()
         self._value = 0
 
@@ -60,8 +61,9 @@ class Counter:
 class Gauge:
     """Last-written value (queue depths, capacities)."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, help: str | None = None):
         self.name = name
+        self.help = help
         self._lock = threading.Lock()
         self._value = 0.0
 
@@ -94,8 +96,10 @@ class Histogram:
     the observed max — better than +Inf for a report meant to be read).
     """
 
-    def __init__(self, name: str, buckets=LATENCY_BUCKETS_SEC):
+    def __init__(self, name: str, buckets=LATENCY_BUCKETS_SEC,
+                 help: str | None = None):
         self.name = name
+        self.help = help
         self.buckets = tuple(sorted(buckets))
         self._lock = threading.Lock()
         self._counts = [0] * (len(self.buckets) + 1)   # last = overflow
@@ -142,7 +146,13 @@ class Histogram:
                 return {"count": 0}
             out = {"count": self._count, "sum": self._sum,
                    "mean": self._sum / self._count,
-                   "min": self._min, "max": self._max}
+                   "min": self._min, "max": self._max,
+                   # Raw per-bucket counts (last = overflow) travel in the
+                   # snapshot so per-host report shards stay mergeable —
+                   # percentiles cannot be combined, bucket counts can
+                   # (merge_histogram_snapshots).
+                   "bucket_bounds": list(self.buckets),
+                   "bucket_counts": list(self._counts)}
         out.update({"p50": self.quantile(0.50), "p95": self.quantile(0.95),
                     "p99": self.quantile(0.99)})
         return out
@@ -159,10 +169,29 @@ class Histogram:
         return out
 
 
-def _prom_name(name: str) -> str:
-    import re
+# Exposition format contract: every non-empty line is a HELP/TYPE comment
+# or a `name{labels} value` sample.  Shared by tools/obs_smoke.py and the
+# test suite so the scrape-format check cannot drift from the emitter.
+import re as _re
 
-    return "firebird_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+PROM_LINE_RE = _re.compile(
+    r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+)$")
+
+
+def _prom_name(name: str, kind: str | None = None) -> str:
+    """Prometheus-sanitized metric name.  Counters get the conventional
+    ``_total`` suffix exactly once — a counter already named ``*_total``
+    (watchdog_stall_total) must not double up."""
+    p = "firebird_" + _re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    if kind == "counter" and not p.endswith("_total"):
+        p += "_total"
+    return p
+
+
+def _help_text(m, kind: str) -> str:
+    """# HELP body: the metric's declared help, or a readable default."""
+    return m.help or f"firebird {kind} {m.name.replace('_', ' ')}"
 
 
 class MetricsRegistry:
@@ -187,23 +216,25 @@ class MetricsRegistry:
             self._once.add(key)
             return True
 
-    def _get(self, store: dict, name: str, factory):
+    def _get(self, store: dict, name: str, factory, help: str | None):
         with self._lock:
             m = store.get(name)
             if m is None:
                 m = store[name] = factory(name)
+            if help and not m.help:   # first declared help wins
+                m.help = help
             return m
 
-    def counter(self, name: str) -> Counter:
-        return self._get(self._counters, name, Counter)
+    def counter(self, name: str, help: str | None = None) -> Counter:
+        return self._get(self._counters, name, Counter, help)
 
-    def gauge(self, name: str) -> Gauge:
-        return self._get(self._gauges, name, Gauge)
+    def gauge(self, name: str, help: str | None = None) -> Gauge:
+        return self._get(self._gauges, name, Gauge, help)
 
-    def histogram(self, name: str,
-                  buckets=LATENCY_BUCKETS_SEC) -> Histogram:
+    def histogram(self, name: str, buckets=LATENCY_BUCKETS_SEC,
+                  help: str | None = None) -> Histogram:
         return self._get(self._histograms, name,
-                         lambda n: Histogram(n, buckets))
+                         lambda n: Histogram(n, buckets), help)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -225,22 +256,25 @@ class MetricsRegistry:
             hists = sorted(self._histograms.items())
         lines = []
         for name, c in counters:
-            p = _prom_name(name)
-            if not p.endswith("_total"):
-                p += "_total"
-            lines += [f"# TYPE {p} counter", f"{p} {c.value}"]
+            p = _prom_name(name, "counter")
+            lines += [f"# HELP {p} {_help_text(c, 'counter')}",
+                      f"# TYPE {p} counter", f"{p} {c.value}"]
         for name, g in gauges:
             p = _prom_name(name)
-            lines += [f"# TYPE {p} gauge", f"{p} {format(g.value, 'g')}"]
+            lines += [f"# HELP {p} {_help_text(g, 'gauge')}",
+                      f"# TYPE {p} gauge", f"{p} {format(g.value, 'g')}"]
         for name, h in hists:
             p = _prom_name(name)
+            lines.append(f"# HELP {p} {_help_text(h, 'histogram')}")
             lines.append(f"# TYPE {p} histogram")
             for le, cum in h.cumulative_buckets():
                 lines.append(f'{p}_bucket{{le="{le}"}} {cum}')
             snap = h.snapshot()
             lines.append(f"{p}_sum {format(snap.get('sum', 0.0), 'g')}")
             lines.append(f"{p}_count {snap['count']}")
-        return "\n".join(lines) + "\n"
+        # An empty registry exposes nothing — not a lone blank line
+        # (scrape format: every line is a comment or a sample).
+        return "\n".join(lines) + "\n" if lines else ""
 
 
 _registry = MetricsRegistry()
@@ -258,30 +292,117 @@ def reset_registry() -> MetricsRegistry:
     return _registry
 
 
-def counter(name: str) -> Counter:
-    return _registry.counter(name)
+def counter(name: str, help: str | None = None) -> Counter:
+    return _registry.counter(name, help)
 
 
-def gauge(name: str) -> Gauge:
-    return _registry.gauge(name)
+def gauge(name: str, help: str | None = None) -> Gauge:
+    return _registry.gauge(name, help)
 
 
-def histogram(name: str, buckets=LATENCY_BUCKETS_SEC) -> Histogram:
-    return _registry.histogram(name, buckets)
+def histogram(name: str, buckets=LATENCY_BUCKETS_SEC,
+              help: str | None = None) -> Histogram:
+    return _registry.histogram(name, buckets, help)
+
+
+# ---------------------------------------------------------------------------
+# Multi-host merge policy (obs.report.merge_reports)
+# ---------------------------------------------------------------------------
+# Counters always sum across host shards and histogram bucket counts always
+# add; gauges are last-written values, so each needs a declared combination.
+# Prefix rules, first match wins; anything undeclared takes the default —
+# "max" reads as "the worst host" for depth/backlog-style gauges, which is
+# the operator-relevant view.
+GAUGE_MERGE_POLICY: tuple[tuple[str, str], ...] = (
+    ("stream_", "sum"),           # per-host stream summary counts add up
+    ("store_queue_depth", "max"),  # worst backlog across the fleet
+    ("mesh_", "max"),             # global topology, identical on every host
+)
+_GAUGE_MERGE_DEFAULT = "max"
+
+
+def gauge_merge_policy(name: str) -> str:
+    """'sum' | 'max' | 'min' for a gauge name under fleet merge."""
+    for prefix, policy in GAUGE_MERGE_POLICY:
+        if name.startswith(prefix):
+            return policy
+    return _GAUGE_MERGE_DEFAULT
+
+
+def merge_gauge_values(name: str, values: list[float]) -> float:
+    policy = gauge_merge_policy(name)
+    if policy == "sum":
+        return float(sum(values))
+    if policy == "min":
+        return float(min(values))
+    return float(max(values))
+
+
+def merge_histogram_snapshots(snaps: list[dict]) -> dict:
+    """Combine per-host histogram snapshots into one fleet snapshot.
+
+    When every live shard carries the same bucket bounds (the normal case
+    — LATENCY_BUCKETS_SEC is a fixed schema precisely so runs compose),
+    bucket counts add and the percentiles are *recomputed* from the merged
+    buckets.  Shards without bucket data (older schema) or with mismatched
+    bounds fall back to a count-weighted percentile average — labeled
+    approximate, never silently wrong about count/sum/min/max, which merge
+    exactly either way.
+    """
+    live = [s for s in snaps if s.get("count", 0) > 0]
+    if not live:
+        return {"count": 0}
+    bounds = live[0].get("bucket_bounds")
+    same = bounds is not None and \
+        all(s.get("bucket_bounds") == bounds for s in live)
+    if same:
+        h = Histogram("merged", buckets=bounds)
+        h._counts = [sum(s["bucket_counts"][i] for s in live)
+                     for i in range(len(bounds) + 1)]
+        h._count = sum(s["count"] for s in live)
+        h._sum = float(sum(s["sum"] for s in live))
+        h._min = min(s["min"] for s in live)
+        h._max = max(s["max"] for s in live)
+        return h.snapshot()
+    total = sum(s["count"] for s in live)
+    out = {"count": total, "sum": float(sum(s["sum"] for s in live)),
+           "min": min(s["min"] for s in live),
+           "max": max(s["max"] for s in live),
+           "percentiles_approximate": True}
+    out["mean"] = out["sum"] / total
+    for q in ("p50", "p95", "p99"):
+        vals = [(s[q], s["count"]) for s in live if s.get(q) is not None]
+        out[q] = (sum(v * c for v, c in vals) / sum(c for _, c in vals)
+                  if vals else None)
+    return out
 
 
 class Counters:
     """Thread-safe run-scoped throughput counters (the original flat
     counter set; the driver logs its snapshot at run end).  Typical keys:
-    chips, pixels, segments, bytes_in, bytes_out."""
+    chips, pixels, segments, bytes_in, bytes_out.
+
+    The rate clock starts at the first ``add`` (or an explicit
+    ``start()``), NOT at construction: the driver builds its Counters
+    before source/store setup and XLA compilation, and dividing by that
+    idle span deflated every ``*_per_sec`` rate — a 100s compile ahead of
+    a 10s run read as a 10x slower pipeline."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counts: dict[str, int] = {}
-        self._t0 = time.monotonic()
+        self._t0: float | None = None
+
+    def start(self) -> None:
+        """Explicitly (re)start the rate clock — call at the moment the
+        run's productive work begins; otherwise the first add starts it."""
+        with self._lock:
+            self._t0 = time.monotonic()
 
     def add(self, key: str, n: int = 1) -> None:
         with self._lock:
+            if self._t0 is None:
+                self._t0 = time.monotonic()
             self._counts[key] = self._counts.get(key, 0) + n
 
     def get(self, key: str) -> int:
@@ -290,7 +411,8 @@ class Counters:
 
     def snapshot(self) -> dict:
         with self._lock:
-            elapsed = time.monotonic() - self._t0
+            elapsed = (time.monotonic() - self._t0) \
+                if self._t0 is not None else 0.0
             out = dict(self._counts)
         out["elapsed_sec"] = elapsed
         for k in list(out):

@@ -121,6 +121,211 @@ def default_report_path(store_path: str) -> str:
                         "obs_report.json")
 
 
+# ---------------------------------------------------------------------------
+# Multi-host aggregation: per-process shards -> one fleet report
+# ---------------------------------------------------------------------------
+
+def shard_report_path(path: str, process_index: int) -> str:
+    """Per-process shard next to the fleet report:
+    obs_report.json -> obs_report.host<N>.json."""
+    root, ext = os.path.splitext(path)
+    return f"{root}.host{int(process_index)}{ext or '.json'}"
+
+
+def _process_info() -> tuple[int, int]:
+    """(process_count, process_index); (1, 0) when jax/distributed is not
+    up — report emission must never require an initialized backend."""
+    try:
+        import jax
+
+        return jax.process_count(), jax.process_index()
+    except Exception:
+        return 1, 0
+
+
+def clear_stale_artifacts(cfg) -> None:
+    """Run-start cleanup for reused report directories (rolling soak).
+
+    Merge-time shard discovery is by filename, so a shard left by a
+    PREVIOUS run in the same directory would satisfy the wait loop
+    instantly and contaminate the new fleet report with stale counters.
+    Every process therefore deletes its OWN shard before doing any work,
+    and process 0 also drops the old merged report — by the time any
+    process can *write* a new shard (a full detect pass later), every
+    peer has long since passed this point (all of them crossed the
+    jax.distributed bring-up barrier before their run began).  Never
+    raises: cleanup must not fail a run over a read-only artifact dir.
+    """
+    try:
+        path = run_report_path(cfg)
+        if path is None:
+            return
+        n_proc, proc_idx = _process_info()
+        if n_proc <= 1:
+            return
+        stale = [shard_report_path(path, proc_idx)]
+        if proc_idx == 0:
+            stale.append(path)
+        for p in stale:
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
+    except OSError:
+        pass
+
+
+def merge_reports(reports: list[dict]) -> dict:
+    """Combine per-host report shards into one fleet report.
+
+    Merge policy (declared with the metric kinds in obs/metrics.py):
+    counters sum; histogram bucket counts add and percentiles recompute
+    from the merged buckets; gauges combine per
+    ``metrics.gauge_merge_policy`` (sum/max/min by name); span tables sum
+    counts/totals and keep the fleet max; run_counters sum, with
+    ``elapsed_sec`` as the fleet max (wall time, not CPU time) and the
+    ``*_per_sec`` rates recomputed against it.
+    """
+    from firebird_tpu.obs import metrics as m
+
+    if not reports:
+        raise ValueError("no report shards to merge")
+    out = {
+        "schema": SCHEMA,
+        "generated_at": max(r.get("generated_at", "") for r in reports),
+        "run": dict(reports[0].get("run", {})),
+    }
+    mets = [r.get("metrics", {}) for r in reports]
+    counters: dict = {}
+    for met in mets:
+        for k, v in met.get("counters", {}).items():
+            counters[k] = counters.get(k, 0) + v
+    gauges: dict = {}
+    for name in sorted({k for met in mets for k in met.get("gauges", {})}):
+        vals = [met["gauges"][name] for met in mets
+                if name in met.get("gauges", {})]
+        gauges[name] = m.merge_gauge_values(name, vals)
+    hists: dict = {}
+    for name in sorted({k for met in mets
+                        for k in met.get("histograms", {})}):
+        hists[name] = m.merge_histogram_snapshots(
+            [met["histograms"][name] for met in mets
+             if name in met.get("histograms", {})])
+    out["metrics"] = {
+        "elapsed_sec": max((met.get("elapsed_sec", 0.0) for met in mets),
+                           default=0.0),
+        "counters": counters, "gauges": gauges, "histograms": hists,
+    }
+    spans: dict = {}
+    for r in reports:
+        for name, s in (r.get("spans") or {}).items():
+            t = spans.setdefault(name, {"count": 0, "total_ms": 0.0,
+                                        "max_ms": 0.0})
+            t["count"] += s.get("count", 0)
+            t["total_ms"] += s.get("total_ms", 0.0)
+            t["max_ms"] = max(t["max_ms"], s.get("max_ms", 0.0))
+    for s in spans.values():
+        s["mean_ms"] = round(s["total_ms"] / max(s["count"], 1), 3)
+        s["total_ms"] = round(s["total_ms"], 3)
+        s["max_ms"] = round(s["max_ms"], 3)
+    out["spans"] = spans
+    rcs = [r["run_counters"] for r in reports if r.get("run_counters")]
+    if rcs:
+        merged: dict = {}
+        elapsed = max(rc.get("elapsed_sec", 0.0) for rc in rcs)
+        for rc in rcs:
+            for k, v in rc.items():
+                if k == "elapsed_sec" or k.endswith("_per_sec"):
+                    continue
+                merged[k] = merged.get(k, 0) + v
+        for k in list(merged):
+            if elapsed > 0:
+                merged[f"{k}_per_sec"] = merged[k] / elapsed
+        merged["elapsed_sec"] = elapsed
+        out["run_counters"] = merged
+    out["fleet"] = {
+        "hosts": len(reports),
+        "host_runs": [{k: r.get("run", {}).get(k)
+                       for k in ("run_id", "host", "process_id", "chips")}
+                      for r in reports],
+    }
+    return out
+
+
+def merge_fleet_report(path: str, n_processes: int,
+                       timeout: float | None = None,
+                       poll_sec: float = 0.25) -> dict | None:
+    """Process 0's half of the aggregation: wait (bounded) for every
+    host's shard next to ``path``, merge whatever arrived, atomically
+    write the fleet report to ``path``.  Returns the merged report, or
+    None when not even one shard exists.  Hosts that never delivered are
+    listed under ``fleet.missing`` rather than failing the merge — a
+    crashed peer must not take down the survivors' telemetry."""
+    import time as _time
+
+    if timeout is None:
+        timeout = float(os.environ.get("FIREBIRD_OBS_MERGE_TIMEOUT", "30"))
+    paths = [shard_report_path(path, j) for j in range(n_processes)]
+    deadline = _time.monotonic() + timeout
+    while not all(os.path.exists(p) for p in paths) \
+            and _time.monotonic() < deadline:
+        _time.sleep(poll_sec)
+    shards, missing = [], []
+    for j, p in enumerate(paths):
+        try:
+            shards.append(json.load(open(p)))
+        except (OSError, ValueError):
+            missing.append(j)
+    if not shards:
+        return None
+    rep = merge_reports(shards)
+    rep["fleet"]["expected_hosts"] = n_processes
+    if missing:
+        rep["fleet"]["missing"] = missing
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rep, f, indent=1)
+    os.replace(tmp, path)
+    return rep
+
+
+def load_fleet_report(directory: str) -> dict | None:
+    """The merged view of a run directory, for tooling (soak_report,
+    bench).
+
+    Prefers the fleet obs_report.json — UNLESS it recorded missing hosts
+    whose shards have since landed (process 0's merge wait is one-shot
+    at its run end; a host draining past FIREBIRD_OBS_MERGE_TIMEOUT
+    writes its shard after the merge), in which case the shards on disk
+    are re-merged so the late host's contribution is not undercounted
+    forever.  When only shards exist (process 0 died before merging),
+    they merge in memory.  None when the directory holds no report."""
+    import glob as _glob
+
+    shards = []
+    for p in sorted(_glob.glob(
+            os.path.join(directory, "obs_report.host*.json"))):
+        try:
+            shards.append(json.load(open(p)))
+        except (OSError, ValueError):
+            continue
+    merged_path = os.path.join(directory, "obs_report.json")
+    if os.path.exists(merged_path):
+        try:
+            merged = json.load(open(merged_path))
+        except (OSError, ValueError):
+            merged = None
+        if merged is not None:
+            fleet = merged.get("fleet") or {}
+            stale = fleet.get("missing") and len(shards) > fleet.get(
+                "hosts", 0)
+            if not stale:
+                return merged
+    return merge_reports(shards) if shards else None
+
+
 def run_report_path(cfg) -> str | None:
     """Where a driver run's report goes, or None to skip.
 
@@ -159,9 +364,31 @@ def finish_run(cfg, *, tracer=None, run: dict | None = None,
     try:
         path = run_report_path(cfg)
         if path is not None:
-            write_report(path, tracer=tracer, run=run,
-                         run_counters=run_counters)
-            out["report"] = path
+            n_proc, proc_idx = _process_info()
+            if n_proc <= 1:
+                write_report(path, tracer=tracer, run=run,
+                             run_counters=run_counters)
+                out["report"] = path
+            else:
+                # Multi-host SPMD: every process writes its own shard
+                # (obs_report.host<N>.json); process 0 then waits for the
+                # fleet and merges into the single obs_report.json that
+                # tooling reads — the per-process view PR 1 left behind
+                # is preserved in the shards.
+                shard = shard_report_path(path, proc_idx)
+                write_report(shard, tracer=tracer, run=run,
+                             run_counters=run_counters)
+                out["report_shard"] = shard
+                if proc_idx == 0:
+                    merged = merge_fleet_report(path, n_proc)
+                    if merged is not None:
+                        out["report"] = path
+                        got = merged["fleet"]["hosts"]
+                        if got < n_proc:
+                            log.warning(
+                                "fleet report merged %d/%d host shards "
+                                "(missing hosts crashed or timed out)",
+                                got, n_proc)
     except OSError as e:
         log.error("obs report write failed: %s", e)
     return out

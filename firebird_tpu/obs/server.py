@@ -1,0 +1,295 @@
+"""Embedded HTTP ops endpoint: the live ops surface for in-flight runs.
+
+Everything PR 1's telemetry produced was post-hoc — obs_report.json and
+the Chrome trace land at run *end*, useless for a multi-hour tile run you
+need to watch (or for a supervisor that must decide whether to restart a
+wedged SPMD process; there is no Spark UI here to fall back on).  This
+module embeds a stdlib ``http.server`` on a daemon thread — off by
+default, enabled with ``FIREBIRD_OPS_PORT`` / ``--ops-port`` — serving:
+
+``/healthz``
+    Liveness.  200 while the run progresses; 503 once the stall watchdog
+    (obs/watchdog.py) sees no batch complete within its deadline.  The
+    handler evaluates the deadline live, so no background thread is
+    needed when something scrapes.
+``/readyz``
+    Readiness: the device mesh is up AND the first batch has been
+    dispatched — i.e. compile + bring-up are behind us and the run is in
+    its steady state.  503 before that.
+``/metrics``
+    The process metrics registry in Prometheus text exposition 0.0.4
+    (``MetricsRegistry.prometheus()``) — point a scraper at it.
+``/progress``
+    JSON: run_id, chips done/total, batches dispatched/drained, current
+    stage, the run counters with ``*_per_sec`` rates, and the watchdog
+    state.
+``/report``
+    The live ``build_report`` dict — the same document obs_report.json
+    will contain, available at any moment mid-run.
+
+The drivers register a :class:`RunStatus` (run identity, totals, the
+shared ``Counters``, the watchdog) in a process-global slot; the
+module-level hooks (:func:`set_stage`, :func:`batch_dispatched`,
+:func:`batch_done`) are no-ops when no run is registered, so
+instrumentation call sites cost one global read when the surface is off —
+the same discipline as obs/tracing.py.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import threading
+from urllib.parse import urlsplit
+
+
+class RunStatus:
+    """Shared mutable view of one driver run, read by the HTTP handlers.
+
+    ``counters`` is the driver's live ``obs.Counters`` (chips/pixels/
+    segments accumulate as batches drain); ``watchdog`` is optional;
+    ``run`` is the report run block (kind, tile, run_id, ...).
+    """
+
+    def __init__(self, run_id: str, kind: str, *, chips_total: int = 0,
+                 counters=None, watchdog=None, run: dict | None = None,
+                 mesh_up: bool = True):
+        self.run_id = run_id
+        self.kind = kind
+        self.chips_total = int(chips_total)
+        self.counters = counters
+        self.watchdog = watchdog
+        self.run = dict(run or {})
+        self._lock = threading.Lock()
+        self._stage = "init"
+        self._mesh_up = bool(mesh_up)
+        self._first_batch = False
+        self._batches_dispatched = 0
+        self._batches_done = 0
+
+    # -- driver-side updates ----------------------------------------------
+
+    def set_stage(self, name: str) -> None:
+        with self._lock:
+            self._stage = name
+
+    def mark_mesh_up(self) -> None:
+        with self._lock:
+            self._mesh_up = True
+
+    def batch_dispatched(self) -> None:
+        """First dispatch flips readiness: compile/bring-up are done."""
+        with self._lock:
+            self._first_batch = True
+            self._batches_dispatched += 1
+
+    def batch_done(self, units: int = 1) -> None:
+        """A batch finished draining — forward progress; beats the
+        watchdog."""
+        with self._lock:
+            self._batches_done += 1
+        if self.watchdog is not None:
+            self.watchdog.beat(units)
+
+    # -- endpoint reads ----------------------------------------------------
+
+    def healthy(self) -> bool:
+        return self.watchdog is None or not self.watchdog.check()
+
+    def ready(self) -> bool:
+        with self._lock:
+            return self._mesh_up and self._first_batch
+
+    def progress(self) -> dict:
+        with self._lock:
+            stage = self._stage
+            dispatched, done = self._batches_dispatched, self._batches_done
+            mesh_up, first = self._mesh_up, self._first_batch
+        counters = self.counters.snapshot() if self.counters is not None \
+            else {}
+        return {
+            "run_id": self.run_id,
+            "kind": self.kind,
+            "stage": stage,
+            "ready": mesh_up and first,
+            "healthy": self.healthy(),
+            "chips_done": int(counters.get("chips", 0)),
+            "chips_total": self.chips_total,
+            "batches_dispatched": dispatched,
+            "batches_done": done,
+            "counters": counters,
+            "watchdog": (self.watchdog.snapshot()
+                         if self.watchdog is not None else None),
+        }
+
+
+_status: RunStatus | None = None
+_status_lock = threading.Lock()
+
+
+def set_status(status: RunStatus) -> RunStatus:
+    global _status
+    with _status_lock:
+        _status = status
+    return status
+
+
+def clear_status() -> None:
+    global _status
+    with _status_lock:
+        _status = None
+
+
+def current() -> RunStatus | None:
+    return _status
+
+
+# Module-level hooks for instrumentation sites (driver/core.py,
+# driver/stream.py): one global read + None check when no run registered.
+
+def set_stage(name: str) -> None:
+    st = _status
+    if st is not None:
+        st.set_stage(name)
+
+
+def batch_dispatched() -> None:
+    st = _status
+    if st is not None:
+        st.batch_dispatched()
+
+
+def batch_done(units: int = 1) -> None:
+    st = _status
+    if st is not None:
+        st.batch_done(units)
+
+
+def mark_mesh_up() -> None:
+    st = _status
+    if st is not None:
+        st.mark_mesh_up()
+
+
+class _OpsHandler(http.server.BaseHTTPRequestHandler):
+    server_version = "firebird-ops/1"
+    protocol_version = "HTTP/1.1"
+
+    # Route access lines to the obs logger at DEBUG, not stderr spam.
+    def log_message(self, fmt, *args):
+        from firebird_tpu.obs import logger
+        logger("change-detection").debug("ops %s", fmt % args)
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj) -> None:
+        self._send(code, json.dumps(obj, default=str).encode(),
+                   "application/json")
+
+    def do_GET(self):  # noqa: N802 (stdlib handler naming)
+        path = urlsplit(self.path).path
+        try:
+            self._route(path)
+        except BrokenPipeError:
+            pass                       # client went away mid-response
+        except Exception as e:         # a broken endpoint must report, not
+            # kill the ops thread — the surface exists to diagnose trouble
+            try:
+                self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
+
+    def _route(self, path: str) -> None:
+        from firebird_tpu.obs import metrics as obs_metrics
+
+        st = self.server.status if self.server.status is not None \
+            else current()
+        if path == "/healthz":
+            if st is None or st.healthy():
+                self._send(200, b"ok\n", "text/plain")
+            else:
+                self._send(503, b"stalled\n", "text/plain")
+        elif path == "/readyz":
+            if st is not None and st.ready():
+                self._send(200, b"ready\n", "text/plain")
+            else:
+                self._send(503, b"not ready\n", "text/plain")
+        elif path == "/metrics":
+            self._send(200, obs_metrics.get_registry().prometheus().encode(),
+                       "text/plain; version=0.0.4")
+        elif path == "/progress":
+            if st is None:
+                self._send_json(503, {"error": "no run registered"})
+            else:
+                self._send_json(200, st.progress())
+        elif path == "/report":
+            from firebird_tpu.obs import report as obs_report
+            from firebird_tpu.obs import tracing
+            self._send_json(200, obs_report.build_report(
+                tracer=tracing.active(),
+                run=st.run if st is not None else {},
+                run_counters=(st.counters.snapshot()
+                              if st is not None and st.counters is not None
+                              else None)))
+        else:
+            self._send_json(404, {"error": f"unknown path {path!r}",
+                                  "paths": ["/healthz", "/readyz", "/metrics",
+                                            "/progress", "/report"]})
+
+
+class OpsServer(http.server.ThreadingHTTPServer):
+    """Threading HTTP server on a daemon thread; ``port`` is the bound
+    port (useful when constructed with port 0 for an ephemeral bind)."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, status: RunStatus | None = None):
+        super().__init__(addr, _OpsHandler)
+        self.status = status
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def start(self) -> "OpsServer":
+        self._thread = threading.Thread(
+            target=self.serve_forever, kwargs={"poll_interval": 0.25},
+            name="firebird-ops", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self.shutdown()
+        self.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def start_ops_server(port: int, status: RunStatus | None = None,
+                     host: str | None = None) -> OpsServer:
+    """Bind and start the ops endpoint.
+
+    ``port`` 0 binds an OS-assigned ephemeral port (tests, obs-smoke);
+    callers gating on config must only call this when the operator set
+    ``FIREBIRD_OPS_PORT``/``--ops-port`` — the surface is off by default
+    and no port is ever bound otherwise (driver/core.py guards on
+    ``cfg.ops_port > 0``).  Bind host comes from FIREBIRD_OPS_HOST
+    (default all interfaces — the endpoint exists to be scraped).
+    """
+    host = host if host is not None else \
+        os.environ.get("FIREBIRD_OPS_HOST", "0.0.0.0")
+    srv = OpsServer((host, int(port)), status=status).start()
+    from firebird_tpu.obs import logger
+    logger("change-detection").info(
+        "ops endpoint up on %s:%d (/healthz /readyz /metrics /progress "
+        "/report)", host, srv.port)
+    return srv

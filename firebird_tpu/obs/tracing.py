@@ -66,7 +66,11 @@ class Tracer:
     Perfetto tracks are readable (MainThread, ThreadPoolExecutor-0_0, ...).
     """
 
-    def __init__(self):
+    def __init__(self, run_id: str | None = None):
+        # Run correlation: the trace artifact carries the same run_id as
+        # the JSON logs, /progress, and the report run block (otherData
+        # plus a process_name metadata track label in Perfetto).
+        self.run_id = run_id
         self._lock = threading.Lock()
         self._events: list[dict] = []
         # tids assign through a threading.local, NOT by OS thread ident:
@@ -103,8 +107,14 @@ class Tracer:
     def to_chrome_trace(self) -> dict:
         with self._lock:
             events = list(self._events)
+        other = {"producer": "firebird_tpu.obs.tracing"}
+        if self.run_id:
+            other["run_id"] = self.run_id
+            events = [{"name": "process_name", "ph": "M", "pid": 0,
+                       "tid": 0, "args": {"name": f"run {self.run_id}"}}] \
+                + events
         return {"traceEvents": events, "displayTimeUnit": "ms",
-                "otherData": {"producer": "firebird_tpu.obs.tracing"}}
+                "otherData": other}
 
     def save(self, path: str) -> str:
         """Write the Chrome-trace JSON (atomic tmp+rename)."""
@@ -143,11 +153,15 @@ def active() -> Tracer | None:
     return _active
 
 
-def start(tracer: Tracer | None = None) -> Tracer:
+def start(tracer: Tracer | None = None,
+          run_id: str | None = None) -> Tracer:
     """Install ``tracer`` (or a fresh one) as the process-global span sink
-    and return it.  Spans from any thread land in the active tracer."""
+    and return it.  Spans from any thread land in the active tracer.
+    ``run_id`` stamps the exported trace for fleet-log correlation."""
     global _active
-    _active = tracer or Tracer()
+    _active = tracer or Tracer(run_id=run_id)
+    if run_id and _active.run_id is None:
+        _active.run_id = run_id
     return _active
 
 

@@ -38,4 +38,17 @@ def init_distributed(coordinator: str | None = None,
                                process_id=process_id)
     log.info("jax.distributed up: %d processes, %d global devices",
              num_processes, len(jax.devices()))
+    # Fleet topology gauges feed /metrics and the merged fleet report
+    # (identical on every host — merge policy "max", obs/metrics.py);
+    # mark_mesh_up is the /readyz mesh half for any already-registered
+    # run status (no-op otherwise — bring-up normally precedes the run).
+    from firebird_tpu.obs import metrics as obs_metrics
+    from firebird_tpu.obs import server as obs_server
+
+    obs_metrics.gauge("mesh_processes",
+                      help="jax.distributed process count").set(num_processes)
+    obs_metrics.gauge("mesh_global_devices",
+                      help="global device count after DCN bring-up").set(
+                          len(jax.devices()))
+    obs_server.mark_mesh_up()
     return True

@@ -65,7 +65,15 @@ def make_mesh(devices=None, n_devices: int | None = None) -> Mesh:
                 raise ValueError(
                     f"need {n_devices} devices, have {len(devices)}")
             devices = devices[:n_devices]
-    return Mesh(np.array(devices), axis_names=("data",))
+    mesh = Mesh(np.array(devices), axis_names=("data",))
+    # Topology gauge for /metrics and the fleet report (merge "max" —
+    # the value is the same on every host of a global mesh).
+    from firebird_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.gauge("mesh_devices",
+                      help="devices in the active data mesh").set(
+                          mesh.devices.size)
+    return mesh
 
 
 def chip_sharding(mesh: Mesh) -> NamedSharding:

@@ -63,8 +63,12 @@ class AsyncWriter:
                 self._error = e if isinstance(e, Exception) \
                     else RuntimeError(f"writer interrupted: {e!r}")
             finally:
-                q.task_done()
+                # Depth BEFORE task_done: the ack releases flush()'s
+                # join(), and the gauge must already reflect the drain
+                # (success or failure alike) when flush returns — a
+                # failing backend must not leave a phantom backlog.
                 self._update_depth()
+                q.task_done()
 
     def _pop_error(self) -> Exception | None:
         err, self._error = self._error, None
@@ -98,6 +102,11 @@ class AsyncWriter:
             for q in self._qs:
                 q.join()
         obs_metrics.histogram("store_flush_seconds").observe(tm.elapsed)
+        # Authoritative sweep AFTER the joins and BEFORE any raise: all
+        # acks happened-before this point, so even if worker-side updates
+        # interleaved badly the gauge lands at the true (empty) depth on
+        # the failure path too — not just when every write succeeded.
+        self._update_depth()
         err = self._pop_error()
         if err is not None:
             raise err

@@ -37,3 +37,15 @@ import pytest
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (shared by the multihost coordinator
+    and the ops-endpoint tests; small bind race accepted)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

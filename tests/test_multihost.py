@@ -10,24 +10,18 @@ single-host enumeration — the framework's multi-host correctness claim.
 """
 
 import glob
+import json
 import os
-import socket
 import sqlite3
 import subprocess
 import sys
 import time
 
 import pytest
+from conftest import free_port as _free_port
 
 from firebird_tpu import grid
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from firebird_tpu.obs import report as obs_report
 
 
 def _run_children(tmp_path, tag, cmd_for, env_for, n=2, timeout=1800):
@@ -116,6 +110,37 @@ def test_two_process_changedetection(tmp_path):
     # every pixel of every chip accounted for
     n_pix = con.execute("SELECT COUNT(*) FROM pixel").fetchone()[0]
     assert n_pix == 4 * 10000
+
+    # --- multi-host report aggregation (obs.report) ---
+    # each process wrote its own shard next to the shared store...
+    shard0 = json.load(open(tmp_path / "obs_report.host0.json"))
+    shard1 = json.load(open(tmp_path / "obs_report.host1.json"))
+    for i, sh in enumerate((shard0, shard1)):
+        obs_report.validate_report(sh)
+        assert sh["run"]["process_id"] == i
+        assert sh["run_counters"]["chips"] == 2
+    # ONE fleet-wide run id: process 0 mints it and broadcasts through
+    # the coordination-service KV store (driver.core.fleet_run_id), so
+    # both hosts' logs/shards join on the same identifier
+    assert shard0["run"]["run_id"] == shard1["run"]["run_id"]
+    # ...and process 0 merged them into one fleet obs_report.json whose
+    # counters equal the sum of the shards
+    fleet = json.load(open(tmp_path / "obs_report.json"))
+    obs_report.validate_report(fleet)
+    assert fleet["fleet"]["hosts"] == 2
+    assert fleet["fleet"]["expected_hosts"] == 2
+    assert "missing" not in fleet["fleet"]
+    assert fleet["run_counters"]["chips"] == 4
+    assert fleet["run_counters"]["pixels"] == 4 * 10000
+    for name, fc in fleet["metrics"]["counters"].items():
+        assert fc == shard0["metrics"]["counters"].get(name, 0) \
+            + shard1["metrics"]["counters"].get(name, 0), name
+    for name, fh in fleet["metrics"]["histograms"].items():
+        parts = [sh["metrics"]["histograms"].get(name, {"count": 0})
+                 for sh in (shard0, shard1)]
+        assert fh["count"] == sum(p["count"] for p in parts), name
+    # the merged view is what tooling loads for this directory
+    assert obs_report.load_fleet_report(str(tmp_path))["fleet"]["hosts"] == 2
 
 
 def test_global_mesh_two_procs_two_devices(tmp_path):

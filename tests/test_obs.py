@@ -53,6 +53,29 @@ def test_counters_snapshot_rates():
     assert "pixels_per_sec" in snap and snap["elapsed_sec"] >= 0
 
 
+def test_counters_rate_clock_excludes_preconstruction_idle():
+    """*_per_sec divides by ACTIVE run time: the clock starts at the
+    first add (or an explicit start()), not at construction — a long
+    setup/compile gap before the run must not deflate the rates."""
+    import time
+
+    c = obs.Counters()
+    time.sleep(0.25)                    # pre-run idle (setup, compile)
+    assert c.snapshot() == {"elapsed_sec": 0.0}   # no clock yet, no rates
+    c.add("chips", 10)
+    snap = c.snapshot()
+    # elapsed measures from the first add, not from construction
+    assert snap["elapsed_sec"] < 0.2, snap
+    assert snap["chips_per_sec"] > 10 / 0.2
+    # explicit start() re-bases the clock (drivers call it at the first
+    # productive moment)
+    c2 = obs.Counters()
+    time.sleep(0.1)
+    c2.start()
+    c2.add("pixels", 100)
+    assert c2.snapshot()["elapsed_sec"] < 0.1
+
+
 # ---------------------------------------------------------------------------
 # Span tracer
 # ---------------------------------------------------------------------------
@@ -136,6 +159,46 @@ def test_histogram_empty_and_overflow():
     assert h.quantile(0.5) == 1e6              # overflow reports observed max
 
 
+def test_histogram_quantile_edge_cases():
+    # empty: every quantile is None, including the extremes
+    h = obs_metrics.Histogram("t_seconds")
+    assert h.quantile(0.0) is None and h.quantile(1.0) is None
+    # single observation: every quantile IS that observation
+    h.observe(0.03)
+    for q in (0.0, 0.5, 0.95, 1.0):
+        assert h.quantile(q) == pytest.approx(0.03)
+    snap = h.snapshot()
+    assert snap["count"] == 1
+    assert snap["min"] == snap["max"] == pytest.approx(0.03)
+    # q=0 / q=1 clamp to the observed range, never the bucket edges
+    h2 = obs_metrics.Histogram("t2_seconds")
+    for v in (0.012, 0.07, 0.9):
+        h2.observe(v)
+    assert h2.quantile(0.0) == pytest.approx(0.012)
+    assert h2.quantile(1.0) == pytest.approx(0.9)
+    assert 0.012 <= h2.quantile(0.5) <= 0.9
+
+
+def test_reset_registry_isolates_runs():
+    """A new driver run must not inherit the previous run's metrics —
+    and handles captured from the OLD registry must not leak into the
+    new one."""
+    reg1 = obs_metrics.reset_registry()
+    obs_metrics.counter("chips").inc(7)
+    obs_metrics.histogram("pipeline_fetch_seconds").observe(0.5)
+    old_counter = obs_metrics.counter("chips")
+    reg2 = obs_metrics.reset_registry()
+    assert reg2 is obs_metrics.get_registry() and reg2 is not reg1
+    # fresh registry: clean slate for the same names
+    assert obs_metrics.counter("chips").value == 0
+    assert obs_metrics.histogram("pipeline_fetch_seconds").snapshot() \
+        == {"count": 0}
+    # the old handle still works but writes to the dead registry only
+    old_counter.inc()
+    assert obs_metrics.counter("chips").value == 0
+    assert reg1.counter("chips").value == 8
+
+
 def test_prometheus_exposition_format():
     reg = obs_metrics.MetricsRegistry()
     reg.counter("chips").inc(5)
@@ -155,6 +218,48 @@ def test_prometheus_exposition_format():
     cums = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
             if line.startswith("firebird_pipeline_fetch_seconds_bucket")]
     assert cums == sorted(cums)
+
+
+def test_prometheus_help_lines_and_total_guard():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("chips", help="chips drained to the store").inc(2)
+    # a counter already named *_total must not become *_total_total
+    reg.counter("watchdog_stall_total").inc()
+    reg.gauge("store_queue_depth").set(1)
+    reg.histogram("pipeline_fetch_seconds").observe(0.01)
+    text = reg.prometheus()
+    assert "# HELP firebird_chips_total chips drained to the store" in text
+    assert "firebird_watchdog_stall_total 1" in text
+    assert "firebird_watchdog_stall_total_total" not in text
+    # every metric gets a HELP line (declared or derived)
+    assert "# HELP firebird_store_queue_depth " in text
+    assert "# HELP firebird_pipeline_fetch_seconds " in text
+    # _prom_name only suffixes counters
+    assert obs_metrics._prom_name("chips", "counter") \
+        == "firebird_chips_total"
+    assert obs_metrics._prom_name("x_total", "counter") \
+        == "firebird_x_total"
+    assert obs_metrics._prom_name("chips") == "firebird_chips"
+
+
+def test_prometheus_exposition_roundtrips_format_regex():
+    """Every exposition line is `# HELP|# TYPE ...` or
+    `name{labels} value` — the format a scraper actually parses (the
+    shared contract regex, also applied by tools/obs_smoke.py)."""
+    prom_line = obs_metrics.PROM_LINE_RE
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("chips").inc(3)
+    reg.counter("watchdog_stall_total")
+    reg.gauge("negative").set(-2.5)
+    reg.gauge("tiny").set(1e-07)
+    h = reg.histogram("pipeline_fetch_seconds")
+    for v in (0.0001, 0.02, 4.0, 1e6):
+        h.observe(v)
+    reg.histogram("empty_seconds")
+    lines = reg.prometheus().splitlines()
+    assert lines, "exposition must not be empty"
+    for ln in lines:
+        assert prom_line.match(ln), f"malformed exposition line: {ln!r}"
 
 
 def test_counter_thread_safety():

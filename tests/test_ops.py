@@ -1,0 +1,503 @@
+"""Live ops surface: HTTP endpoints, stall watchdog, run-correlated JSON
+logs, and multi-host report aggregation (obs/server.py, obs/watchdog.py,
+obs/jsonlog.py, obs.report merge)."""
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from firebird_tpu.config import Config
+from firebird_tpu.obs import jsonlog
+from firebird_tpu.obs import metrics as obs_metrics
+from firebird_tpu.obs import report as obs_report
+from firebird_tpu.obs import server as obs_server
+from firebird_tpu.obs import tracing
+from firebird_tpu.obs.metrics import PROM_LINE_RE as PROM_LINE
+from firebird_tpu.obs.watchdog import Watchdog
+
+
+def _get(port, path):
+    """(status, body bytes) against the local ops server."""
+    try:
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5)
+        return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+@pytest.fixture
+def clean_status():
+    yield
+    obs_server.clear_status()
+    jsonlog.clear_run_context()
+
+
+# ---------------------------------------------------------------------------
+# Ops server endpoints
+# ---------------------------------------------------------------------------
+
+def test_ops_disabled_by_default():
+    """No port is ever bound unless explicitly asked for: the config
+    default is off and both drivers gate on it (cfg.ops_port > 0)."""
+    from firebird_tpu.driver import core
+
+    assert Config().ops_port == 0
+    assert Config.from_env(env={}).ops_port == 0
+    counters = obs_metrics.Counters()
+    try:
+        _, srv, wd = core.start_ops(
+            Config(), "rid", "test", chips_total=1, counters=counters,
+            run_block={})
+        assert srv is None and wd is None
+    finally:
+        core.stop_ops(None, None)
+    with pytest.raises(ValueError):
+        Config(ops_port=99999)
+
+
+def test_ops_endpoints_roundtrip(clean_status):
+    counters = obs_metrics.Counters()
+    counters.add("chips", 3)
+    status = obs_server.RunStatus(
+        "run-1", "changedetection", chips_total=8, counters=counters,
+        run={"kind": "changedetection", "run_id": "run-1"})
+    srv = obs_server.start_ops_server(0, status, host="127.0.0.1")
+    try:
+        code, body = _get(srv.port, "/healthz")
+        assert (code, body) == (200, b"ok\n")
+
+        # not ready until the first batch dispatches
+        code, _ = _get(srv.port, "/readyz")
+        assert code == 503
+        status.batch_dispatched()
+        code, _ = _get(srv.port, "/readyz")
+        assert code == 200
+
+        status.set_stage("dispatch")
+        status.batch_done(3)
+        code, body = _get(srv.port, "/progress")
+        assert code == 200
+        prog = json.loads(body)
+        assert prog["run_id"] == "run-1"
+        assert prog["stage"] == "dispatch"
+        assert prog["chips_done"] == 3 and prog["chips_total"] == 8
+        assert prog["batches_dispatched"] == 1
+        assert prog["batches_done"] == 1
+        assert prog["ready"] and prog["healthy"]
+        assert prog["counters"]["chips"] == 3
+
+        code, body = _get(srv.port, "/metrics")
+        assert code == 200
+        for ln in body.decode().splitlines():
+            assert PROM_LINE.match(ln), ln
+
+        code, body = _get(srv.port, "/report")
+        assert code == 200
+        rep = json.loads(body)
+        obs_report.validate_report(rep)
+        assert rep["run"]["run_id"] == "run-1"
+        assert rep["run_counters"]["chips"] == 3
+
+        code, body = _get(srv.port, "/nope")
+        assert code == 404 and b"unknown path" in body
+    finally:
+        srv.close()
+
+
+def test_ops_server_serves_module_status(clean_status):
+    """A server started without an explicit status falls back to the
+    process-global slot the drivers publish into."""
+    srv = obs_server.start_ops_server(0, host="127.0.0.1")
+    try:
+        code, _ = _get(srv.port, "/progress")
+        assert code == 503                       # no run registered
+        obs_server.set_status(obs_server.RunStatus("run-2", "stream"))
+        obs_server.set_stage("update")
+        code, body = _get(srv.port, "/progress")
+        assert code == 200
+        assert json.loads(body)["stage"] == "update"
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_stall_and_recovery(clean_status):
+    obs_metrics.reset_registry()
+    clock = [0.0]
+    wd = Watchdog(stall_sec=10.0, clock=lambda: clock[0])
+    wd.beat()                   # enter steady state (grace covered below)
+    clock[0] = 9.0
+    assert not wd.check()
+    clock[0] = 11.0
+    assert wd.check() and wd.stalled
+    # one stall episode = one increment, however often it's polled
+    wd.check()
+    assert obs_metrics.counter("watchdog_stall_total").value == 1
+    # a beat clears the stall
+    wd.beat(2)
+    assert not wd.check()
+    assert obs_metrics.counter("watchdog_recovered_total").value == 1
+    snap = wd.snapshot()
+    assert snap["beats"] == 2 and not snap["stalled"]
+    with pytest.raises(ValueError):
+        Watchdog(stall_sec=0)
+
+
+def test_watchdog_bringup_grace_before_first_beat():
+    """Until the first beat the deadline is stall_sec * grace_factor:
+    first-compile bring-up must not read as a stall (a liveness
+    supervisor would restart-loop), but a HUNG bring-up still does."""
+    obs_metrics.reset_registry()
+    clock = [0.0]
+    wd = Watchdog(stall_sec=10.0, grace_factor=3.0, clock=lambda: clock[0])
+    clock[0] = 25.0             # past stall_sec, inside the grace window
+    assert not wd.check()
+    clock[0] = 31.0             # past the grace deadline: genuinely hung
+    assert wd.check()
+    assert obs_metrics.counter("watchdog_stall_total").value == 1
+    # after the first beat the plain deadline applies
+    wd2 = Watchdog(stall_sec=10.0, grace_factor=3.0, clock=lambda: clock[0])
+    wd2.beat()
+    clock[0] += 11.0
+    assert wd2.check()
+
+
+def test_watchdog_flips_healthz_to_503(clean_status):
+    obs_metrics.reset_registry()
+    clock = [0.0]
+    wd = Watchdog(stall_sec=5.0, clock=lambda: clock[0])
+    wd.beat()               # steady state; plain deadline applies
+    status = obs_server.RunStatus("run-3", "changedetection", watchdog=wd)
+    srv = obs_server.start_ops_server(0, status, host="127.0.0.1")
+    try:
+        assert _get(srv.port, "/healthz")[0] == 200
+        clock[0] = 6.0      # simulated stall: batch deadline exceeded
+        code, body = _get(srv.port, "/healthz")
+        assert (code, body) == (503, b"stalled\n")
+        assert obs_metrics.counter("watchdog_stall_total").value == 1
+        assert not json.loads(_get(srv.port, "/progress")[1])["healthy"]
+        wd.beat()           # progress resumes -> healthy again
+        assert _get(srv.port, "/healthz")[0] == 200
+    finally:
+        srv.close()
+
+
+def test_watchdog_throughput_drop_events():
+    obs_metrics.reset_registry()
+    clock = [0.0]
+    wd = Watchdog(stall_sec=1000.0, clock=lambda: clock[0])
+    # steady cadence: 1 beat/sec for 20s, then a 5x slowdown
+    for i in range(20):
+        clock[0] = float(i)
+        wd.beat()
+    assert obs_metrics.counter("watchdog_throughput_drop_total").value == 0
+    for i in range(6):
+        clock[0] = 20.0 + 5.0 * (i + 1)
+        wd.beat()
+    assert obs_metrics.counter("watchdog_throughput_drop_total").value >= 1
+    snap = wd.snapshot()
+    assert snap["throughput_drops"], snap
+    ev = snap["throughput_drops"][0]
+    assert ev["recent_per_sec"] < ev["baseline_per_sec"]
+
+
+# ---------------------------------------------------------------------------
+# Run-correlated JSON logs
+# ---------------------------------------------------------------------------
+
+def test_jsonlog_formatter_carries_run_context(clean_status):
+    jsonlog.set_run_context(run_id="run-x", process_index=3)
+    rec = logging.LogRecord("firebird.pyccd", logging.WARNING, __file__, 1,
+                            "chip (%d,%d) failed", (3, 4), None)
+    line = json.loads(jsonlog.JsonFormatter().format(rec))
+    assert line["message"] == "chip (3,4) failed"
+    assert line["level"] == "WARNING"
+    assert line["logger"] == "firebird.pyccd"
+    assert line["run_id"] == "run-x" and line["process_id"] == 3
+    assert line["host"] == jsonlog.HOST and line["pid"]
+    jsonlog.clear_run_context()
+    line = json.loads(jsonlog.JsonFormatter().format(rec))
+    assert line["run_id"] is None and line["process_id"] is None
+
+
+def test_configure_swaps_formatter_on_env(monkeypatch):
+    import firebird_tpu.obs as obs
+
+    root = logging.getLogger("firebird")
+    monkeypatch.setenv("FIREBIRD_LOG_FORMAT", "json")
+    monkeypatch.setattr(obs, "_configured", False)
+    obs.configure()
+    assert all(isinstance(h.formatter, jsonlog.JsonFormatter)
+               for h in root.handlers)
+    # flipping back restores the ISO text format for later tests
+    monkeypatch.delenv("FIREBIRD_LOG_FORMAT")
+    monkeypatch.setattr(obs, "_configured", False)
+    obs.configure()
+    assert not any(isinstance(h.formatter, jsonlog.JsonFormatter)
+                   for h in root.handlers)
+
+
+def test_new_run_ids_are_unique():
+    ids = {jsonlog.new_run_id() for _ in range(64)}
+    assert len(ids) == 64
+
+
+def test_tracer_carries_run_id():
+    t = tracing.start(run_id="run-y")
+    try:
+        with tracing.span("fetch"):
+            pass
+    finally:
+        tracing.stop()
+    trace = t.to_chrome_trace()
+    assert trace["otherData"]["run_id"] == "run-y"
+    obs_report.validate_trace(trace)
+
+
+# ---------------------------------------------------------------------------
+# Multi-host report aggregation
+# ---------------------------------------------------------------------------
+
+def _host_report(host, *, chips, fetch_obs, queue_depth, elapsed):
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("chips_detected").inc(chips)
+    reg.gauge("store_queue_depth").set(queue_depth)
+    reg.gauge("stream_updated").set(chips)
+    h = reg.histogram("pipeline_fetch_seconds")
+    for v in fetch_obs:
+        h.observe(v)
+    t = tracing.Tracer()
+    with t.span("fetch"):
+        pass
+    return obs_report.build_report(
+        registry=reg, tracer=t,
+        run={"kind": "changedetection", "run_id": "fleet-1", "host": host,
+             "process_id": int(host[-1]), "chips": chips},
+        run_counters={"chips": chips, "elapsed_sec": elapsed,
+                      "chips_per_sec": chips / elapsed})
+
+
+def test_merge_reports_policy():
+    r0 = _host_report("h0", chips=4, fetch_obs=[0.01, 0.02],
+                      queue_depth=5, elapsed=10.0)
+    r1 = _host_report("h1", chips=6, fetch_obs=[0.04, 0.08],
+                      queue_depth=2, elapsed=8.0)
+    fleet = obs_report.merge_reports([r0, r1])
+    obs_report.validate_report(fleet)
+    # counters sum
+    assert fleet["metrics"]["counters"]["chips_detected"] == 10
+    # gauges per declared policy: queue depth max, stream_* sum
+    assert fleet["metrics"]["gauges"]["store_queue_depth"] == 5
+    assert fleet["metrics"]["gauges"]["stream_updated"] == 10
+    # histogram buckets merge; stats recompute over the union
+    h = fleet["metrics"]["histograms"]["pipeline_fetch_seconds"]
+    assert h["count"] == 4
+    assert h["min"] == 0.01 and h["max"] == 0.08
+    assert h["sum"] == pytest.approx(0.15)
+    assert h["min"] <= h["p50"] <= h["p99"] <= h["max"]
+    # spans aggregate
+    assert fleet["spans"]["fetch"]["count"] == 2
+    # run_counters sum; rates recompute against fleet-max elapsed
+    rc = fleet["run_counters"]
+    assert rc["chips"] == 10 and rc["elapsed_sec"] == 10.0
+    assert rc["chips_per_sec"] == pytest.approx(1.0)
+    # fleet identity block
+    assert fleet["fleet"]["hosts"] == 2
+    assert {h["host"] for h in fleet["fleet"]["host_runs"]} == {"h0", "h1"}
+
+
+def test_gauge_merge_policy_declarations():
+    assert obs_metrics.gauge_merge_policy("stream_updated") == "sum"
+    assert obs_metrics.gauge_merge_policy("store_queue_depth") == "max"
+    assert obs_metrics.gauge_merge_policy("anything_else") == "max"
+    assert obs_metrics.merge_gauge_values("stream_x", [1, 2]) == 3
+    assert obs_metrics.merge_gauge_values("depth", [1, 2]) == 2
+
+
+def test_merge_histogram_snapshots_fallback_without_buckets():
+    """Shards from an older schema (no bucket counts) still merge: exact
+    count/sum/min/max, percentiles labeled approximate."""
+    a = {"count": 2, "sum": 0.2, "mean": 0.1, "min": 0.05, "max": 0.15,
+         "p50": 0.1, "p95": 0.15, "p99": 0.15}
+    b = {"count": 6, "sum": 1.2, "mean": 0.2, "min": 0.1, "max": 0.4,
+         "p50": 0.2, "p95": 0.4, "p99": 0.4}
+    m = obs_metrics.merge_histogram_snapshots([a, b])
+    assert m["count"] == 8 and m["min"] == 0.05 and m["max"] == 0.4
+    assert m["percentiles_approximate"]
+    assert m["p50"] == pytest.approx((0.1 * 2 + 0.2 * 6) / 8)
+    assert obs_metrics.merge_histogram_snapshots(
+        [{"count": 0}, {"count": 0}]) == {"count": 0}
+
+
+def test_fleet_shard_write_and_merge(tmp_path):
+    path = str(tmp_path / "obs_report.json")
+    assert obs_report.shard_report_path(path, 1).endswith(
+        "obs_report.host1.json")
+    for i, chips in enumerate((4, 6)):
+        rep = _host_report(f"h{i}", chips=chips, fetch_obs=[0.01],
+                           queue_depth=i, elapsed=5.0)
+        with open(obs_report.shard_report_path(path, i), "w") as f:
+            json.dump(rep, f)
+    merged = obs_report.merge_fleet_report(path, 2, timeout=1.0)
+    assert merged is not None
+    on_disk = json.load(open(path))
+    assert on_disk["metrics"]["counters"]["chips_detected"] == 10
+    assert on_disk["fleet"]["hosts"] == 2
+    assert on_disk["fleet"]["expected_hosts"] == 2
+    assert "missing" not in on_disk["fleet"]
+    # load_fleet_report prefers the merged file...
+    assert obs_report.load_fleet_report(str(tmp_path))["fleet"]["hosts"] == 2
+    # ...and falls back to merging shards when it is gone
+    (tmp_path / "obs_report.json").unlink()
+    fallback = obs_report.load_fleet_report(str(tmp_path))
+    assert fallback["metrics"]["counters"]["chips_detected"] == 10
+
+
+def test_clear_stale_artifacts_scoped_per_process(tmp_path, monkeypatch):
+    """Reused artifact dirs (rolling soak): each process removes its OWN
+    stale shard at run start — and process 0 the stale merged report —
+    so a previous run's shards can never satisfy the merge wait.  A peer
+    host's shard is never touched (it cleans its own at its start)."""
+    import os
+
+    cfg = Config(store_backend="sqlite", store_path=str(tmp_path / "fb.db"))
+    path = obs_report.run_report_path(cfg)
+    shard0 = obs_report.shard_report_path(path, 0)
+    shard1 = obs_report.shard_report_path(path, 1)
+    for p in (path, shard0, shard1):
+        with open(p, "w") as f:
+            f.write("{}")
+    monkeypatch.setattr(obs_report, "_process_info", lambda: (2, 0))
+    obs_report.clear_stale_artifacts(cfg)
+    assert not os.path.exists(path) and not os.path.exists(shard0)
+    assert os.path.exists(shard1)
+    monkeypatch.setattr(obs_report, "_process_info", lambda: (2, 1))
+    obs_report.clear_stale_artifacts(cfg)
+    assert not os.path.exists(shard1)
+    # single-process runs leave everything alone
+    with open(path, "w") as f:
+        f.write("{}")
+    monkeypatch.setattr(obs_report, "_process_info", lambda: (1, 0))
+    obs_report.clear_stale_artifacts(cfg)
+    assert os.path.exists(path)
+
+
+def test_start_ops_tears_down_on_bind_failure(clean_status, monkeypatch):
+    """A failed --ops-port bind must not leak the watchdog thread or the
+    global run status past the raise (nothing else would clean them)."""
+    import socket
+
+    from firebird_tpu.driver import core
+
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    # Same exact address the server will bind: identical addr:port always
+    # conflicts (a wildcard-vs-specific pair would not on Linux when both
+    # sides set SO_REUSEADDR, as OpsServer does).
+    monkeypatch.setenv("FIREBIRD_OPS_HOST", "127.0.0.1")
+    cfg = Config(store_backend="memory", ops_port=port, stall_sec=60.0)
+    try:
+        with pytest.raises(OSError):
+            core.start_ops(cfg, "rid", "test", chips_total=1,
+                           counters=obs_metrics.Counters(), run_block={})
+        assert obs_server.current() is None
+        assert jsonlog.get_run_context()["run_id"] is None
+    finally:
+        blocker.close()
+
+
+def test_merge_fleet_report_tolerates_missing_host(tmp_path):
+    path = str(tmp_path / "obs_report.json")
+    rep = _host_report("h0", chips=4, fetch_obs=[0.01], queue_depth=0,
+                       elapsed=5.0)
+    with open(obs_report.shard_report_path(path, 0), "w") as f:
+        json.dump(rep, f)
+    merged = obs_report.merge_fleet_report(path, 2, timeout=0.3,
+                                           poll_sec=0.05)
+    assert merged["fleet"]["hosts"] == 1
+    assert merged["fleet"]["missing"] == [1]
+    assert obs_report.merge_fleet_report(
+        str(tmp_path / "empty" / "obs_report.json"), 2, timeout=0.1,
+        poll_sec=0.05) is None
+    # A host that outlived process 0's merge wait writes its shard late:
+    # load_fleet_report must re-merge from the shards rather than serve
+    # the incomplete merged file forever.
+    late = _host_report("h1", chips=6, fetch_obs=[0.02], queue_depth=1,
+                        elapsed=7.0)
+    with open(obs_report.shard_report_path(path, 1), "w") as f:
+        json.dump(late, f)
+    reconciled = obs_report.load_fleet_report(str(tmp_path))
+    assert reconciled["fleet"]["hosts"] == 2
+    assert reconciled["run_counters"]["chips"] == 10
+
+
+# ---------------------------------------------------------------------------
+# Driver integration: live surface during a real (synthetic) run
+# ---------------------------------------------------------------------------
+
+def test_driver_serves_ops_surface_during_run(tmp_path):
+    """While batches are in flight the endpoints respond; the /progress
+    chip totals agree with the final obs_report.json; and the default
+    config binds nothing (covered by test_ops_disabled_by_default)."""
+    from firebird_tpu.driver import core
+    from firebird_tpu.ingest import SyntheticSource
+
+    from conftest import free_port
+
+    # Same shape/dtype as test_driver.py so the jit cache entry is shared.
+    port = free_port()
+    cfg = Config(store_backend="sqlite",
+                 store_path=str(tmp_path / "fb.db"),
+                 source_backend="synthetic", chips_per_batch=1,
+                 dtype="float64", device_sharding="off", fetch_retries=0,
+                 ops_port=port, stall_sec=120.0)
+    src = SyntheticSource(seed=9, start="1995-01-01", end="1998-01-01",
+                          cloud_frac=0.1)
+    result: dict = {}
+
+    def run():
+        result["done"] = core.changedetection(
+            x=100, y=200, acquired="1995-01-01/1997-06-01", number=2,
+            chunk_size=2, cfg=cfg, source=src)
+
+    driver = threading.Thread(target=run)
+    driver.start()
+    live: dict = {}
+    try:
+        while driver.is_alive():
+            for p in ("/healthz", "/readyz", "/progress", "/metrics"):
+                try:
+                    live[p] = _get(port, p)
+                except Exception:
+                    pass
+            time.sleep(0.05)   # don't hammer the server during compile
+    finally:
+        driver.join()
+    assert len(result["done"]) == 2
+    assert live["/healthz"][0] == 200
+    assert live["/readyz"][0] == 200          # reached ready mid-run
+    for ln in live["/metrics"][1].decode().splitlines():
+        assert PROM_LINE.match(ln), ln
+    prog = json.loads(live["/progress"][1])
+    rep = json.load(open(tmp_path / "obs_report.json"))
+    assert prog["run_id"] == rep["run"]["run_id"]
+    assert prog["chips_total"] == rep["run"]["chips"] == 2
+    assert prog["chips_done"] <= rep["run_counters"]["chips"] == 2
+    # run identity threads through to the report run block
+    assert rep["run"]["host"] == jsonlog.HOST
+    assert rep["run"]["process_id"] == 0
+    # the surface is gone once the run ends — nothing left bound
+    with pytest.raises(Exception):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=1)

@@ -123,6 +123,42 @@ def test_async_writer_multiworker_raises_on_error():
     w.close()
 
 
+def test_queue_depth_gauge_drains_on_flush_failure():
+    """Regression: the store_queue_depth gauge must read 0 after a flush
+    whose writes FAILED, not just after successful drains — a failing
+    backend must not leave a phantom backlog on the egress-backpressure
+    signal (and the failure itself must still be counted + raised).
+
+    The backend gates on an event so every frame is verifiably queued
+    (gauge > 0) before the first failure fires — no interleaving can
+    short-circuit the test through write()'s error re-raise path."""
+    import threading
+
+    from firebird_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.reset_registry()
+    gate = threading.Event()
+
+    class Boom(MemoryStore):
+        def write(self, table, frame):
+            gate.wait(timeout=10)
+            raise RuntimeError("disk full")
+
+    w = AsyncWriter(Boom(), workers=2)
+    for i in range(8):
+        w.write("chip", {"cx": [i], "cy": [0], "dates": [[]]}, key=(i,))
+    # a real backlog exists while the backend is stuck
+    assert obs_metrics.gauge("store_queue_depth").value > 0
+    gate.set()
+    with pytest.raises(RuntimeError, match="disk full"):
+        w.flush()
+    # all queued frames drained (through the failure path) by flush time
+    assert obs_metrics.gauge("store_queue_depth").value == 0
+    assert obs_metrics.counter("store_write_errors").value >= 1
+    w.close()
+    assert obs_metrics.gauge("store_queue_depth").value == 0
+
+
 def test_async_writer_drains_and_raises(tmp_path):
     store = MemoryStore()
     w = AsyncWriter(store)

@@ -1,19 +1,28 @@
 """Observability smoke test (``make obs-smoke``).
 
-Runs the synthetic-source driver end to end with the span tracer on,
-then validates the two emitted artifacts against the shared schema
-checks (firebird_tpu.obs.report): the Chrome-trace JSON must parse, pass
-``validate_trace``, and contain the four pipeline span names; the
-obs_report.json must pass ``validate_report`` and carry every
-DRIVER_STAGE_HISTOGRAMS stage key.  Exits non-zero on any violation —
-the CI-greppable proof that the telemetry layer still wires through
-every pipeline stage.
+Runs the synthetic-source driver end to end with the span tracer on AND
+the embedded ops endpoint bound to an ephemeral port, polling
+``/healthz`` / ``/readyz`` / ``/metrics`` / ``/progress`` while batches
+are in flight, then validates the emitted artifacts against the shared
+schema checks (firebird_tpu.obs.report): the Chrome-trace JSON must
+parse, pass ``validate_trace``, and contain the four pipeline span
+names; the obs_report.json must pass ``validate_report`` and carry every
+DRIVER_STAGE_HISTOGRAMS stage key; and the live ``/progress`` chip
+totals must agree with the final report.  Exits non-zero on any
+violation — the CI-greppable proof that the telemetry layer still wires
+through every pipeline stage and that the live ops surface serves during
+a real run.
 """
 
 import json
 import os
+import socket
 import sys
 import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -21,25 +30,88 @@ HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
 sys.path.insert(0, HERE)
 
 
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(base: str, path: str, timeout: float = 2.0):
+    """(status, body bytes) — HTTP errors return their status, transport
+    errors return (None, b'')."""
+    try:
+        r = urllib.request.urlopen(base + path, timeout=timeout)
+        return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+    except Exception:
+        return None, b""
+
+
 def main() -> int:
     from firebird_tpu.config import Config
     from firebird_tpu.driver import core
     from firebird_tpu.ingest import SyntheticSource
     from firebird_tpu.obs import report as obs_report
+    # The shared scrape-format contract (every exposition line is a
+    # comment or a sample; also asserted by the test suite).
+    from firebird_tpu.obs.metrics import PROM_LINE_RE as PROM_LINE
 
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
     with tempfile.TemporaryDirectory(prefix="fb_obs_smoke_") as tmp:
         cfg = Config(store_backend="sqlite",
                      store_path=os.path.join(tmp, "smoke.db"),
                      source_backend="synthetic", chips_per_batch=1,
-                     device_sharding="off", fetch_retries=0, trace="1")
+                     device_sharding="off", fetch_retries=0, trace="1",
+                     ops_port=port, stall_sec=120.0)
         src = SyntheticSource(seed=9, start="1995-01-01", end="1998-01-01",
                               cloud_frac=0.1)
-        done = core.changedetection(x=100, y=200,
-                                    acquired="1995-01-01/1997-06-01",
-                                    number=2, chunk_size=2, cfg=cfg,
-                                    source=src)
-        if len(done) != 2:
-            print(f"obs-smoke: driver processed {len(done)}/2 chips",
+
+        result: dict = {}
+
+        def run():
+            result["done"] = core.changedetection(
+                x=100, y=200, acquired="1995-01-01/1997-06-01",
+                number=2, chunk_size=2, cfg=cfg, source=src)
+
+        driver = threading.Thread(target=run, name="smoke-driver")
+        driver.start()
+
+        # Poll the live surface while the run is in flight; keep the last
+        # good sample of each endpoint.
+        live: dict = {}
+        while driver.is_alive():
+            for p in ("/healthz", "/readyz", "/metrics", "/progress"):
+                code, body = _get(base, p)
+                if code is not None:
+                    live[p] = (code, body)
+            time.sleep(0.05)
+        driver.join()
+
+        if len(result.get("done", ())) != 2:
+            print(f"obs-smoke: driver processed "
+                  f"{len(result.get('done', ()))}/2 chips", file=sys.stderr)
+            return 1
+        for p in ("/healthz", "/readyz", "/metrics", "/progress"):
+            if p not in live:
+                print(f"obs-smoke: {p} never responded during the run",
+                      file=sys.stderr)
+                return 1
+        if live["/healthz"][0] != 200:
+            print(f"obs-smoke: /healthz was {live['/healthz'][0]}, not 200",
+                  file=sys.stderr)
+            return 1
+        if live["/readyz"][0] != 200:
+            print("obs-smoke: /readyz never reached 200 during the run",
+                  file=sys.stderr)
+            return 1
+        bad = [ln for ln in live["/metrics"][1].decode().splitlines()
+               if ln and not PROM_LINE.match(ln)]
+        if bad:
+            print(f"obs-smoke: malformed /metrics lines: {bad[:3]}",
                   file=sys.stderr)
             return 1
 
@@ -52,10 +124,29 @@ def main() -> int:
         except ValueError as e:
             print(f"obs-smoke: {e}", file=sys.stderr)
             return 1
+
+        # The live surface and the final artifact must tell one story:
+        # same run, same chip totals.
+        prog = json.loads(live["/progress"][1])
+        if prog["run_id"] != rep["run"]["run_id"]:
+            print(f"obs-smoke: /progress run_id {prog['run_id']} != report "
+                  f"{rep['run']['run_id']}", file=sys.stderr)
+            return 1
+        if prog["chips_total"] != rep["run"]["chips"]:
+            print(f"obs-smoke: /progress chips_total {prog['chips_total']} "
+                  f"!= report chips {rep['run']['chips']}", file=sys.stderr)
+            return 1
+        if prog["chips_done"] > rep["run_counters"]["chips"]:
+            print(f"obs-smoke: /progress chips_done {prog['chips_done']} "
+                  f"exceeds final count {rep['run_counters']['chips']}",
+                  file=sys.stderr)
+            return 1
         print("obs-smoke OK: "
               f"{len(trace['traceEvents'])} trace events, "
               f"{len(rep['metrics']['histograms'])} stage histograms, "
-              f"counters {rep['metrics']['counters']}")
+              f"counters {rep['metrics']['counters']}, "
+              f"live progress {prog['chips_done']}/{prog['chips_total']} "
+              f"chips at stage {prog['stage']!r}")
     return 0
 
 

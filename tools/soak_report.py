@@ -63,13 +63,24 @@ def main() -> int:
 
     # Fold the driver's per-run telemetry artifact (written next to the
     # store by changedetection — firebird_tpu.obs.report) so the round
-    # artifact carries stage latencies, not just totals.
-    obs_path = os.path.join(os.path.dirname(dbs[0]), "obs_report.json")
-    if os.path.exists(obs_path):
-        try:
-            rep["obs_report"] = json.load(open(obs_path))
-        except (OSError, ValueError) as e:
-            rep["obs_report"] = {"error": repr(e)}
+    # artifact carries stage latencies, not just totals.  Prefer the
+    # merged fleet view: load_fleet_report reads obs_report.json (which
+    # under multi-host runs IS the merged document) and falls back to
+    # merging any obs_report.host<N>.json shards in memory when the
+    # merge step itself died.
+    sys.path.insert(0, here)
+    try:
+        from firebird_tpu.obs.report import load_fleet_report
+
+        obs = load_fleet_report(os.path.dirname(dbs[0]))
+        if obs is not None:
+            rep["obs_report"] = obs
+    except Exception as e:
+        rep["obs_report"] = {"error": repr(e)}
+    shards = sorted(glob.glob(os.path.join(os.path.dirname(dbs[0]),
+                                           "obs_report.host*.json")))
+    if shards:
+        rep["obs_report_host_shards"] = [os.path.basename(p) for p in shards]
 
     if os.path.exists(args.log):
         log = open(args.log).read()
