@@ -9,8 +9,8 @@ X ?= 542000
 Y ?= 1650000
 ACQUIRED ?= 1982-01-01/2017-12-31
 
-.PHONY: install test bench obs-smoke image db-up db-schema db-test db-down \
-        changedetection classification clean
+.PHONY: install test bench obs-smoke pipeline-smoke image db-up db-schema \
+        db-test db-down changedetection classification clean
 
 install:
 	pip install -e . --no-build-isolation
@@ -28,6 +28,13 @@ bench:
 # the schema + stage-key contract (docs/OBSERVABILITY.md).
 obs-smoke:
 	python tools/obs_smoke.py
+
+# Zero-stall pipeline check: tiny end-to-end changedetection on CPU with
+# input staging + bulk batch egress + the persistent compile cache on,
+# twice — asserts the obs report carries the stage/egress histograms with
+# nonzero counts and that run 2 hits the compile cache (no XLA recompile).
+pipeline-smoke:
+	python tools/pipeline_smoke.py
 
 image:
 	docker build -f deploy/Dockerfile -t firebird .

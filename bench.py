@@ -17,9 +17,50 @@ attempt dies or stalls, a reduced CPU-platform run still produces a valid
 
 import functools
 import json
+import re
 import subprocess
 import sys
 import time
+
+# ANSI escape sequences in both raw (ESC byte) and repr-escaped forms:
+# autotune errors pass through repr(), which turns the ESC bytes of the
+# remote compiler's colorized log lines into literal "\x1b[2m" text that a
+# raw-byte regex never matches — exactly how BENCH_r05.json ended up with
+# kilobytes of escaped terminal log inside its error fields.
+_ANSI_RE = re.compile(r"(?:\x1b|\\x1b|\\u001b|\\033)\[[0-9;]*[A-Za-z]")
+_ERR_KEYS = frozenset(
+    {"error", "errors", "tail", "traceback", "exception", "stderr"})
+# Matches the autotune error budget (safe_rate): a Mosaic failure's real
+# error often sits past char 600 behind the remote-compile banner, and the
+# artifact must stay diagnosable on its own.
+ERR_TEXT_LIMIT = 1200
+
+
+def clean_text(s: str, limit: int | None = None) -> str:
+    """Strip ANSI escapes; optionally truncate with an honest marker."""
+    s = _ANSI_RE.sub("", s)
+    if limit is not None and len(s) > limit:
+        s = s[:limit] + f"...[+{len(s) - limit} chars]"
+    return s
+
+
+def scrub_artifact(obj, limit: int | None = None):
+    """Sanitize a bench record before it becomes a round artifact: every
+    string loses its ANSI escapes, and strings under error-carrying keys
+    (_ERR_KEYS, applied to the whole subtree) are truncated to
+    ERR_TEXT_LIMIT chars — exception text is for diagnosis, not a
+    terminal-log archive, and multi-KB escaped blobs break casual ``jq``
+    use of the artifacts."""
+    if isinstance(obj, dict):
+        return {k: scrub_artifact(
+            v, limit=ERR_TEXT_LIMIT
+            if isinstance(k, str) and k.lower() in _ERR_KEYS else limit)
+            for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [scrub_artifact(v, limit=limit) for v in obj]
+    if isinstance(obj, str):
+        return clean_text(obj, limit)
+    return obj
 
 # Pinned baseline denominator (VERDICT r4 weak #5: the live-measured CPU
 # reference rate moved 34% between capture hosts, making vs_baseline
@@ -235,14 +276,13 @@ def measure(cpu_only: bool) -> None:
             try:
                 rates[flag] = probe_rate(flag)
             except Exception as e:
-                import re as _re
                 rates[flag] = 0.0
                 # Keep enough of the error to diagnose a Mosaic compile
                 # failure from the artifact alone (160 chars lost the
                 # actual error behind the remote-compile banner), minus
-                # ANSI color codes from the remote compiler's log lines.
-                errors[flag] = _re.sub(
-                    r"\x1b\[[0-9;]*m", "", repr(e))[:1200]
+                # ANSI color codes — including the repr-escaped "\x1b[2m"
+                # text form the raw-byte regex used to miss.
+                errors[flag] = clean_text(repr(e), limit=ERR_TEXT_LIMIT)
             # Partial evidence on stderr after every probe: if a later
             # variant hangs past the watchdog's kill budget (first Mosaic
             # compile of the big kernels through the tunnel), the child's
@@ -366,6 +406,37 @@ def measure(cpu_only: bool) -> None:
 
     dev_rate, seg = timed_rate(run_fn, args, n_pixels, runs)
     e2e_rate = n_pixels / (n_pixels / dev_rate + t_xfer)
+
+    # ---- steady-state drain: bulk vs per-chip egress (ISSUE 3) ----
+    # The driver's drain is now one jax.device_get of the whole batched
+    # result + one vectorized batch_frames pass; time it against the old
+    # per-chip chip_slice/chip_frames loop on the same result so the
+    # before/after is measured on THIS host, and fold the bulk number
+    # into pipeline_drain_seconds so the obs snapshot carries it.
+    pipeline_detail = {}
+    if not small:
+        from firebird_tpu.ccd import format as ccdformat
+
+        t0 = time.time()
+        host_seg = jax.device_get(seg)
+        drain_fetch_s = time.time() - t0
+        t0 = time.time()
+        ccdformat.batch_frames(packed, host_seg, packed.n_chips)
+        drain_fmt_s = time.time() - t0
+        t0 = time.time()
+        for c in range(packed.n_chips):
+            ccdformat.chip_frames(
+                packed, c, kernel.chip_slice(seg, c, to_host=True))
+        drain_per_chip_s = time.time() - t0
+        obs_metrics.histogram("pipeline_drain_seconds").observe(
+            drain_fetch_s + drain_fmt_s)
+        pipeline_detail = {"pipeline": {
+            "steady_state_batch_seconds": round(n_pixels / dev_rate, 4),
+            "drain_bulk_seconds": round(drain_fetch_s + drain_fmt_s, 4),
+            "drain_bulk_fetch_seconds": round(drain_fetch_s, 4),
+            "drain_bulk_format_seconds": round(drain_fmt_s, 4),
+            "drain_per_chip_seconds": round(drain_per_chip_s, 4),
+        }}
 
     # ---- closed-form FLOP model -> MFU / roofline (docs/ROOFLINE.md) ----
     from firebird_tpu.ccd import flops as flopsmod
@@ -535,6 +606,7 @@ def measure(cpu_only: bool) -> None:
             "cpu_ref_pixels_per_sec_per_core_live": round(cpu_rate, 2),
             "baseline_2000_core_pixels_per_sec": round(baseline_2000_cores, 1),
             "mean_segments": float(np.asarray(seg.n_segments).mean()),
+            **pipeline_detail,
             **pallas_detail,
             # Per-run telemetry fold (obs_report schema's metrics half):
             # first-call/compile latencies recorded by timed_rate above.
@@ -556,7 +628,7 @@ def measure(cpu_only: bool) -> None:
                         "docs/BENCH_tpu_evidence_r03.json"}),
         },
     }
-    print(json.dumps(out))
+    print(json.dumps(scrub_artifact(out)))
 
 
 def probe_accelerator(timeout: float = 300.0) -> bool:
@@ -711,7 +783,10 @@ def main() -> int:
                         # so the round artifact still shows hardware
                         # evidence even when the tunnel is down NOW.
                         rec["detail"]["last_tpu_capture"] = cap
-                        out = json.dumps(rec)
+                # Old capture logs predate the scrubber: sanitize the
+                # whole record (incl. any embedded capture) on the way
+                # into the round artifact.
+                out = json.dumps(scrub_artifact(rec))
             except Exception:
                 # best-effort decoration must never lose the artifact
                 pass

@@ -83,6 +83,15 @@ def _iso_col(ordinals: np.ndarray) -> np.ndarray:
     return table[inv]
 
 
+def _check_landsat_schema(packed, what: str) -> None:
+    if packed.sensor.band_names != params.BAND_NAMES:
+        raise ValueError(
+            f"{what} writes the reference's Landsat segment schema "
+            f"(7 bands, ccdc/segment.py:16-56); got sensor "
+            f"{packed.sensor.name!r} with {packed.sensor.n_bands} bands — "
+            "persist non-Landsat results through a sensor-specific schema")
+
+
 def chip_frames(packed, chip: int, seg) -> dict[str, dict]:
     """ChipSegments (host arrays, single chip) -> the three table frames.
 
@@ -92,12 +101,7 @@ def chip_frames(packed, chip: int, seg) -> dict[str, dict]:
     Pixels with no segments contribute the sentinel row (sday=eday=bday=
     0001-01-01, ccdc/pyccd.py:99-103) so reruns stay idempotent.
     """
-    if packed.sensor.band_names != params.BAND_NAMES:
-        raise ValueError(
-            f"chip_frames writes the reference's Landsat segment schema "
-            f"(7 bands, ccdc/segment.py:16-56); got sensor "
-            f"{packed.sensor.name!r} with {packed.sensor.n_bands} bands — "
-            "persist non-Landsat results through a sensor-specific schema")
+    _check_landsat_schema(packed, "chip_frames")
     cx, cy = (int(v) for v in packed.cids[chip])
     T = int(packed.n_obs[chip])
     dates_ord = packed.dates[chip][:T]
@@ -162,3 +166,105 @@ def chip_frames(packed, chip: int, seg) -> dict[str, dict]:
         "dates": dates_col,
     }
     return {"chip": chip_frame, "pixel": pixel, "segment": segment}
+
+
+def batch_frames(packed, seg,
+                 n_real: int | None = None) -> list[tuple[tuple, dict]]:
+    """A whole drained batch -> per-chip table frames in ONE numpy pass.
+
+    ``seg`` is a *host-fetched* batched ChipSegments ([C, P, ...] numpy
+    arrays, e.g. from one ``jax.device_get`` of the device result); the
+    segment table — by far the widest of the three — is built across the
+    entire chip axis at once (row expansion, ISO tables, coefficient
+    convention) and only *split* per chip at the end, so the egress cost
+    is one vectorized pass instead of C python formatting loops.  Padded
+    chips beyond ``n_real`` are dropped.
+
+    Returns ``[((cx, cy), {'chip': .., 'pixel': .., 'segment': ..}), ...]``
+    for the first ``n_real`` chips, each entry identical to
+    ``chip_frames(packed, c, chip_slice(seg, c, to_host=True))`` — the
+    regression surface both drivers' drains share (driver/core.py
+    ``write_batch_frames``).
+    """
+    _check_landsat_schema(packed, "batch_frames")
+    C = packed.n_chips if n_real is None else int(n_real)
+    if C == 0:
+        return []
+    P = seg.n_segments.shape[1]
+
+    # ---- global row expansion across the chip axis ----
+    nseg = np.minimum(np.asarray(seg.n_segments[:C], np.int64),
+                      seg.seg_meta.shape[-2])                  # [C,P]
+    n_rows = np.maximum(nseg, 1).reshape(-1)                   # sentinels
+    R = int(n_rows.sum())
+    flat = np.repeat(np.arange(C * P), n_rows)                 # [R] c*P+p
+    chip_of_row = flat // P
+    pix_of_row = flat % P
+    starts = np.cumsum(n_rows) - n_rows
+    within = np.arange(R) - np.repeat(starts, n_rows)
+    seg_idx = np.where(nseg.reshape(-1)[flat] > 0, within, -1)
+    real = seg_idx >= 0
+    si = np.maximum(seg_idx, 0)
+
+    meta = np.asarray(seg.seg_meta, np.float64)[chip_of_row, pix_of_row, si]
+    rmse = np.asarray(seg.seg_rmse, np.float64)[chip_of_row, pix_of_row, si]
+    mag = np.asarray(seg.seg_mag, np.float64)[chip_of_row, pix_of_row, si]
+    coefs = np.asarray(seg.seg_coef, np.float64)[chip_of_row, pix_of_row, si]
+    # Per-chip design anchors, broadcast per row: the convention change is
+    # elementwise, so per-row anchors are bit-identical to the per-chip
+    # scalar calls.
+    anchors = np.array([float(packed.dates[c][0]) if int(packed.n_obs[c])
+                        else 0.0 for c in range(C)])
+    coefs7, intercept = harmonic.to_pyccd_convention(
+        coefs, anchors[chip_of_row][:, None])
+
+    coords_all = np.stack([packed.pixel_coords(c)
+                           for c in range(C)])                 # [C,P,2]
+    segment = {
+        "cx": packed.cids[chip_of_row, 0].astype(np.int64),
+        "cy": packed.cids[chip_of_row, 1].astype(np.int64),
+        "px": coords_all[chip_of_row, pix_of_row, 0],
+        "py": coords_all[chip_of_row, pix_of_row, 1],
+        "sday": np.where(real, _iso_col(meta[:, 0]), "0001-01-01"),
+        "eday": np.where(real, _iso_col(meta[:, 1]), "0001-01-01"),
+        "bday": np.where(real, _iso_col(meta[:, 2]), "0001-01-01"),
+        "chprob": np.where(real, meta[:, 3], np.nan),
+        "curqa": _int_or_none(meta[:, 4], real),
+        "rfrawp": np.full(R, None, object),
+    }
+    for b in range(params.NUM_BANDS):
+        p = BAND_PREFIX[b]
+        segment[f"{p}mag"] = np.where(real, mag[:, b], np.nan)
+        segment[f"{p}rmse"] = np.where(real, rmse[:, b], np.nan)
+        segment[f"{p}int"] = np.where(real, intercept[:, b], np.nan)
+        col = np.empty(R, object)
+        col[:] = list(coefs7[:, b])
+        col[~real] = None
+        segment[f"{p}coef"] = col
+
+    # ---- split per chip (keyed writes preserve the resume invariant) ----
+    rows_per_chip = n_rows.reshape(C, P).sum(1)
+    bounds = np.concatenate([[0], np.cumsum(rows_per_chip)])
+    mask_all = np.asarray(seg.mask, np.uint8)
+    out = []
+    for c in range(C):
+        cx, cy = (int(v) for v in packed.cids[c])
+        lo, hi = int(bounds[c]), int(bounds[c + 1])
+        seg_c = {k: v[lo:hi] for k, v in segment.items()}
+        T = int(packed.n_obs[c])
+        mask_col = np.empty(P, object)
+        mask_col[:] = list(mask_all[c, :, :T])
+        pixel = {
+            "cx": np.full(P, cx, np.int64), "cy": np.full(P, cy, np.int64),
+            "px": coords_all[c, :, 0], "py": coords_all[c, :, 1],
+            "mask": mask_col,
+        }
+        dates_col = np.empty(1, object)
+        dates_col[0] = [dt.to_iso(int(o)) for o in packed.dates[c][:T]]
+        chip_frame = {
+            "cx": np.array([cx], np.int64), "cy": np.array([cy], np.int64),
+            "dates": dates_col,
+        }
+        out.append(((cx, cy), {"chip": chip_frame, "pixel": pixel,
+                               "segment": seg_c}))
+    return out

@@ -1196,9 +1196,6 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
 # Host-facing API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit,
-                   static_argnames=("dtype", "wcap", "sensor",
-                                    "max_segments"))
 def _detect_batch_wire(Xs, Xts, t, valid, Y_i16, qa_u16, *, dtype,
                        wcap=None, sensor=LANDSAT_ARD,
                        max_segments=MAX_SEGMENTS):
@@ -1210,6 +1207,29 @@ def _detect_batch_wire(Xs, Xts, t, valid, Y_i16, qa_u16, *, dtype,
                               qa_u16.astype(jnp.int32), wcap=wcap,
                               sensor=sensor, max_segments=max_segments,
                               dtype=dtype)
+
+
+_WIRE_STATICS = ("dtype", "wcap", "sensor", "max_segments")
+# Donating twin for the driver's staged steady-state dispatch: the packed
+# wire buffers (spectra + QA, the dominant HBM input term) are consumed by
+# the dispatch, so a deeper pipeline (Config.pipeline_depth) doesn't pin
+# every in-flight batch's inputs alongside its results.  Only safe for
+# single-dispatch callers (check_capacity=False) — a capacity retry would
+# re-dispatch already-deleted buffers.  (Jitted BEFORE the plain wrapper
+# rebinds the name, so both trace the same underlying function and keep
+# one HLO module name — persistent cache entries stay shared/valid.)
+_detect_batch_wire_donated = jax.jit(_detect_batch_wire,
+                                     static_argnames=_WIRE_STATICS,
+                                     donate_argnums=(4, 5))
+_detect_batch_wire = jax.jit(_detect_batch_wire,
+                             static_argnames=_WIRE_STATICS)
+# Donated compiles emit jax's "Some donated buffers were not usable"
+# advisory once per shape (the wire dtypes rarely alias the float result
+# buffers byte-for-byte; the donation is still honored — inputs freed at
+# dispatch).  Deliberately NOT suppressed: a process-global filter would
+# hide real donation bugs in unrelated jax code, and a per-dispatch
+# warnings.catch_warnings races between the warm-compile thread and the
+# main dispatch thread (filters are process-global state).
 
 
 def window_cap(packed) -> int:
@@ -1368,9 +1388,39 @@ def capacity_retry(dispatch, read_worst, S: int, bound: int):
         S = min(2 * S, bound)
 
 
+def stage_packed(packed, dtype) -> tuple:
+    """Host->device staging of a PackedChips batch: the wire-dtype
+    ``_detect_batch_wire`` argument tuple as device arrays, blocking until
+    the transfer lands.  Split out of :func:`detect_packed` so the
+    driver's prefetch thread can ship batch i+1's H2D while batch i
+    computes (driver.core.stage_batch); the main thread then dispatches
+    with ``staged=``."""
+    ensure_x64(dtype)
+    Xs, Xts, valid = prep_batch(packed)
+    args = (jnp.asarray(Xs, dtype), jnp.asarray(Xts, dtype),
+            jnp.asarray(packed.dates, dtype=dtype), jnp.asarray(valid),
+            jnp.asarray(packed.spectra), jnp.asarray(packed.qas))
+    jax.block_until_ready(args)
+    return args
+
+
+def aot_compile(avatars, *, dtype, wcap, sensor=LANDSAT_ARD,
+                max_segments: int = MAX_SEGMENTS, donate: bool = False):
+    """AOT lower+compile the wire-dtype batch program for a shape WITHOUT
+    running it (``avatars`` are jax.ShapeDtypeStructs in the
+    ``_detect_batch_wire`` argument order).  With the persistent
+    compilation cache on, the serialized executable is what the first
+    real dispatch of the same shape deserializes instead of compiling —
+    the driver's background warm start (driver.core.warm_start)."""
+    fn = _detect_batch_wire_donated if donate else _detect_batch_wire
+    return fn.lower(*avatars, dtype=jnp.dtype(dtype), wcap=wcap,
+                    sensor=sensor, max_segments=max_segments).compile()
+
+
 def detect_packed(packed, dtype=jnp.float32,
                   max_segments: int = MAX_SEGMENTS,
-                  check_capacity: bool = True) -> ChipSegments:
+                  check_capacity: bool = True, staged: tuple | None = None,
+                  donate: bool = False) -> ChipSegments:
     """Run the kernel over a PackedChips batch -> ChipSegments with leading
     chip axis [C, P, ...].  The batch's sensor spec selects the band
     layout the kernel compiles for.
@@ -1384,18 +1434,22 @@ def detect_packed(packed, dtype=jnp.float32,
     dispatch fully asynchronous — the caller must then test
     ``n_segments > capacity`` itself before trusting the buffers (the
     driver does this on its drain thread, driver/core.py::drain_batch).
+
+    ``staged`` takes pre-staged device args from :func:`stage_packed`
+    instead of transferring here; ``donate=True`` (honored only with
+    ``check_capacity=False`` — a retry would re-dispatch deleted buffers)
+    frees the wire input buffers at dispatch.
     """
     ensure_x64(dtype)
-    Xs, Xts, valid = prep_batch(packed)
-    args = (jnp.asarray(Xs, dtype), jnp.asarray(Xts, dtype),
-            jnp.asarray(packed.dates, dtype=dtype), jnp.asarray(valid),
-            jnp.asarray(packed.spectra), jnp.asarray(packed.qas))
+    args = staged if staged is not None else stage_packed(packed, dtype)
     kw = dict(dtype=jnp.dtype(dtype), wcap=window_cap(packed),
               sensor=getattr(packed, "sensor", LANDSAT_ARD))
+    fn = _detect_batch_wire_donated if donate and not check_capacity \
+        else _detect_batch_wire
     dispatch = lambda S: record_first_call(
         ("single", packed.spectra.shape, str(kw["dtype"]), kw["wcap"],
          kw["sensor"].name, S),
-        lambda: _detect_batch_wire(*args, max_segments=S, **kw))
+        lambda: fn(*args, max_segments=S, **kw))
     if not check_capacity:
         return dispatch(max(max_segments, 1))
     return capacity_retry(dispatch,

@@ -60,8 +60,13 @@ def entrypoint():
                    "/metrics /progress /report) on this port for the "
                    "duration of the run; overrides FIREBIRD_OPS_PORT — "
                    "off (no port bound) when neither is set")
+@click.option("--compile-cache", default=None,
+              help="persistent XLA compilation cache directory: repeat "
+                   "runs of a shape skip XLA, and the first compile "
+                   "overlaps batch-0 fetch (background AOT warm start); "
+                   "overrides FIREBIRD_COMPILE_CACHE")
 def changedetection(x, y, acquired, number, chunk_size, resume, trace,
-                    ops_port):
+                    ops_port, compile_cache):
     """Run change detection for a tile and save results to the store."""
     from firebird_tpu.config import Config
     from firebird_tpu.driver import core
@@ -74,7 +79,8 @@ def changedetection(x, y, acquired, number, chunk_size, resume, trace,
     # joins, so it must not run from the group callback.
     init_distributed()
     overrides = {k: v for k, v in
-                 (("trace", trace), ("ops_port", ops_port)) if v is not None}
+                 (("trace", trace), ("ops_port", ops_port),
+                  ("compile_cache", compile_cache)) if v is not None}
     return core.changedetection(
         x=x, y=y,
         acquired=acquired or dates.default_acquired(),
@@ -149,7 +155,10 @@ def save(bounds, product_names, product_dates, acquired, clip):
 @click.option("--ops-port", default=None, type=int,
               help="live ops endpoints for the run (see changedetection "
                    "--ops-port)")
-def stream(x, y, acquired, number, trace, ops_port):
+@click.option("--compile-cache", default=None,
+              help="persistent XLA compile cache (see changedetection "
+                   "--compile-cache)")
+def stream(x, y, acquired, number, trace, ops_port, compile_cache):
     """Streaming incremental change detection (no reference equivalent —
     its only mode is full reruns, ccdc/pyccd.py:171-183).  First run per
     chip bootstraps batch detection and a state checkpoint; later runs
@@ -160,7 +169,8 @@ def stream(x, y, acquired, number, trace, ops_port):
 
     init_distributed()
     overrides = {k: v for k, v in
-                 (("trace", trace), ("ops_port", ops_port)) if v is not None}
+                 (("trace", trace), ("ops_port", ops_port),
+                  ("compile_cache", compile_cache)) if v is not None}
     return sdrv.stream(
         x=x, y=y, acquired=acquired, number=number,
         cfg=Config.from_env(**overrides) if overrides else None)

@@ -118,6 +118,21 @@ class Config:
     # watchdog_stall_total.  <= 0 disables the watchdog.
     stall_sec: float = 0.0
 
+    # Max device batches in flight (the one computing + draining ones).
+    # 2 is the classic double-buffer; deeper keeps the device busier when
+    # egress is slow — affordable because staged inputs are donated to
+    # the dispatch (driver/core.py detect_chunk), so depth pins only
+    # result buffers.
+    pipeline_depth: int = 2
+
+    # Persistent XLA compilation cache directory (FIREBIRD_COMPILE_CACHE /
+    # --compile-cache); "" disables.  With it set, every compiled kernel
+    # shape serializes to disk — the second run of a shape skips XLA — and
+    # the drivers AOT-compile the predicted batch shape on a background
+    # thread at run start so the first compile overlaps batch-0 fetch
+    # (driver.core.warm_start).
+    compile_cache: str = ""
+
     # Framework version (reference: version.txt read in keyspace()).
     version: str = _VERSION
 
@@ -140,6 +155,9 @@ class Config:
         if not 0 <= self.ops_port <= 65535:
             raise ValueError("FIREBIRD_OPS_PORT must be 0 (off) or a valid "
                              f"TCP port, got {self.ops_port}")
+        if self.pipeline_depth < 1:
+            raise ValueError("FIREBIRD_PIPELINE_DEPTH must be >= 1, got "
+                             f"{self.pipeline_depth}")
 
     @classmethod
     def from_env(cls, env: dict | None = None, **overrides) -> "Config":
@@ -176,6 +194,9 @@ class Config:
             stream_dir=e.get("FIREBIRD_STREAM_DIR", cls.stream_dir),
             ops_port=int(e.get("FIREBIRD_OPS_PORT", cls.ops_port)),
             stall_sec=float(e.get("FIREBIRD_STALL_SEC", cls.stall_sec)),
+            pipeline_depth=int(e.get("FIREBIRD_PIPELINE_DEPTH",
+                                     cls.pipeline_depth)),
+            compile_cache=e.get("FIREBIRD_COMPILE_CACHE", cls.compile_cache),
         )
         kw.update(overrides)
         return cls(**kw)

@@ -17,7 +17,9 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 import contextlib
+import dataclasses
 import itertools
+import threading
 import time
 import traceback
 
@@ -149,7 +151,8 @@ def start_ops(cfg: Config, run_id: str, kind: str, *, chips_total: int,
             watchdog = obs_watchdog.Watchdog(cfg.stall_sec).start()
         status = obs_server.set_status(obs_server.RunStatus(
             run_id, kind, chips_total=chips_total, counters=counters,
-            watchdog=watchdog, run=run_block, mesh_up=_mesh_ready()))
+            watchdog=watchdog, run=run_block, mesh_up=_mesh_ready(),
+            pipeline_depth=cfg.pipeline_depth))
         if cfg.ops_port > 0:
             server = obs_server.start_ops_server(cfg.ops_port, status)
     except Exception:
@@ -192,6 +195,19 @@ def make_aux_source(cfg: Config, kind: str | None = None):
         return ChipmunkSource(cfg.aux_url,
                               band_parallelism=cfg.band_parallelism)
     return make_source(cfg, kind)
+
+
+def _pad_target(n_chips: int, pad_to: int | None, use_mesh: bool,
+                n_dev: int) -> int:
+    """THE batch pad-target rule, shared by stage_batch, detect_batch,
+    and predict_batch_shape (the warm-compile shape prediction would
+    silently drift from real dispatch padding if this were duplicated):
+    at least ``pad_to`` chips, rounded up to a device-count multiple when
+    sharded."""
+    target = max(pad_to or 0, n_chips)
+    if use_mesh:
+        target = -n_dev * (-target // n_dev)
+    return target
 
 
 def _pad_batch(packed, target: int):
@@ -278,10 +294,188 @@ def resolve_batching(cfg: Config, acquired: str) -> Config:
     """cfg with chips_per_batch resolved (<= 0 means auto-size)."""
     if cfg.chips_per_batch > 0:
         return cfg
-    import dataclasses
-
     return dataclasses.replace(
         cfg, chips_per_batch=auto_chips_per_batch(cfg, acquired))
+
+
+# ---------------------------------------------------------------------------
+# Compile-warm startup: persistent cache + background AOT of the batch shape
+# ---------------------------------------------------------------------------
+
+_cache_listener_installed = False
+_warm_lock = threading.Lock()
+_warm_thread: threading.Thread | None = None
+
+
+def _install_cache_counters() -> None:
+    """Count persistent compile-cache hits/misses into the run registry.
+
+    jax records monitoring events on every persistent-cache lookup
+    (``/jax/compilation_cache/cache_hits``) and write-back (``.../
+    cache_misses``); the listener resolves the CURRENT metrics registry at
+    event time, so per-run reports see their own counts even though the
+    listener itself is registered once per process.  Attribution is
+    best-effort across runs: the events carry no run identity, so a warm
+    compile abandoned by a short run (the 5s join in the driver's
+    finally) lands its hit/miss in whichever run is live when it finishes
+    — bounded by warm_start's one-in-flight guard, and never wrong about
+    the process-wide totals."""
+    global _cache_listener_installed
+    if _cache_listener_installed:
+        return
+    try:
+        from jax._src import monitoring
+
+        def _on_event(event, **kw):
+            if event == "/jax/compilation_cache/cache_hits":
+                obs_metrics.counter(
+                    "compile_cache_hits",
+                    help="persistent XLA compile-cache hits").inc()
+            elif event == "/jax/compilation_cache/cache_misses":
+                obs_metrics.counter(
+                    "compile_cache_misses",
+                    help="persistent XLA compile-cache misses").inc()
+
+        monitoring.register_event_listener(_on_event)
+        _cache_listener_installed = True
+    except Exception:
+        pass         # older jax without the events: counters stay absent
+
+
+def setup_compile_cache(cfg: Config) -> str | None:
+    """Enable the persistent XLA compilation cache (FIREBIRD_COMPILE_CACHE
+    / --compile-cache).  Compiled programs serialize to the directory, so
+    the SECOND run of any shape deserializes instead of compiling — and
+    the background :func:`warm_start` AOT compile of run N becomes the
+    cache hit of run N+1's first dispatch.  Returns the cache path, or
+    None when the config leaves the cache off."""
+    if not cfg.compile_cache:
+        return None
+    import os
+
+    import jax
+
+    path = os.path.abspath(cfg.compile_cache)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Cache every compile: the sub-second CPU-smoke kernels must warm run
+    # 2 as surely as a ten-minute TPU compile does.
+    with contextlib.suppress(Exception):
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    # Un-latch jax's once-per-process cache probe so enabling the cache
+    # mid-process (after an unrelated first compile) still takes effect.
+    with contextlib.suppress(Exception):
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    _install_cache_counters()
+    logger("change-detection").info("persistent compile cache at %s", path)
+    return path
+
+
+def predict_batch_shape(cfg: Config, acquired: str) -> tuple[int, int, int]:
+    """The steady-state padded dispatch shape a run is expected to
+    compile: (C, T, wcap).  C mirrors detect_batch's padding (rounded to
+    a device-count multiple when sharded); T is estimate_obs's bucketed
+    estimate; wcap applies window_cap's rule to a dense 8-day acquisition
+    grid.  A wrong guess wastes only the background compile — the
+    persistent cache still warms the actual shape from run 1's own
+    compile on every later run."""
+    import jax
+
+    from firebird_tpu.ccd import params
+
+    n_dev = jax.local_device_count()
+    use_mesh = cfg.device_sharding != "off" and n_dev > 1
+    C = _pad_target(max(cfg.chips_per_batch, 1), None, use_mesh, n_dev)
+    T = estimate_obs(acquired, cfg)
+    lo, hi = dt.acquired_range(acquired)
+    d = np.arange(lo, hi + 1, 8, dtype=np.int64)[:T]
+    cap = params.MEOW_SIZE
+    if d.size:
+        hi_i = np.searchsorted(d, d + params.INIT_DAYS, side="right")
+        cap = max(cap, int((hi_i - np.arange(d.size)).max()) + 1)
+    wcap = min(-8 * (-cap // 8), T)
+    return C, T, wcap
+
+
+def warm_start(cfg: Config, acquired: str, sensor=None, dtype=None,
+               donate: bool | None = None) -> threading.Thread | None:
+    """AOT-lower/compile the predicted steady-state batch shape on a
+    background thread, so the (multi-second) first XLA compile overlaps
+    batch 0's HTTP fetch instead of serializing after it.
+
+    Only runs when the persistent compilation cache is on: jit keeps its
+    own in-memory table, so the AOT executable can only reach the first
+    real dispatch *through* the cache (AOT writes the entry, the dispatch
+    deserializes it).  A failed or mispredicted warm compile costs
+    nothing but the background work.  Returns the started thread (join it
+    to observe ``warm_compile_seconds``), or None when the cache is off
+    or a previous warm compile is still running (no duplicate compiles).
+    """
+    if not cfg.compile_cache:
+        return None
+    import jax
+
+    from firebird_tpu.ccd.sensor import LANDSAT_ARD
+
+    sensor = sensor or LANDSAT_ARD
+    dtype = dtype if dtype is not None else _DTYPES[cfg.dtype]
+    # Match the program the steady-state loop will dispatch (detect_chunk
+    # donates on accelerators only) — a warm compile of the wrong donation
+    # variant would miss the cache at dispatch time.
+    if donate is None:
+        donate = _should_donate()
+    kernel.ensure_x64(dtype)
+    C, T, wcap = predict_batch_shape(cfg, acquired)
+    B, P = sensor.n_bands, sensor.pixels
+    shapes = ((C, T, 8), (C, T, 5), (C, T), (C, T), (C, B, P, T),
+              (C, P, T))
+    n_dev = jax.local_device_count()
+    use_mesh = cfg.device_sharding != "off" and n_dev > 1
+    # Metrics bind to THIS run's registry at start: a long warm compile
+    # abandoned by a short run (5s join in the driver's finally) must not
+    # record into whichever registry a LATER run has installed.
+    reg = obs_metrics.get_registry()
+
+    def _warm():
+        try:
+            with tracing.span("warm_compile", shape=(C, T, wcap)), \
+                    obs_metrics.timer() as tm:
+                if use_mesh:
+                    from firebird_tpu.parallel import make_mesh
+                    from firebird_tpu.parallel.mesh import \
+                        aot_compile_sharded
+
+                    aot_compile_sharded(
+                        make_mesh(devices=jax.local_devices()), dtype,
+                        wcap, sensor, shapes, donate=donate)
+                else:
+                    avatars = tuple(
+                        jax.ShapeDtypeStruct(s, d) for s, d in zip(
+                            shapes, (dtype, dtype, dtype, jnp.bool_,
+                                     jnp.int16, jnp.uint16)))
+                    kernel.aot_compile(avatars, dtype=dtype, wcap=wcap,
+                                       sensor=sensor, donate=donate)
+            reg.histogram("warm_compile_seconds").observe(tm.elapsed)
+            reg.counter("warm_compiles",
+                        help="background AOT compiles completed").inc()
+        except Exception as e:
+            # Best-effort: the run proceeds cold; first dispatch compiles.
+            logger("change-detection").warning(
+                "warm-start compile failed (run proceeds cold): %s", e)
+
+    global _warm_thread
+    with _warm_lock:
+        if _warm_thread is not None and _warm_thread.is_alive():
+            logger("change-detection").info(
+                "warm-start: previous warm compile still in flight; "
+                "not starting another")
+            return None
+        _warm_thread = threading.Thread(
+            target=_warm, name="firebird-warm-compile", daemon=True)
+        _warm_thread.start()
+        return _warm_thread
 
 
 def _with_retries(cfg: Config, log, what: str, fn):
@@ -357,9 +551,69 @@ def fetch(x, y, outdir: str, acquired: str | None = None,
     return n, len(cids)
 
 
+def _should_donate() -> bool:
+    """Donate staged inputs on accelerators only: on the CPU backend the
+    HBM-footprint argument is moot, and the donated jit twin would just
+    double-compile every shape the (CPU) test suite already caches."""
+    import jax
+
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+@dataclasses.dataclass
+class StagedBatch:
+    """A device-staged input batch (the prefetch thread's product): the
+    kernel argument tuple already resident under the run's sharding, plus
+    the padded host-side PackedChips the drain/recompute path still
+    needs.  ``wcap`` is the (cross-host-agreed, sharded case) window cap
+    the staged args were prepared for."""
+
+    packed: object             # padded PackedChips (host arrays)
+    args: tuple                # device arrays, wire dtypes
+    n_real: int
+    mesh: object | None        # the local data mesh when sharded
+    wcap: int
+
+
+def stage_batch(packed, dtype, sharding: str = "auto",
+                pad_to: int | None = None) -> StagedBatch:
+    """Pad and device_put one batch under the run's sharding — the H2D
+    half of :func:`detect_batch`, run on the prefetch thread so batch
+    i+1's transfer overlaps batch i's compute and the main thread only
+    dispatches.  Blocks until the transfer lands (the *prefetch* thread
+    eats the wait), records ``pipeline_stage_seconds`` and the
+    ``h2d_bytes`` counter."""
+    import jax
+
+    from firebird_tpu.ccd import kernel as k
+
+    n_dev = jax.local_device_count()
+    use_mesh = sharding != "off" and n_dev > 1
+    padded, real = _pad_batch(
+        packed, _pad_target(packed.n_chips, pad_to, use_mesh, n_dev))
+    with tracing.span("stage", chips=real), obs_metrics.timer() as tm:
+        if use_mesh:
+            from firebird_tpu.parallel import make_mesh
+            from firebird_tpu.parallel.mesh import stage_sharded
+
+            mesh = make_mesh(devices=jax.local_devices())
+            args, wcap = stage_sharded(padded, mesh, dtype)
+        else:
+            mesh = None
+            args = k.stage_packed(padded, dtype)
+            wcap = k.window_cap(padded)
+    obs_metrics.histogram("pipeline_stage_seconds").observe(tm.elapsed)
+    obs_metrics.counter(
+        "h2d_bytes", help="bytes staged host->device (packed inputs)").inc(
+        int(sum(getattr(a, "nbytes", 0) for a in args)))
+    return StagedBatch(packed=padded, args=args, n_real=real, mesh=mesh,
+                       wcap=wcap)
+
+
 def detect_batch(packed, dtype, sharding: str = "auto",
                  pad_to: int | None = None, check_capacity: bool = False,
-                 max_segments: int | None = None):
+                 max_segments: int | None = None,
+                 staged: StagedBatch | None = None, donate: bool = False):
     """Run the CCD kernel over a packed batch on every local device.
 
     Single device (or sharding='off'): plain jit dispatch.  Multiple local
@@ -374,18 +628,17 @@ def detect_batch(packed, dtype, sharding: str = "auto",
     multiple of the device count when sharded — so a chunk's ragged final
     batch reuses the same compiled kernel shape as its full batches; padded
     results are dropped by the caller via the returned real count.
+
+    With ``staged`` (a :class:`StagedBatch` from :func:`stage_batch`) the
+    arrays are already device-resident — this call only dispatches.
+    ``donate=True`` frees the staged wire inputs at dispatch (honored only
+    with ``check_capacity=False``; a donated recompute re-stages from
+    ``staged.packed``'s host arrays).
     """
     import jax
 
     from firebird_tpu.ccd import kernel as k
 
-    n_dev = jax.local_device_count()
-    use_mesh = sharding != "off" and n_dev > 1
-    C = packed.n_chips
-    target = max(pad_to or 0, C)
-    if use_mesh:
-        target = -n_dev * (-target // n_dev)
-    padded, real = _pad_batch(packed, target)
     # The default check_capacity=False keeps the dispatch asynchronous
     # (no device sync on this thread); the drain thread — which fetches
     # results anyway — detects segment-capacity overflow and re-runs the
@@ -393,6 +646,21 @@ def detect_batch(packed, dtype, sharding: str = "auto",
     kw = dict(check_capacity=check_capacity)
     if max_segments is not None:
         kw["max_segments"] = max_segments
+    if staged is not None:
+        if staged.mesh is None:
+            return k.detect_packed(staged.packed, dtype=dtype,
+                                   staged=staged.args, donate=donate,
+                                   **kw), staged.n_real
+        from firebird_tpu.parallel.mesh import detect_sharded
+
+        return detect_sharded(staged.packed, staged.mesh, dtype=dtype,
+                              staged=(staged.args, staged.wcap),
+                              donate=donate, **kw), staged.n_real
+
+    n_dev = jax.local_device_count()
+    use_mesh = sharding != "off" and n_dev > 1
+    padded, real = _pad_batch(
+        packed, _pad_target(packed.n_chips, pad_to, use_mesh, n_dev))
     if not use_mesh:
         return k.detect_packed(padded, dtype=dtype, **kw), real
     from firebird_tpu.parallel import make_mesh
@@ -402,10 +670,51 @@ def detect_batch(packed, dtype, sharding: str = "auto",
     return detect_sharded(padded, mesh, dtype=dtype, **kw), real
 
 
+def fetch_results(seg):
+    """The ONE bulk device->host fetch per batch: ``jax.device_get`` of
+    the whole batched ChipSegments pytree, collapsing the old per-chip,
+    per-field ``chip_slice(to_host=True)`` pattern (~C x fields D2H round
+    trips per batch) into a single transfer sweep.  Records
+    ``pipeline_d2h_seconds`` and the ``d2h_bytes`` counter; returns a
+    host-array ChipSegments."""
+    import jax
+
+    nbytes = int(sum(getattr(v, "nbytes", 0)
+                     for v in jax.tree_util.tree_leaves(seg)))
+    with tracing.span("d2h", bytes=nbytes), obs_metrics.timer() as tm:
+        host = jax.device_get(seg)
+    obs_metrics.histogram("pipeline_d2h_seconds").observe(tm.elapsed)
+    obs_metrics.counter(
+        "d2h_bytes", help="bytes fetched device->host (batch results)").inc(
+        nbytes)
+    return host
+
+
+def write_batch_frames(packed, host_seg, n_real, *, writer, counters=None):
+    """Format + queue one drained batch's frames — the shared egress tail
+    of both drivers: ``format.batch_frames`` builds the three tables
+    across the chip axis in one numpy pass, split back into the existing
+    keyed per-chip writes, so the segment frame still lands last per chip
+    (the resume invariant)."""
+    P = host_seg.n_segments.shape[1]
+    for c, (cid, frames) in enumerate(
+            ccdformat.batch_frames(packed, host_seg, n_real)):
+        for table in ("chip", "pixel", "segment"):
+            # keyed: one chip's frames drain in order, so the segment
+            # frame lands last (the resume invariant)
+            writer.write(table, frames[table], key=cid)
+        if counters is not None:
+            counters.add("chips")
+            counters.add("pixels", P)
+            counters.add("segments", int(host_seg.n_segments[c].sum()))
+
+
 def drain_batch(seg, packed, n_real, *, writer, counters, dtype=None,
                 sharding: str = "auto", pad_to: int | None = None):
     """Fetch one batch's results to the host, format, and queue writes
-    (the egress half of ref core.detect, core.py:69-72).
+    (the egress half of ref core.detect, core.py:69-72) — results cross
+    D2H as one bulk :func:`fetch_results` transfer and format through the
+    vectorized :func:`write_batch_frames` path.
 
     Also the capacity backstop for the driver's asynchronous dispatch
     (detect_batch defaults check_capacity=False): if any pixel closed
@@ -414,6 +723,10 @@ def drain_batch(seg, packed, n_real, *, writer, counters, dtype=None,
     check on — rare enough that the synchronous re-run does not matter."""
     cap = seg.seg_meta.shape[-2]                   # [.., P, S, 6] -> S
     with tracing.span("drain", chips=n_real), obs_metrics.timer() as tm:
+        # Capacity probe BEFORE the bulk fetch: n_segments alone is a few
+        # hundred KB, so an overflowed batch never pays a full-result
+        # transfer whose buffers are about to be discarded (and the d2h
+        # telemetry counts only the one real bulk fetch).
         worst = int(np.asarray(seg.n_segments).max())
         if worst > cap:
             logger("pyccd").info(
@@ -426,17 +739,9 @@ def drain_batch(seg, packed, n_real, *, writer, counters, dtype=None,
                                   max_segments=min(
                                       2 * cap,
                                       kernel.capacity_bound(packed)))
-        for c in range(n_real):
-            one = kernel.chip_slice(seg, c, to_host=True)
-            frames = ccdformat.chip_frames(packed, c, one)
-            cid = (int(packed.cids[c][0]), int(packed.cids[c][1]))
-            for table in ("chip", "pixel", "segment"):
-                # keyed: one chip's frames drain in order, so the segment
-                # frame lands last (the resume invariant)
-                writer.write(table, frames[table], key=cid)
-            counters.add("chips")
-            counters.add("pixels", one.n_segments.shape[0])
-            counters.add("segments", int(one.n_segments.sum()))
+        host = fetch_results(seg)
+        write_batch_frames(packed, host, n_real, writer=writer,
+                           counters=counters)
     obs_metrics.histogram("pipeline_drain_seconds").observe(tm.elapsed)
     # Forward-progress beat: a drained batch is the watchdog's liveness
     # unit and /progress's batches_done tick (no-op when no run registered).
@@ -445,13 +750,17 @@ def drain_batch(seg, packed, n_real, *, writer, counters, dtype=None,
 
 def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
     """Run change detection for one chunk of chip ids (ref core.detect,
-    core.py:53-75): ingest -> pack -> kernel -> chip/pixel/segment writes.
+    core.py:53-75): ingest -> pack -> stage -> kernel -> chip/pixel/segment
+    writes.
 
-    Three-stage pipeline: a prefetch thread fetches batch i+1 while batch
-    i is on the device, and a drain thread fetches/formats batch i-1's
-    results while batch i computes — the main thread only packs and
-    dispatches.  In-flight drains are bounded to two batches of host
-    results."""
+    Zero-stall pipeline: the prefetch thread fetches, packs, AND stages
+    (H2D under the run's sharding) batch i+1 while batch i is on the
+    device — the main thread only dispatches — and a drain thread
+    bulk-fetches/formats batch i-1's results while batch i computes.
+    Staged wire inputs are donated to the dispatch (freed on device once
+    consumed), which is what lets the in-flight bound be a configurable
+    ``cfg.pipeline_depth`` instead of a hard 2 without pinning every
+    batch's inputs alongside its results."""
     log.info("finding ccd segments for %d chips", len(cids))
     dtype = _DTYPES[cfg.dtype]
     batches = list(partition_all(cfg.chips_per_batch, cids))
@@ -459,6 +768,7 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
     # a full batch exists to share it with; a single small batch would pay
     # the padding compute for no compile reuse.
     pad_to = cfg.chips_per_batch if len(batches) > 1 else None
+    depth = max(cfg.pipeline_depth, 1)
 
     # Separate single-worker executors: the prefetch slot must not steal
     # the chip-level workers (INPUT_PARTITIONS semantics) or a 1-worker
@@ -477,49 +787,55 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
             obs_metrics.histogram("ingest_chip_seconds").observe(tm.elapsed)
             return chip
 
-        def fetch_batch(bids):
+        def prepare_batch(bids) -> StagedBatch:
+            """fetch -> pack -> device staging, all on the prefetch
+            thread: by the time the main thread picks the batch up, its
+            arrays are already resident under the run's sharding."""
             with tracing.span("fetch", chips=len(bids)), \
                     obs_metrics.timer() as tm:
                 chips = list(chips_ex.map(fetch_one, bids))
             obs_metrics.histogram("pipeline_fetch_seconds").observe(tm.elapsed)
-            return chips
-
-        nxt = prefetch_ex.submit(fetch_batch, batches[0]) if batches else None
-        drains: list[cf.Future] = []
-        for i in range(len(batches)):
-            obs_server.set_stage("fetch")
-            chips = nxt.result()
-            nxt = (prefetch_ex.submit(fetch_batch, batches[i + 1])
-                   if i + 1 < len(batches) else None)
-            obs_server.set_stage("pack")
             with tracing.span("pack", chips=len(chips)), \
                     obs_metrics.timer() as tm:
                 packed = pack(chips, bucket=cfg.obs_bucket,
                               max_obs=cfg.max_obs)
             obs_metrics.histogram("pipeline_pack_seconds").observe(tm.elapsed)
+            return stage_batch(packed, dtype, cfg.device_sharding,
+                               pad_to=pad_to)
+
+        nxt = prefetch_ex.submit(prepare_batch, batches[0]) \
+            if batches else None
+        drains: list[cf.Future] = []
+        for i in range(len(batches)):
+            obs_server.set_stage("fetch")
+            staged = nxt.result()
+            nxt = (prefetch_ex.submit(prepare_batch, batches[i + 1])
+                   if i + 1 < len(batches) else None)
             # The dispatch span measures enqueue time, not device compute
             # (check_capacity=False keeps it async); compute shows up as
             # the gap before the matching drain span closes.
             obs_server.set_stage("dispatch")
-            with tracing.span("dispatch", chips=packed.n_chips), \
+            with tracing.span("dispatch", chips=staged.n_real), \
                     obs_metrics.timer() as tm:
-                seg, n_real = detect_batch(packed, dtype,
+                seg, n_real = detect_batch(staged.packed, dtype,
                                            cfg.device_sharding,
-                                           pad_to=pad_to)
+                                           pad_to=pad_to, staged=staged,
+                                           donate=_should_donate())
             obs_metrics.histogram(
                 "pipeline_dispatch_seconds").observe(tm.elapsed)
             # /readyz flips here: mesh up + first batch dispatched means
             # compile/bring-up are behind us and the run is steady-state.
             obs_server.batch_dispatched()
             drains.append(drain_ex.submit(
-                drain_batch, seg, packed, n_real, writer=writer,
+                drain_batch, seg, staged.packed, n_real, writer=writer,
                 counters=counters, dtype=dtype,
                 sharding=cfg.device_sharding, pad_to=pad_to))
-            # Bound live batches to two (the one computing + the one
-            # draining): a deeper queue would pin additional device
-            # result buffers and packed inputs, risking HBM exhaustion
-            # the old inline drain never hit.
-            while len(drains) > 1:
+            # Bound in-flight batches to cfg.pipeline_depth (the one
+            # computing + depth-1 draining): input donation frees each
+            # batch's staged wire buffers at dispatch, so depth only pins
+            # result buffers — but unbounded depth would still exhaust
+            # HBM, hence the config.
+            while len(drains) > depth - 1:
                 drains.pop(0).result()
         for f in drains:
             f.result()
@@ -558,6 +874,11 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
     # that guarantees its stop — a setup failure here must not leak an
     # active process-global tracer into later runs.)
     obs_metrics.reset_registry()
+    # Compile-warm startup (FIREBIRD_COMPILE_CACHE): persistent cache on,
+    # then AOT-compile the predicted batch shape in the background so the
+    # first XLA compile overlaps batch-0 fetch instead of following it.
+    setup_compile_cache(cfg)
+    warm = warm_start(cfg, acquired)
 
     source = source or make_source(cfg)
     store = store or open_store(cfg.store_backend, cfg.store_path,
@@ -631,6 +952,11 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
     finally:
         obs_server.set_stage("finalize")
         writer.close()
+        # Collect the warm-compile counters for the report when the
+        # background compile already finished (a still-compiling warm
+        # thread of a short run is abandoned, not awaited).
+        if warm is not None:
+            warm.join(timeout=5.0)
         snap = counters.snapshot()
         log.info("change-detection complete: %s", snap)
         if tracer is not None:

@@ -166,6 +166,11 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
     run_id = dcore.fleet_run_id()            # one id for the whole fleet
     jsonlog.set_run_context(run_id=run_id)   # setup log lines carry it too
     obs_metrics.reset_registry()
+    # Compile-warm startup, same contract as the batch driver.  The
+    # bootstrap dispatches at float32 with the capacity check ON (no
+    # donation), so the warm shape must match that variant.
+    dcore.setup_compile_cache(cfg)
+    warm = dcore.warm_start(cfg, acquired, dtype=jnp.float32, donate=False)
     source = source or dcore.make_source(cfg)
     store = store or open_store(cfg.store_backend, cfg.store_path,
                                 cfg.keyspace())
@@ -221,9 +226,17 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
         batches = list(partition_all(max(cfg.chips_per_batch, 1), boot))
         pad_to = cfg.chips_per_batch if len(batches) > 1 else None
         obs_server.set_stage("bootstrap")
+        # Mirror of the batch driver's zero-stall loop (driver/core.py
+        # detect_chunk): the prefetch thread fetches, packs, and STAGES
+        # batch i+1's arrays onto the device while batch i computes; the
+        # drain goes through the shared bulk-egress helpers (one
+        # device_get + vectorized batch_frames) — one code path, one test
+        # surface, for both drivers.
         with cf.ThreadPoolExecutor(
-                max_workers=max(cfg.input_parallelism, 1)) as ex:
-            for bids in batches:
+                max_workers=max(cfg.input_parallelism, 1)) as ex, \
+                cf.ThreadPoolExecutor(max_workers=1) as prefetch_ex:
+
+            def prepare(bids):
                 with tracing.span("fetch", chips=len(bids)), \
                         obs_metrics.timer() as tm:
                     fetched = list(ex.map(lambda c: fetch_chip(c, acquired),
@@ -237,37 +250,51 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                         log.warning("chip (%s,%s): no acquisitions in %s; "
                                     "skipping", cid[0], cid[1], acquired)
                 if not keep:
-                    continue
+                    return None
                 with tracing.span("pack", chips=len(keep)), \
                         obs_metrics.timer() as tm:
                     p = pack([ch for _, ch in keep], bucket=cfg.obs_bucket,
                              max_obs=cfg.max_obs)
                 obs_metrics.histogram(
                     "pipeline_pack_seconds").observe(tm.elapsed)
-                with tracing.span("dispatch", chips=p.n_chips), \
+                return keep, dcore.stage_batch(
+                    p, jnp.float32, cfg.device_sharding, pad_to=pad_to)
+
+            nxt = prefetch_ex.submit(prepare, batches[0]) \
+                if batches else None
+            for i in range(len(batches)):
+                prep = nxt.result()
+                nxt = (prefetch_ex.submit(prepare, batches[i + 1])
+                       if i + 1 < len(batches) else None)
+                if prep is None:
+                    continue
+                keep, staged = prep
+                with tracing.span("dispatch", chips=staged.n_real), \
                         obs_metrics.timer() as tm:
+                    # capacity check ON (synchronous retry): staged args
+                    # may be re-dispatched, so they are NOT donated.
                     seg, n_real = dcore.detect_batch(
-                        p, jnp.float32, cfg.device_sharding, pad_to=pad_to,
-                        check_capacity=True)
+                        staged.packed, jnp.float32, cfg.device_sharding,
+                        pad_to=pad_to, check_capacity=True, staged=staged)
                 obs_metrics.histogram(
                     "pipeline_dispatch_seconds").observe(tm.elapsed)
                 obs_server.batch_dispatched()
                 with tracing.span("drain", chips=n_real), \
                         obs_metrics.timer() as tm:
+                    host = dcore.fetch_results(seg)
+                    dcore.write_batch_frames(staged.packed, host, n_real,
+                                             writer=writer)
                     for c in range(n_real):
                         cid = keep[c][0]
-                        frames = ccdformat.chip_frames(
-                            p, c, kernel.chip_slice(seg, c, to_host=True))
-                        for table in ("chip", "pixel", "segment"):
-                            writer.write(table, frames[table],
-                                         key=tuple(cid))
-                        one = kernel.chip_slice(seg, c)
+                        one = kernel.chip_slice(host, c)
                         st = incremental.StreamState.from_chip(one)
                         sday, curqa = _tail_identity(one)
-                        T = int(p.n_obs[c])
-                        side = dict(sday=sday, curqa=curqa,
-                                    anchor=np.float64(p.dates[c][0]),
-                                    horizon=np.float64(p.dates[c][T - 1]))
+                        T = int(staged.packed.n_obs[c])
+                        side = dict(
+                            sday=sday, curqa=curqa,
+                            anchor=np.float64(staged.packed.dates[c][0]),
+                            horizon=np.float64(
+                                staged.packed.dates[c][T - 1]))
                         summary["bootstrapped"] += 1
                         counters.add("chips")
                         save_state(_state_path(sdir, cid), st, side)
@@ -327,6 +354,8 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
     finally:
         obs_server.set_stage("finalize")
         writer.close()
+        if warm is not None:       # collect warm-compile counters if done
+            warm.join(timeout=5.0)
         for k, v in summary.items():
             obs_metrics.gauge(f"stream_{k}").set(v)
         if tracer is not None:

@@ -23,13 +23,15 @@ DRIVER_STAGE_HISTOGRAMS = (
     "ingest_chip_seconds",
     "pipeline_fetch_seconds",
     "pipeline_pack_seconds",
+    "pipeline_stage_seconds",
     "pipeline_dispatch_seconds",
     "pipeline_drain_seconds",
+    "pipeline_d2h_seconds",
     "store_write_seconds",
     "store_flush_seconds",
     "kernel_first_call_seconds",
 )
-DRIVER_SPAN_NAMES = ("fetch", "pack", "dispatch", "drain")
+DRIVER_SPAN_NAMES = ("fetch", "pack", "stage", "dispatch", "drain", "d2h")
 
 
 def build_report(*, registry=None, tracer=None, run: dict | None = None,

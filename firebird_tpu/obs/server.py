@@ -54,13 +54,14 @@ class RunStatus:
 
     def __init__(self, run_id: str, kind: str, *, chips_total: int = 0,
                  counters=None, watchdog=None, run: dict | None = None,
-                 mesh_up: bool = True):
+                 mesh_up: bool = True, pipeline_depth: int = 2):
         self.run_id = run_id
         self.kind = kind
         self.chips_total = int(chips_total)
         self.counters = counters
         self.watchdog = watchdog
         self.run = dict(run or {})
+        self.pipeline_depth = max(int(pipeline_depth), 1)
         self._lock = threading.Lock()
         self._stage = "init"
         self._mesh_up = bool(mesh_up)
@@ -83,14 +84,26 @@ class RunStatus:
         with self._lock:
             self._first_batch = True
             self._batches_dispatched += 1
+            self._record_inflight()
 
     def batch_done(self, units: int = 1) -> None:
         """A batch finished draining — forward progress; beats the
         watchdog."""
         with self._lock:
             self._batches_done += 1
+            self._record_inflight()
         if self.watchdog is not None:
             self.watchdog.beat(units)
+
+    def _record_inflight(self) -> None:
+        # Called under self._lock: compute-and-set must be atomic or a
+        # dispatch/done race could strand the gauge at a stale value.
+        from firebird_tpu.obs import metrics as obs_metrics
+
+        n = self._batches_dispatched - self._batches_done
+        obs_metrics.gauge(
+            "pipeline_inflight",
+            help="batches dispatched but not yet drained").set(max(n, 0))
 
     # -- endpoint reads ----------------------------------------------------
 
@@ -108,6 +121,7 @@ class RunStatus:
             mesh_up, first = self._mesh_up, self._first_batch
         counters = self.counters.snapshot() if self.counters is not None \
             else {}
+        inflight = max(dispatched - done, 0)
         return {
             "run_id": self.run_id,
             "kind": self.kind,
@@ -118,6 +132,14 @@ class RunStatus:
             "chips_total": self.chips_total,
             "batches_dispatched": dispatched,
             "batches_done": done,
+            # Occupancy ~1 while dispatching: the device stays fed and the
+            # drain bound (pipeline_depth) is the limiter; ~0 means the
+            # host (fetch/pack/stage) is starving the device.
+            "pipeline": {
+                "depth": self.pipeline_depth,
+                "in_flight": inflight,
+                "occupancy": round(inflight / self.pipeline_depth, 3),
+            },
             "counters": counters,
             "watchdog": (self.watchdog.snapshot()
                          if self.watchdog is not None else None),

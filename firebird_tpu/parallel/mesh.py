@@ -129,9 +129,51 @@ def shard_packed(packed, mesh: Mesh, dtype, prepped=None):
             put(packed.qas, jnp.uint16))
 
 
+def _wcap_global_max(mesh: Mesh, v: int) -> int:
+    """Cross-process agreement on a host scalar (the static wcap trace
+    constant): every process of a cross-host SPMD dispatch must trace the
+    same program even though each only sees its local chip slice.
+    Host-local meshes (the driver's per-host loop) must NOT synchronize —
+    hosts run different batch counts and a barrier would deadlock."""
+    if not spans_processes(mesh):
+        return v
+    from jax.experimental import multihost_utils
+    try:
+        return int(np.max(np.asarray(
+            multihost_utils.process_allgather(np.array([v])))))
+    except Exception as e:
+        # ONLY the jax<0.5 CPU backend's deterministic "Multiprocess
+        # computations aren't implemented" falls back to the KV
+        # store; a transient allgather failure must re-raise — if
+        # some processes fell back while others succeeded, the
+        # lockstep _kv_seq counters would skew and every later
+        # fallback would read the wrong sequence's keys.
+        if "Multiprocess computations aren't implemented" not in str(e):
+            raise
+        return _kv_global_max(v)
+
+
+def stage_sharded(packed, mesh: Mesh, dtype) -> tuple[tuple, int]:
+    """The H2D half of :func:`detect_sharded`, split out so the driver's
+    prefetch thread can ship batch i+1 under the run's sharding while
+    batch i computes: returns ``(args, wcap)`` — the sharded device
+    arrays plus the cross-host-agreed window cap — to pass back through
+    ``detect_sharded(..., staged=...)``."""
+    import jax.numpy as jnp
+    from firebird_tpu.ccd.kernel import ensure_x64, window_cap
+
+    dtype = dtype or jnp.float32
+    ensure_x64(dtype)
+    wcap = _wcap_global_max(mesh, window_cap(packed))
+    args = shard_packed(packed, mesh, dtype)
+    jax.block_until_ready(args)
+    return args, wcap
+
+
 def detect_sharded(packed, mesh: Mesh, dtype=None,
                    check_capacity: bool = True,
-                   max_segments: int | None = None):
+                   max_segments: int | None = None,
+                   staged: tuple | None = None, donate: bool = False):
     """Run the CCD kernel with the chip batch sharded over the mesh.
 
     This is the multi-device production path: same math as
@@ -141,47 +183,28 @@ def detect_sharded(packed, mesh: Mesh, dtype=None,
     would fail to trace rather than silently all-gather), and (b) gives
     each shard a plain single-device context, so Mosaic custom calls (the
     Pallas CD kernel, FIREBIRD_PALLAS=1) need no SPMD partitioning rule.
+
+    ``staged`` takes the ``(args, wcap)`` pair from :func:`stage_sharded`
+    instead of transferring here; ``donate=True`` (honored only with
+    ``check_capacity=False`` — a retry would re-dispatch deleted
+    buffers) frees the staged wire inputs at dispatch.
     """
     import jax.numpy as jnp
     from firebird_tpu.ccd.kernel import (MAX_SEGMENTS, capacity_bound,
-                                         capacity_retry, ensure_x64,
-                                         window_cap)
+                                         capacity_retry, ensure_x64)
 
     dtype = dtype or jnp.float32
     ensure_x64(dtype)
-    # wcap is a static trace constant, so every process of a cross-host
-    # SPMD dispatch must agree on it even though each only sees its local
-    # chip slice: max-reduce the per-host bound before tracing.  Host-local
-    # meshes (the driver's per-host loop) must NOT synchronize here —
-    # hosts run different batch counts and a barrier would deadlock.
-    multiproc = spans_processes(mesh)
-
-    def global_max(v: int) -> int:
-        if not multiproc:
-            return v
-        from jax.experimental import multihost_utils
-        try:
-            return int(np.max(np.asarray(
-                multihost_utils.process_allgather(np.array([v])))))
-        except Exception as e:
-            # ONLY the jax<0.5 CPU backend's deterministic "Multiprocess
-            # computations aren't implemented" falls back to the KV
-            # store; a transient allgather failure must re-raise — if
-            # some processes fell back while others succeeded, the
-            # lockstep _kv_seq counters would skew and every later
-            # fallback would read the wrong sequence's keys.
-            if "Multiprocess computations aren't implemented" not in str(e):
-                raise
-            return _kv_global_max(v)
-
-    wcap = global_max(window_cap(packed))
-    args = shard_packed(packed, mesh, dtype)
+    args, wcap = staged if staged is not None \
+        else stage_sharded(packed, mesh, dtype)
+    do_donate = donate and not check_capacity
 
     def dispatch(S):
         from firebird_tpu.ccd.kernel import record_first_call
 
         fn = sharded_detect_fn(mesh, jnp.dtype(dtype), wcap,
-                               packed.sensor, max_segments=S)
+                               packed.sensor, max_segments=S,
+                               donate=do_donate)
         return record_first_call(
             ("sharded", packed.spectra.shape, str(jnp.dtype(dtype)), wcap,
              packed.sensor.name, S, len(mesh.devices.flat)),
@@ -191,8 +214,9 @@ def detect_sharded(packed, mesh: Mesh, dtype=None,
         # Every process must agree on the retry, so max-reduce the local
         # worst (read from addressable shards only — the global array is
         # not fetchable under multi-process sharding).
-        return global_max(max(int(np.asarray(s.data).max())
-                              for s in seg.n_segments.addressable_shards))
+        return _wcap_global_max(mesh, max(
+            int(np.asarray(s.data).max())
+            for s in seg.n_segments.addressable_shards))
 
     S0 = max_segments or MAX_SEGMENTS
     if not check_capacity:
@@ -202,7 +226,8 @@ def detect_sharded(packed, mesh: Mesh, dtype=None,
 
 @functools.lru_cache(maxsize=None)
 def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor,
-                      max_segments: int | None = None):
+                      max_segments: int | None = None,
+                      donate: bool = False):
     """The jitted shard_map program, cached per (mesh, dtype, wcap, sensor,
     capacity) — rebuilding the jit wrapper per batch would retrace every
     dispatch.
@@ -240,4 +265,27 @@ def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor,
 
         wrapped = sm_exp(local_batch, mesh=mesh, in_specs=(spec,) * 6,
                          out_specs=spec, check_rep=False)
-    return jax.jit(wrapped)
+    # Donation frees the sharded wire inputs (spectra + QA) at dispatch —
+    # the driver's staged single-dispatch path only; capacity-retry
+    # callers take the non-donating cache entry (kernel.detect_packed's
+    # same rule).
+    return jax.jit(wrapped, donate_argnums=(4, 5) if donate else ())
+
+
+def aot_compile_sharded(mesh: Mesh, dtype, wcap: int, sensor, shapes,
+                        max_segments: int | None = None,
+                        donate: bool = False):
+    """AOT lower+compile the sharded batch program for a shape without
+    running it (``shapes``: the 6 global array shapes in shard_packed's
+    argument order; wire dtypes applied here).  The sharded half of
+    kernel.aot_compile, for driver.core.warm_start on multi-device
+    topologies."""
+    import jax.numpy as jnp
+
+    fn = sharded_detect_fn(mesh, jnp.dtype(dtype), wcap, sensor,
+                           max_segments=max_segments, donate=donate)
+    sh = chip_sharding(mesh)
+    dts = (dtype, dtype, dtype, jnp.bool_, jnp.int16, jnp.uint16)
+    avatars = tuple(jax.ShapeDtypeStruct(s, jnp.dtype(d), sharding=sh)
+                    for s, d in zip(shapes, dts))
+    return fn.lower(*avatars).compile()

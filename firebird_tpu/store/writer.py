@@ -29,6 +29,16 @@ from firebird_tpu.obs import tracing
 log = logger("change-detection")
 
 
+def _frame_rows(frame: dict) -> int:
+    """Row count of a table frame (all columns share one length)."""
+    for v in frame.values():
+        try:
+            return len(v)
+        except TypeError:
+            continue
+    return 0
+
+
 class AsyncWriter:
     def __init__(self, store, max_queue: int = 16, workers: int = 1):
         self.store = store
@@ -56,6 +66,10 @@ class AsyncWriter:
                         self.store.write(table, frame)
                     obs_metrics.histogram(
                         "store_write_seconds").observe(tm.elapsed)
+                    obs_metrics.counter(
+                        "store_rows_written",
+                        help="rows landed in the results store").inc(
+                        _frame_rows(frame))
             except BaseException as e:  # incl. KeyboardInterrupt: a dead
                 # worker with un-acked items would hang flush() forever
                 log.error("async write to %s failed: %s", table, e)

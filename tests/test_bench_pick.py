@@ -115,3 +115,51 @@ def test_everything_errored_still_returns_a_pick():
     pick, _, _ = autotune_pick(
         {"0": 0.0}, {"0": "RuntimeError"}, {})
     assert pick == "0"
+
+
+# ---------------------------------------------------------------------------
+# Artifact scrubbing (ISSUE 3 satellite): ANSI escapes stripped, error
+# text truncated, before the bench JSON line becomes a round artifact.
+# ---------------------------------------------------------------------------
+
+from bench import ERR_TEXT_LIMIT, clean_text, scrub_artifact  # noqa: E402
+
+
+def test_clean_text_strips_raw_and_repr_escaped_ansi():
+    raw = "\x1b[32m INFO\x1b[0m compiling"
+    assert clean_text(raw) == " INFO compiling"
+    # repr() of a string holding ESC bytes yields literal "\x1b[2m" text —
+    # the form BENCH_r05.json actually embedded.
+    escaped = r"JaxRuntimeError('\x1b[2m2026-08-02\x1b[0m \x1b[33mWARN\x1b[0m boom')"
+    assert "\\x1b[" not in clean_text(escaped)
+    assert "boom" in clean_text(escaped)
+
+
+def test_clean_text_truncates_with_marker():
+    s = "e" * 1000
+    out = clean_text(s, limit=100)
+    assert out.startswith("e" * 100)
+    assert out.endswith("...[+900 chars]")
+    assert clean_text("short", limit=100) == "short"
+
+
+def test_scrub_artifact_truncates_error_fields_only():
+    rec = {
+        "value": 1.5,
+        "detail": {
+            "note": "n" * 2000,                      # not an error key
+            "pallas_autotune": {
+                "errors": {"mega": "\x1b[31m" + "x" * 5000 + "\x1b[0m"},
+            },
+            "last_tpu_capture": {"tail": "t" * 5000},
+            "nested": ["\x1b[2mdim\x1b[0m", 3],
+        },
+    }
+    out = scrub_artifact(rec)
+    assert out["value"] == 1.5
+    err = out["detail"]["pallas_autotune"]["errors"]["mega"]
+    assert len(err) < ERR_TEXT_LIMIT + 40 and "\x1b" not in err
+    assert len(out["detail"]["last_tpu_capture"]["tail"]) < ERR_TEXT_LIMIT + 40
+    assert out["detail"]["note"] == "n" * 2000       # non-error text intact
+    assert out["detail"]["nested"][0] == "dim"
+    assert out["detail"]["nested"][1] == 3

@@ -5,8 +5,9 @@ the embedded ops endpoint bound to an ephemeral port, polling
 ``/healthz`` / ``/readyz`` / ``/metrics`` / ``/progress`` while batches
 are in flight, then validates the emitted artifacts against the shared
 schema checks (firebird_tpu.obs.report): the Chrome-trace JSON must
-parse, pass ``validate_trace``, and contain the four pipeline span
-names; the obs_report.json must pass ``validate_report`` and carry every
+parse, pass ``validate_trace``, and contain every pipeline span name
+(DRIVER_SPAN_NAMES, incl. the stage/d2h staging-egress spans); the
+obs_report.json must pass ``validate_report`` and carry every
 DRIVER_STAGE_HISTOGRAMS stage key; and the live ``/progress`` chip
 totals must agree with the final report.  Exits non-zero on any
 violation — the CI-greppable proof that the telemetry layer still wires
