@@ -9,8 +9,8 @@ X ?= 542000
 Y ?= 1650000
 ACQUIRED ?= 1982-01-01/2017-12-31
 
-.PHONY: install test bench obs-smoke pipeline-smoke image db-up db-schema \
-        db-test db-down changedetection classification clean
+.PHONY: install test bench obs-smoke pipeline-smoke chaos-smoke image \
+        db-up db-schema db-test db-down changedetection classification clean
 
 install:
 	pip install -e . --no-build-isolation
@@ -35,6 +35,14 @@ obs-smoke:
 # nonzero counts and that run 2 hits the compile cache (no XLA recompile).
 pipeline-smoke:
 	python tools/pipeline_smoke.py
+
+# Graceful-degradation check (docs/ROBUSTNESS.md): a synthetic tile run
+# under a seeded fault plan (ingest p=0.05 + a poisoned chip + a store
+# brownout), then `--resume` — asserts the poisoned chip lands in
+# quarantine.json without failing its chunk, the quarantine drains, and
+# the final store is row-for-row identical to a clean run.
+chaos-smoke:
+	python tools/chaos_soak.py
 
 image:
 	docker build -f deploy/Dockerfile -t firebird .

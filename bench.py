@@ -169,6 +169,24 @@ def _fleet_obs_fold() -> dict:
     }}
 
 
+def _chaos_fold() -> dict:
+    """{"chaos_report": ...} when a `make chaos-smoke` artifact exists on
+    this host (tools/chaos_soak.py writes chaos_report.json under
+    FIREBIRD_CHAOS_DIR, default /tmp/fb_chaos) — the robustness round
+    evidence, scrubbed/folded like the soak/obs artifacts.  Empty dict
+    when no chaos run happened."""
+    import os
+
+    path = os.path.join(
+        os.environ.get("FIREBIRD_CHAOS_DIR", "/tmp/fb_chaos"),
+        "chaos_report.json")
+    try:
+        with open(path) as f:
+            return {"chaos_report": json.load(f)}
+    except (OSError, ValueError):
+        return {}
+
+
 def measure(cpu_only: bool) -> None:
     if cpu_only:
         import jax
@@ -615,6 +633,9 @@ def measure(cpu_only: bool) -> None:
             # host: prefer the merged multi-host obs_report over any
             # single process's shard (obs.report.load_fleet_report).
             **_fleet_obs_fold(),
+            # Last chaos-smoke evidence (faults absorbed, store equality
+            # after resume) when a run left its artifact on this host.
+            **_chaos_fold(),
             "streaming_pixels_per_sec": round(stream_rate, 1),
             **s2_detail,
             **hard_detail,

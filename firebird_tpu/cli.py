@@ -65,8 +65,13 @@ def entrypoint():
                    "runs of a shape skip XLA, and the first compile "
                    "overlaps batch-0 fetch (background AOT warm start); "
                    "overrides FIREBIRD_COMPILE_CACHE")
+@click.option("--faults", default=None,
+              help="deterministic fault-injection plan for chaos drills, "
+                   "e.g. 'ingest:p=0.05,seed=7;store:after=40,brownout=3' "
+                   "(docs/ROBUSTNESS.md); overrides FIREBIRD_FAULTS — "
+                   "off (no injection, no proxies) when neither is set")
 def changedetection(x, y, acquired, number, chunk_size, resume, trace,
-                    ops_port, compile_cache):
+                    ops_port, compile_cache, faults):
     """Run change detection for a tile and save results to the store."""
     from firebird_tpu.config import Config
     from firebird_tpu.driver import core
@@ -80,7 +85,8 @@ def changedetection(x, y, acquired, number, chunk_size, resume, trace,
     init_distributed()
     overrides = {k: v for k, v in
                  (("trace", trace), ("ops_port", ops_port),
-                  ("compile_cache", compile_cache)) if v is not None}
+                  ("compile_cache", compile_cache),
+                  ("faults", faults)) if v is not None}
     return core.changedetection(
         x=x, y=y,
         acquired=acquired or dates.default_acquired(),
@@ -158,7 +164,9 @@ def save(bounds, product_names, product_dates, acquired, clip):
 @click.option("--compile-cache", default=None,
               help="persistent XLA compile cache (see changedetection "
                    "--compile-cache)")
-def stream(x, y, acquired, number, trace, ops_port, compile_cache):
+@click.option("--faults", default=None,
+              help="fault-injection plan (see changedetection --faults)")
+def stream(x, y, acquired, number, trace, ops_port, compile_cache, faults):
     """Streaming incremental change detection (no reference equivalent —
     its only mode is full reruns, ccdc/pyccd.py:171-183).  First run per
     chip bootstraps batch detection and a state checkpoint; later runs
@@ -170,7 +178,8 @@ def stream(x, y, acquired, number, trace, ops_port, compile_cache):
     init_distributed()
     overrides = {k: v for k, v in
                  (("trace", trace), ("ops_port", ops_port),
-                  ("compile_cache", compile_cache)) if v is not None}
+                  ("compile_cache", compile_cache),
+                  ("faults", faults)) if v is not None}
     return sdrv.stream(
         x=x, y=y, acquired=acquired, number=number,
         cfg=Config.from_env(**overrides) if overrides else None)

@@ -79,9 +79,29 @@ class Config:
     # when more than one is visible; 'off' forces single-device dispatch.
     device_sharding: str = "auto"
 
-    # Retries per chip fetch before the chunk is failed (reference semantics:
-    # Spark task retry absorbed transient ingest errors).
+    # Retries per chip fetch before the chip is quarantined (reference
+    # semantics: Spark task retry absorbed transient ingest errors).
     fetch_retries: int = 3
+
+    # HTTP timeout (seconds) for the Chipmunk raster client — the knob
+    # behind the previously hardcoded 60 s urlopen timeout.
+    http_timeout: float = 60.0
+
+    # Run-wide ceiling on TOTAL retries across every retry site (ingest
+    # fetches + store writes); 0 = unlimited.  A systemic outage fails
+    # fast into the quarantine instead of multiplying per-chip backoff.
+    retry_budget: int = 0
+
+    # Ingest circuit breaker: this many CONSECUTIVE fetch failures open
+    # the circuit (fetching pauses, half-open probes resume it) for
+    # breaker_cooldown_sec.  0 disables the breaker.
+    breaker_threshold: int = 5
+    breaker_cooldown_sec: float = 30.0
+
+    # Deterministic fault-injection plan (firebird_tpu.faults), e.g.
+    # "ingest:p=0.05,seed=7;store:after=40,brownout=3".  "" (default)
+    # injects nothing and puts no proxy on the hot path.
+    faults: str = ""
 
     # Async egress worker threads.  1 preserves global write order; more
     # raise store throughput (parquet/cassandra scale well; sqlite WAL
@@ -152,6 +172,23 @@ class Config:
         if self.fetch_retries < 0:
             raise ValueError("FIREBIRD_FETCH_RETRIES must be >= 0, got "
                              f"{self.fetch_retries}")
+        if self.http_timeout <= 0:
+            raise ValueError("FIREBIRD_HTTP_TIMEOUT must be > 0 seconds, "
+                             f"got {self.http_timeout}")
+        if self.retry_budget < 0:
+            raise ValueError("FIREBIRD_RETRY_BUDGET must be >= 0 "
+                             f"(0 = unlimited), got {self.retry_budget}")
+        if self.breaker_threshold > 0 and self.breaker_cooldown_sec <= 0:
+            raise ValueError("FIREBIRD_BREAKER_COOLDOWN must be > 0 when "
+                             "the breaker is enabled, got "
+                             f"{self.breaker_cooldown_sec}")
+        # Parse the fault plan now: a typo'd FIREBIRD_FAULTS inside the
+        # driver's failure isolation would otherwise fail every chunk and
+        # exit "successfully" — same fail-fast rationale as dtype above.
+        if self.faults:
+            from firebird_tpu import faults as _faults
+
+            _faults.FaultPlan.parse(self.faults)
         if not 0 <= self.ops_port <= 65535:
             raise ValueError("FIREBIRD_OPS_PORT must be 0 (off) or a valid "
                              f"TCP port, got {self.ops_port}")
@@ -186,6 +223,15 @@ class Config:
                                   cls.device_sharding),
             fetch_retries=int(e.get("FIREBIRD_FETCH_RETRIES",
                                     cls.fetch_retries)),
+            http_timeout=float(e.get("FIREBIRD_HTTP_TIMEOUT",
+                                     cls.http_timeout)),
+            retry_budget=int(e.get("FIREBIRD_RETRY_BUDGET",
+                                   cls.retry_budget)),
+            breaker_threshold=int(e.get("FIREBIRD_BREAKER_THRESHOLD",
+                                        cls.breaker_threshold)),
+            breaker_cooldown_sec=float(e.get("FIREBIRD_BREAKER_COOLDOWN",
+                                             cls.breaker_cooldown_sec)),
+            faults=e.get("FIREBIRD_FAULTS", cls.faults),
             writer_threads=int(e.get("FIREBIRD_WRITER_THREADS",
                                      cls.writer_threads)),
             profile_dir=e.get("FIREBIRD_PROFILE_DIR", cls.profile_dir),

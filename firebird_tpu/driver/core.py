@@ -8,9 +8,13 @@ of Spark jobs: chips are fetched by a host thread pool (INPUT_PARTITIONS
 semantics), packed into device batches, run through the CCD kernel, and
 drained to the store by an async writer so egress overlaps compute.
 
-A failed chunk is logged and skipped (core.py:115-124 prints the traceback);
-because store writes are keyed upserts, rerunning the same tile repairs any
-gap (SURVEY.md §5 durability model).
+Failure handling is per-CHIP, not per-chunk: a chip that exhausts its
+(jittered, budgeted) fetch retries is dead-lettered to quarantine.json and
+its chunk completes without it; kernel/store errors still fail the chunk
+as a backstop (core.py:115-124 semantics) but dead-letter its chips too.
+Because store writes are keyed upserts, ``--resume`` (gated by
+run_manifest.json, draining the quarantine first) repairs any gap
+(SURVEY.md §5 durability model; docs/ROBUSTNESS.md).
 """
 
 from __future__ import annotations
@@ -26,10 +30,13 @@ import traceback
 import jax.numpy as jnp
 import numpy as np
 
+from firebird_tpu import faults as faultlib
 from firebird_tpu import grid
+from firebird_tpu import retry as retrylib
 from firebird_tpu.ccd import format as ccdformat
 from firebird_tpu.ccd import kernel
 from firebird_tpu.config import Config
+from firebird_tpu.driver import quarantine as qlib
 from firebird_tpu.ingest import ChipmunkSource, FileSource, SyntheticSource, pack
 from firebird_tpu.obs import Counters, jsonlog, logger
 from firebird_tpu.obs import metrics as obs_metrics
@@ -128,7 +135,7 @@ def record_topology_metrics() -> None:
 
 
 def start_ops(cfg: Config, run_id: str, kind: str, *, chips_total: int,
-              counters, run_block: dict):
+              counters, run_block: dict, quarantine=None, breaker=None):
     """Bring up the run's live ops surface (shared by both drivers).
 
     Registers the run context for JSON logs, clears stale report shards
@@ -152,7 +159,8 @@ def start_ops(cfg: Config, run_id: str, kind: str, *, chips_total: int,
         status = obs_server.set_status(obs_server.RunStatus(
             run_id, kind, chips_total=chips_total, counters=counters,
             watchdog=watchdog, run=run_block, mesh_up=_mesh_ready(),
-            pipeline_depth=cfg.pipeline_depth))
+            pipeline_depth=cfg.pipeline_depth, quarantine=quarantine,
+            breaker=breaker))
         if cfg.ops_port > 0:
             server = obs_server.start_ops_server(cfg.ops_port, status)
     except Exception:
@@ -181,7 +189,8 @@ def make_source(cfg: Config, kind: str | None = None):
     kind = kind or cfg.source_backend
     if kind == "chipmunk":
         return ChipmunkSource(cfg.ard_url,
-                              band_parallelism=cfg.band_parallelism)
+                              band_parallelism=cfg.band_parallelism,
+                              timeout=cfg.http_timeout)
     if kind == "synthetic":
         return SyntheticSource(seed=0)
     if kind == "file":
@@ -193,8 +202,38 @@ def make_aux_source(cfg: Config, kind: str | None = None):
     kind = kind or cfg.source_backend
     if kind == "chipmunk":
         return ChipmunkSource(cfg.aux_url,
-                              band_parallelism=cfg.band_parallelism)
+                              band_parallelism=cfg.band_parallelism,
+                              timeout=cfg.http_timeout)
     return make_source(cfg, kind)
+
+
+def robustness_setup(cfg: Config, run_id: str, *, source=None, store=None):
+    """The drivers' shared graceful-degradation bring-up (ONE code path
+    for batch and stream): the (usually absent) fault plan wraps the
+    failure seams, one retry budget + ingest circuit breaker are shared
+    by every retry site, the async writer retries store writes, and the
+    dead-letter quarantine carries poisoned chips across runs.  With
+    FIREBIRD_FAULTS unset the wrap_* calls return their argument
+    unchanged — nothing on the hot path.
+
+    Returns (source, store, writer, policy, breaker, quarantine)."""
+    plan = faultlib.FaultPlan.from_config(cfg)
+    source = faultlib.wrap_source(source or make_source(cfg), plan)
+    store = faultlib.wrap_store(
+        store or open_store(cfg.store_backend, cfg.store_path,
+                            cfg.keyspace()), plan)
+    budget = retrylib.RetryBudget(cfg.retry_budget)
+    breaker = retrylib.make_breaker(cfg)
+    policy = retrylib.RetryPolicy.for_ingest(cfg, budget=budget,
+                                             breaker=breaker)
+    writer = faultlib.wrap_writer(
+        AsyncWriter(store, workers=cfg.writer_threads,
+                    retry=retrylib.RetryPolicy.for_store(cfg,
+                                                         budget=budget)),
+        plan)
+    quarantine = qlib.Quarantine.load(qlib.quarantine_path(cfg),
+                                      run_id=run_id)
+    return source, store, writer, policy, breaker, quarantine
 
 
 def _pad_target(n_chips: int, pad_to: int | None, use_mesh: bool,
@@ -478,22 +517,17 @@ def warm_start(cfg: Config, acquired: str, sensor=None, dtype=None,
         return _warm_thread
 
 
-def _with_retries(cfg: Config, log, what: str, fn):
+def _with_retries(cfg: Config, log, what: str, fn, policy=None):
     """Run fn() under the driver's transient-failure policy: the reference
     delegated these to Spark's task retry; here a blip on one fetch must
-    not fail the whole chunk.  Raises the last error after
-    cfg.fetch_retries retries."""
-    for attempt in range(cfg.fetch_retries + 1):
-        try:
-            return fn()
-        except Exception as e:
-            if attempt == cfg.fetch_retries:
-                raise
-            obs_metrics.counter("fetch_retries").inc()
-            delay = min(2.0 ** attempt, 30.0)
-            log.warning("%s failed (attempt %d: %s: %s), retrying in %.0fs",
-                        what, attempt + 1, type(e).__name__, e, delay)
-            time.sleep(delay)
+    not fail the whole chunk.  The real loop lives in
+    :class:`firebird_tpu.retry.RetryPolicy` (decorrelated-jitter backoff,
+    injectable sleep, optional shared budget + circuit breaker); callers
+    without a run-scoped ``policy`` get a one-off built from
+    ``cfg.fetch_retries``.  Raises the last error when retries run out."""
+    if policy is None:
+        policy = retrylib.RetryPolicy(cfg.fetch_retries)
+    return policy.run(log, what, fn)
 
 
 def fetch(x, y, outdir: str, acquired: str | None = None,
@@ -506,17 +540,29 @@ def fetch(x, y, outdir: str, acquired: str | None = None,
     The write side of ingest's FileSource: fetch once over the network,
     then run any number of campaigns with FIREBIRD_SOURCE=file against the
     local archive.  Uses the driver's fetch retries and INPUT_PARTITIONS
-    parallelism.  Returns (chips written, chips attempted).
+    parallelism.  Chips that exhaust their retries are dead-lettered to
+    ``<outdir>/quarantine.json`` (error class + attempt history) so a
+    partial archive mirror is resumable like a partial store: rerun the
+    same fetch and only the manifest's chips are missing work.  Returns
+    (chips written, chips attempted).
     """
     import os
 
     cfg = cfg or Config.from_env()
     acquired = acquired or dt.default_acquired()
     log = logger("timeseries")
-    source = source or make_source(cfg)
+    plan = faultlib.FaultPlan.from_config(cfg)
+    source = faultlib.wrap_source(source or make_source(cfg), plan)
     aux_source = aux_source or (make_aux_source(cfg) if aux else None)
+    if aux_source is not None:
+        aux_source = faultlib.wrap_source(aux_source, plan)
     os.makedirs(outdir, exist_ok=True)
     sink = FileSource(outdir)
+    policy = retrylib.RetryPolicy.for_ingest(
+        cfg, budget=retrylib.RetryBudget(cfg.retry_budget),
+        breaker=retrylib.make_breaker(cfg))
+    quarantine = qlib.Quarantine.load(
+        os.path.join(outdir, "quarantine.json"))
 
     tile = grid.tile(x=x, y=y)
     cids = list(take(number, grid.chips(tile)))
@@ -529,16 +575,21 @@ def fetch(x, y, outdir: str, acquired: str | None = None,
         try:
             _with_retries(cfg, log, f"chip ({xy[0]},{xy[1]}) fetch",
                           lambda: sink.save_chip(
-                              source.chip(xy[0], xy[1], acquired)))
+                              source.chip(xy[0], xy[1], acquired)),
+                          policy=policy)
         except Exception as e:
             log.error("chip (%s,%s) failed: %s", xy[0], xy[1], e)
+            quarantine.record(xy, e, attempts=cfg.fetch_retries + 1,
+                              stage="fetch")
             return 0
+        quarantine.discard(xy)       # a redeemed dead letter drains
         if aux_source is not None:
             try:
                 _with_retries(cfg, log, f"aux ({xy[0]},{xy[1]}) fetch",
                               lambda: sink.save_aux(
                                   xy[0], xy[1],
-                                  aux_source.aux(xy[0], xy[1], acquired)))
+                                  aux_source.aux(xy[0], xy[1], acquired)),
+                              policy=policy)
             except Exception as e:
                 log.error("aux (%s,%s) failed: %s — archive holds the "
                           "chip but no aux layers", xy[0], xy[1], e)
@@ -547,7 +598,10 @@ def fetch(x, y, outdir: str, acquired: str | None = None,
     with cf.ThreadPoolExecutor(
             max_workers=max(cfg.input_parallelism, 1)) as ex:
         n = sum(ex.map(one, cids))
-    log.info("fetch complete: %d/%d chips written", n, len(cids))
+    failed = len(cids) - n
+    log.info("fetch complete: %d/%d chips written, %d failed%s",
+             n, len(cids), failed,
+             f" (dead letters in {quarantine.path})" if failed else "")
     return n, len(cids)
 
 
@@ -748,7 +802,8 @@ def drain_batch(seg, packed, n_real, *, writer, counters, dtype=None,
     obs_server.batch_done(n_real)
 
 
-def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
+def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log,
+                 policy=None, quarantine=None):
     """Run change detection for one chunk of chip ids (ref core.detect,
     core.py:53-75): ingest -> pack -> stage -> kernel -> chip/pixel/segment
     writes.
@@ -760,7 +815,14 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
     Staged wire inputs are donated to the dispatch (freed on device once
     consumed), which is what lets the in-flight bound be a configurable
     ``cfg.pipeline_depth`` instead of a hard 2 without pinning every
-    batch's inputs alongside its results."""
+    batch's inputs alongside its results.
+
+    Per-chip failure isolation: a chip that exhausts its fetch retries is
+    dead-lettered to ``quarantine`` (quarantine.json) and DROPPED from its
+    batch — the remaining chips pack, dispatch, and land normally (the old
+    behavior lost the whole chunk, driver/core.py pre-PR4).  ``policy`` is
+    the run's shared :class:`~firebird_tpu.retry.RetryPolicy` (jitter,
+    budget, ingest breaker).  Returns the chip ids actually processed."""
     log.info("finding ccd segments for %d chips", len(cids))
     dtype = _DTYPES[cfg.dtype]
     batches = list(partition_all(cfg.chips_per_batch, cids))
@@ -780,37 +842,62 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
             cf.ThreadPoolExecutor(max_workers=1) as drain_ex:
 
         def fetch_one(xy):
-            with obs_metrics.timer() as tm:
-                chip = _with_retries(
-                    cfg, log, f"chip ({xy[0]},{xy[1]}) fetch",
-                    lambda: source.chip(xy[0], xy[1], acquired))
+            try:
+                with obs_metrics.timer() as tm:
+                    chip = _with_retries(
+                        cfg, log, f"chip ({xy[0]},{xy[1]}) fetch",
+                        lambda: source.chip(xy[0], xy[1], acquired),
+                        policy=policy)
+            except Exception as e:
+                # Per-chip isolation: dead-letter the poisoned chip and
+                # let the rest of the batch proceed — `--resume` drains
+                # the quarantine once the cause clears.
+                log.error(
+                    "chip (%s,%s) failed after retries (%s: %s); "
+                    "quarantined — its chunk continues without it",
+                    xy[0], xy[1], type(e).__name__, e)
+                if quarantine is not None:
+                    quarantine.record(xy, e,
+                                      attempts=cfg.fetch_retries + 1)
+                return None
             obs_metrics.histogram("ingest_chip_seconds").observe(tm.elapsed)
             return chip
 
-        def prepare_batch(bids) -> StagedBatch:
+        def prepare_batch(bids):
             """fetch -> pack -> device staging, all on the prefetch
             thread: by the time the main thread picks the batch up, its
-            arrays are already resident under the run's sharding."""
+            arrays are already resident under the run's sharding.
+            Returns (surviving chip ids, StagedBatch), or None when every
+            chip of the batch was quarantined."""
             with tracing.span("fetch", chips=len(bids)), \
                     obs_metrics.timer() as tm:
                 chips = list(chips_ex.map(fetch_one, bids))
             obs_metrics.histogram("pipeline_fetch_seconds").observe(tm.elapsed)
-            with tracing.span("pack", chips=len(chips)), \
+            keep = [(cid, ch) for cid, ch in zip(bids, chips)
+                    if ch is not None]
+            if not keep:
+                return None
+            with tracing.span("pack", chips=len(keep)), \
                     obs_metrics.timer() as tm:
-                packed = pack(chips, bucket=cfg.obs_bucket,
+                packed = pack([ch for _, ch in keep], bucket=cfg.obs_bucket,
                               max_obs=cfg.max_obs)
             obs_metrics.histogram("pipeline_pack_seconds").observe(tm.elapsed)
-            return stage_batch(packed, dtype, cfg.device_sharding,
-                               pad_to=pad_to)
+            return [cid for cid, _ in keep], \
+                stage_batch(packed, dtype, cfg.device_sharding,
+                            pad_to=pad_to)
 
         nxt = prefetch_ex.submit(prepare_batch, batches[0]) \
             if batches else None
         drains: list[cf.Future] = []
+        processed: list = []
         for i in range(len(batches)):
             obs_server.set_stage("fetch")
-            staged = nxt.result()
+            prep = nxt.result()
             nxt = (prefetch_ex.submit(prepare_batch, batches[i + 1])
                    if i + 1 < len(batches) else None)
+            if prep is None:
+                continue                 # whole batch quarantined
+            kept, staged = prep
             # The dispatch span measures enqueue time, not device compute
             # (check_capacity=False keeps it async); compute shows up as
             # the gap before the matching drain span closes.
@@ -830,6 +917,7 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
                 drain_batch, seg, staged.packed, n_real, writer=writer,
                 counters=counters, dtype=dtype,
                 sharding=cfg.device_sharding, pad_to=pad_to))
+            processed.extend(kept)
             # Bound in-flight batches to cfg.pipeline_depth (the one
             # computing + depth-1 draining): input donation frees each
             # batch's staged wire buffers at dispatch, so depth only pins
@@ -839,7 +927,7 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log):
                 drains.pop(0).result()
         for f in drains:
             f.result()
-    return list(cids)
+    return processed
 
 
 def changedetection(x, y, acquired: str | None = None, number: int = 2500,
@@ -853,7 +941,11 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
     granularity).  ``resume=True`` skips chips whose segments are already
     stored (the segment table is written last per chip, so presence
     implies completeness) — the explicit restart the reference only got
-    implicitly from rerunning idempotent upserts over a whole tile.
+    implicitly from rerunning idempotent upserts over a whole tile.  The
+    run manifest (run_manifest.json) makes resume REFUSE on a mismatched
+    acquired range and warn on a changed config fingerprint instead of
+    silently mixing results, and chips dead-lettered to quarantine.json
+    by a previous run drain first (docs/ROBUSTNESS.md).
 
     Returns the tuple of chip ids processed successfully.
     """
@@ -880,10 +972,14 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
     setup_compile_cache(cfg)
     warm = warm_start(cfg, acquired)
 
-    source = source or make_source(cfg)
-    store = store or open_store(cfg.store_backend, cfg.store_path,
-                                cfg.keyspace())
-    writer = AsyncWriter(store, workers=cfg.writer_threads)
+    # Refuse-or-warn BEFORE building anything: a resume against a
+    # different acquired range must not interleave date windows (and must
+    # not leave a half-built writer behind when it refuses).
+    if resume:
+        qlib.check_resume(cfg, acquired=acquired, log=log)
+
+    source, store, writer, policy, breaker, quarantine = robustness_setup(
+        cfg, run_id, source=source, store=store)
 
     tile = grid.tile(x=x, y=y)
     cids = list(take(number, grid.chips(tile)))
@@ -892,15 +988,25 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
     if resume:
         # Key on the segment table: it is written LAST per chip through the
         # FIFO writer, so its presence implies the chip/pixel rows landed
-        # too.  Resume assumes the same acquired range as the stored run —
-        # the store is namespaced by inputs+version (keyspace()), not by
-        # date range.
+        # too.
         have = store.chip_ids("segment")
+        # Dead letters whose chips actually landed (quarantined at chunk
+        # granularity but persisted before the failure) drain right away.
+        quarantine.discard_many(have)
         todo = [c for c in cids if c not in have]
         skipped = tuple(c for c in cids if c in have)
+        # Drain the quarantine FIRST: the chips we already know we owe
+        # sort to the front of the todo list (stable, so tile order is
+        # otherwise preserved).
+        qids = quarantine.chip_ids()
+        todo.sort(key=lambda c: tuple(int(v) for v in c) not in qids)
         cids = todo
-        log.info("resume: %d chips already stored (assuming same acquired "
-                 "range), %d to do", len(skipped), len(cids))
+        log.info("resume: %d chips already stored, %d to do (%d draining "
+                 "from quarantine first)", len(skipped), len(cids),
+                 len(qids))
+    else:
+        qlib.write_manifest(cfg, acquired=acquired, run_id=run_id,
+                            tile=tile)
     chunks = list(partition_all(chunk_size, cids))
     log.info("tile h=%s v=%s: %d chips in %d chunks (acquired %s)",
              tile["h"], tile["v"], len(cids), len(chunks), acquired)
@@ -914,7 +1020,8 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
                      resumed=len(skipped))
     _, ops_srv, watchdog = start_ops(
         cfg, run_id, "changedetection", chips_total=len(cids),
-        counters=counters, run_block=run_block)
+        counters=counters, run_block=run_block, quarantine=quarantine,
+        breaker=breaker)
 
     # Opt-in tracing (cfg.profile_dir): the whole run captures a JAX
     # profiler trace viewable in TensorBoard/Perfetto — the tracing
@@ -939,16 +1046,26 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
                     processed = detect_chunk(
                         chunk, source=source, writer=writer,
                         acquired=acquired, cfg=cfg, counters=counters,
-                        log=log)
+                        log=log, policy=policy, quarantine=quarantine)
                     obs_server.set_stage("flush")
                     writer.flush()  # a chunk counts once its rows landed
                     done.extend(processed)
+                    quarantine.discard_many(processed)  # redeemed letters
                 except Exception as e:
-                    # Chunk-level failure isolation (core.py:115-124): log
-                    # and move on; idempotent writes make the rerun cheap.
+                    # Chunk-level failure isolation (core.py:115-124) is
+                    # now the BACKSTOP behind per-chip quarantine (ingest
+                    # failures never reach here anymore): a kernel or
+                    # store error still fails the chunk, but its chips are
+                    # dead-lettered so `--resume` knows exactly what is
+                    # owed instead of rediscovering it by store diff.
                     obs_metrics.counter("chunk_failures").inc()
                     log.error("chunk failed (%d chips): %s", len(chunk), e)
                     traceback.print_exc()
+                    held = quarantine.chip_ids()
+                    quarantine.record_many(
+                        [c for c in chunk
+                         if tuple(int(v) for v in c) not in held],
+                        e, attempts=1, stage="chunk")
     finally:
         obs_server.set_stage("finalize")
         writer.close()
@@ -959,6 +1076,12 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
             warm.join(timeout=5.0)
         snap = counters.snapshot()
         log.info("change-detection complete: %s", snap)
+        if len(quarantine):
+            run_block["chips_quarantined"] = len(quarantine)
+            log.warning(
+                "%d chips in quarantine (%s) — rerun with --resume to "
+                "drain them once the cause clears", len(quarantine),
+                quarantine.path or "in-memory: memory store backend")
         if tracer is not None:
             tracing.stop()
         paths = obs_report.finish_run(

@@ -1,0 +1,273 @@
+"""Dead-letter quarantine + run manifest: per-chip failure isolation.
+
+Before this module, one poisoned chip failed its **whole 2500-chip
+chunk** (driver/core.py logged and skipped, ref core.py:115-124
+semantics), and ``--resume`` silently assumed the acquired range matched
+the stored run.  Now:
+
+- :class:`Quarantine` is the dead-letter manifest (``quarantine.json``
+  next to the results store): every chip that exhausts its retries is
+  recorded with its error class and attempt history, the rest of its
+  chunk completes, and the run exits having lost *chips*, not *chunks*.
+  ``--resume`` drains the quarantine first (quarantined chips sort to
+  the front of the todo list) and entries are discarded as their chips
+  land — a fully drained quarantine is the chaos-smoke success
+  criterion (tools/chaos_soak.py).
+- :class:`RunManifest`-style helpers (:func:`write_manifest`,
+  :func:`check_resume`) pin the run's acquired range, result-affecting
+  config fingerprint, and run_id in ``run_manifest.json``; a resume
+  against a different acquired range **refuses** (the stored segments
+  would silently mix date windows), and a different config fingerprint
+  warns.
+
+Both artifacts live next to the store for file-backed backends and stay
+in-memory for the 'memory' backend (same policy as obs_report.json —
+tests must not litter the CWD).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import json
+import os
+import threading
+
+from firebird_tpu.obs import metrics as obs_metrics
+
+QUARANTINE_SCHEMA = "firebird-quarantine/1"
+MANIFEST_SCHEMA = "firebird-run-manifest/1"
+
+# Exception text in the manifest is for diagnosis, not a log archive
+# (the same discipline as bench.py's ERR_TEXT_LIMIT).
+_MSG_LIMIT = 500
+
+
+def _artifact_dir(cfg) -> str | None:
+    """Directory the store-adjacent artifacts live in; None for the
+    'memory' backend (nothing on disk to sit next to)."""
+    if cfg.store_backend == "memory":
+        return None
+    if cfg.store_backend == "parquet":
+        return os.path.abspath(cfg.store_path)
+    return os.path.dirname(os.path.abspath(cfg.store_path))
+
+
+def quarantine_path(cfg) -> str | None:
+    d = _artifact_dir(cfg)
+    return None if d is None else os.path.join(d, "quarantine.json")
+
+
+def manifest_path(cfg) -> str | None:
+    d = _artifact_dir(cfg)
+    return None if d is None else os.path.join(d, "run_manifest.json")
+
+
+def _key(cid) -> str:
+    return f"{int(cid[0])},{int(cid[1])}"
+
+
+def _now_iso() -> str:
+    return datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+
+
+class Quarantine:
+    """The dead-letter manifest: chip id -> error class + attempt history.
+
+    Thread-safe (records arrive from the fetch pool); every mutation
+    persists atomically when a path is configured, so a crashed run's
+    quarantine survives for the resume.  ``path=None`` keeps the ledger
+    in memory only (memory-backend runs, unit tests).
+    """
+
+    def __init__(self, path: str | None, run_id: str = ""):
+        self.path = path
+        self.run_id = run_id
+        self._lock = threading.Lock()
+        self._entries: dict[str, dict] = {}
+
+    @classmethod
+    def load(cls, path: str | None, run_id: str = "") -> "Quarantine":
+        """A Quarantine seeded from the manifest at ``path`` when one
+        exists (a previous run's dead letters carry into this run's
+        drain); unreadable/foreign files start empty with a warning."""
+        q = cls(path, run_id=run_id)
+        if path is None or not os.path.exists(path):
+            return q
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            if doc.get("schema") != QUARANTINE_SCHEMA:
+                raise ValueError(f"schema {doc.get('schema')!r}")
+            q._entries = dict(doc.get("chips", {}))
+        except (OSError, ValueError) as e:
+            from firebird_tpu.obs import logger
+            logger("change-detection").warning(
+                "unreadable quarantine manifest at %s (%s); starting "
+                "empty", path, e)
+        return q
+
+    def record(self, cid, error: BaseException, attempts: int,
+               stage: str = "ingest") -> None:
+        """Dead-letter one chip.  Repeated failures of the same chip
+        (across runs or chunks) append to its attempt history rather
+        than overwriting it — the manifest shows the whole story."""
+        with self._lock:
+            e = self._entries.setdefault(_key(cid), {
+                "cx": int(cid[0]), "cy": int(cid[1]), "history": []})
+            e["error"] = type(error).__name__
+            e["message"] = str(error)[:_MSG_LIMIT]
+            e["stage"] = stage
+            e["history"].append({
+                "at": _now_iso(), "run_id": self.run_id,
+                "error": type(error).__name__, "attempts": int(attempts)})
+            self._save_locked()
+        obs_metrics.counter(
+            "chips_quarantined",
+            help="chips dead-lettered to quarantine.json").inc()
+
+    def record_many(self, cids, error: BaseException, attempts: int,
+                    stage: str) -> None:
+        for cid in cids:
+            self.record(cid, error, attempts, stage=stage)
+
+    def discard(self, cid) -> bool:
+        """Remove a chip that has since landed; True when it was held."""
+        with self._lock:
+            held = self._entries.pop(_key(cid), None) is not None
+            if held:
+                self._save_locked()
+        return held
+
+    def discard_many(self, cids) -> int:
+        n = 0
+        with self._lock:
+            for cid in cids:
+                n += self._entries.pop(_key(cid), None) is not None
+            if n:
+                self._save_locked()
+        return n
+
+    def chip_ids(self) -> set[tuple[int, int]]:
+        with self._lock:
+            return {(e["cx"], e["cy"]) for e in self._entries.values()}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"schema": QUARANTINE_SCHEMA, "updated_at": _now_iso(),
+                    "run_id": self.run_id, "chips": dict(self._entries)}
+
+    def _save_locked(self) -> None:
+        if self.path is None:
+            return
+        doc = {"schema": QUARANTINE_SCHEMA, "updated_at": _now_iso(),
+               "run_id": self.run_id, "chips": self._entries}
+        try:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, self.path)
+        except OSError as e:
+            # The ledger must never fail the run it exists to protect.
+            from firebird_tpu.obs import logger
+            logger("change-detection").error(
+                "quarantine manifest write failed: %s", e)
+
+    def save(self) -> None:
+        with self._lock:
+            self._save_locked()
+
+
+# ---------------------------------------------------------------------------
+# Run manifest: refuse-or-warn resume identity
+# ---------------------------------------------------------------------------
+
+def config_fingerprint(cfg) -> str:
+    """Hash of the RESULT-affecting knobs: two runs sharing it produce
+    row-identical stores for the same inputs.  Parallelism/batching/ops
+    knobs are deliberately excluded — changing them between a run and
+    its resume is legitimate tuning, not result mixing."""
+    doc = {"dtype": cfg.dtype, "max_obs": cfg.max_obs,
+           "obs_bucket": cfg.obs_bucket, "keyspace": cfg.keyspace()}
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def write_manifest(cfg, *, acquired: str, run_id: str,
+                   tile: dict | None = None) -> str | None:
+    """Pin this run's identity next to the store (atomic write).
+    Returns the path, or None for the memory backend."""
+    path = manifest_path(cfg)
+    if path is None:
+        return None
+    doc = {"schema": MANIFEST_SCHEMA, "written_at": _now_iso(),
+           "run_id": run_id, "acquired": acquired,
+           "config_fingerprint": config_fingerprint(cfg),
+           "config": {"dtype": cfg.dtype, "max_obs": cfg.max_obs,
+                      "obs_bucket": cfg.obs_bucket,
+                      "keyspace": cfg.keyspace()}}
+    if tile:
+        doc["tile"] = {"h": tile.get("h"), "v": tile.get("v")}
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, path)
+    except OSError as e:
+        from firebird_tpu.obs import logger
+        logger("change-detection").error("run manifest write failed: %s", e)
+        return None
+    return path
+
+
+class ResumeMismatch(ValueError):
+    """--resume against a store whose manifest pins different inputs."""
+
+
+def check_resume(cfg, *, acquired: str, log) -> None:
+    """Refuse-or-warn gate for ``--resume`` (the old behavior silently
+    *assumed* the acquired range matched, driver/core.py:900-903):
+
+    - no manifest: warn (pre-manifest store) and proceed on the old
+      assumption;
+    - acquired mismatch: **raise** :class:`ResumeMismatch` — resuming
+      would interleave segments from two date windows in one keyspace;
+    - config-fingerprint mismatch: warn with the differing knobs (the
+      operator may have changed dtype deliberately; the manifest makes
+      it a choice instead of an accident).
+    """
+    path = manifest_path(cfg)
+    if path is None:
+        return
+    if not os.path.exists(path):
+        log.warning("resume: no run manifest at %s (store predates the "
+                    "manifest); assuming the acquired range matches", path)
+        return
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        log.warning("resume: unreadable run manifest at %s (%s); assuming "
+                    "the acquired range matches", path, e)
+        return
+    want = doc.get("acquired")
+    if want and want != acquired:
+        raise ResumeMismatch(
+            f"resume refused: store at {cfg.store_path!r} was produced "
+            f"with acquired={want!r}, this run asks for {acquired!r} — "
+            "resuming would mix date windows; rerun without --resume "
+            "(or against a fresh store) to recompute")
+    fp = doc.get("config_fingerprint")
+    if fp and fp != config_fingerprint(cfg):
+        log.warning(
+            "resume: config fingerprint changed since the stored run "
+            "(stored %s: %s); results may mix variants", fp,
+            doc.get("config"))

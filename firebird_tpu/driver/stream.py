@@ -43,7 +43,6 @@ from firebird_tpu.obs import metrics as obs_metrics
 from firebird_tpu.obs import report as obs_report
 from firebird_tpu.obs import server as obs_server
 from firebird_tpu.obs import tracing
-from firebird_tpu.store import AsyncWriter, open_store
 from firebird_tpu.utils import dates as dt
 from firebird_tpu.utils.fn import partition_all, take
 
@@ -171,10 +170,11 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
     # donation), so the warm shape must match that variant.
     dcore.setup_compile_cache(cfg)
     warm = dcore.warm_start(cfg, acquired, dtype=jnp.float32, donate=False)
-    source = source or dcore.make_source(cfg)
-    store = store or open_store(cfg.store_backend, cfg.store_path,
-                                cfg.keyspace())
-    writer = AsyncWriter(store, workers=cfg.writer_threads)
+    # Same robustness plumbing as the batch driver (one code path:
+    # dcore.robustness_setup): fault-plan proxies, shared retry budget +
+    # ingest breaker, store-write retries, per-chip quarantine.
+    source, store, writer, policy, breaker, quarantine = \
+        dcore.robustness_setup(cfg, run_id, source=source, store=store)
     sdir = state_dir(cfg)
 
     tile = grid.tile(x=x, y=y)
@@ -184,13 +184,35 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
     summary = dict(bootstrapped=0, updated=0, obs_applied=0,
                    pixels_need_batch=0)
 
+    # Chips whose fetch failed THIS run: a just-quarantined chip must not
+    # be drained by the success path below (set add/membership is
+    # GIL-atomic; the fetch pool writes, the serial loops read).
+    failed_cids: set = set()
+
     def fetch_chip(cid, rng_iso):
-        chip = source.chip(cid[0], cid[1], rng_iso)
+        try:
+            chip = dcore._with_retries(
+                cfg, log, f"chip ({cid[0]},{cid[1]}) fetch",
+                lambda: source.chip(cid[0], cid[1], rng_iso),
+                policy=policy)
+        except Exception as e:
+            # Per-chip isolation, batch-driver semantics: dead-letter the
+            # chip and keep streaming the rest of the tile.
+            log.error("chip (%s,%s) failed after retries (%s: %s); "
+                      "quarantined", cid[0], cid[1], type(e).__name__, e)
+            quarantine.record(cid, e, attempts=cfg.fetch_retries + 1,
+                              stage="stream")
+            failed_cids.add(tuple(int(v) for v in cid))
+            return None
         if chip.sensor != LANDSAT_ARD:
             raise ValueError(
                 "stream publishes the reference's Landsat segment "
                 f"schema; got sensor {chip.sensor.name!r}")
-        return chip if chip.dates.shape[0] else None
+        if not chip.dates.shape[0]:
+            log.warning("chip (%s,%s): no acquisitions in %s; skipping",
+                        cid[0], cid[1], rng_iso)
+            return None
+        return chip
 
     def fetch_packed(cid, rng_iso):
         chip = fetch_chip(cid, rng_iso)
@@ -212,7 +234,7 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
     counters = obs_metrics.Counters()
     _, ops_srv, wd = dcore.start_ops(
         cfg, run_id, "stream", chips_total=len(cids), counters=counters,
-        run_block=run_block)
+        run_block=run_block, quarantine=quarantine, breaker=breaker)
     tracer = tracing.start(run_id=run_id) \
         if tracing.wants_trace(cfg.trace) else None
     counters.start()   # rate clock from first productive work, not setup
@@ -243,12 +265,9 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                                           bids))
                 obs_metrics.histogram(
                     "pipeline_fetch_seconds").observe(tm.elapsed)
+                # fetch_chip already logged/quarantined each dropped chip.
                 keep = [(cid, ch) for cid, ch in zip(bids, fetched)
                         if ch is not None]
-                for cid, ch in zip(bids, fetched):
-                    if ch is None:
-                        log.warning("chip (%s,%s): no acquisitions in %s; "
-                                    "skipping", cid[0], cid[1], acquired)
                 if not keep:
                     return None
                 with tracing.span("pack", chips=len(keep)), \
@@ -298,6 +317,7 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                         summary["bootstrapped"] += 1
                         counters.add("chips")
                         save_state(_state_path(sdir, cid), st, side)
+                        quarantine.discard(cid)
                         summary["pixels_need_batch"] += int(
                             np.asarray(st.needs_batch).sum())
                 obs_metrics.histogram(
@@ -346,6 +366,8 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
             summary["pixels_need_batch"] += int(
                 np.asarray(st.needs_batch).sum())
             counters.add("chips")
+            if tuple(int(v) for v in cid) not in failed_cids:
+                quarantine.discard(cid)
             # Per-chip progress beat: updates are host-cheap, so the
             # watchdog's liveness unit here is a processed chip.
             obs_server.batch_done(1)
@@ -356,6 +378,11 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
         writer.close()
         if warm is not None:       # collect warm-compile counters if done
             warm.join(timeout=5.0)
+        summary["quarantined"] = len(quarantine)
+        if summary["quarantined"]:
+            log.warning("%d chips in quarantine (%s) — the next stream "
+                        "run retries them", summary["quarantined"],
+                        quarantine.path or "in-memory")
         for k, v in summary.items():
             obs_metrics.gauge(f"stream_{k}").set(v)
         if tracer is not None:

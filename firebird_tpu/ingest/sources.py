@@ -271,8 +271,12 @@ def decode_raster(rec: dict, dtype=np.int16, side: int = CHIP_SIDE) -> np.ndarra
     return a.reshape(side, side)
 
 
-def _default_http_get(url: str) -> list | dict:
-    with urllib.request.urlopen(url, timeout=60) as r:
+DEFAULT_HTTP_TIMEOUT = 60.0
+
+
+def _default_http_get(url: str, timeout: float = DEFAULT_HTTP_TIMEOUT) \
+        -> list | dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
         return json.loads(r.read().decode())
 
 
@@ -290,6 +294,10 @@ class ChipmunkSource:
     band_parallelism (Config.band_parallelism; 1 restores the strict
     INPUT_PARTITIONS ceiling).
 
+    ``timeout`` bounds each HTTP request of the default client
+    (``FIREBIRD_HTTP_TIMEOUT`` via Config.http_timeout — previously a
+    hardcoded 60 s).
+
     ``registry='auto'`` (default) fetches ``/registry`` once, lazily, and
     derives the ubid maps, wire dtypes, and chip side from it (merlin's
     registry_fn role, SURVEY.md §2.2); on failure it falls back to the
@@ -299,11 +307,17 @@ class ChipmunkSource:
     """
 
     def __init__(self, url: str, http_get=None, band_parallelism: int = 8,
-                 registry="auto"):
+                 registry="auto", timeout: float = DEFAULT_HTTP_TIMEOUT):
         import threading
 
+        if timeout <= 0:
+            raise ValueError(f"http timeout must be > 0 s, got {timeout}")
         self.url = url.rstrip("/")
-        self.http_get = http_get or _default_http_get
+        self.timeout = float(timeout)
+        # The timeout binds only when the default urllib client is in
+        # play; an injected http_get owns its own transport policy.
+        self.http_get = http_get or (
+            lambda u: _default_http_get(u, timeout=self.timeout))
         self.band_parallelism = max(int(band_parallelism), 1)
         self._registry = registry
         self._resolved = None

@@ -8,10 +8,12 @@ module embeds a stdlib ``http.server`` on a daemon thread — off by
 default, enabled with ``FIREBIRD_OPS_PORT`` / ``--ops-port`` — serving:
 
 ``/healthz``
-    Liveness.  200 while the run progresses; 503 once the stall watchdog
-    (obs/watchdog.py) sees no batch complete within its deadline.  The
-    handler evaluates the deadline live, so no background thread is
-    needed when something scrapes.
+    Liveness.  200 ``ok`` while the run progresses; 200 ``degraded``
+    when it is alive but routing around failures (chips in quarantine,
+    ingest breaker not closed — docs/ROBUSTNESS.md); 503 once the stall
+    watchdog (obs/watchdog.py) sees no batch complete within its
+    deadline.  The handler evaluates the deadline live, so no background
+    thread is needed when something scrapes.
 ``/readyz``
     Readiness: the device mesh is up AND the first batch has been
     dispatched — i.e. compile + bring-up are behind us and the run is in
@@ -54,12 +56,18 @@ class RunStatus:
 
     def __init__(self, run_id: str, kind: str, *, chips_total: int = 0,
                  counters=None, watchdog=None, run: dict | None = None,
-                 mesh_up: bool = True, pipeline_depth: int = 2):
+                 mesh_up: bool = True, pipeline_depth: int = 2,
+                 quarantine=None, breaker=None):
         self.run_id = run_id
         self.kind = kind
         self.chips_total = int(chips_total)
         self.counters = counters
         self.watchdog = watchdog
+        # Degradation sources: the dead-letter quarantine
+        # (driver.quarantine.Quarantine) and the ingest circuit breaker
+        # (retry.CircuitBreaker) — both optional, both only *read* here.
+        self.quarantine = quarantine
+        self.breaker = breaker
         self.run = dict(run or {})
         self.pipeline_depth = max(int(pipeline_depth), 1)
         self._lock = threading.Lock()
@@ -110,6 +118,32 @@ class RunStatus:
     def healthy(self) -> bool:
         return self.watchdog is None or not self.watchdog.check()
 
+    def degraded(self) -> bool:
+        """Alive but bleeding: chips in quarantine, or the ingest breaker
+        not closed.  ``/healthz`` stays 200 (a supervisor must NOT
+        restart a run that is making progress around failures) but the
+        body says 'degraded' and ``/progress`` carries the detail."""
+        if self.quarantine is not None and len(self.quarantine) > 0:
+            return True
+        if self.breaker is not None and self.breaker.state != 0:
+            return True
+        return False
+
+    def degraded_block(self) -> dict:
+        """The /progress 'degraded' sub-document (docs/ROBUSTNESS.md)."""
+        from firebird_tpu.obs import metrics as obs_metrics
+
+        return {
+            "active": self.degraded(),
+            "chips_quarantined": (len(self.quarantine)
+                                  if self.quarantine is not None else 0),
+            "breaker": (self.breaker.snapshot()
+                        if self.breaker is not None else None),
+            "faults_injected": obs_metrics.counter("faults_injected").value,
+            "retries": obs_metrics.counter("fetch_retries").value
+            + obs_metrics.counter("store_write_retries").value,
+        }
+
     def ready(self) -> bool:
         with self._lock:
             return self._mesh_up and self._first_batch
@@ -141,6 +175,7 @@ class RunStatus:
                 "occupancy": round(inflight / self.pipeline_depth, 3),
             },
             "counters": counters,
+            "degraded": self.degraded_block(),
             "watchdog": (self.watchdog.snapshot()
                          if self.watchdog is not None else None),
         }
@@ -233,10 +268,15 @@ class _OpsHandler(http.server.BaseHTTPRequestHandler):
         st = self.server.status if self.server.status is not None \
             else current()
         if path == "/healthz":
-            if st is None or st.healthy():
-                self._send(200, b"ok\n", "text/plain")
-            else:
+            if st is not None and not st.healthy():
                 self._send(503, b"stalled\n", "text/plain")
+            elif st is not None and st.degraded():
+                # Degraded is a 200: the run is alive and routing around
+                # failures (quarantined chips, open breaker) — restarting
+                # it would lose the progress it is still making.
+                self._send(200, b"degraded\n", "text/plain")
+            else:
+                self._send(200, b"ok\n", "text/plain")
         elif path == "/readyz":
             if st is not None and st.ready():
                 self._send(200, b"ready\n", "text/plain")

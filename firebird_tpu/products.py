@@ -256,12 +256,22 @@ def save(bounds, products, product_dates, acquired: str | None = None,
             log.info("detecting %d chips with no stored segments", len(missing))
             writer = AsyncWriter(store)
             try:
-                core.detect_chunk(missing, source=source or
-                                  core.make_source(cfg), writer=writer,
-                                  acquired=acquired, cfg=cfg,
-                                  counters=Counters(), log=log)
+                processed = core.detect_chunk(
+                    missing, source=source or core.make_source(cfg),
+                    writer=writer, acquired=acquired, cfg=cfg,
+                    counters=Counters(), log=log)
             finally:
                 writer.close()
+            # detect_chunk isolates failures per chip (returning only the
+            # survivors); a product raster computed over silently missing
+            # segments would be wrong without looking wrong, so here —
+            # with no quarantine/resume loop to drain into — absence must
+            # stay loud, the pre-quarantine behavior.
+            lost = [c for c in missing if c not in set(processed)]
+            if lost:
+                raise RuntimeError(
+                    f"products: {len(lost)} chips failed detection "
+                    f"(first: {lost[0]}); rerun once ingest recovers")
 
     # The cover product maps stored rfrawp votes through the trained
     # model's class order; models are persisted per tile (tile table), so

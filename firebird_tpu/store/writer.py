@@ -40,8 +40,15 @@ def _frame_rows(frame: dict) -> int:
 
 
 class AsyncWriter:
-    def __init__(self, store, max_queue: int = 16, workers: int = 1):
+    """``retry`` is an optional :class:`firebird_tpu.retry.RetryPolicy`
+    applied around each backend ``store.write`` — a store brownout of a
+    few ops heals inline (counted as ``store_write_retries``) instead of
+    poisoning the writer and failing the whole chunk's flush."""
+
+    def __init__(self, store, max_queue: int = 16, workers: int = 1,
+                 retry=None):
         self.store = store
+        self.retry = retry
         n = max(int(workers), 1)
         self._qs = [queue.Queue(maxsize=max_queue) for _ in range(n)]
         self._error: Exception | None = None
@@ -63,7 +70,12 @@ class AsyncWriter:
                 if self._error is None:
                     with tracing.span("store_write", table=table), \
                             obs_metrics.timer() as tm:
-                        self.store.write(table, frame)
+                        if self.retry is not None:
+                            self.retry.run(
+                                log, f"store write to {table}",
+                                lambda: self.store.write(table, frame))
+                        else:
+                            self.store.write(table, frame)
                     obs_metrics.histogram(
                         "store_write_seconds").observe(tm.elapsed)
                     obs_metrics.counter(

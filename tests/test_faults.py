@@ -1,0 +1,521 @@
+"""Fault injection, retry policy, circuit breaker, quarantine, and the
+run manifest (firebird_tpu/faults.py, retry.py, driver/quarantine.py) —
+plus the end-to-end per-chip isolation contract: one poisoned chip costs
+one chip, never its chunk, and --resume drains the quarantine."""
+
+import json
+import os
+
+import pytest
+
+from firebird_tpu import faults as faultlib
+from firebird_tpu import retry as retrylib
+from firebird_tpu.config import Config
+from firebird_tpu.driver import core
+from firebird_tpu.driver import quarantine as qlib
+from firebird_tpu.ingest import SyntheticSource
+from firebird_tpu.obs import metrics as obs_metrics
+from firebird_tpu.store import MemoryStore
+
+ACQ = "1995-01-01/1997-06-01"    # matches test_driver: shared jit cache
+CFG = Config(store_backend="memory", source_backend="synthetic",
+             chips_per_batch=1, dtype="float64", device_sharding="off",
+             fetch_retries=0)
+
+
+def good_source():
+    return SyntheticSource(seed=9, start="1995-01-01", end="1998-01-01",
+                           cloud_frac=0.1)
+
+
+# ---------------------------------------------------------------------------
+# Fault plan parsing
+# ---------------------------------------------------------------------------
+
+def test_plan_parse_and_empty():
+    assert faultlib.FaultPlan.parse("") is None
+    assert faultlib.FaultPlan.parse(None) is None
+    assert faultlib.FaultPlan.parse("  ; ") is None
+    plan = faultlib.FaultPlan.parse(
+        "ingest:p=0.05,timeout,seed=7;store:after=40,brownout=3")
+    assert plan.injector("ingest").spec.p == 0.05
+    assert plan.injector("ingest").spec.kind == "timeout"
+    assert plan.injector("store").spec.after == 40
+    assert plan.injector("store").spec.brownout == 3
+    assert plan.injector("writer") is None
+
+
+@pytest.mark.parametrize("bad", [
+    "nonsense",                      # no colon
+    "bogus:p=0.5",                   # unknown target
+    "ingest:p=2.0",                  # p out of range
+    "ingest:wat=1",                  # unknown key
+    "ingest:p=abc",                  # unparseable value
+    "ingest:frobnicate",             # unknown flag
+    "ingest:seed=7",                 # scope that injects nothing
+    "ingest:p=0.5;ingest:p=0.1",     # duplicate scope
+    "store:chip=1:2",                # chip= is meaningless off ingest/aux
+])
+def test_plan_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        faultlib.FaultPlan.parse(bad)
+
+
+def test_config_validates_fault_plan_and_knobs():
+    with pytest.raises(ValueError):
+        Config(faults="ingest:p=2.0")
+    with pytest.raises(ValueError):
+        Config(http_timeout=0)
+    with pytest.raises(ValueError):
+        Config(retry_budget=-1)
+    with pytest.raises(ValueError):
+        Config(breaker_threshold=2, breaker_cooldown_sec=0)
+    env = {"FIREBIRD_FAULTS": "ingest:p=0.5", "FIREBIRD_HTTP_TIMEOUT": "5",
+           "FIREBIRD_RETRY_BUDGET": "9", "FIREBIRD_BREAKER_THRESHOLD": "2",
+           "FIREBIRD_BREAKER_COOLDOWN": "1.5"}
+    cfg = Config.from_env(env=env)
+    assert (cfg.faults, cfg.http_timeout, cfg.retry_budget,
+            cfg.breaker_threshold, cfg.breaker_cooldown_sec) == \
+        ("ingest:p=0.5", 5.0, 9, 2, 1.5)
+
+
+# ---------------------------------------------------------------------------
+# Injector schedules
+# ---------------------------------------------------------------------------
+
+def _decisions(inj, n, chip=None):
+    out = []
+    for _ in range(n):
+        try:
+            inj.fire(chip=chip)
+            out.append(False)
+        except Exception:
+            out.append(True)
+    return out
+
+
+def test_injector_probability_and_determinism():
+    mk = lambda: faultlib.FaultInjector(
+        faultlib.FaultSpec("ingest", p=0.3, seed=42))
+    a, b = _decisions(mk(), 200), _decisions(mk(), 200)
+    assert a == b                         # seeded: replays identically
+    assert 20 < sum(a) < 120              # roughly p=0.3
+    always = faultlib.FaultInjector(faultlib.FaultSpec("ingest", p=1.0))
+    assert _decisions(always, 5) == [True] * 5
+
+
+def test_injector_brownout_window_is_one_shot():
+    inj = faultlib.FaultInjector(
+        faultlib.FaultSpec("store", after=3, brownout=2))
+    # ops 1-3 fine, 4-5 fail, 6+ healed forever
+    assert _decisions(inj, 8) == [False, False, False, True, True,
+                                  False, False, False]
+
+
+def test_injector_chip_poison_and_error_kinds():
+    inj = faultlib.FaultInjector(
+        faultlib.FaultSpec("ingest", chips=frozenset({(5, 7)}),
+                           kind="timeout"))
+    with pytest.raises(TimeoutError):
+        inj.fire(chip=(5, 7))
+    inj.fire(chip=(5, 8))                 # other chips pass
+    conn = faultlib.FaultInjector(
+        faultlib.FaultSpec("ingest", p=1.0, kind="conn"))
+    with pytest.raises(ConnectionError):
+        conn.fire()
+    io = faultlib.FaultInjector(faultlib.FaultSpec("ingest", p=1.0))
+    with pytest.raises(OSError):
+        io.fire()
+
+
+def test_injection_counters():
+    obs_metrics.reset_registry()
+    inj = faultlib.FaultInjector(faultlib.FaultSpec("store", p=1.0))
+    for _ in range(3):
+        with pytest.raises(OSError):
+            inj.fire()
+    assert obs_metrics.counter("faults_injected").value == 3
+    assert obs_metrics.counter("faults_injected_store").value == 3
+
+
+def test_wrap_identity_off_the_hot_path():
+    """The acceptance bar: with no plan (or no matching scope) the
+    wrappers return the SAME object — zero proxies on the hot path."""
+    src, store, writer = object(), object(), object()
+    assert faultlib.wrap_source(src, None) is src
+    assert faultlib.wrap_store(store, None) is store
+    assert faultlib.wrap_writer(writer, None) is writer
+    plan = faultlib.FaultPlan.parse("store:after=1")
+    assert faultlib.wrap_source(src, plan) is src
+    assert faultlib.wrap_writer(writer, plan) is writer
+    assert isinstance(faultlib.wrap_store(store, plan),
+                      faultlib.FaultyStore)
+
+
+def test_aux_only_plan_still_wraps_the_source():
+    """Regression: a plan with ONLY an aux scope must still proxy the
+    source — otherwise the chaos drill the operator asked for silently
+    tests nothing."""
+    plan = faultlib.FaultPlan.parse("aux:p=1.0")
+    src = faultlib.wrap_source(good_source(), plan)
+    assert isinstance(src, faultlib.FaultySource)
+    assert src.chip(100, 200, ACQ).cx == 100   # chip path uninjected
+    with pytest.raises(OSError):
+        src.aux(100, 200)
+
+
+def test_faulty_source_proxies_and_passes_through():
+    plan = faultlib.FaultPlan.parse("ingest:chip=100:200")
+    src = faultlib.wrap_source(good_source(), plan)
+    assert src.seed == 9                  # __getattr__ passthrough
+    with pytest.raises(OSError):
+        src.chip(100, 200, ACQ)
+    chip = src.chip(3100, 200, ACQ)       # unpoisoned chips flow through
+    assert chip.cx == 3100
+
+
+# ---------------------------------------------------------------------------
+# Retry policy
+# ---------------------------------------------------------------------------
+
+class _Log:
+    def __init__(self):
+        self.lines = []
+
+    def warning(self, fmt, *a):
+        self.lines.append(fmt % a)
+
+    error = warning
+    info = warning
+
+
+def test_retry_policy_jitter_bounds_and_injected_sleep():
+    obs_metrics.reset_registry()
+    delays = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise IOError("blip")
+        return "ok"
+
+    pol = retrylib.RetryPolicy(5, base=1.0, cap=30.0,
+                               sleep=delays.append)
+    assert pol.run(_Log(), "op", flaky) == "ok"
+    assert len(delays) == 3               # three failures, three sleeps
+    # decorrelated jitter: bounded by [base, cap], and bounded by 3x the
+    # previous delay
+    prev = 1.0
+    for d in delays:
+        assert 1.0 <= d <= min(30.0, 3 * max(prev, 1.0) + 1e-9)
+        prev = d
+    assert obs_metrics.counter("fetch_retries").value == 3
+    # satellite: the counter carries a help string now
+    assert obs_metrics.counter("fetch_retries").help
+
+
+def test_retry_policy_exhausts_and_raises():
+    pol = retrylib.RetryPolicy(2, sleep=lambda s: None)
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise IOError("down")
+
+    with pytest.raises(IOError):
+        pol.run(_Log(), "op", always)
+    assert calls["n"] == 3                # 1 try + 2 retries
+
+
+def test_retry_budget_is_shared_and_fails_fast():
+    budget = retrylib.RetryBudget(2)
+    pol = retrylib.RetryPolicy(10, budget=budget, sleep=lambda s: None)
+    log = _Log()
+
+    def always():
+        raise IOError("down")
+
+    with pytest.raises(IOError):
+        pol.run(log, "op", always)
+    # 10 retries allowed per-op, but the run budget capped it at 2
+    assert budget.remaining() == 0
+    assert any("budget is exhausted" in ln for ln in log.lines)
+    # a second policy sharing the budget gets no retries at all
+    calls = {"n": 0}
+    pol2 = retrylib.RetryPolicy(10, budget=budget, sleep=lambda s: None)
+
+    def count():
+        calls["n"] += 1
+        raise IOError("down")
+
+    with pytest.raises(IOError):
+        pol2.run(log, "op", count)
+    assert calls["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_opens_half_opens_and_closes():
+    obs_metrics.reset_registry()
+    clk = _Clock()
+    br = retrylib.CircuitBreaker(3, cooldown_sec=10.0, clock=clk)
+    assert br.state_name() == "closed"
+    for _ in range(3):
+        br.record_failure()
+    assert br.state_name() == "open"
+    assert obs_metrics.counter("breaker_open_total").value == 1
+    assert obs_metrics.gauge("breaker_state").value == retrylib.OPEN
+
+    # acquire blocks while open; the injected sleep advances the clock
+    waits = []
+
+    def sleep(s):
+        waits.append(s)
+        clk.t += s
+
+    br.acquire(sleep)                     # returns once cooldown elapsed
+    assert waits and sum(waits) >= 10.0
+    assert br.state_name() == "half_open"
+    # a second caller must NOT get through while the probe is in flight
+    ok, _ = br._try_enter()
+    assert not ok
+    br.record_success()                   # probe wins: circuit closes
+    assert br.state_name() == "closed"
+    assert obs_metrics.gauge("breaker_state").value == retrylib.CLOSED
+
+
+def test_breaker_reopens_on_failed_probe():
+    clk = _Clock()
+    br = retrylib.CircuitBreaker(2, cooldown_sec=5.0, clock=clk)
+    br.record_failure()
+    br.record_failure()
+    clk.t += 6.0
+    ok, _ = br._try_enter()               # the half-open probe
+    assert ok
+    br.record_failure()                   # probe loses: open again
+    assert br.state_name() == "open"
+    ok, _ = br._try_enter()
+    assert not ok                         # fresh cooldown applies
+
+
+def test_breaker_ignores_stragglers():
+    """Only the half-open probe's own outcome may transition a non-closed
+    circuit: a straggler request admitted back when the circuit was still
+    closed must neither close an open breaker on success nor free the
+    probe slot on failure."""
+    import threading
+
+    clk = _Clock()
+    br = retrylib.CircuitBreaker(2, cooldown_sec=5.0, clock=clk)
+    br.record_failure()
+    br.record_failure()
+    assert br.state_name() == "open"
+    br.record_success()                   # straggler success while open
+    assert br.state_name() == "open"      # proves nothing about NOW
+    clk.t += 6.0
+    ok, _ = br._try_enter()
+    assert ok                             # this thread is the probe
+    res = {}
+
+    def straggler():
+        br.record_failure()               # straggler failure mid-probe
+        res["enter"] = br._try_enter()[0]
+
+    t = threading.Thread(target=straggler)
+    t.start()
+    t.join()
+    assert br.state_name() == "half_open"  # probe slot NOT freed
+    assert res["enter"] is False
+    br.record_success()                   # the probe's outcome decides
+    assert br.state_name() == "closed"
+
+
+def test_make_breaker_from_config():
+    assert retrylib.make_breaker(Config(breaker_threshold=0)) is None
+    br = retrylib.make_breaker(Config(breaker_threshold=4,
+                                      breaker_cooldown_sec=7.0))
+    assert (br.threshold, br.cooldown_sec) == (4, 7.0)
+
+
+# ---------------------------------------------------------------------------
+# Quarantine + run manifest
+# ---------------------------------------------------------------------------
+
+def test_quarantine_roundtrip_and_history(tmp_path):
+    path = str(tmp_path / "quarantine.json")
+    q = qlib.Quarantine(path, run_id="run-1")
+    q.record((3, 4), IOError("chipmunk down"), attempts=4)
+    q.record((3, 4), TimeoutError("still down"), attempts=4)
+    q.record((5, 6), IOError("other"), attempts=1, stage="chunk")
+    assert len(q) == 2 and q.chip_ids() == {(3, 4), (5, 6)}
+
+    q2 = qlib.Quarantine.load(path, run_id="run-2")
+    doc = q2.snapshot()["chips"]
+    e = doc["3,4"]
+    assert e["error"] == "TimeoutError"        # latest error class
+    assert len(e["history"]) == 2              # full attempt history
+    assert doc["5,6"]["stage"] == "chunk"
+    assert q2.discard((3, 4)) and not q2.discard((9, 9))
+    assert qlib.Quarantine.load(path).chip_ids() == {(5, 6)}
+    assert q2.discard_many([(5, 6), (7, 7)]) == 1
+    assert len(qlib.Quarantine.load(path)) == 0
+
+
+def test_quarantine_memory_backend_stays_in_memory():
+    assert qlib.quarantine_path(Config(store_backend="memory")) is None
+    q = qlib.Quarantine(None)
+    q.record((1, 2), IOError("x"), attempts=1)
+    assert len(q) == 1                    # ledger works without a file
+
+
+def test_manifest_refuses_mismatched_acquired(tmp_path):
+    cfg = Config(store_backend="sqlite",
+                 store_path=str(tmp_path / "fb.db"))
+    assert qlib.write_manifest(cfg, acquired=ACQ, run_id="r1",
+                               tile={"h": 20, "v": 11})
+    log = _Log()
+    qlib.check_resume(cfg, acquired=ACQ, log=log)       # match: silent ok
+    with pytest.raises(qlib.ResumeMismatch):
+        qlib.check_resume(cfg, acquired="2001-01-01/2002-01-01", log=log)
+    # changed RESULT-affecting config: warn, not refuse
+    cfg2 = Config(store_backend="sqlite",
+                  store_path=str(tmp_path / "fb.db"), max_obs=128)
+    qlib.check_resume(cfg2, acquired=ACQ, log=log)
+    assert any("fingerprint" in ln for ln in log.lines)
+
+
+def test_manifest_missing_warns_and_proceeds(tmp_path):
+    cfg = Config(store_backend="sqlite",
+                 store_path=str(tmp_path / "fb.db"))
+    log = _Log()
+    qlib.check_resume(cfg, acquired=ACQ, log=log)
+    assert any("no run manifest" in ln for ln in log.lines)
+
+
+# ---------------------------------------------------------------------------
+# Degraded ops surface
+# ---------------------------------------------------------------------------
+
+def test_healthz_reports_degraded_not_dead():
+    import urllib.request
+
+    from firebird_tpu.obs import server as obs_server
+
+    q = qlib.Quarantine(None)
+    q.record((1, 2), IOError("poisoned"), attempts=1)
+    br = retrylib.CircuitBreaker(2, cooldown_sec=30.0, clock=lambda: 0.0)
+    status = obs_server.RunStatus("run-x", "changedetection",
+                                  quarantine=q, breaker=br)
+    srv = obs_server.start_ops_server(0, status, host="127.0.0.1")
+    try:
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/healthz", timeout=5)
+        assert (r.status, r.read()) == (200, b"degraded\n")
+        p = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/progress", timeout=5).read())
+        assert p["degraded"]["active"] is True
+        assert p["degraded"]["chips_quarantined"] == 1
+        assert p["degraded"]["breaker"]["state"] == "closed"
+        # drained quarantine + closed breaker -> plain ok again
+        q.discard((1, 2))
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/healthz", timeout=5)
+        assert (r.status, r.read()) == (200, b"ok\n")
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP timeout knob (satellite)
+# ---------------------------------------------------------------------------
+
+def test_chipmunk_timeout_is_configurable():
+    from firebird_tpu.ingest.sources import ChipmunkSource
+
+    cfg = Config(source_backend="chipmunk", http_timeout=5.5)
+    assert core.make_source(cfg).timeout == 5.5
+    assert core.make_aux_source(cfg).timeout == 5.5
+    with pytest.raises(ValueError):
+        ChipmunkSource("http://x", timeout=0)
+
+
+# ---------------------------------------------------------------------------
+# End to end: poisoned chip -> quarantine -> resume drains
+# ---------------------------------------------------------------------------
+
+def test_poisoned_chip_no_longer_fails_its_chunk(tmp_path):
+    """The acceptance criterion: one permanently failing chip in a
+    2-chip chunk leaves chunk_size-1 chips landed, the poisoned chip in
+    quarantine.json, and a resume (after the poison clears) drains the
+    quarantine and completes the tile — row counts equal to a clean
+    run's."""
+    from firebird_tpu import grid
+    from firebird_tpu.store import SqliteStore
+    from firebird_tpu.utils.fn import take
+
+    cids = list(take(2, grid.chips(grid.tile(x=100, y=200))))
+    poisoned = cids[0]
+    cfg = Config(store_backend="sqlite",
+                 store_path=str(tmp_path / "fb.db"),
+                 source_backend="synthetic", chips_per_batch=1,
+                 dtype="float64", device_sharding="off", fetch_retries=0,
+                 faults=f"ingest:chip={poisoned[0]}:{poisoned[1]}")
+    done = core.changedetection(x=100, y=200, acquired=ACQ, number=2,
+                                chunk_size=2, cfg=cfg, source=good_source())
+    # chunk_size-1 chips of the poisoned chunk landed
+    assert list(done) == [cids[1]]
+    store = SqliteStore(cfg.store_path, cfg.keyspace())
+    assert store.count("chip") == 1
+    qpath = qlib.quarantine_path(cfg)
+    doc = json.load(open(qpath))
+    key = f"{poisoned[0]},{poisoned[1]}"
+    assert doc["chips"][key]["error"] == "InjectedFault"
+    assert doc["chips"][key]["history"][0]["attempts"] == 1
+    # the run manifest pinned this run's identity
+    assert json.load(open(qlib.manifest_path(cfg)))["acquired"] == ACQ
+
+    # resume with the poison cleared: quarantine drains, tile completes
+    healed = Config(**{**cfg.__dict__, "faults": ""})
+    out = core.changedetection(x=100, y=200, acquired=ACQ, number=2,
+                               chunk_size=2, cfg=healed,
+                               source=good_source(), resume=True)
+    assert set(out) == set(cids)
+    assert store.count("chip") == 2
+    assert len(qlib.Quarantine.load(qpath)) == 0
+
+    # resume against a different acquired range REFUSES
+    with pytest.raises(qlib.ResumeMismatch):
+        core.changedetection(x=100, y=200,
+                             acquired="2001-01-01/2002-06-01", number=2,
+                             chunk_size=2, cfg=healed,
+                             source=good_source(), resume=True)
+
+
+def test_transient_injected_faults_cost_retries_not_results(monkeypatch):
+    """An ingest fault plan below the retry ceiling is absorbed entirely:
+    all chips land, faults_injected and fetch_retries both moved."""
+    monkeypatch.setattr(core.time, "sleep", lambda s: None)
+    cfg = Config(store_backend="memory", source_backend="synthetic",
+                 chips_per_batch=1, dtype="float64",
+                 device_sharding="off", fetch_retries=3,
+                 faults="ingest:p=0.4,seed=3")
+    store = MemoryStore("faults")
+    done = core.changedetection(x=100, y=200, acquired=ACQ, number=2,
+                                chunk_size=2, cfg=cfg, source=good_source(),
+                                store=store)
+    assert len(done) == 2
+    assert store.count("chip") == 2
+    # the report registry was reset by the run; read the live registry
+    assert obs_metrics.counter("faults_injected").value > 0
+    assert obs_metrics.counter("fetch_retries").value > 0

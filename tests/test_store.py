@@ -178,6 +178,63 @@ def test_async_writer_drains_and_raises(tmp_path):
     w.close()
 
 
+def test_async_writer_worker_survives_base_exception():
+    """The writer.py BaseException branch (previously untested): a
+    backend raising KeyboardInterrupt must not kill the worker thread
+    with un-acked queue items (flush would hang forever) — the item is
+    acked, the error surfaces from flush as a wrapped Exception, and the
+    writer keeps working afterward."""
+    calls = {"n": 0}
+
+    class Interrupted(MemoryStore):
+        def write(self, table, frame):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise KeyboardInterrupt("operator mashed ^C")
+            return super().write(table, frame)
+
+    store = Interrupted()
+    w = AsyncWriter(store)
+    w.write("chip", {"cx": [1], "cy": [0], "dates": [[]]})
+    with pytest.raises(RuntimeError, match="writer interrupted"):
+        w.flush()                       # surfaces, does NOT hang
+    assert all(t.is_alive() for t in w._threads)
+    # the worker is still functional: later writes land normally
+    w.write("chip", {"cx": [2], "cy": [0], "dates": [[]]})
+    w.flush()
+    assert store.count("chip") == 1
+    w.close()
+
+
+def test_async_writer_retry_policy_heals_brownout():
+    """A store brownout shorter than the retry budget heals inline: no
+    error reaches flush, every row lands, and the retries are counted as
+    store_write_retries (the chaos-smoke store path in miniature)."""
+    from firebird_tpu.obs import metrics as obs_metrics
+    from firebird_tpu.retry import RetryPolicy
+
+    obs_metrics.reset_registry()
+    calls = {"n": 0}
+
+    class Brownout(MemoryStore):
+        def write(self, table, frame):
+            calls["n"] += 1
+            if calls["n"] in (2, 3):   # two consecutive failures
+                raise IOError("store browned out")
+            return super().write(table, frame)
+
+    store = Brownout()
+    w = AsyncWriter(store, retry=RetryPolicy(3, sleep=lambda s: None,
+                                             counter_name="store_write_retries"))
+    for i in range(4):
+        w.write("chip", {"cx": [i], "cy": [0], "dates": [[]]}, key=(i,))
+    w.flush()                           # heals: nothing raises
+    w.close()
+    assert store.count("chip") == 4
+    assert obs_metrics.counter("store_write_retries").value == 2
+    assert obs_metrics.counter("store_write_errors").value == 0
+
+
 # ---------------------------------------------------------------------------
 # Cassandra backend (injectable-session seam; no cluster needed)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,180 @@
+"""Chaos soak (``make chaos-smoke``): faults cost retries, never results.
+
+The end-to-end proof behind docs/ROBUSTNESS.md.  Three runs over the same
+synthetic tile:
+
+clean
+    No faults — the reference store.
+chaos
+    The same tile under a seeded fault plan: every ingest op fails with
+    p=0.05, one chip is permanently poisoned, and the store suffers a
+    brownout window.  Asserts the run SURVIVES (no exception), the
+    poisoned chip (and only work actually lost) is dead-lettered to
+    ``quarantine.json``, faults really were injected, and the rest of
+    the tile landed — one poisoned chip costs one chip, not its chunk.
+resume
+    ``--resume`` against the chaos store with the faults cleared (the
+    brownout is over): asserts the quarantine drains to empty and the
+    final store is **row-for-row identical** to the clean run across the
+    chip/pixel/segment tables.
+
+Writes a ``chaos_report.json`` artifact next to the chaos store (folded
+into bench artifacts by bench.py) and exits non-zero on any violation.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, HERE)
+
+ACQ = "1995-01-01/1996-06-01"
+N_CHIPS = 4
+CHUNK = 2
+
+
+def store_rows(store) -> dict:
+    """Canonical row-set per table: sorted tuples of (column, value)
+    pairs, JSON-normalized so two backends/files compare row-for-row."""
+    out = {}
+    for table in ("chip", "pixel", "segment"):
+        frame = store.read(table)
+        cols = sorted(frame)
+        n = len(frame[cols[0]]) if cols else 0
+        rows = sorted(
+            json.dumps([(c, frame[c][i]) for c in cols], sort_keys=True)
+            for i in range(n))
+        out[table] = rows
+    return out
+
+
+def main() -> int:
+    from firebird_tpu import grid
+    from firebird_tpu.config import Config
+    from firebird_tpu.driver import core
+    from firebird_tpu.driver import quarantine as qlib
+    from firebird_tpu.ingest import SyntheticSource
+    from firebird_tpu.store import SqliteStore
+    from firebird_tpu.utils.fn import take
+
+    def cfg_for(subdir: str, tmp: str, faults: str = "") -> Config:
+        return Config(store_backend="sqlite",
+                      store_path=os.path.join(tmp, subdir, "chaos.db"),
+                      source_backend="synthetic", chips_per_batch=1,
+                      device_sharding="off", dtype="float64",
+                      fetch_retries=2, faults=faults)
+
+    def src():
+        return SyntheticSource(seed=9, start="1995-01-01", end="1998-01-01",
+                               cloud_frac=0.1)
+
+    tile = grid.tile(x=100, y=200)
+    cids = list(take(N_CHIPS, grid.chips(tile)))
+    poisoned = tuple(int(v) for v in cids[1])
+    plan = (f"ingest:p=0.05,seed=7,chip={poisoned[0]}:{poisoned[1]};"
+            "store:after=5,brownout=2")
+
+    with tempfile.TemporaryDirectory(prefix="fb_chaos_") as tmp:
+        # ---- clean reference run --------------------------------------
+        clean_cfg = cfg_for("clean", tmp)
+        os.makedirs(os.path.dirname(clean_cfg.store_path), exist_ok=True)
+        done = core.changedetection(x=100, y=200, acquired=ACQ,
+                                    number=N_CHIPS, chunk_size=CHUNK,
+                                    cfg=clean_cfg, source=src())
+        if len(done) != N_CHIPS:
+            print(f"chaos-smoke: clean run processed {len(done)}/{N_CHIPS}",
+                  file=sys.stderr)
+            return 1
+        clean = store_rows(SqliteStore(clean_cfg.store_path,
+                                       clean_cfg.keyspace()))
+
+        # ---- chaos run under the fault plan ---------------------------
+        chaos_cfg = cfg_for("chaos", tmp, faults=plan)
+        os.makedirs(os.path.dirname(chaos_cfg.store_path), exist_ok=True)
+        done = core.changedetection(x=100, y=200, acquired=ACQ,
+                                    number=N_CHIPS, chunk_size=CHUNK,
+                                    cfg=chaos_cfg, source=src())
+        qpath = qlib.quarantine_path(chaos_cfg)
+        with open(qpath) as f:
+            qdoc = json.load(f)
+        held = {(c["cx"], c["cy"]) for c in qdoc["chips"].values()}
+        if poisoned not in held:
+            print(f"chaos-smoke: poisoned chip {poisoned} not in "
+                  f"quarantine ({held})", file=sys.stderr)
+            return 1
+        # A poisoned chip costs ITSELF, not its chunk: everything not
+        # held in quarantine must have landed.
+        expect_done = {tuple(int(v) for v in c) for c in cids} - held
+        if {tuple(int(v) for v in c) for c in done} != expect_done:
+            print(f"chaos-smoke: chaos run done={sorted(done)} != "
+                  f"expected {sorted(expect_done)}", file=sys.stderr)
+            return 1
+        with open(os.path.join(os.path.dirname(chaos_cfg.store_path),
+                               "obs_report.json")) as f:
+            counters = json.load(f)["metrics"]["counters"]
+        if counters.get("faults_injected", 0) <= 0:
+            print(f"chaos-smoke: no faults injected ({counters})",
+                  file=sys.stderr)
+            return 1
+
+        # ---- resume with the faults cleared ---------------------------
+        resume_cfg = cfg_for("chaos", tmp)     # same store, no plan
+        done = core.changedetection(x=100, y=200, acquired=ACQ,
+                                    number=N_CHIPS, chunk_size=CHUNK,
+                                    cfg=resume_cfg, source=src(),
+                                    resume=True)
+        if len(done) != N_CHIPS:
+            print(f"chaos-smoke: resume completed {len(done)}/{N_CHIPS}",
+                  file=sys.stderr)
+            return 1
+        q = qlib.Quarantine.load(qpath)
+        if len(q):
+            print(f"chaos-smoke: quarantine not drained after resume: "
+                  f"{sorted(q.chip_ids())}", file=sys.stderr)
+            return 1
+        chaos = store_rows(SqliteStore(resume_cfg.store_path,
+                                       resume_cfg.keyspace()))
+        for table in ("chip", "pixel", "segment"):
+            if clean[table] != chaos[table]:
+                a, b = len(clean[table]), len(chaos[table])
+                diff = next((i for i, (x, y) in enumerate(
+                    zip(clean[table], chaos[table])) if x != y), None)
+                print(f"chaos-smoke: {table} rows differ (clean {a} vs "
+                      f"chaos {b}, first mismatch at {diff})",
+                      file=sys.stderr)
+                return 1
+
+        report = {
+            "schema": "firebird-chaos-report/1",
+            "plan": plan,
+            "chips": N_CHIPS,
+            "poisoned_chip": list(poisoned),
+            "faults_injected": counters.get("faults_injected", 0),
+            "fetch_retries": counters.get("fetch_retries", 0),
+            "store_write_retries": counters.get("store_write_retries", 0),
+            "chips_quarantined": counters.get("chips_quarantined", 0),
+            "rows": {t: len(clean[t]) for t in clean},
+            "store_identical_after_resume": True,
+            "quarantine_drained": True,
+        }
+        art_dir = os.environ.get("FIREBIRD_CHAOS_DIR", "/tmp/fb_chaos")
+        os.makedirs(art_dir, exist_ok=True)
+        art = os.path.join(art_dir, "chaos_report.json")
+        with open(art, "w") as f:
+            json.dump(report, f, indent=1)
+        print("chaos-smoke OK: "
+              f"{report['faults_injected']} faults injected, "
+              f"{report['fetch_retries']} fetch retries, "
+              f"{report['store_write_retries']} store retries, "
+              f"quarantined {sorted(held)} -> drained, "
+              f"store identical after resume "
+              f"({sum(report['rows'].values())} rows); artifact {art}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
