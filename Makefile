@@ -9,8 +9,9 @@ X ?= 542000
 Y ?= 1650000
 ACQUIRED ?= 1982-01-01/2017-12-31
 
-.PHONY: install test bench obs-smoke pipeline-smoke chaos-smoke image \
-        db-up db-schema db-test db-down changedetection classification clean
+.PHONY: install test bench obs-smoke pipeline-smoke chaos-smoke \
+        serve-smoke image db-up db-schema db-test db-down changedetection \
+        classification clean
 
 install:
 	pip install -e . --no-build-isolation
@@ -43,6 +44,15 @@ pipeline-smoke:
 # the final store is row-for-row identical to a clean run.
 chaos-smoke:
 	python tools/chaos_soak.py
+
+# Serving-layer check (docs/SERVING.md): tiny synthetic run into a
+# sqlite store, then the query API on an ephemeral port — every endpoint
+# exercised with values cross-checked against products.save output, 8
+# concurrent identical cold misses proven to coalesce into ONE
+# computation, cache hits proven, and the closed-loop loadtest artifact
+# (RPS, p50/p95/p99, hit rate) written + folded by bench.py.
+serve-smoke:
+	python tools/serve_smoke.py
 
 image:
 	docker build -f deploy/Dockerfile -t firebird .

@@ -187,6 +187,25 @@ def _chaos_fold() -> dict:
         return {}
 
 
+def _serve_fold() -> dict:
+    """{"serve_loadtest": ...} when a serving-layer loadtest artifact
+    exists on this host (tools/serve_loadtest.py writes
+    serve_loadtest.json under FIREBIRD_SERVE_DIR, default /tmp/fb_serve;
+    `make serve-smoke` produces one) — the read-path round evidence
+    (RPS, p50/p95/p99, cache hit rate), folded like the chaos/pipeline
+    artifacts.  Empty dict when no loadtest ran."""
+    import os
+
+    path = os.path.join(
+        os.environ.get("FIREBIRD_SERVE_DIR", "/tmp/fb_serve"),
+        "serve_loadtest.json")
+    try:
+        with open(path) as f:
+            return {"serve_loadtest": json.load(f)}
+    except (OSError, ValueError):
+        return {}
+
+
 def measure(cpu_only: bool) -> None:
     if cpu_only:
         import jax
@@ -636,6 +655,9 @@ def measure(cpu_only: bool) -> None:
             # Last chaos-smoke evidence (faults absorbed, store equality
             # after resume) when a run left its artifact on this host.
             **_chaos_fold(),
+            # Last serve-loadtest evidence (read-path RPS/latency/hit
+            # rate) when the serving layer was exercised on this host.
+            **_serve_fold(),
             "streaming_pixels_per_sec": round(stream_rate, 1),
             **s2_detail,
             **hard_detail,

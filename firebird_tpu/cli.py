@@ -301,17 +301,74 @@ def validate(x, y, acquired, n_pixels, dtype, seed):
 
 
 @entrypoint.command()
+@click.option("--port", "-p", default=None, type=int,
+              help="listen port; overrides FIREBIRD_SERVE_PORT "
+                   "(default 8080); 0 binds an ephemeral port")
+@click.option("--cache-entries", default=None, type=int,
+              help="in-memory cache bound (entries); overrides "
+                   "FIREBIRD_SERVE_CACHE_ENTRIES")
+@click.option("--cache-dir", default=None,
+              help="disk spill tier for evicted cache entries; overrides "
+                   "FIREBIRD_SERVE_CACHE_DIR — off when neither is set")
+@click.option("--no-compute", is_flag=True, default=False,
+              help="disable compute-on-miss: absent product rows answer "
+                   "404 instead of running the products.save-path "
+                   "computation (strictly read-only serving)")
+def serve(port, cache_entries, cache_dir, no_compute):
+    """Serve the query API over the configured results store.
+
+    Endpoints: /v1/segments?cx=&cy=, /v1/pixel?x=&y=&date=,
+    /v1/product/<name>?cx=&cy=&date=, /v1/tile/<name>?bounds=&date=,
+    plus /healthz and /metrics.  Cold product requests compute through
+    the products.save path (once per key, coalesced) and persist, so the
+    store warms as it serves.  See docs/SERVING.md."""
+    import signal
+    import threading
+
+    from firebird_tpu.config import Config
+    from firebird_tpu.serve import api as serve_api
+    from firebird_tpu.store import open_store
+
+    overrides = {k: v for k, v in
+                 (("serve_port", port), ("serve_cache_entries", cache_entries),
+                  ("serve_cache_dir", cache_dir)) if v is not None}
+    # --port 0 means "ephemeral bind", which Config rejects as a
+    # deploy-time port; thread it past validation separately.
+    bind_port = overrides.pop("serve_port", None)
+    cfg = Config.from_env(**overrides)
+    if bind_port is None:
+        bind_port = cfg.serve_port
+    store = open_store(cfg.store_backend, cfg.store_path, cfg.keyspace())
+    service = serve_api.ServeService(store, cfg,
+                                     compute_on_miss=not no_compute)
+    srv = serve_api.start_serve_server(bind_port, service)
+    click.echo(f"serving {cfg.store_backend}:{cfg.store_path} "
+               f"[{cfg.keyspace()}] on port {srv.port} (ctrl-c to stop)")
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    try:
+        stop.wait()
+    finally:
+        srv.close()
+        store.close()
+
+
+@entrypoint.command()
 @click.option("--x", "-x", required=False, default=None, type=float,
               help="with -y: also report this tile's chip progress")
 @click.option("--y", "-y", required=False, default=None, type=float)
 def status(x, y):
     """Inspect the configured results store: per-table row counts, chips
-    with stored segments, and (with -x/-y) one tile's completion — the
-    operational view behind `changedetection --resume`."""
+    with stored segments, quarantine state, and (with -x/-y) one tile's
+    completion — the operational view behind `changedetection --resume`."""
+    import collections
     import json as _json
+    import os as _os
 
     from firebird_tpu import grid
     from firebird_tpu.config import Config
+    from firebird_tpu.driver import quarantine as _quarantine
     from firebird_tpu.store import TABLES, open_store
 
     if (x is None) != (y is None):
@@ -326,6 +383,18 @@ def status(x, y):
         "tables": {t: store.count(t) for t in TABLES},
         "chips_with_segments": len(done),
     }
+    # Dead-letter quarantine next to the store (driver/quarantine.py):
+    # chips a run could not land, with their error classes — the part of
+    # "how is my run doing" that table counts cannot show.
+    qpath = _quarantine.quarantine_path(cfg)
+    if qpath is not None and _os.path.exists(qpath):
+        q = _quarantine.Quarantine.load(qpath)
+        errors = collections.Counter(
+            e.get("error", "unknown") for e in q.snapshot()["chips"].values())
+        out["quarantine"] = {"path": qpath, "chips": len(q),
+                             "errors": dict(sorted(errors.items()))}
+    else:
+        out["quarantine"] = {"path": qpath, "chips": 0, "errors": {}}
     if x is not None:
         tile = grid.tile(x, y)
         cids = [tuple(int(v) for v in c) for c in grid.chips(tile)]

@@ -153,6 +153,27 @@ class Config:
     # (driver.core.warm_start).
     compile_cache: str = ""
 
+    # ---- serving layer (firebird_tpu.serve; docs/SERVING.md) ----
+    # `firebird serve` port (FIREBIRD_SERVE_PORT).  Unlike ops_port this
+    # is only read by the serve command — nothing auto-binds it.
+    serve_port: int = 8080
+
+    # In-memory serve cache bound, entries (one decoded chip frame or
+    # product raster each; FIREBIRD_SERVE_CACHE_ENTRIES).
+    serve_cache_entries: int = 256
+
+    # Disk spill tier directory (FIREBIRD_SERVE_CACHE_DIR); "" disables
+    # the second tier.
+    serve_cache_dir: str = ""
+
+    # Admission control: concurrent /v1 requests executing, waiting-line
+    # bound past which requests shed with 429, and the per-request
+    # deadline (504) in seconds (FIREBIRD_SERVE_INFLIGHT /
+    # FIREBIRD_SERVE_QUEUE / FIREBIRD_SERVE_DEADLINE).
+    serve_inflight: int = 16
+    serve_queue: int = 64
+    serve_deadline_sec: float = 30.0
+
     # Framework version (reference: version.txt read in keyspace()).
     version: str = _VERSION
 
@@ -195,6 +216,21 @@ class Config:
         if self.pipeline_depth < 1:
             raise ValueError("FIREBIRD_PIPELINE_DEPTH must be >= 1, got "
                              f"{self.pipeline_depth}")
+        if not 0 < self.serve_port <= 65535:
+            raise ValueError("FIREBIRD_SERVE_PORT must be a valid TCP "
+                             f"port, got {self.serve_port}")
+        if self.serve_cache_entries < 1:
+            raise ValueError("FIREBIRD_SERVE_CACHE_ENTRIES must be >= 1, "
+                             f"got {self.serve_cache_entries}")
+        if self.serve_inflight < 1:
+            raise ValueError("FIREBIRD_SERVE_INFLIGHT must be >= 1, got "
+                             f"{self.serve_inflight}")
+        if self.serve_queue < 0:
+            raise ValueError("FIREBIRD_SERVE_QUEUE must be >= 0, got "
+                             f"{self.serve_queue}")
+        if self.serve_deadline_sec <= 0:
+            raise ValueError("FIREBIRD_SERVE_DEADLINE must be > 0 seconds, "
+                             f"got {self.serve_deadline_sec}")
 
     @classmethod
     def from_env(cls, env: dict | None = None, **overrides) -> "Config":
@@ -243,6 +279,16 @@ class Config:
             pipeline_depth=int(e.get("FIREBIRD_PIPELINE_DEPTH",
                                      cls.pipeline_depth)),
             compile_cache=e.get("FIREBIRD_COMPILE_CACHE", cls.compile_cache),
+            serve_port=int(e.get("FIREBIRD_SERVE_PORT", cls.serve_port)),
+            serve_cache_entries=int(e.get("FIREBIRD_SERVE_CACHE_ENTRIES",
+                                          cls.serve_cache_entries)),
+            serve_cache_dir=e.get("FIREBIRD_SERVE_CACHE_DIR",
+                                  cls.serve_cache_dir),
+            serve_inflight=int(e.get("FIREBIRD_SERVE_INFLIGHT",
+                                     cls.serve_inflight)),
+            serve_queue=int(e.get("FIREBIRD_SERVE_QUEUE", cls.serve_queue)),
+            serve_deadline_sec=float(e.get("FIREBIRD_SERVE_DEADLINE",
+                                           cls.serve_deadline_sec)),
         )
         kw.update(overrides)
         return cls(**kw)

@@ -36,7 +36,8 @@ FORMATS = ("envi", "npy")
 
 
 def mosaic(name: str, date: str, bounds, store,
-           sensor: Sensor = LANDSAT_ARD) -> tuple[np.ndarray, float, float]:
+           sensor: Sensor = LANDSAT_ARD,
+           read_chip=None) -> tuple[np.ndarray, float, float]:
     """Assemble the stored product chips covering ``bounds`` into one
     raster.
 
@@ -45,6 +46,12 @@ def mosaic(name: str, date: str, bounds, store,
     with it fail loudly rather than mis-georeference.  The chip *ids*
     themselves still come from the CONUS Albers grid
     (products.covering_chips) — the only tiling the store keys on.
+
+    ``read_chip(name, date, cx, cy) -> flat cells | None`` overrides the
+    per-chip product lookup — the serving layer injects its cache-aware
+    (and compute-on-miss) reader here so ``/v1/tile`` mosaics reuse
+    every chip raster the point endpoints already built.  Default: read
+    the stored product row.
 
     Returns ``(cells [H, W] int32, ulx, uly)`` — ulx/uly is the projection
     coordinate of the raster's upper-left corner (the UL chip's UL pixel
@@ -56,6 +63,11 @@ def mosaic(name: str, date: str, bounds, store,
             f"sensor {sensor.name!r} chip extent {side * psz} m disagrees "
             f"with the chip grid spacing {grid.CONUS.chip.sx} m — the "
             "mosaic would overlap or gap chips")
+    if read_chip is None:
+        def read_chip(name, date, cx, cy):
+            rows = store.read("product", {"name": name, "date": date,
+                                          "cx": cx, "cy": cy})
+            return rows["cells"][0] if rows["cells"] else None
     cids = products.covering_chips(bounds)
     ulx = min(cx for cx, _ in cids)
     uly = max(cy for _, cy in cids)
@@ -65,12 +77,11 @@ def mosaic(name: str, date: str, bounds, store,
     out = np.full((H, W), FILL_VALUE, np.int32)
     missing = 0
     for cx, cy in cids:
-        rows = store.read("product", {"name": name, "date": date,
-                                      "cx": cx, "cy": cy})
-        if not rows["cells"]:
+        cells_flat = read_chip(name, date, cx, cy)
+        if cells_flat is None:
             missing += 1
             continue
-        flat = np.asarray(rows["cells"][0], np.int32)
+        flat = np.asarray(cells_flat, np.int32)
         if flat.size != sensor.pixels:
             raise ValueError(
                 f"product row ({name}@{date}, chip {cx},{cy}) has "

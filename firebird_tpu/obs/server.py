@@ -39,11 +39,10 @@ the same discipline as obs/tracing.py.
 
 from __future__ import annotations
 
-import http.server
-import json
 import os
 import threading
-from urllib.parse import urlsplit
+
+from firebird_tpu.obs import httpd
 
 
 class RunStatus:
@@ -229,40 +228,10 @@ def mark_mesh_up() -> None:
         st.mark_mesh_up()
 
 
-class _OpsHandler(http.server.BaseHTTPRequestHandler):
+class _OpsHandler(httpd.JsonHandler):
     server_version = "firebird-ops/1"
-    protocol_version = "HTTP/1.1"
 
-    # Route access lines to the obs logger at DEBUG, not stderr spam.
-    def log_message(self, fmt, *args):
-        from firebird_tpu.obs import logger
-        logger("change-detection").debug("ops %s", fmt % args)
-
-    def _send(self, code: int, body: bytes, ctype: str) -> None:
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _send_json(self, code: int, obj) -> None:
-        self._send(code, json.dumps(obj, default=str).encode(),
-                   "application/json")
-
-    def do_GET(self):  # noqa: N802 (stdlib handler naming)
-        path = urlsplit(self.path).path
-        try:
-            self._route(path)
-        except BrokenPipeError:
-            pass                       # client went away mid-response
-        except Exception as e:         # a broken endpoint must report, not
-            # kill the ops thread — the surface exists to diagnose trouble
-            try:
-                self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
-            except Exception:
-                pass
-
-    def _route(self, path: str) -> None:
+    def _route(self, path: str, query: dict) -> None:
         from firebird_tpu.obs import metrics as obs_metrics
 
         st = self.server.status if self.server.status is not None \
@@ -305,35 +274,14 @@ class _OpsHandler(http.server.BaseHTTPRequestHandler):
                                             "/progress", "/report"]})
 
 
-class OpsServer(http.server.ThreadingHTTPServer):
-    """Threading HTTP server on a daemon thread; ``port`` is the bound
-    port (useful when constructed with port 0 for an ephemeral bind)."""
+class OpsServer(httpd.Httpd):
+    """The ops endpoint server (shared lifecycle: obs/httpd.py)."""
 
-    daemon_threads = True
-    allow_reuse_address = True
+    thread_name = "firebird-ops"
 
     def __init__(self, addr, status: RunStatus | None = None):
         super().__init__(addr, _OpsHandler)
         self.status = status
-        self._thread: threading.Thread | None = None
-
-    @property
-    def port(self) -> int:
-        return self.server_address[1]
-
-    def start(self) -> "OpsServer":
-        self._thread = threading.Thread(
-            target=self.serve_forever, kwargs={"poll_interval": 0.25},
-            name="firebird-ops", daemon=True)
-        self._thread.start()
-        return self
-
-    def close(self) -> None:
-        self.shutdown()
-        self.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
 
 
 def start_ops_server(port: int, status: RunStatus | None = None,

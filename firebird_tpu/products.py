@@ -222,6 +222,54 @@ def clip_mask(cx: int, cy: int, bounds: list[tuple[float, float]]) -> np.ndarray
 # The save run
 # ---------------------------------------------------------------------------
 
+def tile_classes(store, cx: int, cy: int,
+                 cache: dict | None = None) -> np.ndarray | None:
+    """The trained model's class order for the tile containing chip
+    (cx, cy), or None when no model is stored — the ``cover`` product's
+    vote-argmax -> label mapping.  ``cache`` (a caller-held dict) keeps
+    one store lookup per tile across a chip loop; models are persisted
+    per tile (tile table), so chips of one tile share the entry."""
+    t = grid.tile(cx, cy)
+    key = (int(t["x"]), int(t["y"]))
+    if cache is None:
+        cache = {}
+    if key not in cache:
+        from firebird_tpu.rf import pipeline as rf_pipeline
+
+        m = rf_pipeline.load_model(store, key[0], key[1])
+        cache[key] = None if m is None else m.classes
+        if m is None:
+            log.warning("cover: no trained model stored for tile "
+                        "(%d, %d); run `firebird classification` first",
+                        *key)
+    return cache[key]
+
+
+def save_chip_raster(store, name: str, date: str, date_ord: int,
+                     cx: int, cy: int, seg: "dict | ChipSegmentArrays",
+                     classes: np.ndarray | None = None,
+                     keep: np.ndarray | None = None) -> np.ndarray:
+    """Compute ONE (product, date, chip) raster and persist it to the
+    keyed product table — the unit of work of the ``save`` run, shared
+    verbatim by the serving layer's compute-on-miss path
+    (serve/api.py), so a raster served cold is byte-identical to one a
+    batch ``firebird save`` would have produced.  Returns the flat
+    [10000] int32 cells as written (clip mask applied)."""
+    vals = chip_product(name, date_ord, cx, cy, seg, classes=classes)
+    if keep is not None:
+        vals = np.where(keep, vals, FILL_VALUE).astype(np.int32)
+    cells = np.empty(1, object)
+    cells[0] = vals.tolist()
+    store.write("product", {
+        "name": np.array([name], object),
+        "date": np.array([date], object),
+        "cx": np.array([cx], np.int64),
+        "cy": np.array([cy], np.int64),
+        "cells": cells,
+    })
+    return vals
+
+
 def save(bounds, products, product_dates, acquired: str | None = None,
          clip: bool = False, cfg: Config | None = None, store=None,
          source=None) -> list[tuple[str, str, int, int]]:
@@ -274,23 +322,9 @@ def save(bounds, products, product_dates, acquired: str | None = None,
                     f"(first: {lost[0]}); rerun once ingest recovers")
 
     # The cover product maps stored rfrawp votes through the trained
-    # model's class order; models are persisted per tile (tile table), so
-    # cache one lookup per tile across the chip loop.
+    # model's class order; tile_classes keeps one tile-table lookup per
+    # tile across the chip loop via this shared dict.
     model_classes: dict[tuple[int, int], np.ndarray | None] = {}
-
-    def classes_for(cx: int, cy: int) -> np.ndarray | None:
-        t = grid.tile(cx, cy)
-        key = (int(t["x"]), int(t["y"]))
-        if key not in model_classes:
-            from firebird_tpu.rf import pipeline as rf_pipeline
-
-            m = rf_pipeline.load_model(store, key[0], key[1])
-            model_classes[key] = None if m is None else m.classes
-            if m is None:
-                log.warning("cover: no trained model stored for tile "
-                            "(%d, %d); its chips are skipped — run "
-                            "`firebird classification` first", *key)
-        return model_classes[key]
 
     written = []
     for cx, cy in cids:
@@ -302,23 +336,13 @@ def save(bounds, products, product_dates, acquired: str | None = None,
         keep = clip_mask(cx, cy, bounds) if clip else None
         arrays = ChipSegmentArrays(cx, cy, seg)
         for name in products:
-            classes = classes_for(cx, cy) if name == "cover" else None
+            classes = tile_classes(store, cx, cy, model_classes) \
+                if name == "cover" else None
             if name == "cover" and classes is None:
                 continue
             for d in product_dates:
-                vals = chip_product(name, date_ords[d], cx, cy, arrays,
-                                    classes=classes)
-                if keep is not None:
-                    vals = np.where(keep, vals, FILL_VALUE).astype(np.int32)
-                cells = np.empty(1, object)
-                cells[0] = vals.tolist()
-                store.write("product", {
-                    "name": np.array([name], object),
-                    "date": np.array([d], object),
-                    "cx": np.array([cx], np.int64),
-                    "cy": np.array([cy], np.int64),
-                    "cells": cells,
-                })
+                save_chip_raster(store, name, d, date_ords[d], cx, cy,
+                                 arrays, classes=classes, keep=keep)
                 written.append((name, d, cx, cy))
     log.info("products complete: %d rasters written", len(written))
     return written

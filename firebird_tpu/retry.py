@@ -147,6 +147,17 @@ class CircuitBreaker:
                 return
             sleep(max(wait, 0.01))
 
+    def try_acquire(self) -> tuple[bool, float]:
+        """Non-blocking admission: ``(admitted, suggested_wait_sec)``.
+
+        The serving layer (serve/flight.py) cannot park a request thread
+        on the breaker cooldown the way the batch drivers do — it answers
+        503 + Retry-After instead.  An admitted caller in the half-open
+        state owns the probe slot and MUST report its outcome via
+        ``record_success``/``record_failure``, same contract as
+        ``acquire``."""
+        return self._try_enter()
+
     def _is_probe_locked(self) -> bool:
         return self._probe_thread == threading.get_ident()
 

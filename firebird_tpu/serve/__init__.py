@@ -1,0 +1,33 @@
+"""firebird_tpu.serve — the production query/serving layer.
+
+The write path (ingest -> CCD kernel -> store) ends at the results
+store; this package is the read path over it, designed like an
+inference server:
+
+- :mod:`firebird_tpu.serve.api` — the HTTP query API (``/v1/segments``,
+  ``/v1/pixel``, ``/v1/product/<name>``, ``/v1/tile/<name>``) plus
+  ``/healthz`` and ``/metrics``, over any Store backend.
+- :mod:`firebird_tpu.serve.cache` — the two-tier (memory LRU + disk
+  spill) chip cache with store-write generation invalidation, so a live
+  detection run and the serving layer can share one store.
+- :mod:`firebird_tpu.serve.flight` — single-flight request coalescing,
+  admission control (429/504), and breaker-backed degraded mode
+  (cache-only serving while the store is down).
+
+Entry points: ``firebird serve`` (cli.py), ``make serve-smoke``
+(tools/serve_smoke.py), ``tools/serve_loadtest.py``.  See
+docs/SERVING.md.
+"""
+
+from firebird_tpu.serve.api import (ServeServer, ServeService,
+                                    start_serve_server)
+from firebird_tpu.serve.cache import LRUCache, StoreGenerations, watch_store
+from firebird_tpu.serve.flight import (AdmissionControl, DeadlineExceeded,
+                                       Overload, SingleFlight, StoreDegraded)
+
+__all__ = [
+    "ServeServer", "ServeService", "start_serve_server",
+    "LRUCache", "StoreGenerations", "watch_store",
+    "AdmissionControl", "DeadlineExceeded", "Overload", "SingleFlight",
+    "StoreDegraded",
+]

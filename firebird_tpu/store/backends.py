@@ -189,6 +189,17 @@ class SqliteStore:
             sql = (f'CREATE TABLE IF NOT EXISTS "{t}" '
                    f'({cols}, PRIMARY KEY ({pk}))')
             _retry_locked(lambda: con.execute(sql))
+        # Secondary (cx, cy) index for the serve-path point reads.  The
+        # segment PK's autoindex already leads with (cx, cy), but the
+        # product PK leads with (name, date) — a `WHERE cx=? AND cy=?`
+        # chip read there (serve cache fills, chip_ids) would scan the
+        # whole table.  Explicit on both so the serving layer's access
+        # pattern is index-backed regardless of which table it reads;
+        # tests pin the query plan (tests/test_store.py).
+        for t in ("segment", "product"):
+            sql = (f'CREATE INDEX IF NOT EXISTS "idx_{t}_chip" '
+                   f'ON "{t}" (cx, cy)')
+            _retry_locked(lambda: con.execute(sql))
         con.commit()
 
     def write(self, table: str, frame: dict) -> int:

@@ -1,0 +1,600 @@
+"""Serving layer: two-tier cache + invalidation, single-flight
+coalescing, admission control, degraded mode, the HTTP query API, and
+read-under-write consistency against a live writer (firebird_tpu.serve;
+docs/SERVING.md)."""
+
+import concurrent.futures
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from firebird_tpu import grid, products
+from firebird_tpu.config import Config
+from firebird_tpu.obs import metrics as obs_metrics
+from firebird_tpu.retry import CircuitBreaker
+from firebird_tpu.serve import api as serve_api
+from firebird_tpu.serve import cache as serve_cache
+from firebird_tpu.serve import flight as serve_flight
+from firebird_tpu.store import AsyncWriter, open_store
+
+# The chip containing projection point (100, 200) — any real grid cell
+# works; this one matches the smoke tools.
+CX, CY = (int(v) for v in grid.snap(100, 200)["chip"]["proj-pt"])
+DATE = "1996-01-01"
+
+
+@pytest.fixture
+def fresh_metrics():
+    """Serve counters are asserted absolutely in several tests; give each
+    its own registry (the suite-wide pattern, tests/test_obs.py)."""
+    obs_metrics.reset_registry()
+    yield
+    obs_metrics.reset_registry()
+
+
+def seg_frame(cx=CX, cy=CY, chprob=1.0, n=3):
+    """A tiny segment frame for chip (cx, cy): n pixels, one row each."""
+    return {
+        "cx": [cx] * n, "cy": [cy] * n,
+        "px": [cx + 30 * i for i in range(n)],
+        "py": [cy - 30] * n,
+        "sday": ["1995-01-01"] * n, "eday": ["1999-01-01"] * n,
+        "bday": ["1997-06-01"] * n, "chprob": [chprob] * n,
+        "curqa": [4, 8, 4][:n] + [4] * max(n - 3, 0),
+        "rfrawp": [None] * n,
+    }
+
+
+def make_service(store=None, **kw):
+    store = store or open_store("memory", "", "t")
+    cfg = Config(store_backend="memory")
+    return serve_api.ServeService(store, cfg, **kw), store
+
+
+# ---------------------------------------------------------------------------
+# Cache: LRU, spill, generations
+# ---------------------------------------------------------------------------
+
+def test_lru_eviction_order(fresh_metrics):
+    c = serve_cache.LRUCache(max_entries=2)
+    c.put(("a",), 1)
+    c.put(("b",), 2)
+    assert c.get(("a",)) == 1          # touches a -> b becomes LRU
+    c.put(("c",), 3)                   # evicts b
+    assert c.get(("b",)) is None
+    assert c.get(("a",)) == 1 and c.get(("c",)) == 3
+    assert obs_metrics.counter("serve_cache_evictions").value == 1
+    assert obs_metrics.counter("serve_cache_misses").value == 1
+    assert obs_metrics.counter("serve_cache_hits").value == 3
+    with pytest.raises(ValueError):
+        serve_cache.LRUCache(max_entries=0)
+
+
+def test_disk_spill_round_trip(tmp_path, fresh_metrics):
+    c = serve_cache.LRUCache(max_entries=1, spill_dir=str(tmp_path))
+    arr = np.arange(6, dtype=np.int32)
+    c.put(("raster",), arr)
+    c.put(("frame",), {"px": [1, 2], "sday": ["1995-01-01", "1995-01-01"]})
+    # raster was evicted to disk; reading it promotes it back (and
+    # evicts the frame, which spills in turn)
+    got = c.get(("raster",))
+    assert isinstance(got, np.ndarray) and (got == arr).all()
+    got = c.get(("frame",))
+    assert got == {"px": [1, 2], "sday": ["1995-01-01", "1995-01-01"]}
+    assert obs_metrics.counter("serve_cache_disk_hits").value == 2
+    assert obs_metrics.counter("serve_cache_spills").value >= 2
+
+
+def test_generations_bump_per_chip_and_table():
+    g = serve_cache.StoreGenerations()
+    assert g.gen("segment", 1, 2) == 0
+    g.bump_frame("segment", {"cx": [1, 1, 5], "cy": [2, 2, 6]})
+    assert g.gen("segment", 1, 2) == 1
+    assert g.gen("segment", 5, 6) == 1
+    assert g.gen("segment", 9, 9) == 0
+    # non-chip tables (tile: the trained model) bump table-wide
+    g.bump_frame("tile", {"tx": [7], "ty": [8], "name": ["rf"]})
+    assert g.table_gen("tile") == 1
+    # table-wide bumps fold into every chip's generation for that table
+    g.bump_table("segment")
+    assert g.gen("segment", 9, 9) == 1
+
+
+def test_watched_store_invalidates_serve_cache(fresh_metrics):
+    svc, store = make_service()
+    watched = svc.watched_store()
+    watched.write("segment", seg_frame(chprob=0.0))
+    first = svc.segments(CX, CY)
+    assert first["chprob"] == [0.0] * 3
+    assert svc.segments(CX, CY) is first          # cached (same object)
+    # a live run rewriting the chip through the watched store must
+    # invalidate: the next read sees the new rows, not the cache
+    watched.write("segment", seg_frame(chprob=1.0))
+    assert svc.segments(CX, CY)["chprob"] == [1.0] * 3
+
+
+# ---------------------------------------------------------------------------
+# Flight: coalescing, admission, deadline
+# ---------------------------------------------------------------------------
+
+def test_single_flight_coalesces(fresh_metrics):
+    import time
+
+    sf = serve_flight.SingleFlight()
+    calls = []
+
+    def compute():
+        # The leader holds the flight open until all three followers
+        # have provably coalesced (the counter increments before each
+        # blocks on the flight) — otherwise a fast compute closes the
+        # window before the followers arrive and the test races.
+        calls.append(1)
+        deadline = time.monotonic() + 10
+        while (obs_metrics.counter("serve_coalesced_waits").value < 3
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        return "value"
+
+    with concurrent.futures.ThreadPoolExecutor(4) as ex:
+        results = [f.result() for f in
+                   [ex.submit(sf.do, "k", compute) for _ in range(4)]]
+    assert results == ["value"] * 4
+    assert len(calls) == 1
+    assert obs_metrics.counter("serve_coalesced_waits").value == 3
+    # the flight deregisters on completion: a LATER call computes fresh
+    assert sf.do("k", compute) == "value"
+    assert len(calls) == 2
+
+
+def test_single_flight_shares_leader_error():
+    sf = serve_flight.SingleFlight()
+    gate = threading.Barrier(2, timeout=10)
+
+    def boom():
+        raise RuntimeError("leader failed")
+
+    def request():
+        gate.wait()
+        return sf.do("k", boom)
+
+    with concurrent.futures.ThreadPoolExecutor(2) as ex:
+        futs = [ex.submit(request) for _ in range(2)]
+        for f in futs:
+            with pytest.raises(RuntimeError, match="leader failed"):
+                f.result()
+
+
+def test_admission_sheds_and_deadlines(fresh_metrics):
+    ac = serve_flight.AdmissionControl(max_inflight=1, max_queue=1,
+                                       deadline_sec=0.5)
+    release = threading.Event()
+    inside = threading.Event()
+
+    def hold():
+        with ac:
+            inside.set()
+            release.wait(10)
+
+    t = threading.Thread(target=hold, daemon=True)
+    t.start()
+    assert inside.wait(5)
+    # With the one slot held: of two more arrivals, whichever queues
+    # first waits past its deadline (504); the other finds the waiting
+    # line full and is shed immediately (429).
+    errs: list = []
+
+    def attempt():
+        try:
+            with ac:
+                pass
+        except Exception as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=attempt, daemon=True)
+               for _ in range(2)]
+    for a in threads:
+        a.start()
+    for a in threads:
+        a.join(10)
+    release.set()
+    t.join(5)
+    kinds = {type(e) for e in errs}
+    assert kinds == {serve_flight.Overload, serve_flight.DeadlineExceeded}
+    shed = next(e for e in errs if isinstance(e, serve_flight.Overload))
+    assert shed.retry_after_sec > 0
+    assert obs_metrics.counter("serve_rejected_total").value >= 1
+    assert obs_metrics.counter("serve_deadline_exceeded_total").value >= 1
+
+
+def test_admission_zero_queue_still_serves(fresh_metrics):
+    """max_queue=0 means 'no waiting line', NOT 'reject everything':
+    free execution slots admit immediately without consulting the
+    queue bound."""
+    ac = serve_flight.AdmissionControl(max_inflight=2, max_queue=0,
+                                       deadline_sec=0.2)
+    with ac:
+        with ac:                       # both slots admit, no queueing
+            pass
+    # slots full -> the zero-length line sheds instantly
+    release = threading.Event()
+    inside = threading.Event()
+
+    def hold():
+        with ac:
+            with ac:
+                inside.set()
+                release.wait(10)
+
+    t = threading.Thread(target=hold, daemon=True)
+    t.start()
+    assert inside.wait(5)
+    with pytest.raises(serve_flight.Overload):
+        with ac:
+            pass
+    release.set()
+    t.join(5)
+
+
+def test_admission_burst_onto_free_slots_never_sheds(fresh_metrics):
+    """max_queue+1 simultaneous arrivals onto an idle controller must
+    all admit (the waiting line only judges requests that actually
+    wait)."""
+    ac = serve_flight.AdmissionControl(max_inflight=8, max_queue=1,
+                                       deadline_sec=1.0)
+    gate = threading.Barrier(6, timeout=10)
+    errs: list = []
+
+    def req():
+        gate.wait()
+        try:
+            with ac:
+                pass
+        except Exception as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=req, daemon=True) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert not errs
+    assert obs_metrics.counter("serve_rejected_total").value == 0
+
+
+def test_spill_dir_is_bounded(tmp_path, fresh_metrics):
+    """Generation churn must not grow the disk tier without bound: the
+    oldest spill files are trimmed past spill_max_files."""
+    c = serve_cache.LRUCache(max_entries=1, spill_dir=str(tmp_path),
+                             spill_max_files=3)
+    for gen in range(10):              # each key unique, as invalidation
+        c.put(("raster", gen), np.arange(4, dtype=np.int32))
+    files = [n for n in os.listdir(tmp_path) if n.endswith(".npy")]
+    assert len(files) <= 3
+
+
+# ---------------------------------------------------------------------------
+# Service: queries, compute-on-miss, degraded mode
+# ---------------------------------------------------------------------------
+
+def test_product_raster_matches_chip_product_and_persists(fresh_metrics):
+    svc, store = make_service()
+    store.write("segment", seg_frame())
+    from firebird_tpu.utils import dates as dt
+
+    got = svc.product_raster("seglength", DATE, CX, CY)
+    want = products.chip_product(
+        "seglength", dt.to_ordinal(DATE), CX, CY,
+        store.read("segment", {"cx": CX, "cy": CY}))
+    assert (got == want).all()
+    # compute-on-miss persisted the row — the store warms as it serves
+    rows = store.read("product", {"name": "seglength", "date": DATE,
+                                  "cx": CX, "cy": CY})
+    assert rows["cells"] and rows["cells"][0] == want.tolist()
+    assert obs_metrics.counter("serve_product_computes").value == 1
+    # second call: cache hit, no recompute
+    svc.product_raster("seglength", DATE, CX, CY)
+    assert obs_metrics.counter("serve_product_computes").value == 1
+
+
+def test_stored_product_row_wins_over_compute(fresh_metrics):
+    svc, store = make_service()
+    store.write("segment", seg_frame())
+    sentinel = np.full(10000, 7, np.int32)
+    cells = np.empty(1, object)
+    cells[0] = sentinel.tolist()
+    store.write("product", {"name": ["curveqa"], "date": [DATE],
+                            "cx": [CX], "cy": [CY], "cells": cells})
+    got = svc.product_raster("curveqa", DATE, CX, CY)
+    assert (got == 7).all()
+    assert obs_metrics.counter("serve_product_computes").value == 0
+
+
+def test_compute_on_miss_disabled_404s():
+    svc, store = make_service(compute_on_miss=False)
+    store.write("segment", seg_frame())
+    with pytest.raises(serve_api.NotFound):
+        svc.product_raster("seglength", DATE, CX, CY)
+
+
+def test_bad_product_and_date_are_400s():
+    svc, _ = make_service()
+    with pytest.raises(serve_api.BadRequest):
+        svc.product_raster("nope", DATE, CX, CY)
+    with pytest.raises(serve_api.BadRequest):
+        svc.product_raster("seglength", "not-a-date", CX, CY)
+
+
+def test_no_segments_is_404():
+    svc, _ = make_service()
+    with pytest.raises(serve_api.NotFound):
+        svc.product_raster("seglength", DATE, CX, CY)
+
+
+def test_pixel_values(fresh_metrics):
+    svc, store = make_service()
+    store.write("segment", seg_frame())
+    out = svc.pixel(CX + 35.0, CY - 35.0, DATE)
+    assert (out["cx"], out["cy"]) == (CX, CY)
+    assert out["pixel"] == {"row": 1, "col": 1}
+    # pixel (row 1, col 1) has no segment row (frame pixels sit on row 1
+    # cols 0..2 at py=cy-30 -> row 1); index math: px=cx+30 -> col 1
+    assert out["products"]["curveqa"] == 8
+    assert out["products"]["cover"] is None     # no trained model stored
+    assert out["products"]["seglength"] > 0
+
+
+def test_degraded_mode_serves_cache_only(fresh_metrics):
+    class Flaky:
+        """Store whose reads can be switched to fail."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.broken = False
+
+        def read(self, table, where=None):
+            if self.broken:
+                raise OSError("store down")
+            return self.inner.read(table, where)
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+    inner = open_store("memory", "", "t")
+    inner.write("segment", seg_frame())
+    other = seg_frame(cx=CX + 3000)
+    inner.write("segment", other)
+    flaky = Flaky(inner)
+    breaker = CircuitBreaker(1, cooldown_sec=60.0, name="serve-store")
+    svc, _ = make_service(store=flaky, breaker=breaker)
+    warm = svc.segments(CX, CY)               # cached while healthy
+    assert not svc.degraded()
+
+    flaky.broken = True
+    # a miss strikes the breaker (threshold 1 -> opens) and maps to 503
+    with pytest.raises(serve_api.StoreError):
+        svc.segments(CX + 3000, CY)
+    assert svc.degraded()
+    # cached answers still serve — degraded, not dead
+    assert svc.segments(CX, CY) is warm
+    # uncached misses now shed with Retry-After instead of hammering
+    with pytest.raises(serve_flight.StoreDegraded):
+        svc.segments(CX + 6000, CY)
+    assert obs_metrics.counter("serve_degraded_misses_total").value == 1
+
+    # the store heals; the breaker's half-open probe readmits
+    flaky.broken = False
+    breaker._opened_at = -1e9                 # cooldown elapsed (test seam)
+    assert svc.segments(CX + 3000, CY)["chprob"] == [1.0] * 3
+    assert not svc.degraded()
+
+
+def test_compute_error_does_not_open_breaker(fresh_metrics, monkeypatch):
+    """A deterministic data-dependent COMPUTE failure is that request's
+    problem — it must not strike the store breaker and degrade every
+    other chip to cache-only serving."""
+    svc, store = make_service(
+        breaker=CircuitBreaker(1, cooldown_sec=60.0, name="serve-store"))
+    store.write("segment", seg_frame())
+
+    def boom(*a, **kw):
+        raise RuntimeError("stale rfrawp vs retrained model")
+
+    monkeypatch.setattr(products, "save_chip_raster", boom)
+    with pytest.raises(RuntimeError, match="stale rfrawp"):
+        svc.product_raster("seglength", DATE, CX, CY)
+    assert not svc.degraded()          # threshold is 1: any strike opens
+    # the store itself keeps serving
+    assert svc.segments(CX, CY)["chprob"] == [1.0] * 3
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def served(fresh_metrics):
+    svc, store = make_service()
+    store.write("segment", seg_frame())
+    srv = serve_api.start_serve_server(0, svc, host="127.0.0.1")
+    yield svc, store, f"http://127.0.0.1:{srv.port}"
+    srv.close()
+
+
+def _get(base, path):
+    try:
+        r = urllib.request.urlopen(base + path, timeout=10)
+        return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def test_http_endpoints_roundtrip(served):
+    svc, store, base = served
+    code, body, _ = _get(base, "/healthz")
+    assert (code, body) == (200, b"ok\n")
+    code, body, _ = _get(base, "/v1/products")
+    assert code == 200 and json.loads(body)["products"] == \
+        list(products.PRODUCTS)
+    code, body, _ = _get(base, f"/v1/segments?cx={CX}&cy={CY}")
+    doc = json.loads(body)
+    assert code == 200 and doc["n"] == 3
+    assert doc["segments"]["curqa"] == [4, 8, 4]
+    code, body, _ = _get(base,
+                         f"/v1/product/curveqa?cx={CX}&cy={CY}&date={DATE}")
+    assert code == 200
+    cells = json.loads(body)["cells"]
+    assert len(cells) == 10000 and sum(cells) == 16
+    # npy format round-trips as a [100, 100] array with chip headers
+    import io
+    code, body, headers = _get(
+        base, f"/v1/product/curveqa?cx={CX}&cy={CY}&date={DATE}&format=npy")
+    assert code == 200
+    arr = np.load(io.BytesIO(body))
+    assert arr.shape == (100, 100) and int(arr.sum()) == 16
+    assert headers["X-Firebird-Chip"] == f"{CX},{CY}"
+    # /metrics carries the serve family next to the pipeline metrics
+    code, body, _ = _get(base, "/metrics")
+    assert code == 200
+    assert b"firebird_serve_request_seconds" in body
+    assert b"firebird_serve_requests_total" in body
+
+
+def test_http_errors(served):
+    _, _, base = served
+    code, body, _ = _get(base, "/v1/segments?cx=1")       # missing cy
+    assert code == 400 and b"cy" in body
+    code, body, _ = _get(base, "/v1/product/nope?cx=1&cy=2&date=" + DATE)
+    assert code == 400
+    code, body, _ = _get(base, f"/v1/product/ccd?cx=1&cy=2&date={DATE}")
+    assert code == 404                                    # no such chip
+    code, body, _ = _get(base, "/nope")
+    assert code == 404 and b"paths" in body
+    assert obs_metrics.counter("serve_errors_total").value >= 3
+
+
+def test_http_coalesced_cold_miss(served):
+    """The acceptance check: 8 concurrent identical cold requests ->
+    exactly ONE underlying product computation."""
+    svc, store, base = served
+    path = f"/v1/product/seglength?cx={CX}&cy={CY}&date={DATE}"
+    with concurrent.futures.ThreadPoolExecutor(8) as ex:
+        codes = [f.result()[0]
+                 for f in [ex.submit(_get, base, path) for _ in range(8)]]
+    assert codes == [200] * 8
+    assert obs_metrics.counter("serve_product_computes").value == 1
+
+
+def test_http_degraded_healthz(fresh_metrics):
+    svc, store = make_service(
+        breaker=CircuitBreaker(1, cooldown_sec=60.0, name="serve-store"))
+    svc.breaker.record_failure()              # threshold 1: open
+    srv = serve_api.start_serve_server(0, svc, host="127.0.0.1")
+    try:
+        code, body, _ = _get(f"http://127.0.0.1:{srv.port}", "/healthz")
+        assert (code, body) == (200, b"degraded\n")
+    finally:
+        srv.close()
+
+
+def test_tile_mosaic_json(served):
+    svc, store, base = served
+    code, body, _ = _get(
+        base, f"/v1/tile/curveqa?bounds={CX + 1},{CY - 1}&date={DATE}"
+              "&format=json")
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["shape"] == [100, 100]
+    assert doc["ulx"] == CX and doc["uly"] == CY
+    flat = np.asarray(doc["cells"], np.int32).ravel()
+    assert int(flat[101]) == 8 or int(flat.sum()) == 16
+
+
+# ---------------------------------------------------------------------------
+# Read-under-write: serve reads while an AsyncWriter flushes (sqlite)
+# ---------------------------------------------------------------------------
+
+def test_serve_reads_under_async_writer_never_torn(tmp_path):
+    """A serve-path read racing a live AsyncWriter upsert must return
+    either the pre- or post-upsert rows — never a torn frame mixing the
+    two.  SqliteStore commits each frame as one transaction, so readers
+    see transaction boundaries, not row-level interleavings."""
+    store = open_store("sqlite", str(tmp_path / "rw.db"), "t")
+    n = 40
+    frames = [seg_frame(chprob=float(v), n=n) for v in (0.0, 1.0)]
+    store.write("segment", frames[0])
+    stop = threading.Event()
+    torn: list = []
+
+    def reader():
+        while not stop.is_set():
+            got = store.read("segment", {"cx": CX, "cy": CY})
+            vals = set(got["chprob"])
+            if len(got["px"]) != n or len(vals) != 1 or \
+                    vals - {0.0, 1.0}:
+                torn.append((len(got["px"]), vals))
+                return
+
+    threads = [threading.Thread(target=reader, daemon=True)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    writer = AsyncWriter(store)
+    try:
+        for i in range(60):
+            writer.write("segment", frames[i % 2], key=(CX, CY))
+            if i % 10 == 9:
+                writer.flush()
+    finally:
+        writer.close()
+        stop.set()
+        for t in threads:
+            t.join(10)
+        store.close()
+    assert not torn, f"torn read frames observed: {torn[:3]}"
+
+
+def test_service_over_sqlite_sees_writer_results(tmp_path, fresh_metrics):
+    """ServeService over a SqliteStore a writer is feeding: reads after
+    a flush see the landed rows (the live-run + serving deployment)."""
+    store = open_store("sqlite", str(tmp_path / "live.db"), "t")
+    svc = serve_api.ServeService(store, Config(store_backend="memory"))
+    watched = svc.watched_store()
+    writer = AsyncWriter(watched)
+    try:
+        writer.write("segment", seg_frame(chprob=0.0), key=(CX, CY))
+        writer.flush()
+        assert svc.segments(CX, CY)["chprob"] == [0.0] * 3
+        writer.write("segment", seg_frame(chprob=1.0), key=(CX, CY))
+        writer.flush()
+        # the AsyncWriter wrote through the watched store -> invalidated
+        assert svc.segments(CX, CY)["chprob"] == [1.0] * 3
+    finally:
+        writer.close()
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# Config knobs
+# ---------------------------------------------------------------------------
+
+def test_serve_config_validation():
+    assert Config().serve_port == 8080
+    cfg = Config.from_env(env={"FIREBIRD_SERVE_PORT": "9001",
+                               "FIREBIRD_SERVE_CACHE_ENTRIES": "7",
+                               "FIREBIRD_SERVE_CACHE_DIR": "/tmp/x",
+                               "FIREBIRD_SERVE_INFLIGHT": "3",
+                               "FIREBIRD_SERVE_QUEUE": "5",
+                               "FIREBIRD_SERVE_DEADLINE": "2.5"})
+    assert (cfg.serve_port, cfg.serve_cache_entries, cfg.serve_cache_dir,
+            cfg.serve_inflight, cfg.serve_queue,
+            cfg.serve_deadline_sec) == (9001, 7, "/tmp/x", 3, 5, 2.5)
+    for bad in ({"serve_port": 0}, {"serve_cache_entries": 0},
+                {"serve_inflight": 0}, {"serve_queue": -1},
+                {"serve_deadline_sec": 0.0}):
+        with pytest.raises(ValueError):
+            Config(**bad)

@@ -397,3 +397,31 @@ def test_schema_matches_reference_column_set():
         ["cx", "cy", "px", "py", "mask"]
     assert [c for c, _ in TABLES["tile"]["columns"]] == \
         ["tx", "ty", "name", "model", "updated"]
+
+
+def test_sqlite_chip_reads_use_secondary_index(tmp_path):
+    """The serve-path point read `WHERE cx=? AND cy=?` must be
+    index-backed on BOTH result tables.  The segment PK's autoindex
+    already leads with (cx, cy), but the product PK leads with
+    (name, date) — without idx_product_chip a per-chip product read
+    scans the whole table (backends.SqliteStore._create)."""
+    store = SqliteStore(str(tmp_path / "idx.db"), "ks")
+    try:
+        con = store._conn()
+        for table in ("segment", "product"):
+            plan = " ".join(
+                row[3] for row in con.execute(
+                    f'EXPLAIN QUERY PLAN SELECT * FROM "{table}" '
+                    "WHERE cx = ? AND cy = ?", (1, 2)))
+            assert "USING INDEX" in plan.upper(), \
+                f"{table} chip read is not index-backed: {plan}"
+            assert "SCAN" not in plan.upper(), \
+                f"{table} chip read scans: {plan}"
+        # the product index is the explicit secondary one
+        plan = " ".join(
+            row[3] for row in con.execute(
+                'EXPLAIN QUERY PLAN SELECT * FROM "product" '
+                "WHERE cx = ? AND cy = ?", (1, 2)))
+        assert "idx_product_chip" in plan
+    finally:
+        store.close()

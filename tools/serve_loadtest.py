@@ -1,0 +1,183 @@
+"""Closed-loop load generator for the serving layer.
+
+Drives a running `firebird serve` endpoint with N concurrent workers
+over a configurable hot/cold key mix (hot keys model the
+few-popular-areas traffic shape the cache exists for; cold keys model
+the long tail) and writes a JSON artifact with the numbers that matter
+for a read path: sustained RPS, latency percentiles (p50/p95/p99), the
+cache hit rate over the run, and the status-code census.  The artifact
+lands under FIREBIRD_SERVE_DIR (default /tmp/fb_serve) and is folded
+into the bench artifact by bench.py (_serve_fold), like the chaos and
+pipeline evidence.
+
+"Closed-loop" means each worker waits for its response before issuing
+the next request — measured latency feeds back into offered load, so
+the numbers describe the server, not a queue in the generator.
+
+Usage (standalone):
+    python tools/serve_loadtest.py --url http://127.0.0.1:8080 \
+        --path "/v1/segments?cx=-585&cy=2805" \
+        --path "/v1/product/seglength?cx=-585&cy=2805&date=1996-01-01" \
+        --concurrency 8 --requests 400 --hot 1 --hot-frac 0.8
+
+The first --hot N paths form the hot set hit with probability
+--hot-frac; the rest are the cold tail.  ``run_loadtest`` is importable
+(tools/serve_smoke.py drives it in-process).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import re
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+ARTIFACT_SCHEMA = "firebird-serve-loadtest/1"
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float | None:
+    if not sorted_vals:
+        return None
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def _scrape_cache_counters(base_url: str, timeout: float) -> tuple[int, int]:
+    """(hits, misses) from the server's /metrics exposition; (0, 0) when
+    the scrape fails (hit rate then reads 0 rather than crashing the
+    loadtest)."""
+    try:
+        text = urllib.request.urlopen(
+            base_url + "/metrics", timeout=timeout).read().decode()
+    except (OSError, urllib.error.URLError):
+        return 0, 0
+    out = []
+    for name in ("firebird_serve_cache_hits_total",
+                 "firebird_serve_cache_misses_total"):
+        m = re.search(rf"^{name} (\d+)$", text, re.M)
+        out.append(int(m.group(1)) if m else 0)
+    return out[0], out[1]
+
+
+def run_loadtest(base_url: str, paths: list[str], *, concurrency: int = 8,
+                 requests: int = 200, hot: int = 1, hot_frac: float = 0.8,
+                 seed: int = 0, timeout: float = 30.0,
+                 out_dir: str | None = None) -> dict:
+    """Drive ``requests`` total requests at ``concurrency`` and return
+    (and write) the artifact dict."""
+    if not paths:
+        raise ValueError("loadtest needs at least one --path")
+    hot = max(min(hot, len(paths)), 0)
+    hot_paths, cold_paths = paths[:hot], paths[hot:]
+    if not cold_paths:
+        hot_frac = 1.0
+    if not hot_paths:
+        hot_frac = 0.0
+
+    h0, m0 = _scrape_cache_counters(base_url, timeout)
+    latencies: list[float] = []
+    status_counts: dict[str, int] = {}
+    lock = threading.Lock()
+    remaining = [int(requests)]
+
+    def worker(wid: int) -> None:
+        rng = random.Random(seed * 1000 + wid)
+        while True:
+            with lock:
+                if remaining[0] <= 0:
+                    return
+                remaining[0] -= 1
+            pool = hot_paths if (rng.random() < hot_frac and hot_paths) \
+                else (cold_paths or hot_paths)
+            path = rng.choice(pool)
+            t0 = time.monotonic()
+            try:
+                r = urllib.request.urlopen(base_url + path, timeout=timeout)
+                r.read()
+                code = r.status
+            except urllib.error.HTTPError as e:
+                e.read()
+                code = e.code
+            except (OSError, urllib.error.URLError):
+                code = 0               # transport failure
+            dt = time.monotonic() - t0
+            with lock:
+                latencies.append(dt)
+                status_counts[str(code)] = status_counts.get(str(code), 0) + 1
+
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(max(int(concurrency), 1))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = max(time.monotonic() - t_start, 1e-9)
+
+    h1, m1 = _scrape_cache_counters(base_url, timeout)
+    dh, dm = h1 - h0, m1 - m0
+    lat = sorted(latencies)
+    ok = sum(n for c, n in status_counts.items() if c == "200")
+    artifact = {
+        "schema": ARTIFACT_SCHEMA,
+        "url": base_url,
+        "paths": len(paths),
+        "hot_paths": hot,
+        "hot_frac": hot_frac,
+        "concurrency": int(concurrency),
+        "requests": len(lat),
+        "ok": ok,
+        "errors": len(lat) - ok,
+        "elapsed_sec": round(elapsed, 3),
+        "rps": round(len(lat) / elapsed, 1),
+        "p50_ms": round(_percentile(lat, 0.50) * 1e3, 2) if lat else None,
+        "p95_ms": round(_percentile(lat, 0.95) * 1e3, 2) if lat else None,
+        "p99_ms": round(_percentile(lat, 0.99) * 1e3, 2) if lat else None,
+        "cache_hits": dh,
+        "cache_misses": dm,
+        "hit_rate": round(dh / (dh + dm), 4) if (dh + dm) > 0 else None,
+        "status_counts": dict(sorted(status_counts.items())),
+    }
+    out_dir = out_dir or os.environ.get("FIREBIRD_SERVE_DIR", "/tmp/fb_serve")
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "serve_loadtest.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(artifact, f, indent=1)
+    os.replace(tmp, path)
+    artifact["artifact_path"] = path
+    return artifact
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", required=True,
+                    help="base URL of a running firebird serve endpoint")
+    ap.add_argument("--path", action="append", default=[],
+                    help="relative request path (repeatable); the first "
+                         "--hot N paths form the hot set")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--hot", type=int, default=1,
+                    help="number of leading --path entries in the hot set")
+    ap.add_argument("--hot-frac", type=float, default=0.8,
+                    help="probability a request draws from the hot set")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--timeout", type=float, default=30.0)
+    args = ap.parse_args()
+    artifact = run_loadtest(
+        args.url.rstrip("/"), args.path, concurrency=args.concurrency,
+        requests=args.requests, hot=args.hot, hot_frac=args.hot_frac,
+        seed=args.seed, timeout=args.timeout)
+    print(json.dumps(artifact, indent=1))
+    return 0 if artifact["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

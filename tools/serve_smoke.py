@@ -1,0 +1,199 @@
+"""Serving-layer smoke test (``make serve-smoke``).
+
+End-to-end proof that the query layer answers correctly over a real
+store, in five acts:
+
+1. a tiny synthetic changedetection run lands 2 chips of segments in a
+   sqlite store (writing through the serve layer's watched store, so
+   cache invalidation is exercised by the very run that feeds it);
+2. the serve endpoint comes up on an ephemeral port and EVERY endpoint
+   answers 200 — /healthz, /metrics, /v1/products, /v1/segments,
+   /v1/pixel, /v1/product/<name> (json and npy), /v1/tile/<name> — with
+   /v1/product values cross-checked byte-for-byte against what a batch
+   ``products.save`` run wrote for the same keys;
+3. N=8 concurrent identical COLD product requests trigger exactly ONE
+   underlying products.save-path computation (single-flight, proven via
+   the serve_product_computes obs counter);
+4. repeat requests prove serve_cache_hits > 0;
+5. the closed-loop loadtest (tools/serve_loadtest.py) runs a hot/cold
+   mix against the live server and its artifact carries RPS +
+   p50/p95/p99 + hit-rate, and bench.py's _serve_fold picks it up.
+
+Exits non-zero on any violation.
+"""
+
+import concurrent.futures
+import io
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, HERE)
+
+ACQ = "1995-01-01/1996-06-01"
+DATE = "1995-06-01"
+
+
+def fail(msg: str) -> int:
+    print(f"serve-smoke: {msg}", file=sys.stderr)
+    return 1
+
+
+def get(base: str, path: str):
+    r = urllib.request.urlopen(base + path, timeout=30)
+    return r.status, r.read()
+
+
+def main() -> int:
+    import numpy as np
+
+    from firebird_tpu import products
+    from firebird_tpu.config import Config
+    from firebird_tpu.driver import core
+    from firebird_tpu.ingest import SyntheticSource
+    from firebird_tpu.obs import metrics as obs_metrics
+    from firebird_tpu.serve import api as serve_api
+    from firebird_tpu.store import open_store
+
+    with tempfile.TemporaryDirectory(prefix="fb_serve_smoke_") as tmp:
+        os.environ["FIREBIRD_SERVE_DIR"] = os.path.join(tmp, "artifacts")
+        cfg = Config(store_backend="sqlite",
+                     store_path=os.path.join(tmp, "smoke.db"),
+                     source_backend="synthetic", chips_per_batch=1,
+                     device_sharding="off", fetch_retries=0,
+                     serve_cache_dir=os.path.join(tmp, "spill"))
+        src = SyntheticSource(seed=9, start="1995-01-01", end="1998-01-01",
+                              cloud_frac=0.1)
+
+        # -- act 1: the write path feeds the store the serve layer reads --
+        store = open_store(cfg.store_backend, cfg.store_path, cfg.keyspace())
+        service = serve_api.ServeService(store, cfg)
+        done = core.changedetection(x=100, y=200, acquired=ACQ, number=2,
+                                    chunk_size=2, cfg=cfg, source=src,
+                                    store=service.watched_store())
+        if len(done) != 2:
+            return fail(f"detection run processed {len(done)}/2 chips")
+        cids = [tuple(int(v) for v in c) for c in done]
+        (cx, cy) = cids[0]
+
+        # Ground truth for the cross-check: a batch products.save run
+        # over chip 0's area (writing through the watched store so the
+        # serve cache cannot serve anything stale afterwards).
+        saved = products.save(
+            bounds=[(cx + 1.0, cy - 1.0)], products=("seglength", "curveqa"),
+            product_dates=(DATE,), cfg=cfg, store=service.watched_store())
+        if not saved:
+            return fail("products.save wrote nothing")
+        truth = store.read("product", {"name": "seglength", "date": DATE,
+                                       "cx": cx, "cy": cy})
+        if not truth["cells"]:
+            return fail("no ground-truth product row after products.save")
+        truth_cells = list(truth["cells"][0])
+
+        srv = serve_api.start_serve_server(0, service, host="127.0.0.1")
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            # -- act 2: every endpoint answers, values cross-checked --
+            code, body = get(base, "/healthz")
+            if (code, body) != (200, b"ok\n"):
+                return fail(f"/healthz: {code} {body!r}")
+            code, body = get(base, "/metrics")
+            if code != 200 or b"firebird_" not in body:
+                return fail(f"/metrics: {code}")
+            code, body = get(base, "/v1/products")
+            if code != 200 or "seglength" not in json.loads(body)["products"]:
+                return fail(f"/v1/products: {code} {body!r}")
+            code, body = get(base, f"/v1/segments?cx={cx}&cy={cy}")
+            seg = json.loads(body)
+            if code != 200 or seg["n"] < 1:
+                return fail(f"/v1/segments returned no rows: {code}")
+            code, body = get(
+                base, f"/v1/product/seglength?cx={cx}&cy={cy}&date={DATE}")
+            served = json.loads(body)
+            if code != 200 or served["cells"] != truth_cells:
+                return fail("/v1/product/seglength disagrees with the "
+                            "products.save row")
+            code, body = get(base, f"/v1/product/curveqa?cx={cx}&cy={cy}"
+                                   f"&date={DATE}&format=npy")
+            arr = np.load(io.BytesIO(body))
+            if code != 200 or arr.shape != (100, 100):
+                return fail(f"npy product: {code} shape {arr.shape}")
+            code, body = get(base, f"/v1/pixel?x={cx + 45}&y={cy - 45}"
+                                   f"&date={DATE}")
+            pix = json.loads(body)
+            if code != 200 or "seglength" not in pix["products"]:
+                return fail(f"/v1/pixel: {code} {body!r}")
+            # cross-check the pixel against the raster it indexes
+            row, col = pix["pixel"]["row"], pix["pixel"]["col"]
+            want = truth_cells[row * 100 + col]
+            if pix["products"]["seglength"] != want:
+                return fail(f"/v1/pixel seglength {pix['products']} != "
+                            f"raster[{row},{col}]={want}")
+            bounds = "&".join(f"bounds={x},{y}" for x, y in cids)
+            code, body = get(base, f"/v1/tile/seglength?{bounds}&date={DATE}"
+                                   f"&format=npy")
+            tile = np.load(io.BytesIO(body))
+            if code != 200 or tile.size < 2 * 100 * 100:
+                return fail(f"/v1/tile: {code} shape {tile.shape}")
+
+            # -- act 3: single-flight on a COLD key --
+            computes0 = obs_metrics.counter("serve_product_computes").value
+            cold = (f"/v1/product/ccd?cx={cids[1][0]}&cy={cids[1][1]}"
+                    f"&date={DATE}")
+            with concurrent.futures.ThreadPoolExecutor(8) as ex:
+                codes = [f.result()[0] for f in
+                         [ex.submit(get, base, cold) for _ in range(8)]]
+            if codes != [200] * 8:
+                return fail(f"coalesced cold requests: {codes}")
+            computes = obs_metrics.counter("serve_product_computes").value \
+                - computes0
+            if computes != 1:
+                return fail(f"8 identical cold misses ran {computes} "
+                            "computations (single-flight broken)")
+
+            # -- act 4: the cache serves repeats --
+            get(base, cold)
+            hits = obs_metrics.counter("serve_cache_hits").value
+            if hits <= 0:
+                return fail("serve_cache_hits did not move")
+
+            # -- act 5: loadtest artifact + bench fold --
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from serve_loadtest import run_loadtest
+            artifact = run_loadtest(
+                base,
+                [f"/v1/segments?cx={cx}&cy={cy}",
+                 f"/v1/product/seglength?cx={cx}&cy={cy}&date={DATE}",
+                 f"/v1/pixel?x={cx + 45}&y={cy - 45}&date={DATE}",
+                 cold],
+                concurrency=8, requests=200, hot=2, hot_frac=0.8, seed=7)
+            for k in ("rps", "p50_ms", "p95_ms", "p99_ms", "hit_rate"):
+                if artifact.get(k) is None:
+                    return fail(f"loadtest artifact missing {k}: {artifact}")
+            if artifact["errors"]:
+                return fail(f"loadtest saw {artifact['errors']} errors: "
+                            f"{artifact['status_counts']}")
+            import bench
+            fold = bench._serve_fold()
+            if "serve_loadtest" not in fold:
+                return fail("bench._serve_fold did not pick up the "
+                            "loadtest artifact")
+        finally:
+            srv.close()
+            store.close()
+
+        print("serve-smoke OK: "
+              f"{len(cids)} chips served, single-flight computes=1, "
+              f"cache hits {hits}, loadtest {artifact['rps']} rps "
+              f"(p50 {artifact['p50_ms']} ms, p99 {artifact['p99_ms']} ms, "
+              f"hit rate {artifact['hit_rate']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
