@@ -10,8 +10,8 @@ Y ?= 1650000
 ACQUIRED ?= 1982-01-01/2017-12-31
 
 .PHONY: install test bench obs-smoke pipeline-smoke chaos-smoke \
-        serve-smoke image db-up db-schema db-test db-down changedetection \
-        classification clean
+        serve-smoke compact-smoke image db-up db-schema db-test db-down \
+        changedetection classification clean
 
 install:
 	pip install -e . --no-build-isolation
@@ -53,6 +53,13 @@ chaos-smoke:
 # (RPS, p50/p95/p99, hit rate) written + folded by bench.py.
 serve-smoke:
 	python tools/serve_smoke.py
+
+# Active-lane compaction check (docs/ROOFLINE.md "Occupancy"): the same
+# synthetic tile with compaction on vs off — asserts the stores are
+# byte-identical, the loop actually compacted (kernel_compactions > 0),
+# and wasted lane-rounds dropped at least 2x; artifact folded by bench.py.
+compact-smoke:
+	python tools/compact_smoke.py
 
 image:
 	docker build -f deploy/Dockerfile -t firebird .

@@ -22,12 +22,16 @@ import subprocess
 import sys
 import time
 
-# ANSI escape sequences in both raw (ESC byte) and repr-escaped forms:
-# autotune errors pass through repr(), which turns the ESC bytes of the
-# remote compiler's colorized log lines into literal "\x1b[2m" text that a
-# raw-byte regex never matches — exactly how BENCH_r05.json ended up with
-# kilobytes of escaped terminal log inside its error fields.
-_ANSI_RE = re.compile(r"(?:\x1b|\\x1b|\\u001b|\\033)\[[0-9;]*[A-Za-z]")
+# ANSI escape sequences in raw (ESC byte) AND arbitrarily re-escaped
+# forms: autotune errors pass through repr() — sometimes more than once
+# (error -> repr in the errors dict -> json.dumps -> the harness's
+# log-tail capture), so the ESC byte shows up as "\x1b[2m", "\\x1b[2m",
+# and deeper.  The single-backslash alternation of the first fix missed
+# the double-escaped form, which is exactly how BENCH_r05.json still
+# ended up with kilobytes of escaped axon terminal log inside its error
+# fields (and a JSON line too large for the harness tail to parse —
+# `parsed: null`).  `\\+` eats any escape depth.
+_ANSI_RE = re.compile(r"(?:\x1b|\\+x1b|\\+u001b|\\+033)\[[0-9;]*[A-Za-z]")
 _ERR_KEYS = frozenset(
     {"error", "errors", "tail", "traceback", "exception", "stderr"})
 # Matches the autotune error budget (safe_rate): a Mosaic failure's real
@@ -169,41 +173,41 @@ def _fleet_obs_fold() -> dict:
     }}
 
 
-def _chaos_fold() -> dict:
-    """{"chaos_report": ...} when a `make chaos-smoke` artifact exists on
-    this host (tools/chaos_soak.py writes chaos_report.json under
-    FIREBIRD_CHAOS_DIR, default /tmp/fb_chaos) — the robustness round
-    evidence, scrubbed/folded like the soak/obs artifacts.  Empty dict
-    when no chaos run happened."""
+def _artifact_fold(key: str, env_var: str, default_dir: str,
+                   filename: str) -> dict:
+    """{key: ...} when a smoke/soak tool left its JSON artifact on this
+    host (under env_var, falling back to default_dir) — per-round
+    evidence folded into the bench record.  Empty dict (not an error)
+    when the tool never ran or the artifact is unreadable."""
     import os
 
-    path = os.path.join(
-        os.environ.get("FIREBIRD_CHAOS_DIR", "/tmp/fb_chaos"),
-        "chaos_report.json")
+    path = os.path.join(os.environ.get(env_var, default_dir), filename)
     try:
         with open(path) as f:
-            return {"chaos_report": json.load(f)}
+            return {key: json.load(f)}
     except (OSError, ValueError):
         return {}
+
+
+def _chaos_fold() -> dict:
+    """`make chaos-smoke` evidence (tools/chaos_soak.py): the robustness
+    round's store-identity-under-faults report."""
+    return _artifact_fold("chaos_report", "FIREBIRD_CHAOS_DIR",
+                          "/tmp/fb_chaos", "chaos_report.json")
+
+
+def _compact_fold() -> dict:
+    """`make compact-smoke` evidence (tools/compact_smoke.py): the
+    on-vs-off store-identity + wasted-lane-round report."""
+    return _artifact_fold("compact_smoke", "FIREBIRD_COMPACT_DIR",
+                          "/tmp/fb_compact", "compact_smoke.json")
 
 
 def _serve_fold() -> dict:
-    """{"serve_loadtest": ...} when a serving-layer loadtest artifact
-    exists on this host (tools/serve_loadtest.py writes
-    serve_loadtest.json under FIREBIRD_SERVE_DIR, default /tmp/fb_serve;
-    `make serve-smoke` produces one) — the read-path round evidence
-    (RPS, p50/p95/p99, cache hit rate), folded like the chaos/pipeline
-    artifacts.  Empty dict when no loadtest ran."""
-    import os
-
-    path = os.path.join(
-        os.environ.get("FIREBIRD_SERVE_DIR", "/tmp/fb_serve"),
-        "serve_loadtest.json")
-    try:
-        with open(path) as f:
-            return {"serve_loadtest": json.load(f)}
-    except (OSError, ValueError):
-        return {}
+    """Serving-layer loadtest evidence (tools/serve_loadtest.py, run by
+    `make serve-smoke`): RPS, p50/p95/p99, cache hit rate."""
+    return _artifact_fold("serve_loadtest", "FIREBIRD_SERVE_DIR",
+                          "/tmp/fb_serve", "serve_loadtest.json")
 
 
 def measure(cpu_only: bool) -> None:
@@ -475,6 +479,16 @@ def measure(cpu_only: bool) -> None:
             "drain_per_chip_seconds": round(drain_per_chip_s, 4),
         }}
 
+    # ---- occupancy: padded vs effective lane-rounds (docs/ROOFLINE.md
+    # "Occupancy") ----  The kernel's per-round (active, paid) capture,
+    # fed through the registry (kernel_round_active_fraction + the
+    # wasted/compaction counters land in the obs snapshot below) and
+    # embedded per round so artifacts show what compaction saved.
+    occupancy_detail = {}
+    occ_det = kernel.record_occupancy(seg)
+    if occ_det is not None:
+        occupancy_detail = {"occupancy": occ_det}
+
     # ---- closed-form FLOP model -> MFU / roofline (docs/ROOFLINE.md) ----
     from firebird_tpu.ccd import flops as flopsmod
 
@@ -643,6 +657,7 @@ def measure(cpu_only: bool) -> None:
             "cpu_ref_pixels_per_sec_per_core_live": round(cpu_rate, 2),
             "baseline_2000_core_pixels_per_sec": round(baseline_2000_cores, 1),
             "mean_segments": float(np.asarray(seg.n_segments).mean()),
+            **occupancy_detail,
             **pipeline_detail,
             **pallas_detail,
             # Per-run telemetry fold (obs_report schema's metrics half):
@@ -658,6 +673,9 @@ def measure(cpu_only: bool) -> None:
             # Last serve-loadtest evidence (read-path RPS/latency/hit
             # rate) when the serving layer was exercised on this host.
             **_serve_fold(),
+            # Last compact-smoke evidence (stores identical on vs off,
+            # wasted lane-rounds reduced) when one ran on this host.
+            **_compact_fold(),
             "streaming_pixels_per_sec": round(stream_rate, 1),
             **s2_detail,
             **hard_detail,
@@ -674,13 +692,21 @@ def measure(cpu_only: bool) -> None:
     print(json.dumps(scrub_artifact(out)))
 
 
-def probe_accelerator(timeout: float = 300.0) -> bool:
+def probe_accelerator(timeout: float = 300.0) -> dict:
     """Cheap health check before the full accelerator attempt: the tunnel
     to the chip can hang indefinitely (even jax.devices() blocks), and the
     full attempt's budget is an hour — a tiny device round-trip under a
-    short timeout decides whether that budget is worth spending."""
+    short timeout decides whether that budget is worth spending.
+
+    Returns the structured ``tunnel_health`` block the bench artifact
+    embeds instead of a raw log tail: ``ok`` (probe passed), ``rc``
+    (probe exit code, None on timeout), ``backend`` (the platform the
+    probe reached, when any), ``reason`` (short, ANSI-stripped
+    diagnosis: 'ok' / 'timeout after Ns' / 'cpu-only backend' / the
+    probe's last stderr line)."""
     code = ("import sys, jax, jax.numpy as jnp\n"
             "d = jax.devices()[0]\n"
+            "print('PROBE_PLATFORM', d.platform)\n"
             "if d.platform == 'cpu': sys.exit(1)\n"
             "x = jnp.ones((128, 128))\n"
             "(x @ x).block_until_ready()\n"
@@ -689,8 +715,23 @@ def probe_accelerator(timeout: float = 300.0) -> bool:
         r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                            text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
-        return False
-    return r.returncode == 0 and "PROBE_OK" in r.stdout
+        return {"ok": False, "rc": None, "backend": None,
+                "reason": f"timeout after {timeout:.0f}s (tunnel hung)"}
+    backend = None
+    for line in r.stdout.splitlines():
+        if line.startswith("PROBE_PLATFORM "):
+            backend = line.split(None, 1)[1].strip()
+    ok = r.returncode == 0 and "PROBE_OK" in r.stdout
+    if ok:
+        reason = "ok"
+    elif backend == "cpu":
+        reason = "cpu-only backend (no accelerator visible)"
+    else:
+        err = [l for l in clean_text(r.stderr).splitlines() if l.strip()]
+        reason = clean_text(err[-1], limit=300) if err \
+            else f"probe exited rc={r.returncode}"
+    return {"ok": ok, "rc": r.returncode, "backend": backend,
+            "reason": reason}
 
 
 CAPTURE_LOGS = ("bench_tpu_new.log", "bench_out.log")
@@ -786,9 +827,11 @@ def main() -> int:
     # compile cycles through the (slow) tunnel; a dead tunnel never spends
     # it because the probe gates the attempt.
     ladder = [([], 3600), (["--cpu"], 2700), (["--cpu", "--small"], 900)]
-    if not probe_accelerator():
-        print("bench: accelerator probe failed/hung; skipping the "
-              "accelerator attempt", file=sys.stderr)
+    tunnel_health = probe_accelerator()
+    if not tunnel_health["ok"]:
+        print("bench: accelerator probe failed/hung "
+              f"({tunnel_health['reason']}); skipping the accelerator "
+              "attempt", file=sys.stderr)
         ladder = ladder[1:]
     for args, timeout in ladder:
         env = dict(os.environ)
@@ -817,6 +860,11 @@ def main() -> int:
             out = lines[-1]
             try:
                 rec = json.loads(out)
+                # Structured tunnel evidence in EVERY artifact (the
+                # satellite behind BENCH_r05's parsed:null): rc/backend/
+                # reason from the probe instead of a raw ANSI log tail.
+                rec.setdefault("detail", {})["tunnel_health"] = \
+                    tunnel_health
                 if rec.get("detail", {}).get("platform") == "cpu":
                     cap = _best_tpu_capture(here)
                     if cap is not None:
@@ -835,9 +883,11 @@ def main() -> int:
                 pass
             print(out)
             return 0
-    print(json.dumps({"metric": "ccdc_pixels_per_sec", "value": 0.0,
-                      "unit": "pixels/sec", "vs_baseline": 0.0,
-                      "detail": {"error": "all benchmark attempts failed"}}))
+    print(json.dumps(scrub_artifact(
+        {"metric": "ccdc_pixels_per_sec", "value": 0.0,
+         "unit": "pixels/sec", "vs_baseline": 0.0,
+         "detail": {"error": "all benchmark attempts failed",
+                    "tunnel_health": tunnel_health}})))
     return 1
 
 

@@ -120,12 +120,31 @@ class ChipSegments:
     # ^ [.., 3] int32: rounds in which the cond-gated INIT / shared-fit /
     #   segment-close blocks actually executed (diagnostic; feeds the
     #   measurement-driven roofline model in ccd.flops / bench.py).
+    occupancy: jnp.ndarray | None = None
+    # ^ [.., R_max, 2] int32 per-chip, per-executed-round (active_lanes,
+    #   paid_lanes): active = lanes with phase != DONE entering the round,
+    #   paid = lanes in COMPACT_LANE_BLOCK-wide blocks containing any
+    #   active lane (the skip-guard accounting unit; full width when
+    #   compaction is off).  The capture is the same on every backend so
+    #   CPU runs predict TPU behavior — which means ``paid`` is measured
+    #   compute only where the Pallas per-block guards execute; the lax
+    #   fallback paths carry the guards for control-flow parity but
+    #   compute every lane (under vmap the slab cond is a select), so
+    #   there ``paid`` models what the guards would skip and only the
+    #   stage-2 bucket narrows real work.  Rows past ``rounds`` are
+    #   zero.  Feeds flops.occupancy_detail and record_occupancy.
+    compactions: jnp.ndarray | None = None
+    # ^ [..] int32: dense-prefix compactions the batch's loop performed,
+    #   recorded at each loop's first chip row and zero elsewhere — sum
+    #   over the chip axis for the batch total (correct under sharding,
+    #   where each shard runs its own loop; see _detect_batch_impl).
 
 
 jax.tree_util.register_pytree_node(
     ChipSegments,
     lambda s: ((s.n_segments, s.seg_meta, s.seg_rmse, s.seg_mag, s.seg_coef,
-                s.mask, s.procedure, s.rounds, s.vario, s.round_counts),
+                s.mask, s.procedure, s.rounds, s.vario, s.round_counts,
+                s.occupancy, s.compactions),
                None),
     lambda _, c: ChipSegments(*c),
 )
@@ -194,7 +213,7 @@ def _masked_median(x, m):
     return jnp.where(n > 0, med, 0.0)
 
 
-def _fit_lasso_coefs(X, Y, w, coefmask, XX=None):
+def _fit_lasso_coefs(X, Y, w, coefmask, XX=None, active=None):
     """Batched Lasso coefficients via cyclic coordinate descent on Grams.
 
     Mirrors harmonic.lasso_cd_gram exactly (same update, same iteration
@@ -211,6 +230,12 @@ def _fit_lasso_coefs(X, Y, w, coefmask, XX=None):
             precomputed once per chip.  The 0/1 weights make the two Gram
             formulations bit-identical per term, and [P,T]x[T,64] is one
             MXU matmul instead of a [P,T,8] broadcast temporary.
+        active: optional [P] bool skip guard (compaction mode): pixels
+            outside it are guaranteed all-zero ``w`` rows, whose CD
+            output is exactly zero — so the Pallas kernel skips whole
+            dead lane blocks (a per-block ``pl.when``) and the lax path
+            cond-gates the CD slab on any(active).  ``None`` preserves
+            the unguarded program.
 
     Returns:
         coefs [P,7,8].
@@ -231,8 +256,19 @@ def _fit_lasso_coefs(X, Y, w, coefmask, XX=None):
             from firebird_tpu.ccd import pallas_ops
 
             return pallas_ops.lasso_cd(G, c, diag, coefmask,
+                                       active=active,
                                        interpret=not on_tpu)
-    return _lasso_cd_lax(G, c, diag, coefmask)
+    if active is None:
+        return _lasso_cd_lax(G, c, diag, coefmask)
+    # The lax slab guard: an all-dead slab (here the slab is the whole
+    # call — the batch-level cond gates already bound it) skips the CD
+    # loop for the exact zeros it would compute.  Under vmap the cond
+    # degenerates to a select; the value is identical either way, so
+    # tier-1 CPU runs exercise the same control flow the Pallas
+    # per-block guards take on TPU.
+    return lax.cond(jnp.any(active),
+                    lambda: _lasso_cd_lax(G, c, diag, coefmask),
+                    lambda: jnp.zeros_like(c))
 
 
 def _lasso_cd_lax(G, c, diag, coefmask):
@@ -257,13 +293,13 @@ def _lasso_cd_lax(G, c, diag, coefmask):
     return lax.fori_loop(0, params.LASSO_ITERS, one_iter, b0)
 
 
-def _fit_lasso(X, Y, w, coefmask, XX=None):
+def _fit_lasso(X, Y, w, coefmask, XX=None, active=None):
     """_fit_lasso_coefs plus the weighted-window RMSE.
 
     Returns:
         (coefs [P,7,8], rmse [P,7]).
     """
-    b = _fit_lasso_coefs(X, Y, w, coefmask, XX=XX)
+    b = _fit_lasso_coefs(X, Y, w, coefmask, XX=XX, active=active)
     n = jnp.maximum(jnp.sum(w, -1), 1.0)
     pred = jnp.einsum("pbc,tc->pbt", b, X)
     r = Y - pred
@@ -272,7 +308,7 @@ def _fit_lasso(X, Y, w, coefmask, XX=None):
     return b, rmse
 
 
-def _coefmask_for(n, P):
+def _coefmask_for(n):
     """[P,8] allowed-coefficient mask from per-pixel obs counts (4/6/8)."""
     nc = jnp.where(n >= params.MAX_COEFS * params.NUM_OBS_FACTOR, 8,
                    jnp.where(n >= params.MID_COEFS * params.NUM_OBS_FACTOR, 6, 4))
@@ -565,20 +601,26 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
     return jax.tree_util.tree_map(lambda a: a[0], out)
 
 
-def _fit_chip(res, w, coefmask, with_rmse=True, *, fit_pallas, on_tpu):
+def _fit_chip(res, w, coefmask, with_rmse=True, *, fit_pallas, on_tpu,
+              active=None):
     """One chip's batched Lasso fit, routed to the winning implementation
     (the fused Pallas Gram+corr+CD+RMSE kernel reads the wire-dtype
-    resident spectra; the lax path reads the widened float view)."""
+    resident spectra; the lax path reads the widened float view).
+    ``active`` is the compaction-mode skip guard: pixels outside it carry
+    all-zero windows, so dead lane blocks are skipped for the zeros they
+    would compute (see _fit_lasso_coefs)."""
     if fit_pallas:
         from firebird_tpu.ccd import pallas_ops
 
         b, r = pallas_ops.lasso_fit(res["Yt"], w, res["X"], coefmask,
-                                    with_rmse=with_rmse,
+                                    with_rmse=with_rmse, active=active,
                                     interpret=not on_tpu)
         return (b, r) if with_rmse else b
     if with_rmse:
-        return _fit_lasso(res["X"], res["Y"], w, coefmask, XX=res["XX"])
-    return _fit_lasso_coefs(res["X"], res["Y"], w, coefmask, XX=res["XX"])
+        return _fit_lasso(res["X"], res["Y"], w, coefmask, XX=res["XX"],
+                          active=active)
+    return _fit_lasso_coefs(res["X"], res["Y"], w, coefmask, XX=res["XX"],
+                            active=active)
 
 
 def _write_seg(bufs, nseg, wmask, meta, rmse_s, mag_s, coef_s, *, S):
@@ -604,7 +646,7 @@ def _write_seg(bufs, nseg, wmask, meta, rmse_s, mag_s, coef_s, *, S):
 
 
 def _prologue(X, Xt, t, valid, Y, qa, *, sensor, S, fdtype, fit,
-              wire_only=False):
+              wire_only=False, guards=False):
     """One chip's pre-loop work: QA triage, usable sets, the one-shot
     snow/insufficient-clear fit, variogram, and the standard-procedure
     start state.  Returns (res, state): ``res`` holds the loop-invariant
@@ -678,7 +720,8 @@ def _prologue(X, Xt, t, valid, Y, qa, *, sensor, S, fdtype, fit,
     alt_n = jnp.sum(alt_usable, -1)
     alt_fit = is_alt & (alt_n >= params.MEOW_SIZE)
     w_alt = (alt_usable & alt_fit[:, None]).astype(fdtype)
-    alt_coefs, alt_rmse = fit(res, w_alt, _coefmask_for(alt_n, P), True)
+    alt_coefs, alt_rmse = fit(res, w_alt, _coefmask_for(alt_n), True,
+                              active=alt_fit if guards else None)
     first_i = jnp.argmax(alt_usable, -1)
     last_i = T - 1 - jnp.argmax(alt_usable[:, ::-1], -1)
     alt_meta = jnp.stack([
@@ -720,19 +763,24 @@ def _prologue(X, Xt, t, valid, Y, qa, *, sensor, S, fdtype, fit,
     return res, state
 
 
-def _init_block(res, st, *, sensor, W, fdtype, fit, f32_ok):
+def _init_block(res, st, *, sensor, W, fdtype, fit, f32_ok, guards=False):
     """One chip's INIT-phase round work: initialization-window search, the
     Tmask IRLS screen, and the stability test.  Runs under a scalar
     lax.cond — on rounds where no pixel is initializing (most of them:
     after round 1 the only INIT pixels are post-break restarts) the whole
     block, including its one-hot window tensors (the loop's dominant HBM
     term), is skipped outright.  Every output is consumed downstream only
-    under in_init-derived masks, so the skip branch's zeros are inert."""
+    under in_init-derived masks, so the skip branch's zeros are inert.
+    ``guards`` (compaction mode) threads the in_init lane set into the
+    Pallas kernels as a per-block skip guard — dense-prefix compaction
+    clusters DONE lanes into whole trailing blocks, which then cost a
+    predicate instead of the window search + IRLS."""
     _DET = list(sensor.detection_bands)
     _TMB = list(sensor.tmask_bands)
     X, Xt, t = res["X"], res["Xt"], res["t"]
     alive = st["alive"]
     in_init = st["phase"] == PHASE_INIT
+    act = in_init if guards else None
 
     if use_pallas("init") and f32_ok:
         # f32_ok: the shared Mosaic gate from _detect_batch_impl
@@ -742,7 +790,8 @@ def _init_block(res, st, *, sensor, W, fdtype, fit, f32_ok):
 
         return pallas_ops.init_window(
             alive, st["cur_i"], in_init, t, X, Xt, res["Yt"],
-            res["vario"], W=W, sensor=sensor, interpret=not on_tpu)
+            res["vario"], W=W, sensor=sensor, active=act,
+            interpret=not on_tpu)
 
     Y = res["Y"]
     P, B, T = Y.shape
@@ -794,7 +843,7 @@ def _init_block(res, st, *, sensor, W, fdtype, fit, f32_ok):
         on_tpu = jax.default_backend() == "tpu"
         from firebird_tpu.ccd import pallas_ops
 
-        tmask_fn = functools.partial(pallas_ops.tmask_bad,
+        tmask_fn = functools.partial(pallas_ops.tmask_bad, active=act,
                                      interpret=not on_tpu)
     bad_w = tmask_fn(Xt_w, Y2w, valid_w.astype(fdtype),
                      res["vario"][:, _TMB])
@@ -808,7 +857,7 @@ def _init_block(res, st, *, sensor, W, fdtype, fit, f32_ok):
     w_stab = w_init & ~tm_removed[:, None]
     cm4 = jnp.arange(params.MAX_COEFS)[None, :] < 4
     cm4 = jnp.broadcast_to(cm4, (P, params.MAX_COEFS))
-    c4 = fit(res, w_stab.astype(fdtype), cm4, False)
+    c4 = fit(res, w_stab.astype(fdtype), cm4, False, active=act)
     r_w = Yw7 - jnp.sum(c4[:, :, None, :] * Xw8[:, None, :, :], -1)
     stab_w = valid_w & ~bad_w
     n4 = jnp.maximum(jnp.sum(stab_w, -1), 1.0)
@@ -855,16 +904,19 @@ def _init_zeros(st):
                 n_ok=zi, alive_init=st["alive"])
 
 
-def _mon_block(res, st, *, sensor, change_thr, outlier_thr, f32_ok):
+def _mon_block(res, st, *, sensor, change_thr, outlier_thr, f32_ok,
+               guards=False):
     """One chip's MONITOR-phase round work: score all remaining
     observations against the current model and locate the first event
     (break / refit / tail) in rank space.  Runs under a scalar lax.cond
     (skipped on round 1, when every standard pixel is still
-    initializing)."""
+    initializing).  ``guards`` threads the in_mon lane set into the
+    Pallas kernels as a per-block skip guard (see _init_block)."""
     _DET = list(sensor.detection_bands)
     X = res["X"]
     alive, included = st["alive"], st["included"]
     in_mon = st["phase"] == PHASE_MONITOR
+    act = in_mon if guards else None
 
     # All event logic runs in rank space on the absolute time axis:
     # rank[p, t] = index of observation t in pixel p's compacted alive
@@ -888,7 +940,7 @@ def _mon_block(res, st, *, sensor, change_thr, outlier_thr, f32_ok):
             res["Yd"], st["coefs"][:, _DET, :], dden, res["X"], alive,
             included, st["cur_k"], st["n_last_fit"], in_mon,
             change_thr=change_thr, outlier_thr=outlier_thr,
-            interpret=not on_tpu)
+            active=act, interpret=not on_tpu)
     else:
         # HIGHEST is already the context default (_detect_batch_core);
         # pinned explicitly so the score matches the Pallas twin's full-f32
@@ -904,7 +956,7 @@ def _mon_block(res, st, *, sensor, change_thr, outlier_thr, f32_ok):
             from firebird_tpu.ccd import pallas_ops
 
             chain = functools.partial(pallas_ops.monitor_chain,
-                                      interpret=not on_tpu)
+                                      active=act, interpret=not on_tpu)
         mon = chain(s, alive, included, rank, st["cur_k"],
                     st["n_last_fit"], in_mon,
                     change_thr=change_thr, outlier_thr=outlier_thr)
@@ -939,7 +991,11 @@ def _close_block(res, st, mon, *, S, fdtype):
     the full result-buffer rewrite."""
     t, X = res["t"], res["X"]
     alive = st["alive"]
-    B, T, P = res["Yt"].shape
+    # Shapes from the always-present carries, not res["Yt"]: compaction
+    # mode carries only the residents the traced paths actually read, so
+    # the wire view may be absent here when the float view serves.
+    P, B, _K = st["coefs"].shape
+    T = X.shape[0]
     is_tail, is_brk = mon["is_tail"], mon["is_brk"]
     ev_rank, pos_ev, m = mon["ev_rank"], mon["pos_ev"], mon["m"]
     included_mon = mon["included_mon"]
@@ -996,9 +1052,107 @@ def _close_block(res, st, mon, *, S, fdtype):
                       st["rmse"], mag_new, st["coefs"], S=S)
 
 
+# ---------------------------------------------------------------------------
+# Active-lane compaction (docs/ROOFLINE.md "Occupancy"): the event loop's
+# cost tracks the ACTIVE pixel set, not the padded batch.
+# ---------------------------------------------------------------------------
+
+# The skip-guard accounting unit: a trailing lane block containing no
+# active lane costs a per-block predicate in the Pallas kernels instead
+# of its Gram/CD/monitor work.  Matches pallas_ops.BLOCK_P's scale (the
+# per-kernel widths are 128-512; 512 is the accounting width the
+# occupancy capture and flops.occupancy_detail use).
+COMPACT_LANE_BLOCK = 512
+
+# State-dict keys permuted along their leading pixel axis by a compaction
+# (the [C,P,...] loop carries).
+_COMPACT_PIXEL_KEYS = ("phase", "cur_i", "cur_k", "alive", "included",
+                       "coefs", "rmse", "n_last_fit", "first_seg", "nseg")
+# Carried residents whose pixel axis is NOT leading (wire layout [B,T,P]).
+_COMPACT_RESP_AXIS = {"Yt": 2, "Yd": 2}
+
+
+def _dense_prefix_perm(alive):
+    """Stable dense-prefix permutation from an alive mask [P]: returns
+    gather indices g (i32 [P]) with out[i] = in[g[i]], alive lanes first,
+    original order preserved within each class (cumsum-derived targets,
+    inverted by one scatter of iota)."""
+    P = alive.shape[0]
+    a32 = alive.astype(jnp.int32)
+    na = jnp.sum(a32)
+    tgt = jnp.where(alive, jnp.cumsum(a32) - 1,
+                    na + jnp.cumsum(1 - a32) - 1).astype(jnp.int32)
+    return jnp.zeros(P, jnp.int32).at[tgt].set(
+        jnp.arange(P, dtype=jnp.int32))
+
+
+def _take_pixels(a, g, axis=0):
+    """Lane gather along ``axis``.  Minor-axis residents ([B,T,P] wire
+    layouts) route through a leading-axis move so XLA lowers a major-axis
+    gather + copies instead of a serialized per-lane minor-axis gather
+    (the same TPU pathology the one-hot selections avoid)."""
+    if axis == 0:
+        return jnp.take(a, g, axis=0)
+    return jnp.moveaxis(jnp.take(jnp.moveaxis(a, axis, 0), g, axis=0),
+                        0, axis)
+
+
+def _compact_state(st):
+    """One compaction sweep: permute every per-pixel loop carry — state,
+    result buffers, carried residents, and the running permutation — so
+    lanes with phase != PHASE_DONE form a dense prefix per chip.  The
+    math is permutation-invariant per lane (everything in the round body
+    is elementwise over P or a per-lane reduce over T), so results are
+    bit-identical; ``perm`` carries current-position -> original-pixel
+    for the exit unpermute."""
+    def one(stc):
+        g = _dense_prefix_perm(stc["phase"] != PHASE_DONE)
+        out = {k: _take_pixels(stc[k], g) for k in _COMPACT_PIXEL_KEYS}
+        out["bufs"] = tuple(_take_pixels(b, g) for b in stc["bufs"])
+        out["resp"] = {k: _take_pixels(v, g, _COMPACT_RESP_AXIS.get(k, 0))
+                       for k, v in stc["resp"].items()}
+        out["perm"] = _take_pixels(stc["perm"], g)
+        return dict(stc, **out)
+
+    return jax.vmap(one)(st)
+
+
+def _unpermute(a, perm):
+    """Invert a carried permutation at loop exit: out[perm[p]] = a[p],
+    per chip (one scatter per output field, at most twice per dispatch)."""
+    return jax.vmap(lambda ac, pc: jnp.zeros_like(ac).at[pc].set(ac))(
+        a, perm)
+
+
+def _paid_lanes(phase, block_widths):
+    """Per-chip lanes the round pays for under the per-block skip
+    guards: COMPACT_LANE_BLOCK-wide blocks containing any active lane,
+    weighted by their real width ([C] i32).  ``block_widths`` is the
+    trace-time numpy width vector (last block may be ragged).  This is
+    the guard-accounting MODEL, identical on every backend: measured
+    compute where the Pallas guards run, predicted skips on the lax
+    fallback (whose slab cond computes every lane under vmap — see the
+    ChipSegments.occupancy note)."""
+    C, P = phase.shape
+    nb = block_widths.shape[0]
+    pad = nb * COMPACT_LANE_BLOCK - P
+    act = jnp.pad(phase != PHASE_DONE, ((0, 0), (0, pad)))
+    blk = jnp.any(act.reshape(C, nb, COMPACT_LANE_BLOCK), -1)
+    return jnp.sum(blk * jnp.asarray(block_widths, jnp.int32)[None, :],
+                   -1).astype(jnp.int32)
+
+
+def _block_widths(P: int) -> np.ndarray:
+    nb = -(-P // COMPACT_LANE_BLOCK)
+    w = np.full(nb, COMPACT_LANE_BLOCK, np.int32)
+    w[-1] = P - (nb - 1) * COMPACT_LANE_BLOCK
+    return w
+
+
 def _detect_batch_core(Xs, Xts, ts, valids, Ys, qas, *,
                        wcap: int | None = None, sensor=LANDSAT_ARD,
-                       max_segments: int = MAX_SEGMENTS, dtype=None):
+                       max_segments: int = MAX_SEGMENTS, dtype=None,
+                       compact: bool | None = None):
     """A chip batch: Xs [C,T,8], Xts [C,T,5], ts [C,T], valids [C,T],
     Ys [C,B,P,T] (wire int16 or float), qas [C,P,T] int32 → ChipSegments
     with [C, ...] leading axes.
@@ -1025,15 +1179,23 @@ def _detect_batch_core(Xs, Xts, ts, valids, Ys, qas, *,
     result-buffer capacity; n_segments counts every closed segment even
     past capacity, so a caller can detect overflow (n_segments >
     max_segments) and re-dispatch with a larger buffer — detect_packed
-    does this automatically."""
+    does this automatically.
+
+    ``compact`` (static) enables active-lane compaction (None defers to
+    FIREBIRD_COMPACT at trace time): the loop periodically permutes the
+    per-pixel state so working lanes form a dense prefix, threads
+    per-block skip guards into the Pallas kernels, and re-enters a
+    power-of-two bucket once the alive fraction falls below
+    FIREBIRD_COMPACT_FLOOR — row-identical results, cost tracking the
+    active set instead of the padded batch."""
     with jax.default_matmul_precision("highest"):
         return _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, wcap=wcap,
                                   sensor=sensor, max_segments=max_segments,
-                                  dtype=dtype)
+                                  dtype=dtype, compact=compact)
 
 
 def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
-                       max_segments, dtype):
+                       max_segments, dtype, compact=None):
     C, B, P, T = Ys.shape
     S = max_segments
     W = T if wcap is None else min(wcap, T)
@@ -1056,10 +1218,17 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
     fit_pallas = (use_pallas("fit") or mega) and f32_ok
     fit = functools.partial(_fit_chip, fit_pallas=fit_pallas, on_tpu=on_tpu)
     wire_only = (mega or _wire_resident_only()) and f32_ok
+    # Active-lane compaction (trace-time resolution, like use_pallas).
+    # The mega route already stops paying for finished pixels its own way
+    # (each VMEM block's while_loop exits when ITS pixels are done), so
+    # compaction applies to the XLA/per-component loop only.
+    compact_on = (params.compact_default() if compact is None
+                  else bool(compact)) and not mega
 
     res, state = jax.vmap(functools.partial(
         _prologue, sensor=sensor, S=S, fdtype=fdtype, fit=fit,
-        wire_only=wire_only))(Xs, Xts, ts, valids, Ys, qas)
+        wire_only=wire_only, guards=compact_on))(Xs, Xts, ts, valids, Ys,
+                                                 qas)
 
     if mega:
         # Whole-loop mega kernel: the entire event loop in one
@@ -1087,109 +1256,255 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
 
     initf = jax.vmap(functools.partial(
         _init_block, sensor=sensor, W=W, fdtype=fdtype, fit=fit,
-        f32_ok=f32_ok))
+        f32_ok=f32_ok, guards=compact_on))
     monf = jax.vmap(functools.partial(
         _mon_block, sensor=sensor, change_thr=change_thr,
-        outlier_thr=outlier_thr, f32_ok=f32_ok))
+        outlier_thr=outlier_thr, f32_ok=f32_ok, guards=compact_on))
     closef = jax.vmap(functools.partial(_close_block, S=S, fdtype=fdtype))
-    fitf = jax.vmap(lambda r, w, n: fit(r, w, _coefmask_for(n, P)))
+    if compact_on:
+        fitf = jax.vmap(lambda r, w, n, a: fit(r, w, _coefmask_for(n),
+                                               active=a))
+    else:
+        fitf = jax.vmap(lambda r, w, n: fit(r, w, _coefmask_for(n)))
 
     max_rounds = 2 * T + 8
 
+    # ---- compaction parameters (trace-time; params.compact_*) ----
+    every = params.compact_every()
+    floor = params.compact_floor() if compact_on else 0.0
+    bucket = 1 << max(int(max(P * floor, 1) - 1).bit_length(), 3) \
+        if floor > 0 else P
+    # The re-entry loop is a second traced copy of the round body: real
+    # lane savings at chip scale, pure compile cost for tiny batches.
+    cascade_on = (compact_on and 0 < bucket < P
+                  and P >= params.compact_min_lanes())
+
+    # In-loop per-pixel residents: compaction must permute the spectra
+    # views the traced block paths actually read alongside the state, so
+    # they move into the while_loop carry (originals die after carry
+    # init; the compaction sweep permutes the carried copies).  Keys
+    # mirror the blocks' trace-time routing exactly — a path that would
+    # read an uncarried resident fails loudly at trace (KeyError), never
+    # silently reads the unpermuted original.
+    score_pallas = use_pallas("score") and f32_ok
+    init_pallas = use_pallas("init") and f32_ok
+    resp_keys = ["vario"]
+    if "Y" in res:
+        resp_keys.append("Y")
+    if fit_pallas or init_pallas or "Y" not in res:
+        resp_keys.append("Yt")
+    if score_pallas:
+        resp_keys.append("Yd")
+    res_shared = {k: res[k] for k in ("X", "Xt", "t", "XX")}
+
+    if compact_on:
+        state = dict(state,
+                     resp={k: res[k] for k in resp_keys},
+                     perm=jnp.tile(jnp.arange(P, dtype=jnp.int32)[None],
+                                   (C, 1)),
+                     # Baseline for the "enough lanes died" trigger: full
+                     # width, so never-alive lanes (snow/insufficient/
+                     # no-data pixels, DONE from round 0) count toward
+                     # the first periodic compaction.
+                     base_alive=jnp.full((C,), P, jnp.int32))
+
+    def _loop_res(st):
+        return dict(res_shared, **st["resp"]) if compact_on else res
+
     def cond(carry):
-        st, rounds, _ = carry
-        return (rounds < max_rounds) & jnp.any(st["phase"] != PHASE_DONE)
+        st, rounds, _, _, _, tail = carry
+        return ((rounds < max_rounds)
+                & jnp.any(st["phase"] != PHASE_DONE) & ~tail)
 
-    def body(carry):
-        st, rounds, counts = carry
-        phase = st["phase"]
-        in_init = phase == PHASE_INIT
-        in_mon = phase == PHASE_MONITOR
+    def _make_body(allow_cascade_exit):
+        def body(carry):
+            st, rounds, counts, occ, ncomp, tail = carry
+            res_l = _loop_res(st)
+            phase = st["phase"]
+            in_init = phase == PHASE_INIT
+            in_mon = phase == PHASE_MONITOR
 
-        any_init = jnp.any(in_init)
-        init = lax.cond(any_init,
-                        lambda: initf(res, st), lambda: _init_zeros(st))
-        mon = lax.cond(jnp.any(in_mon),
-                       lambda: monf(res, st), lambda: _mon_zeros(st))
+            # Occupancy capture: lanes entering the round still working,
+            # and lanes the guarded kernels pay for (whole blocks with
+            # any active lane; the full width when compaction is off).
+            Pc = phase.shape[1]
+            active_c = jnp.sum(phase != PHASE_DONE, -1).astype(jnp.int32)
+            paid_c = _paid_lanes(phase, _block_widths(Pc)) if compact_on \
+                else jnp.full_like(active_c, Pc)
+            occ = lax.dynamic_update_slice(
+                occ, jnp.stack([active_c, paid_c], -1)[None],
+                (rounds, jnp.zeros((), rounds.dtype),
+                 jnp.zeros((), rounds.dtype)))
 
-        close = mon["is_tail"] | mon["is_brk"]
-        any_close = jnp.any(close)
-        bufs, nseg = lax.cond(any_close,
-                              lambda: closef(res, st, mon),
-                              lambda: (st["bufs"], st["nseg"]))
+            any_init = jnp.any(in_init)
+            init = lax.cond(any_init,
+                            lambda: initf(res_l, st),
+                            lambda: _init_zeros(st))
+            mon = lax.cond(jnp.any(in_mon),
+                           lambda: monf(res_l, st), lambda: _mon_zeros(st))
 
-        # Refit / init-ok shared fit (skipped when no pixel needs one).
-        init_ok, is_refit = init["init_ok"], mon["is_refit"]
-        do_fit = init_ok | is_refit
-        any_fit = jnp.any(do_fit)
-        n_full = jnp.where(init_ok, init["n_ok"], mon["n_rf"])
+            close = mon["is_tail"] | mon["is_brk"]
+            any_close = jnp.any(close)
+            bufs, nseg = lax.cond(any_close,
+                                  lambda: closef(res_l, st, mon),
+                                  lambda: (st["bufs"], st["nseg"]))
 
-        def _run_fit():
-            # The [C,P,T] fit-window build lives inside the branch so a
-            # no-fit round materializes nothing.
-            w_full = jnp.where(init_ok[..., None], init["w_stab"],
-                               mon["included_mon"] & is_refit[..., None])
-            return fitf(res, w_full.astype(fdtype), n_full)
+            # Refit / init-ok shared fit (skipped when no pixel needs one).
+            init_ok, is_refit = init["init_ok"], mon["is_refit"]
+            do_fit = init_ok | is_refit
+            any_fit = jnp.any(do_fit)
+            n_full = jnp.where(init_ok, init["n_ok"], mon["n_rf"])
 
-        cfull, rfull = lax.cond(any_fit, _run_fit,
-                                lambda: (st["coefs"], st["rmse"]))
+            def _run_fit():
+                # The [C,P,T] fit-window build lives inside the branch so
+                # a no-fit round materializes nothing.
+                w_full = jnp.where(init_ok[..., None], init["w_stab"],
+                                   mon["included_mon"]
+                                   & is_refit[..., None])
+                if compact_on:
+                    return fitf(res_l, w_full.astype(fdtype), n_full,
+                                do_fit)
+                return fitf(res_l, w_full.astype(fdtype), n_full)
 
-        # ================= next state (batched elementwise) =============
-        is_tail, is_brk = mon["is_tail"], mon["is_brk"]
-        phase_n = jnp.where(
-            init["init_nowin"] | (init["init_bad"] & ~init["has_adv"]),
-            PHASE_DONE,
-            jnp.where(init_ok, PHASE_MONITOR,
-                      jnp.where(is_tail, PHASE_DONE,
-                                jnp.where(is_brk, PHASE_INIT, phase))))
-        cur_i_n = jnp.where(
-            init["init_tm"], init["i_next_tm"],
-            jnp.where(init["init_bad"] & init["has_adv"], init["i_adv"],
-                      jnp.where(is_brk, mon["pos_ev"], st["cur_i"])))
-        cur_k_n = jnp.where(init_ok, init["j"] + 1,
-                            jnp.where(is_refit, mon["pos_ev"] + 1,
-                                      st["cur_k"]))
-        alive_n = jnp.where(in_init[..., None], init["alive_init"],
-                            jnp.where(in_mon[..., None], mon["alive_mon"],
-                                      st["alive"]))
-        included_n = jnp.where(
-            init_ok[..., None], init["w_stab"],
-            jnp.where(is_brk[..., None], False,
-                      jnp.where(in_mon[..., None], mon["included_mon"],
-                                st["included"])))
-        coefs_n = jnp.where(do_fit[..., None, None], cfull, st["coefs"])
-        rmse_n = jnp.where(do_fit[..., None], rfull, st["rmse"])
-        nlast_n = jnp.where(do_fit, n_full.astype(jnp.int32),
-                            st["n_last_fit"])
-        first_n = st["first_seg"] & ~is_brk
+            cfull, rfull = lax.cond(any_fit, _run_fit,
+                                    lambda: (st["coefs"], st["rmse"]))
 
-        st_n = dict(phase=phase_n.astype(jnp.int32),
-                    cur_i=cur_i_n.astype(jnp.int32),
-                    cur_k=cur_k_n.astype(jnp.int32),
-                    alive=alive_n, included=included_n,
-                    coefs=coefs_n, rmse=rmse_n, n_last_fit=nlast_n,
-                    first_seg=first_n, nseg=nseg, bufs=bufs)
-        counts_n = counts + jnp.stack(
-            [any_init, any_fit, any_close]).astype(jnp.int32)
-        return (st_n, rounds + 1, counts_n)
+            # ============== next state (batched elementwise) ============
+            is_tail, is_brk = mon["is_tail"], mon["is_brk"]
+            phase_n = jnp.where(
+                init["init_nowin"] | (init["init_bad"] & ~init["has_adv"]),
+                PHASE_DONE,
+                jnp.where(init_ok, PHASE_MONITOR,
+                          jnp.where(is_tail, PHASE_DONE,
+                                    jnp.where(is_brk, PHASE_INIT, phase))))
+            cur_i_n = jnp.where(
+                init["init_tm"], init["i_next_tm"],
+                jnp.where(init["init_bad"] & init["has_adv"],
+                          init["i_adv"],
+                          jnp.where(is_brk, mon["pos_ev"], st["cur_i"])))
+            cur_k_n = jnp.where(init_ok, init["j"] + 1,
+                                jnp.where(is_refit, mon["pos_ev"] + 1,
+                                          st["cur_k"]))
+            alive_n = jnp.where(in_init[..., None], init["alive_init"],
+                                jnp.where(in_mon[..., None],
+                                          mon["alive_mon"], st["alive"]))
+            included_n = jnp.where(
+                init_ok[..., None], init["w_stab"],
+                jnp.where(is_brk[..., None], False,
+                          jnp.where(in_mon[..., None], mon["included_mon"],
+                                    st["included"])))
+            coefs_n = jnp.where(do_fit[..., None, None], cfull,
+                                st["coefs"])
+            rmse_n = jnp.where(do_fit[..., None], rfull, st["rmse"])
+            nlast_n = jnp.where(do_fit, n_full.astype(jnp.int32),
+                                st["n_last_fit"])
+            first_n = st["first_seg"] & ~is_brk
 
-    state, rounds, counts = lax.while_loop(
-        cond, body, (state, jnp.zeros((), jnp.int32),
-                     jnp.zeros((3,), jnp.int32)))
+            st_n = dict(st, phase=phase_n.astype(jnp.int32),
+                        cur_i=cur_i_n.astype(jnp.int32),
+                        cur_k=cur_k_n.astype(jnp.int32),
+                        alive=alive_n, included=included_n,
+                        coefs=coefs_n, rmse=rmse_n, n_last_fit=nlast_n,
+                        first_seg=first_n, nseg=nseg, bufs=bufs)
+            counts_n = counts + jnp.stack(
+                [any_init, any_fit, any_close]).astype(jnp.int32)
 
-    meta_b, rmse_b, mag_b, coef_b = state["bufs"]
-    final_mask = jnp.where(res["is_std"][..., None], state["alive"],
+            if compact_on:
+                # ---- dense-prefix compaction ----
+                n_alive = jnp.sum(st_n["phase"] != PHASE_DONE,
+                                  -1).astype(jnp.int32)          # [C]
+                dead_since = st_n["base_alive"] - n_alive
+                # Slack from the CURRENT lane width: inside the stage-2
+                # bucket the "1/16 of lanes died" cadence must mean 1/16
+                # of the bucket, or the tail never re-compacts.
+                periodic = (((rounds + 1) % every) == 0) \
+                    & (jnp.max(dead_since) >= max(Pc // 16, 1))
+                if allow_cascade_exit:
+                    # Forced compaction on the bucket-entry transition:
+                    # survivors must sit in the prefix before the loop
+                    # exits and stage 2 slices it.
+                    ready = jnp.max(n_alive) <= bucket
+                else:
+                    ready = jnp.zeros((), bool)
+                do_c = periodic | (ready & ~tail)
+                st_n = lax.cond(do_c, _compact_state, lambda s: s, st_n)
+                st_n = dict(st_n, base_alive=jnp.where(
+                    do_c, n_alive, st_n["base_alive"]))
+                ncomp = ncomp + do_c.astype(jnp.int32)
+                tail = tail | ready
+            return (st_n, rounds + 1, counts_n, occ, ncomp, tail)
+
+        return body
+
+    carry0 = (state, jnp.zeros((), jnp.int32), jnp.zeros((3,), jnp.int32),
+              jnp.zeros((max_rounds, C, 2), jnp.int32),
+              jnp.zeros((), jnp.int32), jnp.zeros((), bool))
+    state, rounds, counts, occ, ncomp, tail = lax.while_loop(
+        cond, _make_body(cascade_on), carry0)
+
+    if cascade_on:
+        # ---- stage 2: bucketed re-entry for the long tail ----
+        # The exit compaction put every still-working lane in the dense
+        # prefix, so the "gather survivors" is a static slice [:, :bucket]
+        # of each carried array; the same loop body re-traces at the
+        # bucket shape and finishes them; one static slice-assign merges
+        # the results back.  All inside the jitted program — no host
+        # round-trip, no extra compile shapes for the warm-start cache to
+        # predict (a stage-2 that never runs costs zero rounds).
+        def _slice_p(a, axis=1):
+            idx = [slice(None)] * a.ndim
+            idx[axis] = slice(0, bucket)
+            return a[tuple(idx)]
+
+        st2 = {k: _slice_p(state[k]) for k in _COMPACT_PIXEL_KEYS}
+        st2["bufs"] = tuple(_slice_p(b) for b in state["bufs"])
+        st2["resp"] = {
+            k: _slice_p(v, 1 + _COMPACT_RESP_AXIS.get(k, 0))
+            for k, v in state["resp"].items()}
+        st2["perm"] = _slice_p(state["perm"])
+        st2["base_alive"] = jnp.sum(st2["phase"] != PHASE_DONE,
+                                    -1).astype(jnp.int32)
+        carry2 = (st2, rounds, counts, occ, ncomp, jnp.zeros((), bool))
+        st2, rounds, counts, occ, ncomp, _ = lax.while_loop(
+            cond, _make_body(False), carry2)
+        merge = lambda full, part: full.at[:, :bucket].set(part)
+        state = dict(state,
+                     nseg=merge(state["nseg"], st2["nseg"]),
+                     alive=merge(state["alive"], st2["alive"]),
+                     bufs=tuple(merge(f, p) for f, p in
+                                zip(state["bufs"], st2["bufs"])),
+                     perm=merge(state["perm"], st2["perm"]))
+
+    nseg, bufs, alive = state["nseg"], state["bufs"], state["alive"]
+    if compact_on:
+        # Land every per-pixel output back in original pixel order (the
+        # carried permutation's inverse, one scatter per field).
+        perm = state["perm"]
+        nseg = _unpermute(nseg, perm)
+        alive = _unpermute(alive, perm)
+        bufs = tuple(_unpermute(b, perm) for b in bufs)
+
+    meta_b, rmse_b, mag_b, coef_b = bufs
+    final_mask = jnp.where(res["is_std"][..., None], alive,
                            jnp.where(res["is_alt"][..., None],
                                      res["alt_mask"], False))
     return ChipSegments(
-        n_segments=state["nseg"],
+        n_segments=nseg,
         seg_meta=meta_b.reshape(C, P, S, 6),
         seg_rmse=rmse_b.reshape(C, P, S, B),
         seg_mag=mag_b.reshape(C, P, S, B),
         seg_coef=coef_b.reshape(C, P, S, B, params.MAX_COEFS),
         mask=final_mask, procedure=res["procedure"],
         rounds=jnp.broadcast_to(rounds, (C,)), vario=res["vario"],
-        round_counts=jnp.broadcast_to(counts, (C, 3)))
+        round_counts=jnp.broadcast_to(counts, (C, 3)),
+        occupancy=jnp.transpose(occ, (1, 0, 2)),
+        # The count lands on the loop's FIRST chip row only (zeros
+        # elsewhere): under shard_map each shard runs its own loop over
+        # its chip slice, so a per-chip broadcast would make any host
+        # aggregation wrong (sum overcounts by chips-per-shard, max
+        # drops all but the busiest shard) — one nonzero per loop makes
+        # the chip-sum THE batch total (record_occupancy).
+        compactions=jnp.where(jnp.arange(C) == 0, ncomp, 0))
 
 
 # ---------------------------------------------------------------------------
@@ -1198,18 +1513,20 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
 
 def _detect_batch_wire(Xs, Xts, t, valid, Y_i16, qa_u16, *, dtype,
                        wcap=None, sensor=LANDSAT_ARD,
-                       max_segments=MAX_SEGMENTS):
+                       max_segments=MAX_SEGMENTS, compact=None):
     """Batch detect from wire dtypes: spectra/QA arrive as int16/uint16 and
     widen on device — halves host->device transfer vs shipping float32, and
     the core keeps a wire-dtype resident copy so the Pallas fit path reads
-    int16 from HBM (docs/ROOFLINE.md item 1)."""
+    int16 from HBM (docs/ROOFLINE.md item 1).  ``compact`` (static) is
+    the active-lane-compaction override (None = FIREBIRD_COMPACT at
+    trace time)."""
     return _detect_batch_core(Xs, Xts, t, valid, Y_i16,
                               qa_u16.astype(jnp.int32), wcap=wcap,
                               sensor=sensor, max_segments=max_segments,
-                              dtype=dtype)
+                              dtype=dtype, compact=compact)
 
 
-_WIRE_STATICS = ("dtype", "wcap", "sensor", "max_segments")
+_WIRE_STATICS = ("dtype", "wcap", "sensor", "max_segments", "compact")
 # Donating twin for the driver's staged steady-state dispatch: the packed
 # wire buffers (spectra + QA, the dominant HBM input term) are consumed by
 # the dispatch, so a deeper pipeline (Config.pipeline_depth) doesn't pin
@@ -1358,6 +1675,55 @@ def record_first_call(key: tuple, fn):
     return out
 
 
+# Histogram buckets for kernel_round_active_fraction (a 0..1 fraction,
+# not a latency; sixteenths resolve the tail the compaction targets).
+FRACTION_BUCKETS = tuple(i / 16 for i in range(1, 17))
+
+
+def record_occupancy(seg) -> dict | None:
+    """Feed the event loop's occupancy capture into the obs registry.
+
+    ``seg`` is a host-fetched ChipSegments (driver.core.drain_batch calls
+    this after its bulk fetch; bench.py after its timed run).  Per
+    executed round and chip, ``kernel_round_active_fraction`` observes
+    active/padded lanes; the counters accumulate active / wasted
+    (paid - active) lane-rounds and compactions — the padded-vs-effective
+    accounting flops.occupancy_detail turns into the bench artifact.
+    Returns the summary dict, or None when the dispatch carried no
+    occupancy capture (mega route, pre-compaction artifacts)."""
+    occ = getattr(seg, "occupancy", None)
+    if occ is None:
+        return None
+    from firebird_tpu.ccd import flops
+    from firebird_tpu.obs import metrics as obs_metrics
+
+    det = flops.occupancy_detail(
+        np.asarray(occ), np.asarray(seg.rounds),
+        int(seg.mask.shape[-2]))
+    hist = obs_metrics.histogram("kernel_round_active_fraction",
+                                 buckets=FRACTION_BUCKETS,
+                                 help="active-lane fraction per event-loop "
+                                      "round per chip")
+    hist.observe_many(det.pop("_fractions"))
+    obs_metrics.counter(
+        "kernel_active_lane_rounds",
+        help="lane-rounds with a working pixel").inc(
+        det["active_lane_rounds"])
+    obs_metrics.counter(
+        "kernel_wasted_lane_rounds",
+        help="paid lane-rounds with no working pixel "
+             "(effective - active)").inc(det["wasted_lane_rounds"])
+    comp = getattr(seg, "compactions", None)
+    if comp is not None:
+        # Per-loop counts land on each loop's first chip row (zeros
+        # elsewhere), so the chip-sum is the batch total across shards.
+        obs_metrics.counter(
+            "kernel_compactions",
+            help="dense-prefix lane compactions").inc(
+            int(np.asarray(comp).sum()))
+    return det
+
+
 def capacity_bound(packed) -> int:
     """An upper bound on segments any pixel of the batch can close:
     closed segments have disjoint included-observation sets of at least
@@ -1405,22 +1771,28 @@ def stage_packed(packed, dtype) -> tuple:
 
 
 def aot_compile(avatars, *, dtype, wcap, sensor=LANDSAT_ARD,
-                max_segments: int = MAX_SEGMENTS, donate: bool = False):
+                max_segments: int = MAX_SEGMENTS, donate: bool = False,
+                compact: bool | None = None):
     """AOT lower+compile the wire-dtype batch program for a shape WITHOUT
     running it (``avatars`` are jax.ShapeDtypeStructs in the
     ``_detect_batch_wire`` argument order).  With the persistent
     compilation cache on, the serialized executable is what the first
     real dispatch of the same shape deserializes instead of compiling —
-    the driver's background warm start (driver.core.warm_start)."""
+    the driver's background warm start (driver.core.warm_start).
+    ``compact`` must match what the real dispatch will pass (the drivers
+    pass cfg.compact both here and at dispatch) or the warm entry misses
+    the jit cache."""
     fn = _detect_batch_wire_donated if donate else _detect_batch_wire
     return fn.lower(*avatars, dtype=jnp.dtype(dtype), wcap=wcap,
-                    sensor=sensor, max_segments=max_segments).compile()
+                    sensor=sensor, max_segments=max_segments,
+                    compact=compact).compile()
 
 
 def detect_packed(packed, dtype=jnp.float32,
                   max_segments: int = MAX_SEGMENTS,
                   check_capacity: bool = True, staged: tuple | None = None,
-                  donate: bool = False) -> ChipSegments:
+                  donate: bool = False,
+                  compact: bool | None = None) -> ChipSegments:
     """Run the kernel over a PackedChips batch -> ChipSegments with leading
     chip axis [C, P, ...].  The batch's sensor spec selects the band
     layout the kernel compiles for.
@@ -1438,17 +1810,19 @@ def detect_packed(packed, dtype=jnp.float32,
     ``staged`` takes pre-staged device args from :func:`stage_packed`
     instead of transferring here; ``donate=True`` (honored only with
     ``check_capacity=False`` — a retry would re-dispatch deleted buffers)
-    frees the wire input buffers at dispatch.
+    frees the wire input buffers at dispatch.  ``compact`` overrides the
+    FIREBIRD_COMPACT default (params.compact_default) per call.
     """
     ensure_x64(dtype)
     args = staged if staged is not None else stage_packed(packed, dtype)
     kw = dict(dtype=jnp.dtype(dtype), wcap=window_cap(packed),
-              sensor=getattr(packed, "sensor", LANDSAT_ARD))
+              sensor=getattr(packed, "sensor", LANDSAT_ARD),
+              compact=compact)
     fn = _detect_batch_wire_donated if donate and not check_capacity \
         else _detect_batch_wire
     dispatch = lambda S: record_first_call(
         ("single", packed.spectra.shape, str(kw["dtype"]), kw["wcap"],
-         kw["sensor"].name, S),
+         kw["sensor"].name, S, compact),
         lambda: fn(*args, max_segments=S, **kw))
     if not check_capacity:
         return dispatch(max(max_segments, 1))

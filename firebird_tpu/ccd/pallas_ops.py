@@ -50,12 +50,60 @@ from firebird_tpu.ccd import params
 BLOCK_P = 512   # pixels per grid step (4 x 128 lanes, f32)
 
 
-def _cd_block(G_ref, c_ref, diag_ref, mask_ref, out_ref, *, iters, alpha,
-              n_coefs):
+# ---------------------------------------------------------------------------
+# Per-block skip guards (active-lane compaction).  Every kernel here
+# grids over pixel-lane blocks; with the event loop's dense-prefix
+# compaction on (FIREBIRD_COMPACT, kernel._detect_batch_impl), dead
+# lanes cluster into whole trailing blocks — so each wrapper accepts an
+# optional ``active`` [P] lane mask, reduces it to a per-block count,
+# and the block body runs under ``pl.when(count > 0)``: an all-dead
+# block costs a predicate plus a zero-fill of its outputs (exactly the
+# values the dead lanes would compute — all-zero windows / in_mon=False
+# produce zeros through the real math, so the guard is bit-identical).
+# ``active=None`` (the default, and every pre-compaction call site)
+# traces the unguarded program unchanged.
+# ---------------------------------------------------------------------------
+
+def _block_counts(active, BP: int, Pp: int):
+    """[1, Pp//BP] i32 per-block active-lane counts (prefix sums over the
+    compacted alive mask, differenced per block — computed as one padded
+    reshape-reduce)."""
+    a = jnp.pad(jnp.asarray(active).astype(jnp.int32),
+                (0, Pp - active.shape[0]))
+    return jnp.sum(a.reshape(Pp // BP, BP), -1)[None]
+
+
+_CNT_SPEC = pl.BlockSpec((1, 1), lambda i: (0, i))
+
+
+def _when_active(cnt_ref, compute, zero):
+    """Run ``compute`` when the block has any active lane, else ``zero``
+    (the cheap output fill).  ``cnt_ref is None`` means unguarded."""
+    if cnt_ref is None:
+        compute()
+        return
+
+    @pl.when(cnt_ref[0, 0] > 0)
+    def _():
+        compute()
+
+    @pl.when(cnt_ref[0, 0] == 0)
+    def _():
+        zero()
+
+
+def _zero_refs(*refs):
+    for r in refs:
+        r[...] = jnp.zeros(r.shape, r.dtype)
+
+
+def _cd_block(G_ref, c_ref, diag_ref, mask_ref, *refs, iters, alpha,
+              n_coefs, guarded=False):
     """One pixel block: full CD loop in VMEM.
 
     G [K,K,Pb], c [B,K,Pb], diag [K,Pb], mask [K,Pb] (0/1) -> b [B,K,Pb].
     """
+    cnt_ref, out_ref = (refs if guarded else (None,) + refs)
     G = G_ref[...]
     c = c_ref[...]
     diag = diag_ref[...]
@@ -80,12 +128,17 @@ def _cd_block(G_ref, c_ref, diag_ref, mask_ref, out_ref, *, iters, alpha,
             b = jnp.where(sel, bj[:, None, :], b)
         return b
 
-    out_ref[...] = lax.fori_loop(0, iters, one_iter, jnp.zeros_like(c))
+    def compute():
+        out_ref[...] = lax.fori_loop(0, iters, one_iter, jnp.zeros_like(c))
+
+    # A dead block's lanes all carry zero-weight systems (c == 0), whose
+    # CD output is exactly zero — the fill matches the computed values.
+    _when_active(cnt_ref, compute, lambda: _zero_refs(out_ref))
 
 
 @functools.partial(jax.jit, static_argnames=("iters", "interpret"))
 def lasso_cd(G, c, diag, coefmask, *, iters=params.LASSO_ITERS,
-             interpret=False):
+             active=None, interpret=False):
     """Pallas port of kernel's CD loop (bit-compatible update order).
 
     Args:
@@ -93,6 +146,8 @@ def lasso_cd(G, c, diag, coefmask, *, iters=params.LASSO_ITERS,
         c: [P, B, K] normalized X^T y per band.
         diag: [P, K] Gram diagonals (pre-floored).
         coefmask: [P, K] allowed coefficients (bool or 0/1).
+        active: optional [P] bool skip guard — lanes outside it must
+            carry zero-weight systems (see module note).
     Returns:
         b [P, B, K], identical (up to float assoc.) to the lax fori_loop
         version in kernel._fit_lasso_coefs.
@@ -109,21 +164,27 @@ def lasso_cd(G, c, diag, coefmask, *, iters=params.LASSO_ITERS,
     dg = jnp.pad(diag.T, ((0, 0), (0, pad)), constant_values=1.0)
     mk = jnp.pad(coefmask.T.astype(dt), ((0, 0), (0, pad)))
 
+    args = [Gt, ct, dg, mk]
+    in_specs = [
+        pl.BlockSpec((K, K, BLOCK_P), lambda i: (0, 0, i)),
+        pl.BlockSpec((B, K, BLOCK_P), lambda i: (0, 0, i)),
+        pl.BlockSpec((K, BLOCK_P), lambda i: (0, i)),
+        pl.BlockSpec((K, BLOCK_P), lambda i: (0, i)),
+    ]
+    if active is not None:
+        args.append(_block_counts(active, BLOCK_P, Pp))
+        in_specs.append(_CNT_SPEC)
     kern = functools.partial(_cd_block, iters=iters,
-                             alpha=float(params.LASSO_ALPHA), n_coefs=K)
+                             alpha=float(params.LASSO_ALPHA), n_coefs=K,
+                             guarded=active is not None)
     bt = pl.pallas_call(
         kern,
         grid=(Pp // BLOCK_P,),
-        in_specs=[
-            pl.BlockSpec((K, K, BLOCK_P), lambda i: (0, 0, i)),
-            pl.BlockSpec((B, K, BLOCK_P), lambda i: (0, 0, i)),
-            pl.BlockSpec((K, BLOCK_P), lambda i: (0, i)),
-            pl.BlockSpec((K, BLOCK_P), lambda i: (0, i)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((B, K, BLOCK_P), lambda i: (0, 0, i)),
         out_shape=jax.ShapeDtypeStruct((B, K, Pp), dt),
         interpret=interpret,
-    )(Gt, ct, dg, mk)
+    )(*args)
     return bt[:, :, :P].transpose(2, 0, 1)
 
 
@@ -164,16 +225,18 @@ def _gram_cd_core(XT, XXT, y_of, wb, mask, *, B, K, iters, alpha):
     cs = [jnp.dot(XT, y_of(bb) * wb, preferred_element_type=f32) / n
           for bb in range(B)]                                 # B x [K, BP]
 
-    # Mosaic legality (real-v5e remote compiler, r5): when this core is
-    # inlined into the INIT/mega programs, any 3D [B,K,BP] op whose
-    # lowering touches the tiled sublane (K) axis — vector.extract
+    # Mosaic legality (real-v5e remote compiler, r5): any 3D [B,K,BP] op
+    # whose lowering touches the tiled sublane (K) axis — vector.extract
     # c[:, j], one-hot selects over K, and axis-1 reductions — dies in
-    # ApplyVectorLayoutPass ("Check failed: limits[i] <= dim(i)"; the
-    # standalone fit program happened to survive the same graph).  So
-    # the CD state lives as a python list of K 2D [B,BP] column planes:
-    # the Gauss-Seidel update reads rows via strided slices, the
-    # column write is a free trace-time list rebind, and the iteration
-    # loop is python-unrolled (no scf.for region for the pass to walk).
+    # ApplyVectorLayoutPass ("Check failed: limits[i] <= dim(i)").  This
+    # core is shared by EVERY fit call site — the INIT-window kernel and
+    # the mega block inline it, and the fit component's _fit_block wraps
+    # it — so the 2D-column-plane discipline below is the contract for
+    # all of them, not an inlining workaround.  The CD state lives as a
+    # python list of K 2D [B,BP] column planes: the Gauss-Seidel update
+    # reads rows via strided slices, the column write is a free
+    # trace-time list rebind, and the iteration loop is python-unrolled
+    # (no scf.for region for the pass to walk).
     c_cols = [jnp.concatenate([cs[bb][j:j + 1] for bb in range(B)], 0)
               for j in range(K)]                              # K x [B, BP]
     G_rows = [[G[j * K + k:j * K + k + 1] for k in range(K)]
@@ -197,8 +260,8 @@ def _gram_cd_core(XT, XXT, y_of, wb, mask, *, B, K, iters, alpha):
     return beta, n
 
 
-def _fit_block(x_ref, xt_ref, xxt_ref, y_ref, w_ref, mask_ref, b_ref, r_ref,
-               *, B, K, iters, alpha, with_rmse):
+def _fit_block(x_ref, xt_ref, xxt_ref, y_ref, w_ref, mask_ref, *refs,
+               B, K, iters, alpha, with_rmse, guarded=False):
     """One pixel block: Gram/corr builds, the full CD loop, and the
     weighted-window RMSE, all in VMEM.
 
@@ -210,29 +273,38 @@ def _fit_block(x_ref, xt_ref, xxt_ref, y_ref, w_ref, mask_ref, b_ref, r_ref,
     window count before the CD loop, same update order, intercept
     unpenalized, rmse over the same weighted window.
     """
-    X = x_ref[...]
-    wb = w_ref[...]                                           # [T, BP]
-    f32 = wb.dtype
-    y_of = lambda bb: y_ref[bb].astype(f32)
-    beta, n = _gram_cd_core(xt_ref[...], xxt_ref[...], y_of, wb,
-                            mask_ref[...], B=B, K=K, iters=iters,
-                            alpha=alpha)
-    b_ref[...] = beta
+    cnt_ref, b_ref, r_ref = (refs if guarded else (None,) + refs)
 
-    if with_rmse:
-        rs = []
-        for bb in range(B):
-            pred = jnp.dot(X, beta[bb], preferred_element_type=f32)
-            r = y_of(bb) - pred
-            rs.append(jnp.sqrt(jnp.maximum(
-                jnp.sum(r * r * wb, 0, keepdims=True) / n, 0.0)))
-        r_ref[...] = jnp.concatenate(rs, 0)                   # [B, BP]
-    else:
-        r_ref[...] = jnp.zeros_like(r_ref)
+    def compute():
+        X = x_ref[...]
+        wb = w_ref[...]                                       # [T, BP]
+        f32 = wb.dtype
+        y_of = lambda bb: y_ref[bb].astype(f32)
+        beta, n = _gram_cd_core(xt_ref[...], xxt_ref[...], y_of, wb,
+                                mask_ref[...], B=B, K=K, iters=iters,
+                                alpha=alpha)
+        b_ref[...] = beta
+
+        if with_rmse:
+            rs = []
+            for bb in range(B):
+                pred = jnp.dot(X, beta[bb], preferred_element_type=f32)
+                r = y_of(bb) - pred
+                rs.append(jnp.sqrt(jnp.maximum(
+                    jnp.sum(r * r * wb, 0, keepdims=True) / n, 0.0)))
+            r_ref[...] = jnp.concatenate(rs, 0)               # [B, BP]
+        else:
+            r_ref[...] = jnp.zeros(r_ref.shape, r_ref.dtype)
+
+    # A dead block's lanes carry all-zero windows: Gram/corr are zero,
+    # the CD output is zero, and the zero-window RMSE is zero — the fill
+    # is the exact computed value, not an approximation.
+    _when_active(cnt_ref, compute, lambda: _zero_refs(b_ref, r_ref))
 
 
 @functools.partial(jax.jit, static_argnames=("with_rmse", "interpret"))
-def lasso_fit(Yt, w, X, coefmask, *, with_rmse=True, interpret=False):
+def lasso_fit(Yt, w, X, coefmask, *, with_rmse=True, active=None,
+              interpret=False):
     """Fused Pallas twin of kernel._fit_lasso / _fit_lasso_coefs.
 
     Under plain XLA the fit path materializes the [P,B,T] ``Y*w`` product
@@ -246,6 +318,8 @@ def lasso_fit(Yt, w, X, coefmask, *, with_rmse=True, interpret=False):
         w: [P, T] 0/1 fit-window weights (float).
         X: [T, K] design (chip-shared).
         coefmask: [P, K] allowed coefficients.
+        active: optional [P] bool skip guard — inactive lanes must carry
+            all-zero windows (see module note).
     Returns:
         (coefs [P, B, K], rmse [P, B]) — rmse is zeros when
         ``with_rmse=False``.
@@ -263,26 +337,32 @@ def lasso_fit(Yt, w, X, coefmask, *, with_rmse=True, interpret=False):
     wp = jnp.pad(w.T, ((0, 0), (0, pad)))
     mk = jnp.pad(coefmask.T.astype(f32), ((0, 0), (0, pad)))
 
+    args = [X.astype(f32), XT.astype(f32), XXT.astype(f32), yp, wp, mk]
+    full = lambda shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+    in_specs = [
+        full((T, K)), full((K, T)), full((K * K, T)),
+        pl.BlockSpec((B, T, BP), lambda i: (0, 0, i)),
+        pl.BlockSpec((T, BP), lambda i: (0, i)),
+        pl.BlockSpec((K, BP), lambda i: (0, i)),
+    ]
+    if active is not None:
+        args.append(_block_counts(active, BP, Pp))
+        in_specs.append(_CNT_SPEC)
     kern = functools.partial(_fit_block, B=B, K=K,
                              iters=int(params.LASSO_ITERS),
                              alpha=float(params.LASSO_ALPHA),
-                             with_rmse=bool(with_rmse))
-    full = lambda shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+                             with_rmse=bool(with_rmse),
+                             guarded=active is not None)
     beta, rmse = pl.pallas_call(
         kern,
         grid=(Pp // BP,),
-        in_specs=[
-            full((T, K)), full((K, T)), full((K * K, T)),
-            pl.BlockSpec((B, T, BP), lambda i: (0, 0, i)),
-            pl.BlockSpec((T, BP), lambda i: (0, i)),
-            pl.BlockSpec((K, BP), lambda i: (0, i)),
-        ],
+        in_specs=in_specs,
         out_specs=[pl.BlockSpec((B, K, BP), lambda i: (0, 0, i)),
                    pl.BlockSpec((B, BP), lambda i: (0, i))],
         out_shape=[jax.ShapeDtypeStruct((B, K, Pp), f32),
                    jax.ShapeDtypeStruct((B, Pp), f32)],
         interpret=interpret,
-    )(X.astype(f32), XT.astype(f32), XXT.astype(f32), yp, wp, mk)
+    )(*args)
     return beta[:, :, :P].transpose(2, 0, 1), rmse[:, :P].T
 
 
@@ -416,18 +496,28 @@ def _monitor_logic(s, alive, included, rank, cur_k, nlast, in_mon, *,
 
 
 def _monitor_block(s_ref, alive_ref, inc_ref, rank_ref, curk_ref, nlast_ref,
-                   inmon_ref, *out_refs, change_thr, outlier_thr, peek,
-                   refit_factor, T):
+                   inmon_ref, *refs, change_thr, outlier_thr, peek,
+                   refit_factor, T, guarded=False):
     """One pixel block of kernel._monitor_chain, everything in VMEM."""
-    outs = _monitor_logic(
-        s_ref[...], alive_ref[...] > 0, inc_ref[...] > 0, rank_ref[...],
-        curk_ref[...], nlast_ref[...], inmon_ref[...] > 0,
-        change_thr=change_thr, outlier_thr=outlier_thr, peek=peek,
-        refit_factor=refit_factor, T=T)
-    for ref, val in zip(out_refs, outs):
-        # x64 mode promotes index arithmetic to int64; ref stores don't
-        # auto-cast in interpret mode, so land at the ref's dtype.
-        ref[...] = val.astype(ref.dtype)
+    cnt_ref, out_refs = ((refs[0], refs[1:]) if guarded
+                         else (None, refs))
+
+    def compute():
+        outs = _monitor_logic(
+            s_ref[...], alive_ref[...] > 0, inc_ref[...] > 0, rank_ref[...],
+            curk_ref[...], nlast_ref[...], inmon_ref[...] > 0,
+            change_thr=change_thr, outlier_thr=outlier_thr, peek=peek,
+            refit_factor=refit_factor, T=T)
+        for ref, val in zip(out_refs, outs):
+            # x64 mode promotes index arithmetic to int64; ref stores
+            # don't auto-cast in interpret mode, so land at the ref's
+            # dtype.
+            ref[...] = val.astype(ref.dtype)
+
+    # An all-inactive block (no in_mon lane) has every consumer of its
+    # outputs masked on in_mon downstream (kernel._mon_block): zeros are
+    # inert, same as _mon_zeros' skip branch.
+    _when_active(cnt_ref, compute, lambda: _zero_refs(*out_refs))
 
 
 def _mon_scored_logic(yd_of, coefs_d, dden, X, alive, included, cur_k,
@@ -457,8 +547,8 @@ def _mon_scored_logic(yd_of, coefs_d, dden, X, alive, included, cur_k,
 
 def _monitor_scored_block(yd_ref, coef_ref, dden_ref, x_ref, alive_ref,
                           inc_ref, curk_ref, nlast_ref, inmon_ref,
-                          *out_refs, change_thr, outlier_thr, peek,
-                          refit_factor, T, nb):
+                          *refs, change_thr, outlier_thr, peek,
+                          refit_factor, T, nb, guarded=False):
     """Score-fused monitor block: compute the chi2 score plane s — the
     detection-band predictions against the current model — *inside* VMEM
     from wire-dtype spectra, then run the shared event logic.
@@ -469,25 +559,32 @@ def _monitor_scored_block(yd_ref, coef_ref, dden_ref, x_ref, alive_ref,
     once as int16, predictions are one [T,K]x[K,BP] MXU dot per band,
     and rank is a log-step shift-add over the alive plane.
     """
-    outs = _mon_scored_logic(
-        lambda b: yd_ref[b], coef_ref[...], dden_ref[...], x_ref[...],
-        alive_ref[...] > 0, inc_ref[...] > 0, curk_ref[...],
-        nlast_ref[...], inmon_ref[...] > 0, change_thr=change_thr,
-        outlier_thr=outlier_thr, peek=peek, refit_factor=refit_factor,
-        T=T, nb=nb)
-    for ref, val in zip(out_refs, outs):
-        ref[...] = val.astype(ref.dtype)   # see _monitor_block
+    cnt_ref, out_refs = ((refs[0], refs[1:]) if guarded
+                         else (None, refs))
+
+    def compute():
+        outs = _mon_scored_logic(
+            lambda b: yd_ref[b], coef_ref[...], dden_ref[...], x_ref[...],
+            alive_ref[...] > 0, inc_ref[...] > 0, curk_ref[...],
+            nlast_ref[...], inmon_ref[...] > 0, change_thr=change_thr,
+            outlier_thr=outlier_thr, peek=peek, refit_factor=refit_factor,
+            T=T, nb=nb)
+        for ref, val in zip(out_refs, outs):
+            ref[...] = val.astype(ref.dtype)   # see _monitor_block
+
+    _when_active(cnt_ref, compute, lambda: _zero_refs(*out_refs))
 
 
 @functools.partial(jax.jit, static_argnames=("change_thr", "outlier_thr",
                                              "interpret"))
 def monitor_chain(s, alive, included, rank, cur_k, n_last_fit, in_mon, *,
-                  change_thr, outlier_thr, interpret=False):
+                  change_thr, outlier_thr, active=None, interpret=False):
     """Pallas port of kernel._monitor_chain (same output contract).
 
     Values are identical for every lane the caller uses: argmax' no-hit
     default (0), the INF sentinels, and the normal/tail partition all
     mirror the jnp reference exactly; the only arithmetic is integer.
+    ``active`` (normally in_mon) is the per-block skip guard.
     """
     P, T = s.shape
     BP = mon_block_p(T)
@@ -496,21 +593,26 @@ def monitor_chain(s, alive, included, rank, cur_k, n_last_fit, in_mon, *,
     plane, vec = _pad_helpers(pad)
 
     i32 = jnp.int32
-    args = (plane(s), plane(alive.astype(i32)), plane(included.astype(i32)),
+    args = [plane(s), plane(alive.astype(i32)), plane(included.astype(i32)),
             plane(rank.astype(i32)), vec(cur_k.astype(i32)),
-            vec(n_last_fit.astype(i32), 1), vec(in_mon.astype(i32)))
+            vec(n_last_fit.astype(i32), 1), vec(in_mon.astype(i32))]
+    pspec = pl.BlockSpec((T, BP), lambda i: (0, i))
+    vspec = pl.BlockSpec((1, BP), lambda i: (0, i))
+    in_specs = [pspec, pspec, pspec, pspec, vspec, vspec, vspec]
+    if active is not None:
+        args.append(_block_counts(active, BP, Pp))
+        in_specs.append(_CNT_SPEC)
     kern = functools.partial(_monitor_block, change_thr=float(change_thr),
                              outlier_thr=float(outlier_thr),
                              peek=int(params.PEEK_SIZE),
-                             refit_factor=float(params.REFIT_FACTOR), T=T)
-    pspec = pl.BlockSpec((T, BP), lambda i: (0, i))
-    vspec = pl.BlockSpec((1, BP), lambda i: (0, i))
+                             refit_factor=float(params.REFIT_FACTOR), T=T,
+                             guarded=active is not None)
     vshape = jax.ShapeDtypeStruct((1, Pp), i32)
     pshape = jax.ShapeDtypeStruct((T, Pp), i32)
     outs = pl.pallas_call(
         kern,
         grid=(Pp // BP,),
-        in_specs=[pspec, pspec, pspec, pspec, vspec, vspec, vspec],
+        in_specs=in_specs,
         out_specs=[vspec] * 8 + [pspec] * 2,
         out_shape=[vshape] * 8 + [pshape] * 2,
         interpret=interpret,
@@ -531,7 +633,7 @@ def scored_block_p(T: int, nb: int, y_bytes: int) -> int:
                                              "interpret"))
 def monitor_chain_scored(Yd, coefs_d, dden, X, alive, included, cur_k,
                          n_last_fit, in_mon, *, change_thr, outlier_thr,
-                         interpret=False):
+                         active=None, interpret=False):
     """Score-fused Pallas twin of kernel._mon_block's score + chain.
 
     Args:
@@ -542,6 +644,7 @@ def monitor_chain_scored(Yd, coefs_d, dden, X, alive, included, cur_k,
         X: [T, K] design (chip-shared).
         alive, included: [P, T] bool planes.
         cur_k, n_last_fit: [P] int; in_mon: [P] bool.
+        active: optional [P] bool per-block skip guard (normally in_mon).
     Returns:
         The kernel._monitor_chain output dict (same contract); rank is
         derived in-kernel from the alive plane.
@@ -562,27 +665,33 @@ def monitor_chain_scored(Yd, coefs_d, dden, X, alive, included, cur_k,
     kern = functools.partial(
         _monitor_scored_block, change_thr=float(change_thr),
         outlier_thr=float(outlier_thr), peek=int(params.PEEK_SIZE),
-        refit_factor=float(params.REFIT_FACTOR), T=T, nb=nb)
+        refit_factor=float(params.REFIT_FACTOR), T=T, nb=nb,
+        guarded=active is not None)
     pspec = pl.BlockSpec((T, BP), lambda i: (0, i))
     vspec = pl.BlockSpec((1, BP), lambda i: (0, i))
+    args = [yp, cf.astype(f32), dd.astype(f32), X,
+            plane(alive.astype(i32)), plane(included.astype(i32)),
+            vec(cur_k.astype(i32)), vec(n_last_fit.astype(i32), 1),
+            vec(in_mon.astype(i32))]
+    in_specs = [
+        pl.BlockSpec((nb, T, BP), lambda i: (0, 0, i)),
+        pl.BlockSpec((nb, K, BP), lambda i: (0, 0, i)),
+        pl.BlockSpec((nb, BP), lambda i: (0, i)),
+        pl.BlockSpec((T, K), lambda i: (0, 0)),
+        pspec, pspec, vspec, vspec, vspec,
+    ]
+    if active is not None:
+        args.append(_block_counts(active, BP, Pp))
+        in_specs.append(_CNT_SPEC)
     outs = pl.pallas_call(
         kern,
         grid=(Pp // BP,),
-        in_specs=[
-            pl.BlockSpec((nb, T, BP), lambda i: (0, 0, i)),
-            pl.BlockSpec((nb, K, BP), lambda i: (0, 0, i)),
-            pl.BlockSpec((nb, BP), lambda i: (0, i)),
-            pl.BlockSpec((T, K), lambda i: (0, 0)),
-            pspec, pspec, vspec, vspec, vspec,
-        ],
+        in_specs=in_specs,
         out_specs=[vspec] * 8 + [pspec] * 2,
         out_shape=[jax.ShapeDtypeStruct((1, Pp), i32)] * 8
         + [jax.ShapeDtypeStruct((T, Pp), i32)] * 2,
         interpret=interpret,
-    )(yp, cf.astype(f32), dd.astype(f32), X,
-      plane(alive.astype(i32)), plane(included.astype(i32)),
-      vec(cur_k.astype(i32)), vec(n_last_fit.astype(i32), 1),
-      vec(in_mon.astype(i32)))
+    )(*args)
     return _mon_outs_to_dict(outs, P)
 
 
@@ -746,37 +855,52 @@ def _init_logic(alive, cur_i, in_init, t_col, X, Xtr, XTK, XXT, y_of,
 
 
 def _init_window_block(alive_ref, curi_ref, inin_ref, t_ref, x_ref, xtr_ref,
-                       xtk_ref, xxt_ref, y_ref, vario_ref,
-                       nowin_ref, tm_ref, ok_ref, bad_flag_ref, hasadv_ref,
-                       inext_ref, iadv_ref, j_ref, nok_ref, wstab_ref,
-                       alive_out_ref, **statics):
+                       xtk_ref, xxt_ref, y_ref, vario_ref, *refs,
+                       guarded=False, **statics):
     """One pixel block of kernel._init_block: ref boundary around
     _init_logic (the standalone 'init' component's pallas_call body)."""
-    t_col = t_ref[...]
-    f32 = t_col.dtype
-    out = _init_logic(
-        alive_ref[...] > 0, curi_ref[...], inin_ref[...] > 0, t_col,
-        x_ref[...], xtr_ref[...], xtk_ref[...], xxt_ref[...],
-        lambda b: y_ref[b].astype(f32), vario_ref[...], **statics)
-    one = jnp.int32(1)
-    as_i = lambda b: jnp.where(b, one, 0)
-    nowin_ref[...] = as_i(out["init_nowin"])
-    tm_ref[...] = as_i(out["init_tm"])
-    ok_ref[...] = as_i(out["init_ok"])
-    bad_flag_ref[...] = as_i(out["init_bad"])
-    hasadv_ref[...] = as_i(out["has_adv"])
-    # index arithmetic promotes to int64 under x64: land at ref dtype
-    inext_ref[...] = out["i_next_tm"].astype(inext_ref.dtype)
-    iadv_ref[...] = out["i_adv"].astype(iadv_ref.dtype)
-    j_ref[...] = out["j"].astype(j_ref.dtype)
-    nok_ref[...] = out["n_ok"].astype(nok_ref.dtype)
-    wstab_ref[...] = as_i(out["w_stab"])
-    alive_out_ref[...] = as_i(out["alive_init"])
+    cnt_ref, (nowin_ref, tm_ref, ok_ref, bad_flag_ref, hasadv_ref,
+              inext_ref, iadv_ref, j_ref, nok_ref, wstab_ref,
+              alive_out_ref) = ((refs[0], refs[1:]) if guarded
+                                else (None, refs))
+
+    def compute():
+        t_col = t_ref[...]
+        f32 = t_col.dtype
+        out = _init_logic(
+            alive_ref[...] > 0, curi_ref[...], inin_ref[...] > 0, t_col,
+            x_ref[...], xtr_ref[...], xtk_ref[...], xxt_ref[...],
+            lambda b: y_ref[b].astype(f32), vario_ref[...], **statics)
+        one = jnp.int32(1)
+        as_i = lambda b: jnp.where(b, one, 0)
+        nowin_ref[...] = as_i(out["init_nowin"])
+        tm_ref[...] = as_i(out["init_tm"])
+        ok_ref[...] = as_i(out["init_ok"])
+        bad_flag_ref[...] = as_i(out["init_bad"])
+        hasadv_ref[...] = as_i(out["has_adv"])
+        # index arithmetic promotes to int64 under x64: land at ref dtype
+        inext_ref[...] = out["i_next_tm"].astype(inext_ref.dtype)
+        iadv_ref[...] = out["i_adv"].astype(iadv_ref.dtype)
+        j_ref[...] = out["j"].astype(j_ref.dtype)
+        nok_ref[...] = out["n_ok"].astype(nok_ref.dtype)
+        wstab_ref[...] = as_i(out["w_stab"])
+        alive_out_ref[...] = as_i(out["alive_init"])
+
+    def skip():
+        # The no-initializing-lane block mirrors kernel._init_zeros:
+        # every flag/index output is inert zeros (consumers mask on
+        # in_init-derived flags), and alive passes through unchanged —
+        # the Tmask screen only removes observations for INIT lanes.
+        _zero_refs(nowin_ref, tm_ref, ok_ref, bad_flag_ref, hasadv_ref,
+                   inext_ref, iadv_ref, j_ref, nok_ref, wstab_ref)
+        alive_out_ref[...] = alive_ref[...].astype(alive_out_ref.dtype)
+
+    _when_active(cnt_ref, compute, skip)
 
 
 @functools.partial(jax.jit, static_argnames=("W", "sensor", "interpret"))
 def init_window(alive, cur_i, in_init, t, X, Xt, Yt, vario, *, W, sensor,
-                interpret=False):
+                active=None, interpret=False):
     """Fused Pallas twin of kernel._init_block (same output contract).
 
     Args:
@@ -784,6 +908,9 @@ def init_window(alive, cur_i, in_init, t, X, Xt, Yt, vario, *, W, sensor,
         t: [T] float ordinal days; X: [T, K]; Xt: [T, NT] designs.
         Yt: [B, T, P] resident spectra (wire int16 or float32).
         vario: [P, B] variogram.
+        active: optional [P] bool per-block skip guard (normally
+            in_init; skipped blocks pass alive through and zero the
+            rest, kernel._init_zeros' contract).
     Returns:
         kernel._init_block's output dict.
     """
@@ -813,28 +940,34 @@ def init_window(alive, cur_i, in_init, t, X, Xt, Yt, vario, *, W, sensor,
         huber_k=float(params.HUBER_K),
         tmask_const=float(params.TMASK_CONST),
         meow=int(params.MEOW_SIZE), init_days=float(params.INIT_DAYS),
-        stab_factor=float(params.STABILITY_FACTOR))
+        stab_factor=float(params.STABILITY_FACTOR),
+        guarded=active is not None)
     pspec = pl.BlockSpec((T, BP), lambda i: (0, i))
     vspec = pl.BlockSpec((1, BP), lambda i: (0, i))
     full = lambda shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
     vshape = jax.ShapeDtypeStruct((1, Pp), i32)
     pshape = jax.ShapeDtypeStruct((T, Pp), i32)
+    args = [plane(alive.astype(i32)), vec(cur_i.astype(i32)),
+            vec(in_init.astype(i32)), t.astype(f32)[:, None], X, Xt,
+            XT.astype(f32), XXT.astype(f32), yp, vp]
+    in_specs = [
+        pspec, vspec, vspec,
+        full((T, 1)), full((T, K)), full((T, NT)),
+        full((K, T)), full((K * K, T)),
+        pl.BlockSpec((B, T, BP), lambda i: (0, 0, i)),
+        pl.BlockSpec((B, BP), lambda i: (0, i)),
+    ]
+    if active is not None:
+        args.append(_block_counts(active, BP, Pp))
+        in_specs.append(_CNT_SPEC)
     outs = pl.pallas_call(
         kern,
         grid=(Pp // BP,),
-        in_specs=[
-            pspec, vspec, vspec,
-            full((T, 1)), full((T, K)), full((T, NT)),
-            full((K, T)), full((K * K, T)),
-            pl.BlockSpec((B, T, BP), lambda i: (0, 0, i)),
-            pl.BlockSpec((B, BP), lambda i: (0, i)),
-        ],
+        in_specs=in_specs,
         out_specs=[vspec] * 9 + [pspec] * 2,
         out_shape=[vshape] * 9 + [pshape] * 2,
         interpret=interpret,
-    )(plane(alive.astype(i32)), vec(cur_i.astype(i32)),
-      vec(in_init.astype(i32)), t.astype(f32)[:, None], X, Xt,
-      XT.astype(f32), XXT.astype(f32), yp, vp)
+    )(*args)
     (nowin, tm, ok, badf, hasadv, inext, iadv, jj, nok, wstab,
      alive_out) = outs
     cut = lambda x: x[0, :P]
@@ -994,27 +1127,35 @@ def _tmask_core(X, Y, wm, vario, *, nt, nb, n_pow, iters, huber_k,
     return bad
 
 
-def _tmask_block(xt_ref, y2_ref, w_ref, vario_ref, bad_ref, *, nt, nb,
-                 n_pow, iters, huber_k, tmask_const):
+def _tmask_block(xt_ref, y2_ref, w_ref, vario_ref, *refs, nt, nb,
+                 n_pow, iters, huber_k, tmask_const, guarded=False):
     """One pixel block of kernel._tmask_bad, all six IRLS solves in VMEM
     (xt [nt,W,BP], y2 [nb,W,BP], w [W,BP] 0/1, vario [nb,BP] -> bad
     [W,BP] int32 0/1)."""
-    bad = _tmask_core([xt_ref[c] for c in range(nt)],
-                      [y2_ref[b] for b in range(nb)],
-                      w_ref[...], vario_ref[...], nt=nt, nb=nb,
-                      n_pow=n_pow, iters=iters, huber_k=huber_k,
-                      tmask_const=tmask_const)
-    bad_ref[...] = jnp.where(bad, jnp.int32(1), 0)
+    cnt_ref, bad_ref = (refs if guarded else (None,) + refs)
+
+    def compute():
+        bad = _tmask_core([xt_ref[c] for c in range(nt)],
+                          [y2_ref[b] for b in range(nb)],
+                          w_ref[...], vario_ref[...], nt=nt, nb=nb,
+                          n_pow=n_pow, iters=iters, huber_k=huber_k,
+                          tmask_const=tmask_const)
+        bad_ref[...] = jnp.where(bad, jnp.int32(1), 0)
+
+    # A dead block carries all-zero window masks: bad = (...) & mask is
+    # False everywhere, so the zero fill is the exact computed value.
+    _when_active(cnt_ref, compute, lambda: _zero_refs(bad_ref))
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def tmask_bad(Xtw, Y2, w, vario2, *, interpret=False):
+def tmask_bad(Xtw, Y2, w, vario2, *, active=None, interpret=False):
     """Pallas port of kernel._tmask_bad (same contract: [P,W] bool).
 
     Replaces the six sequential Gram/corr reduces, Cholesky chains, and
     ten masked medians per round — each a separate [P,*]-sized fusion
     paying the profiled per-op floor — with one VMEM-resident pass per
-    pixel block.
+    pixel block.  ``active`` (normally the caller's in_init set) is the
+    per-block skip guard.
     """
     P, W, nt = Xtw.shape
     nb = Y2.shape[1]
@@ -1032,20 +1173,26 @@ def tmask_bad(Xtw, Y2, w, vario2, *, interpret=False):
         _tmask_block, nt=nt, nb=nb, n_pow=n_pow,
         iters=int(params.TMASK_IRLS_ITERS),
         huber_k=float(params.HUBER_K),
-        tmask_const=float(params.TMASK_CONST))
+        tmask_const=float(params.TMASK_CONST),
+        guarded=active is not None)
+    args = [xt, y2, wp, vp]
+    in_specs = [
+        pl.BlockSpec((nt, W, BP), lambda i: (0, 0, i)),
+        pl.BlockSpec((nb, W, BP), lambda i: (0, 0, i)),
+        pl.BlockSpec((W, BP), lambda i: (0, i)),
+        pl.BlockSpec((nb, BP), lambda i: (0, i)),
+    ]
+    if active is not None:
+        args.append(_block_counts(active, BP, Pp))
+        in_specs.append(_CNT_SPEC)
     out = pl.pallas_call(
         kern,
         grid=(Pp // BP,),
-        in_specs=[
-            pl.BlockSpec((nt, W, BP), lambda i: (0, 0, i)),
-            pl.BlockSpec((nb, W, BP), lambda i: (0, 0, i)),
-            pl.BlockSpec((W, BP), lambda i: (0, i)),
-            pl.BlockSpec((nb, BP), lambda i: (0, i)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((W, BP), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((W, Pp), jnp.int32),
         interpret=interpret,
-    )(xt, y2, wp, vp)
+    )(*args)
     return (out[:, :P] > 0).T
 
 

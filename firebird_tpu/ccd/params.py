@@ -139,6 +139,60 @@ def variogram_adjusted_default() -> bool:
 
     return os.environ.get("FIREBIRD_VARIOGRAM", "adjusted") == "adjusted"
 
+def compact_default() -> bool:
+    """Whether active-lane compaction runs in the event loop
+    (FIREBIRD_COMPACT; default on).
+
+    Compaction periodically permutes the per-pixel loop state so lanes
+    whose pixels are still working (phase != DONE) form a dense prefix —
+    trailing all-dead lane blocks then cost a per-block predicate in the
+    Pallas kernels instead of a Gram build + CD loop, and the long tail
+    re-enters a smaller bucketed loop (kernel._detect_batch_impl).
+    Results are row-identical either way (the permutation is inverted at
+    loop exit).  Read at trace time like FIREBIRD_PALLAS — set before
+    the first detect call; explicit ``compact=`` arguments to
+    detect_packed/detect_sharded override per call."""
+    import os
+
+    return os.environ.get("FIREBIRD_COMPACT", "1") not in ("", "0")
+
+
+def compact_every() -> int:
+    """Rounds between compaction checks (FIREBIRD_COMPACT_EVERY,
+    default 4, min 1).  A check only permutes when at least 1/16 of a
+    chip's lanes died since the last compaction — the gather sweep over
+    the carried residents must buy skipped blocks.  Trace-time read."""
+    import os
+
+    return max(int(os.environ.get("FIREBIRD_COMPACT_EVERY", "4")), 1)
+
+
+def compact_min_lanes() -> int:
+    """Smallest pixel count that builds the bucketed re-entry loop
+    (FIREBIRD_COMPACT_MIN_LANES, default 1024).  The cascade is a second
+    traced copy of the round body — real lane savings at chip scale
+    (P=10000), pure compile cost for the tiny pixel slices the test
+    suite dispatches — so small batches keep the single compacted loop.
+    Trace-time read; tests crafting small cascades lower it."""
+    import os
+
+    return max(int(os.environ.get("FIREBIRD_COMPACT_MIN_LANES", "1024")), 1)
+
+
+def compact_floor() -> float:
+    """Alive-fraction floor triggering bucketed re-entry
+    (FIREBIRD_COMPACT_FLOOR, default 1/8; 0 disables the cascade).
+    When every chip's alive count fits the next power-of-two bucket of
+    floor*P lanes, the loop exits, survivors (a dense prefix after the
+    forced compaction) are sliced into the bucket, and a smaller-shape
+    loop finishes them (kernel._detect_batch_impl stage 2).  Trace-time
+    read."""
+    import os
+
+    v = float(os.environ.get("FIREBIRD_COMPACT_FLOOR", "0.125"))
+    return min(max(v, 0.0), 1.0)
+
+
 # ---------------------------------------------------------------------------
 # Curve QA flags (segment provenance), pyccd-style bit values.
 # ---------------------------------------------------------------------------

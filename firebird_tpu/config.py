@@ -138,6 +138,15 @@ class Config:
     # watchdog_stall_total.  <= 0 disables the watchdog.
     stall_sec: float = 0.0
 
+    # Active-lane compaction in the CCD event loop (FIREBIRD_COMPACT,
+    # default on): dense-prefix lane permutation + per-block skip guards
+    # + bucketed re-entry for the long tail, so loop cost tracks the
+    # ACTIVE pixel set instead of the padded batch (docs/ROOFLINE.md
+    # "Occupancy").  Results are row-identical either way; cadence and
+    # re-entry floor tune via FIREBIRD_COMPACT_EVERY /
+    # FIREBIRD_COMPACT_FLOOR (ccd.params.compact_*).
+    compact: bool = True
+
     # Max device batches in flight (the one computing + draining ones).
     # 2 is the classic double-buffer; deeper keeps the device busier when
     # egress is slow — affordable because staged inputs are donated to
@@ -276,6 +285,7 @@ class Config:
             stream_dir=e.get("FIREBIRD_STREAM_DIR", cls.stream_dir),
             ops_port=int(e.get("FIREBIRD_OPS_PORT", cls.ops_port)),
             stall_sec=float(e.get("FIREBIRD_STALL_SEC", cls.stall_sec)),
+            compact=e.get("FIREBIRD_COMPACT", "1") not in ("", "0"),
             pipeline_depth=int(e.get("FIREBIRD_PIPELINE_DEPTH",
                                      cls.pipeline_depth)),
             compile_cache=e.get("FIREBIRD_COMPILE_CACHE", cls.compile_cache),

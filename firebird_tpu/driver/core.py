@@ -488,14 +488,16 @@ def warm_start(cfg: Config, acquired: str, sensor=None, dtype=None,
 
                     aot_compile_sharded(
                         make_mesh(devices=jax.local_devices()), dtype,
-                        wcap, sensor, shapes, donate=donate)
+                        wcap, sensor, shapes, donate=donate,
+                        compact=cfg.compact)
                 else:
                     avatars = tuple(
                         jax.ShapeDtypeStruct(s, d) for s, d in zip(
                             shapes, (dtype, dtype, dtype, jnp.bool_,
                                      jnp.int16, jnp.uint16)))
                     kernel.aot_compile(avatars, dtype=dtype, wcap=wcap,
-                                       sensor=sensor, donate=donate)
+                                       sensor=sensor, donate=donate,
+                                       compact=cfg.compact)
             reg.histogram("warm_compile_seconds").observe(tm.elapsed)
             reg.counter("warm_compiles",
                         help="background AOT compiles completed").inc()
@@ -667,7 +669,8 @@ def stage_batch(packed, dtype, sharding: str = "auto",
 def detect_batch(packed, dtype, sharding: str = "auto",
                  pad_to: int | None = None, check_capacity: bool = False,
                  max_segments: int | None = None,
-                 staged: StagedBatch | None = None, donate: bool = False):
+                 staged: StagedBatch | None = None, donate: bool = False,
+                 compact: bool | None = None):
     """Run the CCD kernel over a packed batch on every local device.
 
     Single device (or sharding='off'): plain jit dispatch.  Multiple local
@@ -697,7 +700,7 @@ def detect_batch(packed, dtype, sharding: str = "auto",
     # (no device sync on this thread); the drain thread — which fetches
     # results anyway — detects segment-capacity overflow and re-runs the
     # batch through this same function with the check on (drain_batch).
-    kw = dict(check_capacity=check_capacity)
+    kw = dict(check_capacity=check_capacity, compact=compact)
     if max_segments is not None:
         kw["max_segments"] = max_segments
     if staged is not None:
@@ -764,7 +767,8 @@ def write_batch_frames(packed, host_seg, n_real, *, writer, counters=None):
 
 
 def drain_batch(seg, packed, n_real, *, writer, counters, dtype=None,
-                sharding: str = "auto", pad_to: int | None = None):
+                sharding: str = "auto", pad_to: int | None = None,
+                compact: bool | None = None):
     """Fetch one batch's results to the host, format, and queue writes
     (the egress half of ref core.detect, core.py:69-72) — results cross
     D2H as one bulk :func:`fetch_results` transfer and format through the
@@ -789,11 +793,15 @@ def drain_batch(seg, packed, n_real, *, writer, counters, dtype=None,
             obs_metrics.counter("capacity_redispatches").inc()
             seg, _ = detect_batch(packed, dtype or seg.seg_meta.dtype,
                                   sharding, pad_to=pad_to,
-                                  check_capacity=True,
+                                  check_capacity=True, compact=compact,
                                   max_segments=min(
                                       2 * cap,
                                       kernel.capacity_bound(packed)))
         host = fetch_results(seg)
+        # Occupancy telemetry: the event loop's per-round active/paid
+        # lane capture feeds kernel_round_active_fraction and the
+        # compaction counters (the batch results are on the host anyway).
+        kernel.record_occupancy(host)
         write_batch_frames(packed, host, n_real, writer=writer,
                            counters=counters)
     obs_metrics.histogram("pipeline_drain_seconds").observe(tm.elapsed)
@@ -907,7 +915,8 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log,
                 seg, n_real = detect_batch(staged.packed, dtype,
                                            cfg.device_sharding,
                                            pad_to=pad_to, staged=staged,
-                                           donate=_should_donate())
+                                           donate=_should_donate(),
+                                           compact=cfg.compact)
             obs_metrics.histogram(
                 "pipeline_dispatch_seconds").observe(tm.elapsed)
             # /readyz flips here: mesh up + first batch dispatched means
@@ -916,7 +925,8 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log,
             drains.append(drain_ex.submit(
                 drain_batch, seg, staged.packed, n_real, writer=writer,
                 counters=counters, dtype=dtype,
-                sharding=cfg.device_sharding, pad_to=pad_to))
+                sharding=cfg.device_sharding, pad_to=pad_to,
+                compact=cfg.compact))
             processed.extend(kept)
             # Bound in-flight batches to cfg.pipeline_depth (the one
             # computing + depth-1 draining): input donation frees each

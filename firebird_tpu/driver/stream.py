@@ -294,13 +294,15 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                     # may be re-dispatched, so they are NOT donated.
                     seg, n_real = dcore.detect_batch(
                         staged.packed, jnp.float32, cfg.device_sharding,
-                        pad_to=pad_to, check_capacity=True, staged=staged)
+                        pad_to=pad_to, check_capacity=True, staged=staged,
+                        compact=cfg.compact)
                 obs_metrics.histogram(
                     "pipeline_dispatch_seconds").observe(tm.elapsed)
                 obs_server.batch_dispatched()
                 with tracing.span("drain", chips=n_real), \
                         obs_metrics.timer() as tm:
                     host = dcore.fetch_results(seg)
+                    kernel.record_occupancy(host)
                     dcore.write_batch_frames(staged.packed, host, n_real,
                                              writer=writer)
                     for c in range(n_real):

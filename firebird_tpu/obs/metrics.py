@@ -120,6 +120,30 @@ class Histogram:
             self._min = min(self._min, v)
             self._max = max(self._max, v)
 
+    def observe_many(self, values) -> None:
+        """Bulk observe: vectorized binning + ONE lock acquisition for
+        the whole array.  The per-batch occupancy feed
+        (kernel.record_occupancy) delivers thousands of chip-round
+        fractions from the driver's drain thread — per-value observe()
+        calls there would serialize against every scraper."""
+        if not metrics_enabled():
+            return
+        import numpy as np
+
+        v = np.asarray(values, float).reshape(-1)
+        if v.size == 0:
+            return
+        # side='left' matches observe()'s bisect_left binning exactly.
+        binc = np.bincount(np.searchsorted(self.buckets, v, side="left"),
+                           minlength=len(self.buckets) + 1)
+        with self._lock:
+            for i, c in enumerate(binc):
+                self._counts[i] += int(c)
+            self._sum += float(v.sum())
+            self._count += v.size
+            self._min = min(self._min, float(v.min()))
+            self._max = max(self._max, float(v.max()))
+
     def quantile(self, q: float) -> float | None:
         with self._lock:
             counts, total = list(self._counts), self._count

@@ -143,6 +143,26 @@ class RunStatus:
             + obs_metrics.counter("store_write_retries").value,
         }
 
+    @staticmethod
+    def _kernel_block() -> dict:
+        """Event-loop lane occupancy for /progress (kernel.record_occupancy
+        feeds the counters as batches drain): active vs wasted lane-rounds
+        and the compaction count — a wasted share near zero means the
+        compacted loop pays only for working pixels under the skip-guard
+        accounting (measured on Pallas-guarded kernels, modeled on the
+        lax fallbacks — ChipSegments.occupancy)."""
+        from firebird_tpu.obs import metrics as obs_metrics
+
+        active = obs_metrics.counter("kernel_active_lane_rounds").value
+        wasted = obs_metrics.counter("kernel_wasted_lane_rounds").value
+        return {
+            "active_lane_rounds": active,
+            "wasted_lane_rounds": wasted,
+            "wasted_share": round(wasted / max(active + wasted, 1), 4),
+            "compactions": obs_metrics.counter(
+                "kernel_compactions").value,
+        }
+
     def ready(self) -> bool:
         with self._lock:
             return self._mesh_up and self._first_batch
@@ -172,6 +192,7 @@ class RunStatus:
                 "depth": self.pipeline_depth,
                 "in_flight": inflight,
                 "occupancy": round(inflight / self.pipeline_depth, 3),
+                "kernel": self._kernel_block(),
             },
             "counters": counters,
             "degraded": self.degraded_block(),

@@ -173,7 +173,8 @@ def stage_sharded(packed, mesh: Mesh, dtype) -> tuple[tuple, int]:
 def detect_sharded(packed, mesh: Mesh, dtype=None,
                    check_capacity: bool = True,
                    max_segments: int | None = None,
-                   staged: tuple | None = None, donate: bool = False):
+                   staged: tuple | None = None, donate: bool = False,
+                   compact: bool | None = None):
     """Run the CCD kernel with the chip batch sharded over the mesh.
 
     This is the multi-device production path: same math as
@@ -187,7 +188,11 @@ def detect_sharded(packed, mesh: Mesh, dtype=None,
     ``staged`` takes the ``(args, wcap)`` pair from :func:`stage_sharded`
     instead of transferring here; ``donate=True`` (honored only with
     ``check_capacity=False`` — a retry would re-dispatch deleted
-    buffers) frees the staged wire inputs at dispatch.
+    buffers) frees the staged wire inputs at dispatch.  ``compact``
+    overrides FIREBIRD_COMPACT per call (kernel._detect_batch_core;
+    compaction is per-shard — each shard permutes its own chips' lanes,
+    so no cross-shard dependence is introduced and the zero-collective
+    property holds).
     """
     import jax.numpy as jnp
     from firebird_tpu.ccd.kernel import (MAX_SEGMENTS, capacity_bound,
@@ -204,10 +209,10 @@ def detect_sharded(packed, mesh: Mesh, dtype=None,
 
         fn = sharded_detect_fn(mesh, jnp.dtype(dtype), wcap,
                                packed.sensor, max_segments=S,
-                               donate=do_donate)
+                               donate=do_donate, compact=compact)
         return record_first_call(
             ("sharded", packed.spectra.shape, str(jnp.dtype(dtype)), wcap,
-             packed.sensor.name, S, len(mesh.devices.flat)),
+             packed.sensor.name, S, len(mesh.devices.flat), compact),
             lambda: fn(*args))
 
     def read_worst(seg):
@@ -227,7 +232,8 @@ def detect_sharded(packed, mesh: Mesh, dtype=None,
 @functools.lru_cache(maxsize=None)
 def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor,
                       max_segments: int | None = None,
-                      donate: bool = False):
+                      donate: bool = False,
+                      compact: bool | None = None):
     """The jitted shard_map program, cached per (mesh, dtype, wcap, sensor,
     capacity) — rebuilding the jit wrapper per batch would retrace every
     dispatch.
@@ -241,7 +247,7 @@ def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor,
 
     core = functools.partial(_detect_batch_core, wcap=wcap, sensor=sensor,
                              max_segments=max_segments or MAX_SEGMENTS,
-                             dtype=dtype)
+                             dtype=dtype, compact=compact)
 
     def local_batch(Xs, Xts, t, valid, Y_i16, qa_u16):
         # Wire-dtype spectra pass through: the core widens them itself and
@@ -274,16 +280,19 @@ def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor,
 
 def aot_compile_sharded(mesh: Mesh, dtype, wcap: int, sensor, shapes,
                         max_segments: int | None = None,
-                        donate: bool = False):
+                        donate: bool = False,
+                        compact: bool | None = None):
     """AOT lower+compile the sharded batch program for a shape without
     running it (``shapes``: the 6 global array shapes in shard_packed's
     argument order; wire dtypes applied here).  The sharded half of
     kernel.aot_compile, for driver.core.warm_start on multi-device
-    topologies."""
+    topologies.  ``compact`` must match the real dispatch's value (see
+    kernel.aot_compile)."""
     import jax.numpy as jnp
 
     fn = sharded_detect_fn(mesh, jnp.dtype(dtype), wcap, sensor,
-                           max_segments=max_segments, donate=donate)
+                           max_segments=max_segments, donate=donate,
+                           compact=compact)
     sh = chip_sharding(mesh)
     dts = (dtype, dtype, dtype, jnp.bool_, jnp.int16, jnp.uint16)
     avatars = tuple(jax.ShapeDtypeStruct(s, jnp.dtype(d), sharding=sh)

@@ -1,0 +1,276 @@
+"""Active-lane compaction: row-identical results, real lane savings.
+
+The compaction machinery (kernel._detect_batch_impl: dense-prefix
+permutation carried in the loop state, per-block skip guards, bucketed
+re-entry) must be INVISIBLE in results — every per-lane decision is
+permutation-invariant, and the carried permutation is inverted at loop
+exit.  These tests pin compact-on vs compact-off to exact equality on
+synthetic and fuzz-adversarial workloads including the edge cases
+(everything done before round 1, a single alive pixel, alive count
+exactly on the re-entry bucket boundary), check the occupancy capture
+and telemetry, and prove the driver's resume-after-quarantine path is
+store-identical under compaction (slow-marked; `make compact-smoke` is
+the fast on-vs-off store proof).
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from firebird_tpu.ccd import flops, kernel, params, synthetic
+from firebird_tpu.ingest.packer import PackedChips
+
+P_TEST = 32      # every kernel case shares one compiled shape pair
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _small_cascade_env():
+    """Let the P=32 cases build the bucketed re-entry loop (production
+    gates it at FIREBIRD_COMPACT_MIN_LANES=1024 to keep tiny-shape
+    compiles cheap).  Module-scoped and set before the first compile of
+    this module's (unique) shapes; trace-time read."""
+    old = os.environ.get("FIREBIRD_COMPACT_MIN_LANES")
+    os.environ["FIREBIRD_COMPACT_MIN_LANES"] = "8"
+    yield
+    if old is None:
+        os.environ.pop("FIREBIRD_COMPACT_MIN_LANES", None)
+    else:
+        os.environ["FIREBIRD_COMPACT_MIN_LANES"] = old
+
+
+def _grid():
+    return synthetic.acquisition_dates("1995-01-01", "2000-01-01", 16)
+
+
+def _std_pixel(rng, t, brk=False):
+    Y = synthetic.harmonic_series(t, rng)
+    if brk:
+        Y[:, t.shape[0] // 2:] += 800.0
+    return Y, np.full(t.shape[0], synthetic.QA_CLEAR, np.uint16)
+
+
+def _fill_pixel(t):
+    return (np.full((7, t.shape[0]), params.FILL_VALUE, np.float64),
+            np.full(t.shape[0], synthetic.QA_FILL, np.uint16))
+
+
+def _pack(t, pixels):
+    Ys, qas = zip(*pixels)
+    spectra = np.stack([np.asarray(Y, np.int16) for Y in Ys])
+    spectra = spectra.transpose(1, 0, 2)[None]
+    return PackedChips(cids=np.zeros((1, 2), np.int64),
+                       dates=t[None].astype(np.int32),
+                       spectra=spectra, qas=np.stack(qas)[None],
+                       n_obs=np.array([t.shape[0]], np.int32))
+
+
+def _run_pair(p, dtype=jnp.float64):
+    on = kernel.detect_packed(p, dtype=dtype, compact=True)
+    off = kernel.detect_packed(p, dtype=dtype, compact=False)
+    return on, off
+
+
+def _assert_identical(on, off):
+    """Results (not diagnostics) must match bit for bit: segments, days,
+    QA, coefficients, magnitudes, masks, procedures."""
+    for f in ("n_segments", "seg_meta", "seg_rmse", "seg_mag", "seg_coef",
+              "mask", "procedure", "rounds", "round_counts", "vario"):
+        np.testing.assert_array_equal(np.asarray(getattr(on, f)),
+                                      np.asarray(getattr(off, f)),
+                                      err_msg=f)
+
+
+def _mixed_pixels(n_std=8, seed=7):
+    """n_std standard pixels (half with breaks) scattered among fill
+    lanes — DONE-from-round-0 lanes interleave with long-lived ones, so
+    the dense-prefix permutation actually moves rows."""
+    rng = np.random.default_rng(seed)
+    t = _grid()
+    pixels = [_std_pixel(rng, t, brk=i % 2 == 0) for i in range(n_std)]
+    pixels += [_fill_pixel(t) for _ in range(P_TEST - n_std)]
+    order = rng.permutation(P_TEST)
+    return t, [pixels[i] for i in order]
+
+
+def test_compact_row_identical_mixed():
+    """The headline contract on a heterogeneous chip — and with 8
+    standard pixels against the bucket of pow2(32/8)=8 lanes, the alive
+    count sits EXACTLY on the re-entry boundary, so the cascade slices a
+    full bucket (the off-by-one hot spot)."""
+    t, pixels = _mixed_pixels(n_std=8)
+    on, off = _run_pair(_pack(t, pixels))
+    _assert_identical(on, off)
+    # the cascade case really compacted and really captured occupancy
+    assert int(np.asarray(on.compactions)[0]) > 0
+    occ = np.asarray(on.occupancy)[0]
+    r = int(np.asarray(on.rounds)[0])
+    assert (occ[:r, 0] > 0).all()          # active lanes every round
+    assert (occ[r:] == 0).all()            # rows past the loop are zero
+    # compact-off pays the full width every round
+    occ_off = np.asarray(off.occupancy)[0]
+    assert (occ_off[:r, 1] == P_TEST).all()
+
+
+def test_compact_row_identical_single_alive_pixel():
+    rng = np.random.default_rng(3)
+    t = _grid()
+    pixels = [_fill_pixel(t) for _ in range(P_TEST)]
+    pixels[17] = _std_pixel(rng, t, brk=True)
+    on, off = _run_pair(_pack(t, pixels))
+    _assert_identical(on, off)
+    assert int(np.asarray(on.n_segments)[0, 17]) >= 1
+
+
+def test_compact_all_done_before_round_one():
+    """Every pixel resolved by the prologue (fill -> no-data): the loop
+    body never runs, occupancy stays empty, results still identical."""
+    t = _grid()
+    on, off = _run_pair(_pack(t, [_fill_pixel(t) for _ in range(P_TEST)]))
+    _assert_identical(on, off)
+    assert int(np.asarray(on.rounds)[0]) == 0
+    assert int(np.asarray(on.compactions)[0]) == 0
+    assert (np.asarray(on.occupancy) == 0).all()
+
+
+def test_compact_row_identical_fuzz_subset():
+    """Adversarial pixels (the fuzz generator's QA mixes, spikes, step
+    changes, range violations) through the same shared shape — compact
+    on/off must agree bit for bit on every field."""
+    from tests.test_fuzz_parity import SPECIALS, _fuzz_pixel
+
+    rng = np.random.default_rng(606)
+    t = _grid()
+    pixels = [_fuzz_pixel(t, rng, special=SPECIALS.get(i))
+              for i in range(P_TEST)]
+    on, off = _run_pair(_pack(t, pixels))
+    _assert_identical(on, off)
+
+
+def test_occupancy_detail_and_wasted_reduction():
+    """The occupancy model: compact-off pays padded lanes every round;
+    compact-on's effective lane-rounds track the active set (trailing
+    dead blocks skipped, bucket re-entry for the tail), so wasted
+    lane-rounds drop — on this mixed workload by far more than the 2x
+    acceptance bar."""
+    t, pixels = _mixed_pixels(n_std=8, seed=11)
+    on, off = _run_pair(_pack(t, pixels))
+    d_on = flops.occupancy_detail(np.asarray(on.occupancy),
+                                  np.asarray(on.rounds), P_TEST)
+    d_off = flops.occupancy_detail(np.asarray(off.occupancy),
+                                   np.asarray(off.rounds), P_TEST)
+    assert d_on["active_lane_rounds"] == d_off["active_lane_rounds"]
+    assert d_off["effective_lane_rounds"] == d_off["padded_lane_rounds"]
+    assert d_on["wasted_lane_rounds"] * 2 <= d_off["wasted_lane_rounds"]
+    assert d_on["per_round"][0]["paid"] <= P_TEST
+    assert "_fractions" in d_on           # histogram feed
+
+
+def test_record_occupancy_feeds_registry():
+    from firebird_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.reset_registry()
+    t, pixels = _mixed_pixels(n_std=8, seed=19)
+    on, _ = _run_pair(_pack(t, pixels))
+    host = kernel.ChipSegments(*[
+        None if getattr(on, f.name) is None else np.asarray(getattr(on, f.name))
+        for f in dataclasses.fields(on)])
+    det = kernel.record_occupancy(host)
+    assert det is not None and "_fractions" not in det
+    assert obs_metrics.counter("kernel_compactions").value > 0
+    assert obs_metrics.counter("kernel_active_lane_rounds").value \
+        == det["active_lane_rounds"]
+    assert obs_metrics.counter("kernel_wasted_lane_rounds").value \
+        == det["wasted_lane_rounds"]
+    h = obs_metrics.histogram("kernel_round_active_fraction",
+                              buckets=kernel.FRACTION_BUCKETS)
+    assert h.snapshot()["count"] > 0
+    # pre-compaction artifacts (occupancy=None) are a no-op, not a crash
+    legacy = dataclasses.replace(host, occupancy=None)
+    assert kernel.record_occupancy(legacy) is None
+    obs_metrics.reset_registry()
+
+
+def test_expected_compaction_speedup_model():
+    assert flops.expected_compaction_speedup(1.0) == pytest.approx(1.0, abs=0.03)
+    assert flops.expected_compaction_speedup(0.5) == pytest.approx(2.0, rel=0.05)
+    # floor: a single block is the narrowest the guards can pay
+    assert flops.expected_compaction_speedup(0.0, lanes=10000) \
+        == pytest.approx(10000 / 512, rel=0.01)
+
+
+def test_compact_knob_resolution():
+    """FIREBIRD_COMPACT / Config.compact contract."""
+    from firebird_tpu.config import Config
+
+    assert params.compact_default() in (True, False)
+    old = os.environ.get("FIREBIRD_COMPACT")
+    try:
+        os.environ["FIREBIRD_COMPACT"] = "0"
+        assert not params.compact_default()
+        assert not Config.from_env().compact
+        os.environ["FIREBIRD_COMPACT"] = "1"
+        assert params.compact_default()
+        assert Config.from_env().compact
+    finally:
+        if old is None:
+            os.environ.pop("FIREBIRD_COMPACT", None)
+        else:
+            os.environ["FIREBIRD_COMPACT"] = old
+    assert params.compact_every() >= 1
+    assert 0.0 <= params.compact_floor() <= 1.0
+
+
+@pytest.mark.slow
+def test_resume_after_quarantine_with_compaction(tmp_path):
+    """Driver-level: a poisoned chip quarantined under compaction-ON,
+    then resume — the final store is row-for-row identical to a clean
+    compaction-OFF run (on-vs-off AND resume equivalence in one; the
+    fast path of this proof is `make compact-smoke`)."""
+    from firebird_tpu import grid
+    from firebird_tpu.config import Config
+    from firebird_tpu.driver import core
+    from firebird_tpu.driver import quarantine as qlib
+    from firebird_tpu.ingest import SyntheticSource
+    from firebird_tpu.store import SqliteStore
+    from firebird_tpu.utils.fn import take
+    from tools.chaos_soak import store_rows
+
+    ACQ = "1995-01-01/1997-06-01"     # matches test_driver's jit cache
+    src = lambda: SyntheticSource(seed=0)
+    cids = list(take(2, grid.chips(grid.tile(x=100, y=200))))
+    poisoned = cids[0]
+
+    clean_cfg = Config(store_backend="sqlite",
+                       store_path=str(tmp_path / "clean.db"),
+                       source_backend="synthetic", chips_per_batch=1,
+                       dtype="float64", device_sharding="off",
+                       compact=False)
+    core.changedetection(x=100, y=200, acquired=ACQ, number=2,
+                         chunk_size=2, cfg=clean_cfg, source=src())
+    clean = store_rows(SqliteStore(clean_cfg.store_path,
+                                   clean_cfg.keyspace()))
+
+    cfg = Config(store_backend="sqlite",
+                 store_path=str(tmp_path / "compact.db"),
+                 source_backend="synthetic", chips_per_batch=1,
+                 dtype="float64", device_sharding="off", fetch_retries=0,
+                 compact=True,
+                 faults=f"ingest:chip={poisoned[0]}:{poisoned[1]}")
+    done = core.changedetection(x=100, y=200, acquired=ACQ, number=2,
+                                chunk_size=2, cfg=cfg, source=src())
+    assert list(done) == [cids[1]]
+    qpath = qlib.quarantine_path(cfg)
+    assert len(qlib.Quarantine.load(qpath)) == 1
+
+    healed = Config(**{**cfg.__dict__, "faults": ""})
+    out = core.changedetection(x=100, y=200, acquired=ACQ, number=2,
+                               chunk_size=2, cfg=healed, source=src(),
+                               resume=True)
+    assert set(out) == set(cids)
+    assert len(qlib.Quarantine.load(qpath)) == 0
+    compacted = store_rows(SqliteStore(cfg.store_path, cfg.keyspace()))
+    for table in ("chip", "pixel", "segment"):
+        assert clean[table] == compacted[table], table

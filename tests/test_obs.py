@@ -151,6 +151,21 @@ def test_histogram_percentiles():
     assert snap["min"] <= snap["p99"] <= snap["max"]
 
 
+def test_histogram_observe_many_matches_observe():
+    """Bulk ingestion (the drain thread's occupancy feed) lands the same
+    state as per-value observe — identical snapshot, one lock hold."""
+    vals = [ms / 1000.0 for ms in range(1, 101)] + [1e6]  # incl. overflow
+    one = obs_metrics.Histogram("t_seconds")
+    for v in vals:
+        one.observe(v)
+    bulk = obs_metrics.Histogram("t_seconds")
+    bulk.observe_many(vals)
+    bulk.observe_many([])                       # no-op, not a crash
+    s1, s2 = one.snapshot(), bulk.snapshot()
+    assert s1 == pytest.approx(s2)
+    assert s2["count"] == len(vals)
+
+
 def test_histogram_empty_and_overflow():
     h = obs_metrics.Histogram("t_seconds")
     assert h.snapshot() == {"count": 0}

@@ -96,6 +96,7 @@ def _wire_args(p):
             p.dates.astype(np.float64), valid, p.spectra, p.qas)
 
 
+@pytest.mark.slow  # ~27s interpret-mode run; tier-1 (-m 'not slow') keeps the lax sharded parity (test_parallel) + single-device Pallas rungs
 def test_pallas_inside_sharded_detect(monkeypatch):
     """The sharded production path (shard_map over the mesh) composes with
     the Pallas CD loop: each shard runs its own single-device Mosaic call,
@@ -554,6 +555,7 @@ def test_detect_mega_matches_batch_core(monkeypatch):
         np.asarray(got.vario), np.asarray(ref.vario), rtol=1e-6)
 
 
+@pytest.mark.slow  # ~60s interpret-mode run; tier-1 (-m 'not slow') keeps test_detect_mega_matches_batch_core as the mega-route parity rung
 def test_detect_mega_sentinel2_and_capacity(monkeypatch):
     """Band-layout genericity + the overflow contract on the mega route:
     the 12-band Sentinel-2 kernel (different detection/tmask sets, no
@@ -645,3 +647,202 @@ def test_mega_inside_sharded_detect(monkeypatch):
                                   np.asarray(ref.n_segments))
     np.testing.assert_allclose(np.asarray(got.seg_meta),
                                np.asarray(ref.seg_meta), atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# Per-block skip guards (active-lane compaction, ISSUE 6): a block with
+# no active lane must cost only its predicate + zero-fill, and a guarded
+# call must agree with the unguarded one everywhere the caller reads.
+# ---------------------------------------------------------------------------
+
+def test_fit_guard_skips_dead_blocks_bit_identical(monkeypatch):
+    """lasso_fit with an active mask whose trailing blocks are all dead
+    (the post-compaction layout): guarded output equals unguarded on
+    every lane — dead lanes carry all-zero windows, whose computed fit
+    IS zero, so the skip fill is exact."""
+    from firebird_tpu.ccd import harmonic
+
+    # Narrow blocks keep the two-block interpret run tier-1 cheap.
+    monkeypatch.setattr(pallas_ops, "fit_block_p", lambda *a: 128)
+    rng = np.random.default_rng(8)
+    T, B, K = 40, 7, params.MAX_COEFS
+    BP = pallas_ops.fit_block_p(T, B, 2)
+    P = 2 * BP                     # two blocks; block 1 fully dead
+    t = np.sort(rng.integers(729000, 730500, T)).astype(np.float64)
+    X = jnp.asarray(harmonic.design_matrix(t, t[0], K), jnp.float32)
+    Yt = jnp.asarray(rng.integers(0, 5000, (B, T, P)), jnp.int16)
+    active = np.zeros(P, bool)
+    active[: BP // 2] = True       # dense prefix, as compaction leaves it
+    w = jnp.asarray(
+        (rng.random((P, T)) < 0.8) & active[:, None], jnp.float32)
+    mask = jnp.ones((P, K), bool)
+    ref = pallas_ops.lasso_fit(Yt, w, X, mask, interpret=True)
+    got = pallas_ops.lasso_fit(Yt, w, X, mask,
+                               active=jnp.asarray(active), interpret=True)
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+    # the skipped block really wrote zeros
+    assert (np.asarray(got[0])[BP:] == 0).all()
+
+
+def test_guarded_fit_inside_shard_map(monkeypatch):
+    """The guarded kernels' per-block count operand composes with
+    shard_map the same way the kernels themselves do (each shard runs
+    its own single-device Mosaic call — the cnt-ref BlockSpec included):
+    chip-sharded guarded lasso_fit equals the per-chip direct calls, and
+    an all-dead shard still writes zeros through its guard."""
+    from jax.sharding import PartitionSpec
+
+    from firebird_tpu.ccd import harmonic
+    from firebird_tpu.parallel import make_mesh
+
+    monkeypatch.setattr(pallas_ops, "fit_block_p", lambda *a: 128)
+    rng = np.random.default_rng(10)
+    T, B, K = 40, 7, params.MAX_COEFS
+    BP = pallas_ops.fit_block_p(T, B, 2)
+    P, D = 2 * BP, 2               # two blocks per chip, two shards
+    t = np.sort(rng.integers(729000, 730500, T)).astype(np.float64)
+    X = jnp.asarray(harmonic.design_matrix(t, t[0], K), jnp.float32)
+    Yt = jnp.asarray(rng.integers(0, 5000, (D, B, T, P)), jnp.int16)
+    active = np.zeros((D, P), bool)
+    active[0, : BP // 2] = True    # shard 0: dense prefix
+    w = jnp.asarray((rng.random((D, P, T)) < 0.8) & active[..., None],
+                    jnp.float32)
+    mask = jnp.ones((D, P, K), bool)
+    act = jnp.asarray(active)
+
+    mesh = make_mesh(n_devices=D)
+    spec = PartitionSpec("data")
+
+    def local(Ytc, wc, mc, ac):
+        out = pallas_ops.lasso_fit(Ytc[0], wc[0], X, mc[0], active=ac[0],
+                                   interpret=True)
+        return jax.tree_util.tree_map(lambda o: o[None], out)
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        fn = sm(local, mesh=mesh, in_specs=(spec,) * 4, out_specs=spec,
+                check_vma=False)
+    else:  # jax < 0.5: experimental module, check_rep spelling
+        from jax.experimental.shard_map import shard_map as sm_exp
+
+        fn = sm_exp(local, mesh=mesh, in_specs=(spec,) * 4,
+                    out_specs=spec, check_rep=False)
+    got = fn(Yt, w, mask, act)
+    for d in range(D):
+        ref = pallas_ops.lasso_fit(Yt[d], w[d], X, mask[d],
+                                   active=act[d], interpret=True)
+        for r, g in zip(ref, (got[0][d], got[1][d])):
+            np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+    # shard 1 has no active lane: every block skipped, zeros written
+    assert (np.asarray(got[0])[1] == 0).all()
+
+
+def test_monitor_scored_guard_matches_on_active_lanes():
+    """monitor_chain_scored under a dense-prefix in_mon mask: guarded ==
+    unguarded on every lane the caller uses (in_mon lanes; the rest are
+    masked downstream, kernel._mon_block)."""
+    from firebird_tpu.ccd import harmonic
+    from firebird_tpu.ccd.sensor import chi2_thresholds
+
+    rng = np.random.default_rng(9)
+    T, nb, K = 48, 5, params.MAX_COEFS
+    BP = pallas_ops.scored_block_p(T, nb, 2)
+    P = 2 * BP
+    t = np.sort(rng.integers(729000, 730500, T)).astype(np.float64)
+    X = jnp.asarray(harmonic.design_matrix(t, t[0], K), jnp.float32)
+    Yd = jnp.asarray(rng.integers(0, 5000, (nb, T, P)), jnp.int16)
+    coefs = jnp.asarray(rng.normal(0, 1, (P, nb, K)), jnp.float32)
+    dden = jnp.asarray(rng.uniform(50, 200, (P, nb)), jnp.float32)
+    alive = jnp.asarray(rng.random((P, T)) < 0.8)
+    included = jnp.asarray(rng.random((P, T)) < 0.3)
+    cur_k = jnp.asarray(rng.integers(0, T // 2, P), jnp.int32)
+    nlast = jnp.asarray(rng.integers(12, 40, P), jnp.int32)
+    in_mon = jnp.asarray(np.arange(P) < BP // 3)   # dense prefix
+    ct, ot = chi2_thresholds(nb)
+    kw = dict(change_thr=float(ct), outlier_thr=float(ot), interpret=True)
+    ref = pallas_ops.monitor_chain_scored(Yd, coefs, dden, X, alive,
+                                          included, cur_k, nlast, in_mon,
+                                          **kw)
+    got = pallas_ops.monitor_chain_scored(Yd, coefs, dden, X, alive,
+                                          included, cur_k, nlast, in_mon,
+                                          active=in_mon, **kw)
+    use = np.asarray(in_mon)
+    for k in ref:
+        np.testing.assert_array_equal(np.asarray(ref[k])[use],
+                                      np.asarray(got[k])[use], err_msg=k)
+        # the dead trailing block wrote zeros/False
+        assert (np.asarray(got[k])[BP:] == 0).all(), k
+
+
+@pytest.mark.slow  # ~35s: two interpret-mode traces of the W-unrolled
+# init body; the tier-1 guard coverage stays with the fit/monitor/cd
+# rungs, which exercise the same _when_active plumbing.
+def test_init_window_guard_passes_alive_through(monkeypatch):
+    """init_window's skipped blocks mirror kernel._init_zeros: flags and
+    indices zero, alive passed through untouched."""
+    from firebird_tpu.ccd import harmonic
+    from firebird_tpu.ccd.sensor import LANDSAT_ARD
+
+    # Narrow blocks + a small W keep the two-block interpret run tier-1
+    # cheap: the init body unrolls per window slot, and interpret-mode
+    # cost is dominated by tracing that body, not by lanes.
+    monkeypatch.setattr(pallas_ops, "init_block_p", lambda *a: 128)
+    rng = np.random.default_rng(10)
+    T, B, K, NT, W = 32, 7, params.MAX_COEFS, params.TMASK_COEFS, 8
+    BP = pallas_ops.init_block_p(T, W, B, 2)
+    P = 2 * BP
+    t = np.sort(rng.integers(729000, 730500, T)).astype(np.float64)
+    X = jnp.asarray(harmonic.design_matrix(t, t[0], K), jnp.float32)
+    Xt_full = harmonic.design_matrix(t, t[0], params.TMASK_COEFS + 1)
+    Xt = jnp.asarray(np.concatenate([Xt_full[:, :1], Xt_full[:, 2:]], 1),
+                     jnp.float32)
+    Yt = jnp.asarray(rng.integers(0, 5000, (B, T, P)), jnp.int16)
+    vario = jnp.asarray(rng.uniform(20, 100, (P, B)), jnp.float32)
+    alive = jnp.asarray(rng.random((P, T)) < 0.7)
+    cur_i = jnp.asarray(rng.integers(0, T // 2, P), jnp.int32)
+    in_init = jnp.asarray(np.arange(P) < BP // 2)  # block 1 fully dead
+    kw = dict(W=W, sensor=LANDSAT_ARD, interpret=True)
+    ref = pallas_ops.init_window(alive, cur_i, in_init,
+                                 jnp.asarray(t, jnp.float32), X, Xt, Yt,
+                                 vario, **kw)
+    got = pallas_ops.init_window(alive, cur_i, in_init,
+                                 jnp.asarray(t, jnp.float32), X, Xt, Yt,
+                                 vario, active=in_init, **kw)
+    use = np.asarray(in_init)
+    for k in ref:
+        np.testing.assert_array_equal(np.asarray(ref[k])[use],
+                                      np.asarray(got[k])[use], err_msg=k)
+    # the skipped block passes alive through (Tmask removes nothing for
+    # non-INIT lanes) and zeroes the flags
+    np.testing.assert_array_equal(np.asarray(got["alive_init"])[BP:],
+                                  np.asarray(alive)[BP:])
+    assert not np.asarray(got["init_ok"])[BP:].any()
+
+
+def test_lasso_cd_and_tmask_guards():
+    """The remaining guarded kernels: all-dead calls fill exact zeros;
+    mixed calls agree with unguarded on active lanes."""
+    G, c, d, m = _systems(P=24, dtype=jnp.float64)
+    dead = jnp.zeros(24, bool)
+    z = pallas_ops.lasso_cd(G, jnp.zeros_like(c), d, m, active=dead,
+                            interpret=True)
+    assert (np.asarray(z) == 0).all()
+    act = jnp.asarray(np.arange(24) < 9)
+    ref = pallas_ops.lasso_cd(G, c, d, m, interpret=True)
+    got = pallas_ops.lasso_cd(G, c, d, m, active=act, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ref)[:9], np.asarray(got)[:9])
+
+    rng = np.random.default_rng(12)
+    P, W, nt, nb = 20, 16, params.TMASK_COEFS, 2
+    Xtw = jnp.asarray(rng.normal(0, 1, (P, W, nt)), jnp.float32)
+    Y2 = jnp.asarray(rng.normal(1000, 200, (P, nb, W)), jnp.float32)
+    w = jnp.asarray(rng.random((P, W)) < 0.8, jnp.float32)
+    v2 = jnp.asarray(rng.uniform(20, 80, (P, nb)), jnp.float32)
+    ref = pallas_ops.tmask_bad(Xtw, Y2, w, v2, interpret=True)
+    act = jnp.ones(P, bool)
+    got = pallas_ops.tmask_bad(Xtw, Y2, w, v2, active=act, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    z = pallas_ops.tmask_bad(Xtw, Y2, w, v2, active=jnp.zeros(P, bool),
+                             interpret=True)
+    assert not np.asarray(z).any()

@@ -246,6 +246,9 @@ def test_progress_reports_pipeline_occupancy():
     st.batch_dispatched()
     st.batch_done()
     prog = st.progress()
+    kern = prog["pipeline"].pop("kernel")   # lane occupancy (test_compact)
+    assert set(kern) == {"active_lane_rounds", "wasted_lane_rounds",
+                         "wasted_share", "compactions"}
     assert prog["pipeline"] == {"depth": 3, "in_flight": 1,
                                 "occupancy": round(1 / 3, 3)}
     assert obs_metrics.gauge("pipeline_inflight").value == 1

@@ -1,0 +1,145 @@
+"""Compaction smoke (``make compact-smoke``): compaction costs rounds'
+worth of gathers, never results.
+
+Two driver runs over the same synthetic tile — active-lane compaction ON
+(FIREBIRD_COMPACT semantics, cfg.compact=True, the default) vs OFF —
+asserting:
+
+1. the stores are **byte-identical** row-for-row across the chip/pixel/
+   segment tables (the compaction permutation is invisible in results);
+2. the ON run actually compacted (``kernel_compactions`` > 0 in its
+   obs report) — a smoke that silently never triggers proves nothing;
+3. the ON run's **wasted lane-rounds** (paid-but-dead, from the kernel's
+   per-round occupancy capture) are LOWER than the OFF run's — the
+   skip-guard/bucket machinery buys real lane-rounds, and by at least
+   the 2x the acceptance bar asks for on this workload.
+
+Writes a ``compact_smoke.json`` artifact (FIREBIRD_COMPACT_DIR, default
+/tmp/fb_compact; folded into bench artifacts by bench.py) and exits
+non-zero on any violation.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Aggressive cadence for the smoke: the tiny tile's loop runs few rounds,
+# so check every round and re-enter the bucket early (trace-time knobs,
+# ccd.params.compact_*; set before the first detect call).
+os.environ.setdefault("FIREBIRD_COMPACT_EVERY", "1")
+os.environ.setdefault("FIREBIRD_COMPACT_FLOOR", "0.5")
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, HERE)
+
+from tools.chaos_soak import store_rows  # noqa: E402  (shared canonicalizer)
+
+ACQ = "1995-01-01/1998-01-01"
+N_CHIPS = 2
+
+
+def _wasted(store_dir: str) -> dict:
+    with open(os.path.join(store_dir, "obs_report.json")) as f:
+        counters = json.load(f)["metrics"]["counters"]
+    return {
+        "active_lane_rounds": counters.get("kernel_active_lane_rounds", 0),
+        "wasted_lane_rounds": counters.get("kernel_wasted_lane_rounds", 0),
+        "compactions": counters.get("kernel_compactions", 0),
+    }
+
+
+def main() -> int:
+    import dataclasses
+
+    from firebird_tpu.config import Config
+    from firebird_tpu.driver import core
+    from firebird_tpu.ingest import SyntheticSource
+    from firebird_tpu.store import SqliteStore
+
+    def cfg_for(subdir: str, tmp: str, compact: bool) -> Config:
+        cfg = Config(store_backend="sqlite",
+                     store_path=os.path.join(tmp, subdir, "compact.db"),
+                     source_backend="synthetic", chips_per_batch=1,
+                     device_sharding="off", dtype="float64",
+                     compact=compact)
+        os.makedirs(os.path.dirname(cfg.store_path), exist_ok=True)
+        return cfg
+
+    def src():
+        # Heterogeneous lifetimes on purpose: half the area carries a
+        # step change (those pixels re-initialize and close a second
+        # segment — more event-loop rounds), the rest tails out early —
+        # exactly the converged-lanes-riding-dead regime compaction
+        # exists for.
+        return SyntheticSource(seed=13, start="1995-01-01",
+                               end="1999-01-01", cloud_frac=0.1,
+                               change_frac=0.5)
+
+    rows = {}
+    stats = {}
+    with tempfile.TemporaryDirectory(prefix="fb_compact_") as tmp:
+        for label, compact in (("off", False), ("on", True)):
+            cfg = cfg_for(label, tmp, compact)
+            done = core.changedetection(x=100, y=200, acquired=ACQ,
+                                        number=N_CHIPS, chunk_size=N_CHIPS,
+                                        cfg=cfg, source=src())
+            if len(done) != N_CHIPS:
+                print(f"compact-smoke: {label} run processed "
+                      f"{len(done)}/{N_CHIPS}", file=sys.stderr)
+                return 1
+            rows[label] = store_rows(SqliteStore(cfg.store_path,
+                                                 cfg.keyspace()))
+            stats[label] = _wasted(os.path.dirname(cfg.store_path))
+
+    for table in ("chip", "pixel", "segment"):
+        if rows["on"][table] != rows["off"][table]:
+            diff = next((i for i, (a, b) in enumerate(
+                zip(rows["off"][table], rows["on"][table])) if a != b),
+                None)
+            print(f"compact-smoke: {table} rows differ with compaction on "
+                  f"(off {len(rows['off'][table])} vs on "
+                  f"{len(rows['on'][table])}, first mismatch at {diff})",
+                  file=sys.stderr)
+            return 1
+    if stats["on"]["compactions"] <= 0:
+        print(f"compact-smoke: compaction never triggered ({stats['on']})",
+              file=sys.stderr)
+        return 1
+    w_on, w_off = (stats["on"]["wasted_lane_rounds"],
+                   stats["off"]["wasted_lane_rounds"])
+    if not w_on * 2 <= w_off:
+        print(f"compact-smoke: wasted lane-rounds not halved "
+              f"(on {w_on} vs off {w_off})", file=sys.stderr)
+        return 1
+
+    report = {
+        "schema": "firebird-compact-smoke/1",
+        "chips": N_CHIPS,
+        "acquired": ACQ,
+        "compact_every": os.environ["FIREBIRD_COMPACT_EVERY"],
+        "compact_floor": os.environ["FIREBIRD_COMPACT_FLOOR"],
+        "rows": {t: len(rows["on"][t]) for t in rows["on"]},
+        "store_identical": True,
+        "compactions": stats["on"]["compactions"],
+        "wasted_lane_rounds_on": w_on,
+        "wasted_lane_rounds_off": w_off,
+        "wasted_reduction": round(w_off / max(w_on, 1), 2),
+        "active_lane_rounds": stats["on"]["active_lane_rounds"],
+    }
+    art_dir = os.environ.get("FIREBIRD_COMPACT_DIR", "/tmp/fb_compact")
+    os.makedirs(art_dir, exist_ok=True)
+    art = os.path.join(art_dir, "compact_smoke.json")
+    with open(art, "w") as f:
+        json.dump(report, f, indent=1)
+    print("compact-smoke OK: stores identical "
+          f"({sum(report['rows'].values())} rows), "
+          f"{report['compactions']} compactions, wasted lane-rounds "
+          f"{w_off} -> {w_on} ({report['wasted_reduction']}x); "
+          f"artifact {art}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
