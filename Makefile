@@ -9,14 +9,25 @@ X ?= 542000
 Y ?= 1650000
 ACQUIRED ?= 1982-01-01/2017-12-31
 
-.PHONY: install test bench obs-smoke pipeline-smoke chaos-smoke \
+.PHONY: install lint test bench obs-smoke pipeline-smoke chaos-smoke \
         serve-smoke compact-smoke image db-up db-schema db-test db-down \
         changedetection classification clean
 
 install:
 	pip install -e . --no-build-isolation
 
-test:
+# Static contract checker (docs/STATIC_ANALYSIS.md): the jax-hotpath,
+# knob-registry, metrics-contract, and thread-ownership rule families
+# over the repo itself.  Fails on findings NOT absorbed by the committed
+# lint_baseline.json; the JSON summary lands in FIREBIRD_LINT_DIR
+# (default /tmp/fb_lint) where bench.py folds it into round artifacts.
+lint:
+	python -m firebird_tpu.analysis \
+	  --json "$${FIREBIRD_LINT_DIR:-/tmp/fb_lint}/lint_report.json"
+
+# The default verify path runs the contract checker first: a knob/metric/
+# hotpath/ownership drift fails the build before the (slower) test suite.
+test: lint
 	python -m pytest tests/ -x -q
 
 bench:

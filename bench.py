@@ -173,15 +173,16 @@ def _fleet_obs_fold() -> dict:
     }}
 
 
-def _artifact_fold(key: str, env_var: str, default_dir: str,
-                   filename: str) -> dict:
+def _artifact_fold(key: str, env_var: str, filename: str) -> dict:
     """{key: ...} when a smoke/soak tool left its JSON artifact on this
-    host (under env_var, falling back to default_dir) — per-round
-    evidence folded into the bench record.  Empty dict (not an error)
-    when the tool never ran or the artifact is unreadable."""
+    host (under env_var's directory, default from config.KNOBS) —
+    per-round evidence folded into the bench record.  Empty dict (not an
+    error) when the tool never ran or the artifact is unreadable."""
     import os
 
-    path = os.path.join(os.environ.get(env_var, default_dir), filename)
+    from firebird_tpu.config import env_knob
+
+    path = os.path.join(env_knob(env_var), filename)
     try:
         with open(path) as f:
             return {key: json.load(f)}
@@ -193,21 +194,29 @@ def _chaos_fold() -> dict:
     """`make chaos-smoke` evidence (tools/chaos_soak.py): the robustness
     round's store-identity-under-faults report."""
     return _artifact_fold("chaos_report", "FIREBIRD_CHAOS_DIR",
-                          "/tmp/fb_chaos", "chaos_report.json")
+                          "chaos_report.json")
 
 
 def _compact_fold() -> dict:
     """`make compact-smoke` evidence (tools/compact_smoke.py): the
     on-vs-off store-identity + wasted-lane-round report."""
     return _artifact_fold("compact_smoke", "FIREBIRD_COMPACT_DIR",
-                          "/tmp/fb_compact", "compact_smoke.json")
+                          "compact_smoke.json")
 
 
 def _serve_fold() -> dict:
     """Serving-layer loadtest evidence (tools/serve_loadtest.py, run by
     `make serve-smoke`): RPS, p50/p95/p99, cache hit rate."""
     return _artifact_fold("serve_loadtest", "FIREBIRD_SERVE_DIR",
-                          "/tmp/fb_serve", "serve_loadtest.json")
+                          "serve_loadtest.json")
+
+
+def _lint_fold() -> dict:
+    """`make lint` evidence (firebird_tpu.analysis): the static contract
+    checker's summary — clean flag, per-rule counts, baselined and
+    suppressed totals (docs/STATIC_ANALYSIS.md)."""
+    return _artifact_fold("lint_report", "FIREBIRD_LINT_DIR",
+                          "lint_report.json")
 
 
 def measure(cpu_only: bool) -> None:
@@ -676,6 +685,9 @@ def measure(cpu_only: bool) -> None:
             # Last compact-smoke evidence (stores identical on vs off,
             # wasted lane-rounds reduced) when one ran on this host.
             **_compact_fold(),
+            # Last `make lint` summary (contract-checker clean flag +
+            # per-rule counts) when the linter ran on this host.
+            **_lint_fold(),
             "streaming_pixels_per_sec": round(stream_rate, 1),
             **s2_detail,
             **hard_detail,

@@ -1,0 +1,26 @@
+"""firebird-lint: repo-native static analysis (docs/STATIC_ANALYSIS.md).
+
+Four AST-checked contract families over the codebase itself:
+
+- **jax-hotpath** — no host syncs or Python branching on traced values
+  inside jitted/pallas code, and jit static-arg sets that agree with
+  ``ccd.kernel._WIRE_STATICS``.
+- **knob-registry** — every ``FIREBIRD_*`` env read routes through the
+  ``config.KNOBS`` registry, is documented, and is actually read
+  somewhere (dead-knob detection).
+- **metrics-contract** — obs instruments satisfy the Prometheus naming
+  rules, carry help text, and match the docs' metric tables both ways.
+- **thread-ownership** — ``# guarded-by: <lock>`` annotated shared state
+  is only touched under its lock.
+
+Run with ``firebird lint``, ``make lint``, or
+``python -m firebird_tpu.analysis``.  Stdlib ``ast`` only — importing
+this package never imports jax.
+"""
+
+from firebird_tpu.analysis.engine import (Baseline, Finding, LintResult,
+                                          RULE_DOCS, families, main,
+                                          run_lint)
+
+__all__ = ["Baseline", "Finding", "LintResult", "RULE_DOCS", "families",
+           "main", "run_lint"]

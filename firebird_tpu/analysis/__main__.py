@@ -1,0 +1,9 @@
+"""``python -m firebird_tpu.analysis`` — the firebird-lint entry point
+(`make lint` uses this form so it works without the console script)."""
+
+import sys
+
+from firebird_tpu.analysis.engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

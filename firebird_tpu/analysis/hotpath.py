@@ -1,0 +1,465 @@
+"""jax-hotpath rules: no host syncs or Python branching in traced code.
+
+The contract: code that executes under a ``jax.jit`` trace, a ``lax``
+control-flow body, or a Pallas kernel must stay on the device.  A
+``.item()`` / ``device_get`` / ``np.asarray`` on a tracer either crashes
+at trace time or — worse — silently forces a host sync per dispatch; a
+Python ``if`` on a traced value bakes one branch into the compiled
+program.  And the jit/AOT seam has its own drift mode (the PR 6
+near-bug): ``kernel.aot_compile`` passes static kwargs to ``.lower()``
+by hand, so a static added to ``_WIRE_STATICS`` but not to the AOT call
+site makes every warm-start compile key miss silently.
+
+Traced code is found statically, per module:
+
+- functions decorated with ``jax.jit`` / ``partial(jax.jit, ...)``,
+- functions wrapped by a ``name = jax.jit(fn, ...)`` assignment,
+- functions (or lambdas) passed to ``lax`` control flow
+  (``while_loop``/``cond``/``scan``/``fori_loop``/``switch``),
+  ``pallas_call``, ``vmap``/``pmap``/``shard_map``/``checkpoint``,
+- functions defined inside, or called by bare name from, any of the
+  above (transitive, same module).
+
+For directly-jitted functions the ``static_argnames``/``static_argnums``
+set is resolved (including through a module-level tuple like
+``_WIRE_STATICS``), so branching on a *static* argument is — correctly —
+not a finding.  Transitively-traced helpers have unknown staticness and
+only get the unambiguous host-sync checks; branching there is the
+developer's call (document with a suppression if a checker ever grows
+into it).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from firebird_tpu.analysis.engine import LintContext, SourceFile, rule
+
+# Call wrappers whose function-valued arguments execute traced.
+TRACING_WRAPPERS = {"while_loop", "cond", "scan", "fori_loop", "switch",
+                    "pallas_call", "vmap", "pmap", "shard_map",
+                    "checkpoint", "remat"}
+
+# Zero-arg attribute calls that force (or imply) a device->host sync.
+HOST_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+
+# Attribute accesses on a traced value that are static at trace time —
+# branching on these is legitimate shape/dtype dispatch, not a traced
+# branch.
+STATIC_VALUE_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+# numpy conversion entry points that materialize their argument on host.
+NP_CONVERTERS = {"asarray", "array", "copy", "ascontiguousarray"}
+
+CASTS = {"float", "int", "bool"}
+
+
+class TracedFn:
+    """One function body that executes under a trace."""
+
+    def __init__(self, node, reason: str, static: set[str] | None,
+                 statics_known: bool):
+        self.node = node                    # FunctionDef / Lambda
+        self.reason = reason                # "jit" | "wrapper" | "reach"
+        self.static = static or set()
+        # True when the static-arg set is authoritative (a jit site we
+        # resolved, or a control-flow body where every param is traced).
+        self.statics_known = statics_known
+
+    @property
+    def params(self) -> set[str]:
+        a = self.node.args
+        names = [p.arg for p in
+                 list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return set(names)
+
+    @property
+    def traced_params(self) -> set[str]:
+        return self.params - self.static
+
+
+class ModuleScan:
+    """Per-module alias/def/jit-site inventory."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.np_aliases: set[str] = set()
+        self.jit_names: set[str] = set()          # from jax import jit
+        self.defs: dict[str, ast.AST] = {}        # name -> innermost def
+        self.str_tuples: dict[str, tuple[str, ...]] = {}
+        # wrapped function name -> [(statics or None, call node)]
+        self.jit_sites: dict[str, list] = {}
+        # assigned wrapper name -> statics (from `w = jax.jit(f, ...)`)
+        self.wrapper_statics: dict[str, set[str] | None] = {}
+        self._scan_imports()
+        self._scan_defs()
+        self._scan_tuples()
+
+    def _scan_imports(self) -> None:
+        for node in ast.walk(self.src.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "numpy":
+                        self.np_aliases.add(a.asname or "numpy")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name == "jit":
+                            self.jit_names.add(a.asname or "jit")
+
+    def _scan_defs(self) -> None:
+        for node in ast.walk(self.src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs[node.name] = node
+
+    def _scan_tuples(self) -> None:
+        for node in self.src.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                elts = node.value.elts
+                if elts and all(isinstance(e, ast.Constant)
+                                and isinstance(e.value, str) for e in elts):
+                    self.str_tuples[node.targets[0].id] = tuple(
+                        e.value for e in elts)
+
+    # -- jit expression recognition ----------------------------------------
+
+    def is_jit_expr(self, node: ast.AST) -> bool:
+        """``jax.jit`` (or an imported ``jit``) as a bare reference."""
+        if isinstance(node, ast.Attribute) and node.attr == "jit":
+            return True
+        return isinstance(node, ast.Name) and node.id in self.jit_names
+
+    def jit_call_statics(self, call: ast.Call,
+                         fn_node=None) -> set[str] | None:
+        """The static-arg name set a ``jax.jit(...)`` call declares, or
+        None when it cannot be resolved statically."""
+        statics: set[str] = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                names = self._resolve_names(kw.value)
+                if names is None:
+                    return None
+                statics |= names
+            elif kw.arg == "static_argnums":
+                if fn_node is None:
+                    return None
+                nums = self._resolve_nums(kw.value)
+                if nums is None:
+                    return None
+                a = fn_node.args
+                pos = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+                for i in nums:
+                    if 0 <= i < len(pos):
+                        statics.add(pos[i])
+                    else:
+                        return None
+        return statics
+
+    def _resolve_names(self, node: ast.AST) -> set[str] | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return {node.value}
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = set()
+            for e in node.elts:
+                got = self._resolve_names(e)
+                if got is None:
+                    return None
+                out |= got
+            return out
+        if isinstance(node, ast.Name) and node.id in self.str_tuples:
+            return set(self.str_tuples[node.id])
+        return None
+
+    @staticmethod
+    def _resolve_nums(node: ast.AST) -> list[int] | None:
+        try:
+            v = ast.literal_eval(node)
+        except (ValueError, SyntaxError):
+            return None
+        if isinstance(v, int):
+            return [v]
+        if isinstance(v, (tuple, list)) \
+                and all(isinstance(i, int) for i in v):
+            return list(v)
+        return None
+
+    def decorator_statics(self, fn) -> tuple[bool, set[str] | None]:
+        """(is_jitted, statics) for a function's decorator list."""
+        for dec in fn.decorator_list:
+            if self.is_jit_expr(dec):
+                return True, set()
+            if isinstance(dec, ast.Call):
+                if self.is_jit_expr(dec.func):
+                    return True, self.jit_call_statics(dec, fn)
+                # functools.partial(jax.jit, ...) / partial(jax.jit, ...)
+                f = dec.func
+                is_partial = (isinstance(f, ast.Name) and f.id == "partial") \
+                    or (isinstance(f, ast.Attribute) and f.attr == "partial")
+                if is_partial and dec.args \
+                        and self.is_jit_expr(dec.args[0]):
+                    return True, self.jit_call_statics(dec, fn)
+        return False, None
+
+
+def _collect_traced(scan: ModuleScan) -> dict[int, TracedFn]:
+    """id(def-node) -> TracedFn for every traced body in the module."""
+    traced: dict[int, TracedFn] = {}
+
+    def add(node, reason, static, known):
+        if id(node) not in traced:
+            traced[id(node)] = TracedFn(node, reason, static, known)
+            return True
+        return False
+
+    # 1. decorated defs
+    for fn in scan.defs.values():
+        jitted, statics = scan.decorator_statics(fn)
+        if jitted:
+            add(fn, "jit", statics, statics is not None)
+
+    # 2. jax.jit(fn, ...) call sites + wrapper-name assignments
+    for node in ast.walk(scan.src.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and scan.is_jit_expr(node.value.func):
+            call = node.value
+            wrapped = call.args[0] if call.args else None
+            fn = scan.defs.get(wrapped.id) \
+                if isinstance(wrapped, ast.Name) else None
+            statics = scan.jit_call_statics(call, fn)
+            scan.wrapper_statics[node.targets[0].id] = statics
+            if isinstance(wrapped, ast.Name):
+                scan.jit_sites.setdefault(wrapped.id, []).append(
+                    (statics, call))
+                if fn is not None:
+                    add(fn, "jit", statics, statics is not None)
+
+    # 3. control-flow / pallas wrapper arguments: every param is traced
+    for node in ast.walk(scan.src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name not in TRACING_WRAPPERS:
+            continue
+        cands = list(node.args)
+        for a in list(cands):
+            if isinstance(a, (ast.Tuple, ast.List)):
+                cands.extend(a.elts)
+        for a in cands:
+            if isinstance(a, ast.Lambda):
+                add(a, "wrapper", set(), True)
+            elif isinstance(a, ast.Name) and a.id in scan.defs:
+                add(scan.defs[a.id], "wrapper", set(), True)
+
+    # 4. transitive closure: nested defs and same-module callees of
+    #    traced bodies are traced (unknown staticness: host-sync only).
+    work = list(traced.values())
+    while work:
+        tf = work.pop()
+        for node in ast.walk(tf.node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not tf.node:
+                if add(node, "reach", None, False):
+                    work.append(traced[id(node)])
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in scan.defs:
+                callee = scan.defs[node.func.id]
+                if add(callee, "reach", None, False):
+                    work.append(traced[id(callee)])
+    return traced
+
+
+# -- per-body checks --------------------------------------------------------
+
+def _own_nodes(fn) -> list[ast.AST]:
+    """Statements of ``fn`` excluding nested function/lambda bodies (they
+    are traced bodies of their own and checked separately)."""
+    out = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        out.append(n)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _subtree_names(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _branch_names(test: ast.AST) -> set[str]:
+    """Names in a branch test that would make it a traced branch —
+    occurrences under static accessors (``.shape``/``.dtype``/...,
+    ``len()``, ``isinstance()``, ``is``/``is not`` comparisons) pruned."""
+    if isinstance(test, ast.Attribute) and test.attr in STATIC_VALUE_ATTRS:
+        return set()
+    if isinstance(test, ast.Call) and isinstance(test.func, ast.Name) \
+            and test.func.id in ("len", "isinstance", "hasattr",
+                                 "getattr", "callable"):
+        return set()
+    if isinstance(test, ast.Compare) \
+            and all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops):
+        return set()
+    names = {test.id} if isinstance(test, ast.Name) else set()
+    for child in ast.iter_child_nodes(test):
+        names |= _branch_names(child)
+    return names
+
+
+def _check_body(ctx: LintContext, src: SourceFile, scan: ModuleScan,
+                tf: TracedFn) -> None:
+    traced_params = tf.traced_params
+    for node in _own_nodes(tf.node):
+        # host syncs ----------------------------------------------------
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in HOST_SYNC_ATTRS and not node.args:
+                    ctx.emit("hotpath-host-sync", src, node.lineno,
+                             f".{f.attr}() inside traced code forces a "
+                             "device->host sync")
+                    continue
+                if f.attr == "device_get":
+                    ctx.emit("hotpath-host-sync", src, node.lineno,
+                             "device_get inside traced code forces a "
+                             "device->host transfer")
+                    continue
+                if isinstance(f.value, ast.Name) \
+                        and f.value.id in scan.np_aliases \
+                        and f.attr in NP_CONVERTERS \
+                        and node.args \
+                        and _subtree_names(node.args[0]) & traced_params:
+                    ctx.emit("hotpath-host-sync", src, node.lineno,
+                             f"np.{f.attr} on a traced argument "
+                             "materializes it on host (use jnp)")
+                    continue
+            elif isinstance(f, ast.Name):
+                if f.id == "device_get":
+                    ctx.emit("hotpath-host-sync", src, node.lineno,
+                             "device_get inside traced code forces a "
+                             "device->host transfer")
+                    continue
+                if tf.statics_known and f.id in CASTS \
+                        and len(node.args) == 1 \
+                        and isinstance(node.args[0], ast.Name) \
+                        and node.args[0].id in traced_params:
+                    ctx.emit("hotpath-host-sync", src, node.lineno,
+                             f"{f.id}() on traced argument "
+                             f"{node.args[0].id!r} concretizes a tracer")
+                    continue
+        # traced branches -----------------------------------------------
+        if tf.statics_known and isinstance(node, (ast.If, ast.While)):
+            hits = _branch_names(node.test) & traced_params
+            if hits:
+                ctx.emit("hotpath-traced-branch", src, node.lineno,
+                         "Python branch on traced argument(s) "
+                         f"{', '.join(sorted(hits))} — use lax.cond/"
+                         "jnp.where or declare the arg static")
+
+
+# -- statics drift ----------------------------------------------------------
+
+def _check_statics(ctx: LintContext, src: SourceFile,
+                   scan: ModuleScan) -> None:
+    # (a) every jit site wrapping the same function agrees on statics
+    for name, sites in sorted(scan.jit_sites.items()):
+        known = [(s, c) for s, c in sites if s is not None]
+        if len(known) > 1:
+            first, _ = known[0]
+            for statics, call in known[1:]:
+                if statics != first:
+                    ctx.emit(
+                        "hotpath-statics-drift", src, call.lineno,
+                        f"jit of {name!r} declares statics "
+                        f"{sorted(statics)} but an earlier site "
+                        f"declares {sorted(first)} — AOT cache keys "
+                        "will miss")
+        # (b) declared statics must be real parameters
+        fn = scan.defs.get(name)
+        if fn is None:
+            continue
+        params = TracedFn(fn, "jit", set(), True).params
+        for statics, call in known:
+            ghost = statics - params
+            if ghost:
+                ctx.emit("hotpath-statics-drift", src, call.lineno,
+                         f"static_argnames {sorted(ghost)} are not "
+                         f"parameters of {name!r}")
+
+    # (c) .lower(...) AOT call sites pass exactly the wrapper's statics
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))):
+            continue
+        local_env: dict[str, list[str]] = {}
+        for stmt in ast.walk(node):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                v = stmt.value
+                if isinstance(v, ast.Name):
+                    local_env[stmt.targets[0].id] = [v.id]
+                elif isinstance(v, ast.IfExp) \
+                        and isinstance(v.body, ast.Name) \
+                        and isinstance(v.orelse, ast.Name):
+                    local_env[stmt.targets[0].id] = [v.body.id,
+                                                     v.orelse.id]
+        for call in ast.walk(node):
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "lower"
+                    and isinstance(call.func.value, ast.Name)):
+                continue
+            base = call.func.value.id
+            cands = local_env.get(base, [base])
+            statics_sets = [scan.wrapper_statics[c] for c in cands
+                            if c in scan.wrapper_statics]
+            if len(statics_sets) != len(cands) \
+                    or any(s is None for s in statics_sets):
+                continue   # not (all) jit wrappers, or unresolvable
+            want = statics_sets[0]
+            if any(s != want for s in statics_sets[1:]):
+                continue   # drift already reported at the jit sites
+            got = {kw.arg for kw in call.keywords if kw.arg is not None}
+            if got != want:
+                missing = sorted(want - got)
+                extra = sorted(got - want)
+                detail = "; ".join(
+                    p for p in (f"missing {missing}" if missing else "",
+                                f"extra {extra}" if extra else "") if p)
+                ctx.emit("hotpath-statics-drift", src, call.lineno,
+                         f"AOT .lower() kwargs disagree with the jit "
+                         f"wrapper's static set ({detail}) — the warm "
+                         "entry will never match a real dispatch")
+
+
+@rule("jax-hotpath", {
+    "hotpath-host-sync":
+        "host-sync call (.item/device_get/np.asarray/float-cast) inside "
+        "traced code",
+    "hotpath-traced-branch":
+        "Python if/while on a traced (non-static) argument inside "
+        "traced code",
+    "hotpath-statics-drift":
+        "jit/AOT static-arg sets disagree (or name ghost parameters)",
+})
+def check_hotpath(ctx: LintContext) -> None:
+    for src in ctx.sources:
+        scan = ModuleScan(src)
+        traced = _collect_traced(scan)
+        for tf in traced.values():
+            _check_body(ctx, src, scan, tf)
+        if scan.jit_sites or scan.wrapper_statics:
+            _check_statics(ctx, src, scan)

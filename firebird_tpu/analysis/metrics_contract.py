@@ -1,0 +1,243 @@
+"""metrics-contract rules: obs instruments vs naming, help, and docs.
+
+The obs registry (obs/metrics.py) is get-or-create by name from ~50
+call sites across 15 modules — nothing ever forced a new instrument to
+(a) survive Prometheus exposition (``PROM_LINE_RE``), (b) carry a
+``# HELP`` body, or (c) land in the docs' metric tables.  These rules
+close all three loops, both directions: every registered instrument
+must be documented, and every metric a docs table declares must still
+have a registration site (so a renamed counter cannot leave a stale
+table row behind).
+
+Registration sites are AST call sites of ``counter(...)`` /
+``gauge(...)`` / ``histogram(...)`` — the module helpers, the
+``obs_metrics.*`` aliases, and registry-method calls alike.  Dynamic
+names (f-strings like ``f"stream_{k}"``) become ``stream_*`` patterns
+and match docs wildcards (``stream_*``) or placeholder spellings
+(``faults_injected_<scope>``, ``serve_requests_{segments,pixel}``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from firebird_tpu.analysis.engine import LintContext, rule
+
+METRICS_MODULE = "firebird_tpu/obs/metrics.py"
+
+# Mirrors obs.metrics._prom_name's input expectations: what the
+# sanitizer would have to rewrite is what we reject at the source.
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# Files whose metric tables / code spans document instruments.  A metric
+# may be documented in any of them; table rows in any of them must
+# resolve to a live registration.
+DOC_FILES = ("docs/OBSERVABILITY.md", "docs/ROBUSTNESS.md",
+             "docs/SERVING.md", "docs/ROOFLINE.md")
+
+_KINDS = {"counter", "gauge", "histogram"}
+
+
+class Site:
+    def __init__(self, kind: str, name: str, dynamic: bool,
+                 src, line: int, has_help: bool):
+        self.kind = kind
+        self.name = name          # literal name, or the '*' pattern
+        self.dynamic = dynamic
+        self.src = src
+        self.line = line
+        self.has_help = has_help
+
+
+def _name_arg(node: ast.Call) -> tuple[str, bool] | None:
+    """(name_or_pattern, dynamic) from the call's first argument."""
+    if not node.args:
+        return None
+    a = node.args[0]
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        return a.value, False
+    if isinstance(a, ast.JoinedStr):
+        parts = [str(v.value) if isinstance(v, ast.Constant) else "*"
+                 for v in a.values]
+        return "".join(parts), True
+    return None
+
+
+def collect_sites(ctx: LintContext) -> list[Site]:
+    sites = []
+    for src in ctx.sources:
+        if not src.relpath.startswith("firebird_tpu/"):
+            continue
+        if src.relpath == METRICS_MODULE:
+            continue  # the registry's own plumbing, not instrumentation
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            kind = None
+            if isinstance(f, ast.Name) and f.id in _KINDS:
+                kind = f.id
+            elif isinstance(f, ast.Attribute) and f.attr in _KINDS:
+                kind = f.attr
+            if kind is None:
+                continue
+            named = _name_arg(node)
+            if named is None:
+                continue
+            name, dynamic = named
+            has_help = any(k.arg == "help" and not (
+                isinstance(k.value, ast.Constant) and k.value.value is None)
+                for k in node.keywords)
+            sites.append(Site(kind, name, dynamic, src, node.lineno,
+                              has_help))
+    return sites
+
+
+# -- docs parsing -----------------------------------------------------------
+
+_CODE_SPAN_RE = re.compile(r"`([^`]+)`")
+_BRACE_RE = re.compile(r"\{([^{}]*)\}")
+_TABLE_KIND_RE = re.compile(r"^(counter|gauge|histogram)s?\b")
+_METRIC_TOKEN_RE = re.compile(r"^[a-z][a-z0-9_*]*$")
+
+
+def _expand(token: str) -> list[str]:
+    """Expand doc spellings into match patterns: ``{a,b}`` alternates
+    (including the empty alternate of ``{,_x}``), ``<placeholder>`` and
+    literal ``*`` wildcards."""
+    token = re.sub(r"<[^<>]+>", "*", token)
+    m = _BRACE_RE.search(token)
+    if not m:
+        return [token]
+    head, tail = token[:m.start()], token[m.end():]
+    out = []
+    for alt in m.group(1).split(","):
+        out.extend(_expand(head + alt + tail))
+    return out
+
+
+def doc_patterns(ctx: LintContext) -> dict[str, tuple[str, int]]:
+    """Every metric-ish pattern the docs mention anywhere (code spans):
+    pattern -> (file, line).  The "is it documented" direction."""
+    out: dict[str, tuple[str, int]] = {}
+    for rel in DOC_FILES:
+        text = ctx.read_text(rel)
+        if text is None:
+            continue
+        for i, line in enumerate(text.splitlines(), start=1):
+            for span in _CODE_SPAN_RE.findall(line):
+                for tok in _expand(span.strip()):
+                    if _METRIC_TOKEN_RE.fullmatch(tok):
+                        out.setdefault(tok, (rel, i))
+    return out
+
+
+def doc_table_metrics(ctx: LintContext) -> dict[str, tuple[str, int]]:
+    """Metrics DECLARED by a docs table (rows whose second column is a
+    counter/gauge/histogram kind): pattern -> (file, line).  The reverse
+    direction — these must all resolve to a live registration site."""
+    out: dict[str, tuple[str, int]] = {}
+    for rel in DOC_FILES:
+        text = ctx.read_text(rel)
+        if text is None:
+            continue
+        for i, line in enumerate(text.splitlines(), start=1):
+            if not line.lstrip().startswith("|"):
+                continue
+            cells = [c.strip() for c in line.strip().strip("|").split("|")]
+            if len(cells) < 2 or not _TABLE_KIND_RE.match(cells[1]):
+                continue
+            for span in _CODE_SPAN_RE.findall(cells[0]):
+                for tok in _expand(span.strip()):
+                    if _METRIC_TOKEN_RE.fullmatch(tok):
+                        out.setdefault(tok, (rel, i))
+    return out
+
+
+def help_catalog(ctx: LintContext) -> set[str]:
+    """Keys of obs.metrics.METRIC_HELP (exact names and glob patterns)
+    — the central # HELP fallback a site-less instrument may rely on."""
+    src = ctx.source(METRICS_MODULE)
+    if src is None:
+        return set()
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "METRIC_HELP" \
+                and isinstance(node.value, ast.Dict):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return set()
+
+
+def _pattern_match(pattern: str, name: str) -> bool:
+    """Glob-ish match where '*' spans word characters; tried both ways
+    so a dynamic site (stream_*) matches a docs wildcard (stream_*)."""
+    if pattern == name:
+        return True
+    rex = re.escape(pattern).replace(r"\*", r"[a-z0-9_]+")
+    if re.fullmatch(rex, name):
+        return True
+    rex2 = re.escape(name).replace(r"\*", r"[a-z0-9_]+")
+    return re.fullmatch(rex2, pattern) is not None
+
+
+@rule("metrics-contract", {
+    "metric-name":
+        "instrument name breaks the Prometheus naming contract",
+    "metric-total-suffix":
+        "non-counter instrument named *_total (masquerades as a counter)",
+    "metric-help":
+        "instrument never registered with help text at any call site",
+    "metric-undocumented":
+        "registered instrument missing from the docs' metric tables/spans",
+    "metric-doc-stale":
+        "docs table declares a metric with no registration site left",
+})
+def check_metrics(ctx: LintContext) -> None:
+    sites = collect_sites(ctx)
+    if not sites:
+        return
+    docs = doc_patterns(ctx)
+    tables = doc_table_metrics(ctx)
+    catalog = help_catalog(ctx)
+
+    by_name: dict[tuple[str, str], list[Site]] = {}
+    for s in sites:
+        by_name.setdefault((s.kind, s.name), []).append(s)
+
+    for (kind, name), group in sorted(by_name.items()):
+        first = min(group, key=lambda s: (s.src.relpath, s.line))
+        bare = name.replace("*", "x")
+        if not NAME_RE.fullmatch(bare) or "__" in bare \
+                or bare.endswith("_"):
+            ctx.emit("metric-name", first.src, first.line,
+                     f"{kind} {name!r} would not survive Prometheus "
+                     "exposition (want ^[a-z][a-z0-9_]*$, no '__', no "
+                     "trailing '_')")
+            continue
+        if kind != "counter" and name.endswith("_total"):
+            ctx.emit("metric-total-suffix", first.src, first.line,
+                     f"{kind} {name!r} ends in _total — that suffix is "
+                     "the counter convention (obs.metrics._prom_name)")
+        if not any(s.has_help for s in group) \
+                and not any(_pattern_match(p, name) for p in catalog):
+            ctx.emit("metric-help", first.src, first.line,
+                     f"{kind} {name!r} has no help text: pass help= at "
+                     "a registration site or add an "
+                     "obs.metrics.METRIC_HELP entry")
+        if not any(_pattern_match(p, name) for p in docs):
+            ctx.emit("metric-undocumented", first.src, first.line,
+                     f"{kind} {name!r} is not mentioned in any of "
+                     f"{', '.join(DOC_FILES)}")
+
+    # Reverse: every table-declared metric still has a registration.
+    live = [s.name for s in sites]
+    for pat, (rel, line) in sorted(tables.items()):
+        if not any(_pattern_match(pat, p) or _pattern_match(p, pat)
+                   for p in live):
+            ctx.emit("metric-doc-stale", rel, line,
+                     f"docs table declares {pat!r} but no code "
+                     "registers it")

@@ -69,9 +69,9 @@ def use_pallas(component: str = "lasso") -> bool:
     "all-on" configs keep their meaning.  Read at trace time: set it
     before the first detect call — already-compiled programs keep their
     path."""
-    import os
+    from firebird_tpu.config import env_knob
 
-    v = os.environ.get("FIREBIRD_PALLAS", "0")
+    v = env_knob("FIREBIRD_PALLAS")
     if v in ("", "0"):
         return False
     if v == "1":

@@ -135,9 +135,9 @@ def variogram_adjusted_default() -> bool:
     hold the full kernel<->oracle parity envelope.  Read at trace time —
     set before the first detect call (one compiled fn per mode).
     """
-    import os
+    from firebird_tpu.config import env_knob
 
-    return os.environ.get("FIREBIRD_VARIOGRAM", "adjusted") == "adjusted"
+    return env_knob("FIREBIRD_VARIOGRAM") == "adjusted"
 
 def compact_default() -> bool:
     """Whether active-lane compaction runs in the event loop
@@ -152,9 +152,9 @@ def compact_default() -> bool:
     loop exit).  Read at trace time like FIREBIRD_PALLAS — set before
     the first detect call; explicit ``compact=`` arguments to
     detect_packed/detect_sharded override per call."""
-    import os
+    from firebird_tpu.config import env_knob
 
-    return os.environ.get("FIREBIRD_COMPACT", "1") not in ("", "0")
+    return env_knob("FIREBIRD_COMPACT") not in ("", "0")
 
 
 def compact_every() -> int:
@@ -162,9 +162,9 @@ def compact_every() -> int:
     default 4, min 1).  A check only permutes when at least 1/16 of a
     chip's lanes died since the last compaction — the gather sweep over
     the carried residents must buy skipped blocks.  Trace-time read."""
-    import os
+    from firebird_tpu.config import env_knob
 
-    return max(int(os.environ.get("FIREBIRD_COMPACT_EVERY", "4")), 1)
+    return max(int(env_knob("FIREBIRD_COMPACT_EVERY")), 1)
 
 
 def compact_min_lanes() -> int:
@@ -174,9 +174,9 @@ def compact_min_lanes() -> int:
     (P=10000), pure compile cost for the tiny pixel slices the test
     suite dispatches — so small batches keep the single compacted loop.
     Trace-time read; tests crafting small cascades lower it."""
-    import os
+    from firebird_tpu.config import env_knob
 
-    return max(int(os.environ.get("FIREBIRD_COMPACT_MIN_LANES", "1024")), 1)
+    return max(int(env_knob("FIREBIRD_COMPACT_MIN_LANES")), 1)
 
 
 def compact_floor() -> float:
@@ -187,9 +187,9 @@ def compact_floor() -> float:
     forced compaction) are sliced into the bucket, and a smaller-shape
     loop finishes them (kernel._detect_batch_impl stage 2).  Trace-time
     read."""
-    import os
+    from firebird_tpu.config import env_knob
 
-    v = float(os.environ.get("FIREBIRD_COMPACT_FLOOR", "0.125"))
+    v = float(env_knob("FIREBIRD_COMPACT_FLOOR"))
     return min(max(v, 0.0), 1.0)
 
 

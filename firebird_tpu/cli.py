@@ -26,9 +26,9 @@ def apply_platform(platform: str | None = None) -> None:
     user environment settings can win; a runtime config update always
     takes precedence, so FIREBIRD_JAX_PLATFORM is the reliable override.
     """
-    import os
+    from firebird_tpu.config import env_knob
 
-    p = platform or os.environ.get("FIREBIRD_JAX_PLATFORM")
+    p = platform or env_knob("FIREBIRD_JAX_PLATFORM")
     if p:
         import jax
 
@@ -304,6 +304,9 @@ def validate(x, y, acquired, n_pixels, dtype, seed):
 @click.option("--port", "-p", default=None, type=int,
               help="listen port; overrides FIREBIRD_SERVE_PORT "
                    "(default 8080); 0 binds an ephemeral port")
+@click.option("--host", default=None,
+              help="bind address; overrides FIREBIRD_SERVE_HOST "
+                   "(default 0.0.0.0 — use 127.0.0.1 to stay host-local)")
 @click.option("--cache-entries", default=None, type=int,
               help="in-memory cache bound (entries); overrides "
                    "FIREBIRD_SERVE_CACHE_ENTRIES")
@@ -314,7 +317,7 @@ def validate(x, y, acquired, n_pixels, dtype, seed):
               help="disable compute-on-miss: absent product rows answer "
                    "404 instead of running the products.save-path "
                    "computation (strictly read-only serving)")
-def serve(port, cache_entries, cache_dir, no_compute):
+def serve(port, host, cache_entries, cache_dir, no_compute):
     """Serve the query API over the configured results store.
 
     Endpoints: /v1/segments?cx=&cy=, /v1/pixel?x=&y=&date=,
@@ -330,7 +333,8 @@ def serve(port, cache_entries, cache_dir, no_compute):
     from firebird_tpu.store import open_store
 
     overrides = {k: v for k, v in
-                 (("serve_port", port), ("serve_cache_entries", cache_entries),
+                 (("serve_port", port), ("serve_host", host),
+                  ("serve_cache_entries", cache_entries),
                   ("serve_cache_dir", cache_dir)) if v is not None}
     # --port 0 means "ephemeral bind", which Config rejects as a
     # deploy-time port; thread it past validation separately.
@@ -341,7 +345,8 @@ def serve(port, cache_entries, cache_dir, no_compute):
     store = open_store(cfg.store_backend, cfg.store_path, cfg.keyspace())
     service = serve_api.ServeService(store, cfg,
                                      compute_on_miss=not no_compute)
-    srv = serve_api.start_serve_server(bind_port, service)
+    srv = serve_api.start_serve_server(bind_port, service,
+                                       host=cfg.serve_host)
     click.echo(f"serving {cfg.store_backend}:{cfg.store_path} "
                f"[{cfg.keyspace()}] on port {srv.port} (ctrl-c to stop)")
     stop = threading.Event()
@@ -404,6 +409,25 @@ def status(x, y):
             "chips_total": len(cids),
         }
     click.echo(_json.dumps(out, indent=1))
+
+
+@entrypoint.command(context_settings=dict(
+    ignore_unknown_options=True, help_option_names=[]))
+@click.argument("args", nargs=-1, type=click.UNPROCESSED)
+def lint(args):
+    """Run the repo's static contract checker (docs/STATIC_ANALYSIS.md).
+
+    Four AST rule families: jax-hotpath (no host syncs / traced
+    branches / static-arg drift in jitted code), knob-registry
+    (FIREBIRD_* env vars vs config.KNOBS and the docs), metrics-contract
+    (obs instruments vs naming/help/doc tables), and thread-ownership
+    (`# guarded-by:` annotated state only touched under its lock).
+    Exits nonzero on findings not absorbed by the committed baseline.
+    All options (--json, --update-baseline, --rules, --list-rules, ...)
+    pass through to `python -m firebird_tpu.analysis --help`."""
+    from firebird_tpu.analysis import main as lint_main
+
+    raise SystemExit(lint_main(list(args)))
 
 
 @entrypoint.command()

@@ -30,6 +30,192 @@ def _cqlstr(s: str) -> str:
     return re.sub(r"[^a-zA-Z0-9_]", "_", s)
 
 
+# ---------------------------------------------------------------------------
+# Knob registry
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One declared ``FIREBIRD_*`` environment knob.
+
+    The registry below is THE contract firebird-lint's knob-registry rule
+    family enforces (docs/STATIC_ANALYSIS.md): every env read in the
+    codebase must be of a registered knob, from ``Config.from_env`` /
+    :func:`env_knob` or a module declared in ``readers``; every
+    non-internal knob must appear in the docs; and every registered knob
+    must still have a reader somewhere (dead-knob detection).
+
+    ``field``: the :class:`Config` attribute ``from_env`` feeds, or None
+    for knobs deliberately outside Config (trace-time kernel knobs read
+    per trace, tool artifact dirs).  ``readers``: repo-relative modules
+    (``.py`` or ``.sh``) allowed to read the env var directly — the
+    declared exceptions to the route-through-config rule, each with a
+    reason a reviewer can audit here.  ``internal``: exempt from the
+    documentation requirement (harness-only switches).
+    """
+
+    name: str
+    help: str
+    field: str | None = None
+    default: str | None = None
+    readers: tuple = ()
+    internal: bool = False
+
+
+# NOTE for firebird-lint: this tuple must stay a literal of Knob(...)
+# calls with constant arguments — the linter parses it from source (so
+# fixture repos lint hermetically) and ast.literal_eval's each argument.
+KNOBS = (
+    # ---- data plumbing (Config-backed) ----
+    Knob(name="FIREBIRD_STORE_BACKEND", field="store_backend",
+         help="results store backend: sqlite | parquet | memory"),
+    Knob(name="FIREBIRD_STORE_PATH", field="store_path",
+         help="results store path"),
+    Knob(name="FIREBIRD_SOURCE", field="source_backend",
+         help="ingest source: chipmunk | synthetic | file"),
+    Knob(name="FIREBIRD_SOURCE_PATH", field="source_path",
+         help="file-source archive directory (FIREBIRD_SOURCE=file)"),
+    Knob(name="FIREBIRD_BAND_PARALLELISM", field="band_parallelism",
+         help="concurrent per-chip band fetches"),
+    Knob(name="FIREBIRD_CHIPS_PER_BATCH", field="chips_per_batch",
+         help="chips per device dispatch (<= 0: auto-size)"),
+    Knob(name="FIREBIRD_MAX_OBS", field="max_obs",
+         help="max padded observations per pixel series"),
+    Knob(name="FIREBIRD_OBS_BUCKET", field="obs_bucket",
+         help="time-axis padding granularity (compile-shape bucketing)"),
+    Knob(name="FIREBIRD_DTYPE", field="dtype",
+         help="kernel compute dtype: float32 | float64"),
+    Knob(name="FIREBIRD_DEVICE_SHARDING", field="device_sharding",
+         help="chip-batch sharding over local devices: auto | off"),
+    Knob(name="FIREBIRD_FETCH_RETRIES", field="fetch_retries",
+         help="per-chip fetch retries before quarantine"),
+    Knob(name="FIREBIRD_HTTP_TIMEOUT", field="http_timeout",
+         help="Chipmunk HTTP timeout (seconds)"),
+    Knob(name="FIREBIRD_RETRY_BUDGET", field="retry_budget",
+         help="run-wide total retry ceiling (0 = unlimited)"),
+    Knob(name="FIREBIRD_BREAKER_THRESHOLD", field="breaker_threshold",
+         help="consecutive fetch failures that open the ingest breaker"),
+    Knob(name="FIREBIRD_BREAKER_COOLDOWN", field="breaker_cooldown_sec",
+         help="ingest breaker cooldown (seconds)"),
+    Knob(name="FIREBIRD_FAULTS", field="faults",
+         help="deterministic fault-injection plan (docs/ROBUSTNESS.md)"),
+    Knob(name="FIREBIRD_WRITER_THREADS", field="writer_threads",
+         help="async store-writer worker threads"),
+    Knob(name="FIREBIRD_PIPELINE_DEPTH", field="pipeline_depth",
+         help="max device batches in flight"),
+    Knob(name="FIREBIRD_COMPILE_CACHE", field="compile_cache",
+         help="persistent XLA compile cache directory"),
+    Knob(name="FIREBIRD_STREAM_DIR", field="stream_dir",
+         help="streaming-state checkpoint directory"),
+    # ---- observability (Config-backed) ----
+    Knob(name="FIREBIRD_PROFILE_DIR", field="profile_dir",
+         help="jax.profiler trace output directory (device-side)"),
+    Knob(name="FIREBIRD_TRACE", field="trace",
+         help="host span tracer output (Chrome-trace JSON)"),
+    Knob(name="FIREBIRD_OBS_REPORT", field="obs_report",
+         help="per-run obs_report.json destination policy"),
+    Knob(name="FIREBIRD_OPS_PORT", field="ops_port",
+         help="embedded ops endpoint port (0 = never bound)"),
+    Knob(name="FIREBIRD_OPS_HOST", field="ops_host",
+         default="0.0.0.0",
+         help="ops endpoint bind address"),
+    Knob(name="FIREBIRD_STALL_SEC", field="stall_sec",
+         help="watchdog stall deadline (seconds; 0 = off)"),
+    Knob(name="FIREBIRD_OBS_MERGE_TIMEOUT", field="obs_merge_timeout",
+         default="30",
+         help="seconds process 0 waits for host report shards"),
+    # ---- serving layer (Config-backed) ----
+    Knob(name="FIREBIRD_SERVE_PORT", field="serve_port",
+         help="firebird serve listen port"),
+    Knob(name="FIREBIRD_SERVE_HOST", field="serve_host",
+         default="0.0.0.0",
+         help="firebird serve bind address"),
+    Knob(name="FIREBIRD_SERVE_CACHE_ENTRIES", field="serve_cache_entries",
+         help="in-memory serve cache bound (entries)"),
+    Knob(name="FIREBIRD_SERVE_CACHE_DIR", field="serve_cache_dir",
+         help="serve cache disk spill tier directory"),
+    Knob(name="FIREBIRD_SERVE_INFLIGHT", field="serve_inflight",
+         help="concurrent /v1 requests executing"),
+    Knob(name="FIREBIRD_SERVE_QUEUE", field="serve_queue",
+         help="admission waiting-line bound (past it: 429)"),
+    Knob(name="FIREBIRD_SERVE_DEADLINE", field="serve_deadline_sec",
+         help="per-request deadline (seconds; past it: 504)"),
+    # ---- trace-time kernel knobs (read per trace, not per run — a
+    # Config field would freeze them at construction; declared readers
+    # route through env_knob) ----
+    Knob(name="FIREBIRD_COMPACT", field="compact", default="1",
+         help="active-lane compaction in the CCD event loop"),
+    Knob(name="FIREBIRD_COMPACT_EVERY", default="4",
+         readers=("tools/compact_smoke.py",),  # pins the child kernel's env
+         help="event-loop rounds between compaction sweeps"),
+    Knob(name="FIREBIRD_COMPACT_MIN_LANES", default="1024",
+         help="min padded lanes before bucketed re-entry applies"),
+    Knob(name="FIREBIRD_COMPACT_FLOOR", default="0.125",
+         readers=("tools/compact_smoke.py",),  # pins the child kernel's env
+         help="bucket fraction that triggers loop re-entry"),
+    Knob(name="FIREBIRD_PALLAS", default="0",
+         help="Pallas kernel component selection (0/1/comma list)"),
+    Knob(name="FIREBIRD_VARIOGRAM", default="adjusted",
+         help="variogram mode: adjusted | plain"),
+    # ---- process-wide switches read before/without a Config ----
+    Knob(name="FIREBIRD_JAX_PLATFORM",
+         help="pin the JAX platform (cpu/tpu) before first use"),
+    Knob(name="FIREBIRD_NO_NATIVE",
+         help="disable the native acceleration extensions"),
+    Knob(name="FIREBIRD_METRICS", default="1",
+         readers=("firebird_tpu/obs/metrics.py",),  # per-call hot gate
+         help="0 disables all metric recording"),
+    Knob(name="FIREBIRD_LOG_LEVEL", default="INFO",
+         readers=("firebird_tpu/obs/__init__.py",),  # logging bootstrap
+         help="root log level"),
+    Knob(name="FIREBIRD_LOG_LEVELS",
+         readers=("firebird_tpu/obs/__init__.py",),
+         help="per-category log levels (comma list)"),
+    Knob(name="FIREBIRD_LOG_FORMAT", default="text",
+         readers=("firebird_tpu/obs/__init__.py",
+                  "firebird_tpu/obs/jsonlog.py"),
+         help="text | json structured log lines"),
+    # ---- bench/smoke harness knobs (artifact dirs + budgets; read by
+    # the tools that own the artifact, folded by bench.py) ----
+    Knob(name="FIREBIRD_BENCH_BUDGET", default="2700",
+         readers=("bench.py", "tools/tpu_watchdog.sh"),
+         help="bench wall-clock budget (seconds)"),
+    Knob(name="FIREBIRD_TILE_BUDGET", default="3000",
+         readers=("tools/tpu_tile_run.sh",),
+         help="full-tile TPU run timeout (seconds)"),
+    Knob(name="FIREBIRD_SOAK_DIR", default="/tmp/fb_soak",
+         readers=("bench.py",),
+         help="soak-run artifact directory"),
+    Knob(name="FIREBIRD_CHAOS_DIR", default="/tmp/fb_chaos",
+         help="chaos-soak artifact directory"),
+    Knob(name="FIREBIRD_COMPACT_DIR", default="/tmp/fb_compact",
+         readers=("tools/compact_smoke.py",),
+         help="compact-smoke artifact directory"),
+    Knob(name="FIREBIRD_SERVE_DIR", default="/tmp/fb_serve",
+         help="serve-loadtest artifact directory"),
+    Knob(name="FIREBIRD_LINT_DIR", default="/tmp/fb_lint",
+         readers=("Makefile",), internal=True,
+         help="lint-report artifact directory (make lint)"),
+)
+
+KNOBS_BY_NAME = {k.name: k for k in KNOBS}
+
+
+def env_knob(name: str, env: dict | None = None) -> str | None:
+    """Read a registered ``FIREBIRD_*`` knob from the environment.
+
+    The declared route for read sites outside ``Config.from_env``
+    (trace-time kernel knobs, tool artifact dirs): unset returns the
+    registry default, and an unregistered name raises KeyError loudly —
+    firebird-lint's knob-registry rules keep every raw ``os.environ``
+    read either here or in a declared ``readers`` module.
+    """
+    k = KNOBS_BY_NAME[name]
+    e = os.environ if env is None else env
+    v = e.get(name)
+    return k.default if v is None else v
+
+
 @dataclasses.dataclass(frozen=True)
 class Config:
     """Deploy-time configuration.
@@ -138,6 +324,14 @@ class Config:
     # watchdog_stall_total.  <= 0 disables the watchdog.
     stall_sec: float = 0.0
 
+    # Ops endpoint bind address (FIREBIRD_OPS_HOST): 0.0.0.0 serves the
+    # fleet network; 127.0.0.1 keeps the surface host-local.
+    ops_host: str = "0.0.0.0"
+
+    # Seconds process 0 waits for the other hosts' obs-report shards
+    # before merging what arrived (FIREBIRD_OBS_MERGE_TIMEOUT).
+    obs_merge_timeout: float = 30.0
+
     # Active-lane compaction in the CCD event loop (FIREBIRD_COMPACT,
     # default on): dense-prefix lane permutation + per-block skip guards
     # + bucketed re-entry for the long tail, so loop cost tracks the
@@ -166,6 +360,9 @@ class Config:
     # `firebird serve` port (FIREBIRD_SERVE_PORT).  Unlike ops_port this
     # is only read by the serve command — nothing auto-binds it.
     serve_port: int = 8080
+
+    # `firebird serve` bind address (FIREBIRD_SERVE_HOST / --host).
+    serve_host: str = "0.0.0.0"
 
     # In-memory serve cache bound, entries (one decoded chip frame or
     # product raster each; FIREBIRD_SERVE_CACHE_ENTRIES).
@@ -225,6 +422,10 @@ class Config:
         if self.pipeline_depth < 1:
             raise ValueError("FIREBIRD_PIPELINE_DEPTH must be >= 1, got "
                              f"{self.pipeline_depth}")
+        if self.obs_merge_timeout < 0:
+            raise ValueError("FIREBIRD_OBS_MERGE_TIMEOUT must be >= 0 "
+                             "seconds (0 = merge whatever already "
+                             f"arrived), got {self.obs_merge_timeout}")
         if not 0 < self.serve_port <= 65535:
             raise ValueError("FIREBIRD_SERVE_PORT must be a valid TCP "
                              f"port, got {self.serve_port}")
@@ -284,12 +485,16 @@ class Config:
             obs_report=e.get("FIREBIRD_OBS_REPORT", cls.obs_report),
             stream_dir=e.get("FIREBIRD_STREAM_DIR", cls.stream_dir),
             ops_port=int(e.get("FIREBIRD_OPS_PORT", cls.ops_port)),
+            ops_host=e.get("FIREBIRD_OPS_HOST", cls.ops_host),
             stall_sec=float(e.get("FIREBIRD_STALL_SEC", cls.stall_sec)),
+            obs_merge_timeout=float(e.get("FIREBIRD_OBS_MERGE_TIMEOUT",
+                                          cls.obs_merge_timeout)),
             compact=e.get("FIREBIRD_COMPACT", "1") not in ("", "0"),
             pipeline_depth=int(e.get("FIREBIRD_PIPELINE_DEPTH",
                                      cls.pipeline_depth)),
             compile_cache=e.get("FIREBIRD_COMPILE_CACHE", cls.compile_cache),
             serve_port=int(e.get("FIREBIRD_SERVE_PORT", cls.serve_port)),
+            serve_host=e.get("FIREBIRD_SERVE_HOST", cls.serve_host),
             serve_cache_entries=int(e.get("FIREBIRD_SERVE_CACHE_ENTRIES",
                                           cls.serve_cache_entries)),
             serve_cache_dir=e.get("FIREBIRD_SERVE_CACHE_DIR",

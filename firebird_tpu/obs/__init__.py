@@ -63,7 +63,7 @@ CATEGORIES = (
     "pyccd",
 )
 
-_configured = False
+_configured = False  # guarded-by: _lock
 _lock = threading.Lock()
 
 

@@ -44,7 +44,7 @@ class Counter:
         self.name = name
         self.help = help
         self._lock = threading.Lock()
-        self._value = 0
+        self._value = 0  # guarded-by: _lock
 
     def inc(self, n: int = 1) -> None:
         if not metrics_enabled():
@@ -65,7 +65,7 @@ class Gauge:
         self.name = name
         self.help = help
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0  # guarded-by: _lock
 
     def set(self, v: float) -> None:
         if not metrics_enabled():
@@ -102,11 +102,11 @@ class Histogram:
         self.help = help
         self.buckets = tuple(sorted(buckets))
         self._lock = threading.Lock()
-        self._counts = [0] * (len(self.buckets) + 1)   # last = overflow
-        self._sum = 0.0
-        self._count = 0
-        self._min = float("inf")
-        self._max = float("-inf")
+        self._counts = [0] * (len(self.buckets) + 1)  # guarded-by: _lock
+        self._sum = 0.0  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
+        self._min = float("inf")  # guarded-by: _lock
+        self._max = float("-inf")  # guarded-by: _lock
 
     def observe(self, v: float) -> None:
         if not metrics_enabled():
@@ -213,9 +213,72 @@ def _prom_name(name: str, kind: str | None = None) -> str:
     return p
 
 
+# Central ``# HELP`` catalog for instruments registered at hot call
+# sites where an inline ``help=`` kwarg would crowd the instrumentation
+# (an inline help still wins; this is the fallback before the generic
+# default).  Glob keys (``stream_*``) cover dynamically-named families.
+# firebird-lint's metric-help rule accepts an instrument iff SOME
+# registration site passes help= or its name matches an entry here — so
+# a new instrument cannot ship help-less.
+METRIC_HELP = {
+    "kernel_first_call_seconds":
+        "per-shape first kernel call wall time (~ XLA compile)",
+    "kernel_dispatch_shapes":
+        "distinct compiled kernel shapes dispatched this run",
+    "warm_compile_seconds":
+        "background AOT warm-start compile wall time",
+    "pipeline_fetch_seconds": "per-batch source fetch wall time",
+    "pipeline_pack_seconds": "per-batch dense packing wall time",
+    "pipeline_stage_seconds": "per-batch H2D staging wall time",
+    "pipeline_dispatch_seconds": "per-batch dispatch (enqueue) wall time",
+    "pipeline_drain_seconds": "per-batch result drain wall time",
+    "pipeline_d2h_seconds": "per-batch bulk device_get wall time",
+    "ingest_chip_seconds": "per-chip source fetch wall time",
+    "ingest_http_seconds": "chipmunk HTTP request wall time",
+    "ingest_http_requests": "chipmunk HTTP requests issued",
+    "ingest_bytes_in": "decoded ingest payload bytes",
+    "capacity_redispatches":
+        "batches re-dispatched at doubled segment capacity",
+    "chunk_failures": "chunks abandoned by the per-chunk isolation",
+    "fetch_retries": "chip fetches retried after transient errors",
+    "store_write_seconds": "store backend write wall time",
+    "store_flush_seconds": "writer flush (drain-all) wall time",
+    "store_write_errors": "store writes that exhausted their retries",
+    "store_write_retries": "store writes retried after transient errors",
+    "store_queue_depth": "frames queued to the async writer",
+    "watchdog_stall_total": "stall episodes declared by the watchdog",
+    "watchdog_recovered_total": "stalls cleared by a later batch beat",
+    "watchdog_throughput_drop_total":
+        "rolling-window throughput drop events",
+    "stream_publish_seconds": "streaming update publish wall time",
+    "stream_*": "per-run streaming driver summary values",
+    "faults_injected_*": "injected faults by scope (chaos drills)",
+    "serve_requests_segments": "/v1/segments requests served",
+    "serve_requests_pixel": "/v1/pixel requests served",
+    "serve_requests_product": "/v1/product requests served",
+    "serve_requests_tile": "/v1/tile requests served",
+    "serve_deadline_exceeded_total":
+        "requests past their deadline (504)",
+}
+
+
+def _catalog_help(name: str) -> str | None:
+    h = METRIC_HELP.get(name)
+    if h is not None:
+        return h
+    import fnmatch
+
+    for pat, text in METRIC_HELP.items():
+        if "*" in pat and fnmatch.fnmatch(name, pat):
+            return text
+    return None
+
+
 def _help_text(m, kind: str) -> str:
-    """# HELP body: the metric's declared help, or a readable default."""
-    return m.help or f"firebird {kind} {m.name.replace('_', ' ')}"
+    """# HELP body: the metric's declared help, the METRIC_HELP catalog
+    entry, or a readable default."""
+    return m.help or _catalog_help(m.name) \
+        or f"firebird {kind} {m.name.replace('_', ' ')}"
 
 
 class MetricsRegistry:
@@ -224,10 +287,14 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
+        # The three stores are mutated only inside _get (under _lock);
+        # accessors pass the dict REFERENCE through, which is why they
+        # are not guarded-by annotated — the linter checks lexical
+        # with-scopes, not aliases (docs/STATIC_ANALYSIS.md).
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[str, Histogram] = {}
-        self._once: set = set()
+        self._once: set = set()  # guarded-by: _lock
         self._t0 = time.monotonic()
 
     def once(self, key) -> bool:
@@ -312,7 +379,9 @@ def reset_registry() -> MetricsRegistry:
     """Swap in a fresh default registry (test isolation; a run-scoped
     report should not carry a previous run's latencies)."""
     global _registry
-    _registry = MetricsRegistry()
+    # Single-reference swap between runs (tests, driver run setup) while
+    # no instrumented thread is live; readers grab the reference once.
+    _registry = MetricsRegistry()  # firebird-lint: disable=ownership-global-mutation
     return _registry
 
 
@@ -414,8 +483,8 @@ class Counters:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counts: dict[str, int] = {}
-        self._t0: float | None = None
+        self._counts: dict[str, int] = {}  # guarded-by: _lock
+        self._t0: float | None = None  # guarded-by: _lock
 
     def start(self) -> None:
         """Explicitly (re)start the rate clock — call at the moment the

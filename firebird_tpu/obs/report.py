@@ -266,7 +266,9 @@ def merge_fleet_report(path: str, n_processes: int,
     import time as _time
 
     if timeout is None:
-        timeout = float(os.environ.get("FIREBIRD_OBS_MERGE_TIMEOUT", "30"))
+        from firebird_tpu.config import env_knob
+
+        timeout = float(env_knob("FIREBIRD_OBS_MERGE_TIMEOUT"))
     paths = [shard_report_path(path, j) for j in range(n_processes)]
     deadline = _time.monotonic() + timeout
     while not all(os.path.exists(p) for p in paths) \
@@ -382,7 +384,9 @@ def finish_run(cfg, *, tracer=None, run: dict | None = None,
                              run_counters=run_counters)
                 out["report_shard"] = shard
                 if proc_idx == 0:
-                    merged = merge_fleet_report(path, n_proc)
+                    merged = merge_fleet_report(
+                        path, n_proc,
+                        timeout=getattr(cfg, "obs_merge_timeout", None))
                     if merged is not None:
                         out["report"] = path
                         got = merged["fleet"]["hosts"]

@@ -39,7 +39,6 @@ the same discipline as obs/tracing.py.
 
 from __future__ import annotations
 
-import os
 import threading
 
 from firebird_tpu.obs import httpd
@@ -70,11 +69,11 @@ class RunStatus:
         self.run = dict(run or {})
         self.pipeline_depth = max(int(pipeline_depth), 1)
         self._lock = threading.Lock()
-        self._stage = "init"
-        self._mesh_up = bool(mesh_up)
-        self._first_batch = False
-        self._batches_dispatched = 0
-        self._batches_done = 0
+        self._stage = "init"  # guarded-by: _lock
+        self._mesh_up = bool(mesh_up)  # guarded-by: _lock
+        self._first_batch = False  # guarded-by: _lock
+        self._batches_dispatched = 0  # guarded-by: _lock
+        self._batches_done = 0  # guarded-by: _lock
 
     # -- driver-side updates ----------------------------------------------
 
@@ -102,7 +101,7 @@ class RunStatus:
         if self.watchdog is not None:
             self.watchdog.beat(units)
 
-    def _record_inflight(self) -> None:
+    def _record_inflight(self) -> None:  # guarded-by: _lock
         # Called under self._lock: compute-and-set must be atomic or a
         # dispatch/done race could strand the gauge at a stale value.
         from firebird_tpu.obs import metrics as obs_metrics
@@ -201,7 +200,9 @@ class RunStatus:
         }
 
 
-_status: RunStatus | None = None
+# Mutation under _status_lock; the per-batch hook reads (set_stage,
+# current, ...) grab the one reference lock-free on purpose.
+_status: RunStatus | None = None  # guarded-by: _status_lock
 _status_lock = threading.Lock()
 
 
@@ -313,11 +314,14 @@ def start_ops_server(port: int, status: RunStatus | None = None,
     callers gating on config must only call this when the operator set
     ``FIREBIRD_OPS_PORT``/``--ops-port`` — the surface is off by default
     and no port is ever bound otherwise (driver/core.py guards on
-    ``cfg.ops_port > 0``).  Bind host comes from FIREBIRD_OPS_HOST
-    (default all interfaces — the endpoint exists to be scraped).
+    ``cfg.ops_port > 0``).  Bind host comes from ``Config.ops_host`` /
+    FIREBIRD_OPS_HOST (default all interfaces — the endpoint exists to
+    be scraped); cfg-carrying callers pass it explicitly.
     """
-    host = host if host is not None else \
-        os.environ.get("FIREBIRD_OPS_HOST", "0.0.0.0")
+    if host is None:
+        from firebird_tpu.config import env_knob
+
+        host = env_knob("FIREBIRD_OPS_HOST")
     srv = OpsServer((host, int(port)), status=status).start()
     from firebird_tpu.obs import logger
     logger("change-detection").info(

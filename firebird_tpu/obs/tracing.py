@@ -159,7 +159,9 @@ def start(tracer: Tracer | None = None,
     and return it.  Spans from any thread land in the active tracer.
     ``run_id`` stamps the exported trace for fleet-log correlation."""
     global _active
-    _active = tracer or Tracer(run_id=run_id)
+    # Single-reference swap from the run-owning thread; span() reads the
+    # reference once, so torn state is impossible under the GIL.
+    _active = tracer or Tracer(run_id=run_id)  # firebird-lint: disable=ownership-global-mutation
     if run_id and _active.run_id is None:
         _active.run_id = run_id
     return _active
@@ -168,7 +170,8 @@ def start(tracer: Tracer | None = None,
 def stop() -> Tracer | None:
     """Uninstall and return the active tracer (None if none installed)."""
     global _active
-    t, _active = _active, None
+    # See start(): single-reference swap, run-owning thread only.
+    t, _active = _active, None  # firebird-lint: disable=ownership-global-mutation
     return t
 
 

@@ -68,12 +68,14 @@ class Watchdog:
         self.drop_frac = float(drop_frac)
         self._clock = clock
         self._lock = threading.Lock()
-        self._last_beat = clock()
-        self._stalled = False
-        self._beats: collections.deque = collections.deque(maxlen=window)
-        self._beat_count = 0
-        self._in_drop = False
-        self._drop_events: collections.deque = collections.deque(maxlen=16)
+        self._last_beat = clock()  # guarded-by: _lock
+        self._stalled = False  # guarded-by: _lock
+        self._beats: collections.deque = \
+            collections.deque(maxlen=window)  # guarded-by: _lock
+        self._beat_count = 0  # guarded-by: _lock
+        self._in_drop = False  # guarded-by: _lock
+        self._drop_events: collections.deque = \
+            collections.deque(maxlen=16)  # guarded-by: _lock
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
 

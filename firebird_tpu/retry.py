@@ -48,7 +48,7 @@ class RetryBudget:
     def __init__(self, limit: int = 0):
         self.limit = int(limit)
         self._lock = threading.Lock()
-        self._spent = 0
+        self._spent = 0  # guarded-by: _lock
 
     def take(self) -> bool:
         """Consume one retry; False when the budget is exhausted."""
@@ -93,15 +93,15 @@ class CircuitBreaker:
         self.name = name
         self._clock = clock
         self._lock = threading.Lock()
-        self._state = CLOSED
-        self._consecutive = 0
-        self._opened_at = 0.0
+        self._state = CLOSED  # guarded-by: _lock
+        self._consecutive = 0  # guarded-by: _lock
+        self._opened_at = 0.0  # guarded-by: _lock
         # Thread id of the half-open probe, or None.  Probe ownership is
         # by thread: only the probe's own outcome may transition a
         # non-closed circuit — a straggler request admitted back when the
         # circuit was still closed must neither close an open breaker on
         # success nor free the probe slot on failure.
-        self._probe_thread: int | None = None
+        self._probe_thread: int | None = None  # guarded-by: _lock
 
     def _set_state_locked(self, state: int) -> None:
         if state == OPEN and self._state != OPEN:
@@ -233,7 +233,7 @@ class RetryPolicy:
         self.budget = budget
         self.breaker = breaker
         self._sleep = sleep
-        self._rng = rng or random.Random()
+        self._rng = rng or random.Random()  # guarded-by: _rng_lock
         self._rng_lock = threading.Lock()
         self.counter_name = counter_name
         self.counter_help = counter_help

@@ -44,7 +44,6 @@ histogram, ``serve_requests_total`` + per-endpoint counters,
 from __future__ import annotations
 
 import io
-import os
 
 import numpy as np
 
@@ -514,10 +513,13 @@ class ServeServer(httpd.Httpd):
 def start_serve_server(port: int, service: ServeService,
                        host: str | None = None) -> ServeServer:
     """Bind and start the query API.  ``port`` 0 binds an ephemeral port
-    (tests, serve-smoke).  Bind host comes from FIREBIRD_SERVE_HOST
-    (default all interfaces — the endpoint exists to be queried)."""
-    host = host if host is not None else \
-        os.environ.get("FIREBIRD_SERVE_HOST", "0.0.0.0")
+    (tests, serve-smoke).  Bind host comes from ``Config.serve_host`` /
+    FIREBIRD_SERVE_HOST (default all interfaces — the endpoint exists
+    to be queried); cfg-carrying callers pass it explicitly."""
+    if host is None:
+        from firebird_tpu.config import env_knob
+
+        host = env_knob("FIREBIRD_SERVE_HOST")
     srv = ServeServer((host, int(port)), service).start()
     log.info("serve endpoint up on %s:%d (/healthz /metrics /v1/products "
              "/v1/segments /v1/pixel /v1/product/<name> /v1/tile/<name>)",

@@ -71,7 +71,7 @@ class LRUCache:
         self.spill_max_files = (int(spill_max_files)
                                 if spill_max_files is not None
                                 else self.max_entries * 4)
-        self._spill_count = 0
+        self._spill_count = 0  # guarded-by: _lock
         if self.spill_dir:
             os.makedirs(self.spill_dir, exist_ok=True)
             # One directory scan at construction; spills maintain the
@@ -80,7 +80,8 @@ class LRUCache:
                 n.endswith((".npy", ".json"))
                 for n in os.listdir(self.spill_dir))
         self._lock = threading.Lock()
-        self._entries: collections.OrderedDict = collections.OrderedDict()
+        self._entries: collections.OrderedDict = \
+            collections.OrderedDict()  # guarded-by: _lock
 
     def __len__(self) -> int:
         with self._lock:
@@ -226,8 +227,8 @@ class StoreGenerations:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._gens: dict[tuple, int] = {}
-        self._table_gens: dict[str, int] = {}
+        self._gens: dict[tuple, int] = {}  # guarded-by: _lock
+        self._table_gens: dict[str, int] = {}  # guarded-by: _lock
 
     def gen(self, table: str, cx, cy) -> int:
         with self._lock:

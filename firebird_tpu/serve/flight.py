@@ -74,7 +74,7 @@ class SingleFlight:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._flights: dict = {}
+        self._flights: dict = {}  # guarded-by: _lock
 
     def do(self, key, fn, deadline: "Deadline | None" = None):
         with self._lock:
@@ -136,7 +136,7 @@ class AdmissionControl:
         self.deadline_sec = float(deadline_sec)
         self._sem = threading.BoundedSemaphore(self.max_inflight)
         self._lock = threading.Lock()
-        self._waiting = 0
+        self._waiting = 0  # guarded-by: _lock
 
     def _inflight_gauge(self, delta: int) -> None:
         obs_metrics.gauge(

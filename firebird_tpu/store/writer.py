@@ -51,7 +51,10 @@ class AsyncWriter:
         self.retry = retry
         n = max(int(workers), 1)
         self._qs = [queue.Queue(maxsize=max_queue) for _ in range(n)]
-        self._error: Exception | None = None
+        self._lock = threading.Lock()
+        # First pending write error: set by any worker, popped (and
+        # cleared) by the caller thread in write()/flush().
+        self._error: Exception | None = None  # guarded-by: _lock
         self._rr = itertools.count()
         self._threads = []
         for q in self._qs:
@@ -67,7 +70,9 @@ class AsyncWriter:
                 return
             table, frame = item
             try:
-                if self._error is None:
+                with self._lock:
+                    poisoned = self._error is not None
+                if not poisoned:
                     with tracing.span("store_write", table=table), \
                             obs_metrics.timer() as tm:
                         if self.retry is not None:
@@ -86,8 +91,9 @@ class AsyncWriter:
                 # worker with un-acked items would hang flush() forever
                 log.error("async write to %s failed: %s", table, e)
                 obs_metrics.counter("store_write_errors").inc()
-                self._error = e if isinstance(e, Exception) \
-                    else RuntimeError(f"writer interrupted: {e!r}")
+                with self._lock:
+                    self._error = e if isinstance(e, Exception) \
+                        else RuntimeError(f"writer interrupted: {e!r}")
             finally:
                 # Depth BEFORE task_done: the ack releases flush()'s
                 # join(), and the gauge must already reflect the drain
@@ -97,7 +103,8 @@ class AsyncWriter:
                 q.task_done()
 
     def _pop_error(self) -> Exception | None:
-        err, self._error = self._error, None
+        with self._lock:
+            err, self._error = self._error, None
         return err
 
     def _check_alive(self) -> None:

@@ -30,3 +30,29 @@ def test_keyspace_derivation():
 def test_overrides():
     cfg = Config.from_env(env={}, chips_per_batch=16)
     assert cfg.chips_per_batch == 16
+
+
+def test_knob_defaults_agree_with_config_defaults():
+    # A knob that declares BOTH a Config field and a registry default has
+    # two homes for that default (Knob.default feeds env_knob readers,
+    # the Config field feeds from_env's fallback).  Keep them in
+    # agreement: setting the env var to its own registry default must be
+    # a no-op on the resulting Config.
+    from firebird_tpu.config import KNOBS
+
+    baseline = Config.from_env(env={})
+    for knob in KNOBS:
+        if knob.field is None or knob.default is None:
+            continue
+        pinned = Config.from_env(env={knob.name: knob.default})
+        assert getattr(pinned, knob.field) == getattr(baseline, knob.field), (
+            f"{knob.name}: registry default {knob.default!r} disagrees "
+            f"with Config.{knob.field} default "
+            f"{getattr(baseline, knob.field)!r}")
+
+
+def test_obs_merge_timeout_zero_means_merge_now():
+    # 0 = "merge whatever shards already arrived, don't wait" — a valid
+    # operator setting the validation must not reject.
+    cfg = Config.from_env(env={"FIREBIRD_OBS_MERGE_TIMEOUT": "0"})
+    assert cfg.obs_merge_timeout == 0.0

@@ -1,0 +1,721 @@
+"""firebird-lint (firebird_tpu.analysis) — the static contract checker.
+
+Each rule family is proven against a hermetic fixture repo built in
+tmp_path with a seeded violation, plus the engine mechanics (suppression
+comments, baseline round-trip, family filtering, parse errors, CLI exit
+codes) and the self-check: the REAL repo must lint clean modulo the
+committed lint_baseline.json — the acceptance contract `make lint`
+enforces in CI (docs/STATIC_ANALYSIS.md).
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from firebird_tpu.analysis import Baseline, run_lint
+from firebird_tpu.analysis import engine
+
+
+def build_repo(tmp_path, files):
+    """Materialize {relpath: source} as a fixture repo rooted at tmp_path."""
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return str(tmp_path)
+
+
+def rules_hit(result):
+    return {f.rule for f in result.findings}
+
+
+def by_rule(result, rule):
+    return [f for f in result.findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# jax-hotpath
+# ---------------------------------------------------------------------------
+
+def test_hotpath_host_sync_in_jitted_fn(tmp_path):
+    root = build_repo(tmp_path, {"mod.py": """
+        import jax
+
+        @jax.jit
+        def f(x):
+            v = x.item()
+            return v
+    """})
+    res = run_lint(root)
+    hits = by_rule(res, "hotpath-host-sync")
+    assert len(hits) == 1 and hits[0].path == "mod.py"
+    assert ".item()" in hits[0].message
+
+
+def test_hotpath_device_get_in_while_loop_body(tmp_path):
+    root = build_repo(tmp_path, {"mod.py": """
+        import jax
+        from jax import lax
+
+        def body(carry):
+            y = jax.device_get(carry)
+            return carry + 1
+
+        def run(c0):
+            return lax.while_loop(lambda c: c < 3, body, c0)
+    """})
+    res = run_lint(root)
+    assert len(by_rule(res, "hotpath-host-sync")) == 1
+
+
+def test_hotpath_np_asarray_on_traced_arg(tmp_path):
+    root = build_repo(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x).sum()
+    """})
+    res = run_lint(root)
+    hits = by_rule(res, "hotpath-host-sync")
+    assert len(hits) == 1 and "np.asarray" in hits[0].message
+
+
+def test_hotpath_traced_branch_vs_static_and_shape(tmp_path):
+    # Branching on a traced arg is a finding; branching on a declared
+    # static (resolved through a module-level tuple like _WIRE_STATICS)
+    # or on .shape/.dtype is legitimate trace-time dispatch.
+    root = build_repo(tmp_path, {"mod.py": """
+        import jax
+
+        _STATICS = ("mode",)
+
+        @jax.jit
+        def bad(x):
+            if x > 0:
+                return x
+            return -x
+
+        def good(x, mode):
+            if mode == "fast":
+                return x
+            if x.shape[0] > 4:
+                return x * 2
+            return -x
+
+        good_j = jax.jit(good, static_argnames=_STATICS)
+    """})
+    res = run_lint(root)
+    hits = by_rule(res, "hotpath-traced-branch")
+    assert len(hits) == 1
+    assert "'x'" in hits[0].message or "x" in hits[0].message
+
+
+def test_hotpath_statics_drift_between_jit_sites(tmp_path):
+    root = build_repo(tmp_path, {"mod.py": """
+        import jax
+
+        def f(x, k):
+            return x * k
+
+        a = jax.jit(f, static_argnames=("k",))
+        b = jax.jit(f)
+    """})
+    res = run_lint(root)
+    assert len(by_rule(res, "hotpath-statics-drift")) == 1
+
+
+def test_hotpath_aot_lower_kwargs_must_match_statics(tmp_path):
+    # The PR 6 near-bug shape: a static added at the jit wrapper but not
+    # to the hand-written .lower(...) AOT warm call site.
+    root = build_repo(tmp_path, {"mod.py": """
+        import jax
+
+        def f(x, k, m):
+            return x * k
+
+        fj = jax.jit(f, static_argnames=("k", "m"))
+
+        def warm(spec):
+            return fj.lower(spec, k=2).compile()
+    """})
+    res = run_lint(root)
+    hits = by_rule(res, "hotpath-statics-drift")
+    assert len(hits) == 1 and "'m'" in hits[0].message
+
+
+def test_hotpath_ghost_static_name(tmp_path):
+    root = build_repo(tmp_path, {"mod.py": """
+        import jax
+
+        def f(x):
+            return x
+
+        fj = jax.jit(f, static_argnames=("nope",))
+    """})
+    res = run_lint(root)
+    hits = by_rule(res, "hotpath-statics-drift")
+    assert len(hits) == 1 and "not " in hits[0].message
+
+
+def test_hotpath_untraced_code_unflagged(tmp_path):
+    root = build_repo(tmp_path, {"mod.py": """
+        import jax
+
+        def host_side(x):
+            v = x.item()
+            if x > 0:
+                return v
+            return -v
+    """})
+    res = run_lint(root)
+    assert "hotpath-host-sync" not in rules_hit(res)
+    assert "hotpath-traced-branch" not in rules_hit(res)
+
+
+# ---------------------------------------------------------------------------
+# knob-registry
+# ---------------------------------------------------------------------------
+
+KNOB_CONFIG = """
+    KNOBS = (
+        Knob(name="FIREBIRD_GOOD", field="good",
+             help="a registered, documented, read knob"),
+        Knob(name="FIREBIRD_DEAD", help="nothing reads this anymore"),
+        Knob(name="FIREBIRD_SECRET", internal=True,
+             readers=("other.py",), help="internal: no doc needed"),
+        Knob(name="FIREBIRD_GHOST_FIELD", field="missing",
+             help="declares a Config field that does not exist"),
+    )
+
+    class Config:
+        good: str = "x"
+
+        @classmethod
+        def from_env(cls, e):
+            return cls(good=e.get("FIREBIRD_GOOD", "x"))
+"""
+
+KNOB_README = """
+    # fixture
+
+    `FIREBIRD_GOOD` and `FIREBIRD_GHOST_FIELD` and `FIREBIRD_DEAD` are
+    documented here; `FIREBIRD_STALE` is documented but unregistered.
+"""
+
+
+def test_knob_unregistered_and_reader_drift(tmp_path):
+    root = build_repo(tmp_path, {
+        "firebird_tpu/config.py": KNOB_CONFIG,
+        "README.md": KNOB_README,
+        "other.py": """
+            import os
+
+            def f():
+                a = os.environ.get("FIREBIRD_UNKNOWN")     # unregistered
+                b = os.environ.get("FIREBIRD_GOOD")        # reader drift
+                c = os.environ.get("FIREBIRD_SECRET")      # declared reader
+                return a, b, c
+        """})
+    res = run_lint(root)
+    unreg = by_rule(res, "knob-unregistered-read")
+    assert len(unreg) == 1 and "FIREBIRD_UNKNOWN" in unreg[0].message
+    drift = by_rule(res, "knob-reader-drift")
+    assert len(drift) == 1 and "FIREBIRD_GOOD" in drift[0].message
+
+
+def test_knob_dead_undocumented_stale_and_field(tmp_path):
+    root = build_repo(tmp_path, {
+        "firebird_tpu/config.py": KNOB_CONFIG,
+        "README.md": KNOB_README,
+    })
+    res = run_lint(root)
+    # FIREBIRD_DEAD: registered + documented but zero reads/references.
+    # (FIREBIRD_SECRET is dead too here — its declared reader file does
+    # not exist in this fixture.)
+    dead = {f.message.split()[0] for f in by_rule(res, "knob-dead")}
+    assert dead == {"FIREBIRD_DEAD", "FIREBIRD_SECRET",
+                    "FIREBIRD_GHOST_FIELD"}
+    # FIREBIRD_SECRET is internal: exempt from the doc requirement (it
+    # IS dead here too — its declared reader file has no reference).
+    assert not any("FIREBIRD_SECRET" in f.message
+                   for f in by_rule(res, "knob-undocumented"))
+    # FIREBIRD_STALE: documented, never registered.
+    stale = by_rule(res, "knob-doc-stale")
+    assert len(stale) == 1 and stale[0].path == "README.md"
+    # FIREBIRD_GHOST_FIELD: declares Config field 'missing'.
+    field = by_rule(res, "knob-config-field")
+    assert len(field) == 1 and "'missing'" in field[0].message
+
+
+def test_env_knob_call_of_unregistered_name(tmp_path):
+    # env_knob raises KeyError at RUNTIME for an unregistered name; the
+    # linter must catch the drift statically (a knob renamed in KNOBS
+    # with one env_knob caller missed).
+    root = build_repo(tmp_path, {
+        "firebird_tpu/config.py": KNOB_CONFIG,
+        "firebird_tpu/mod.py": """
+            from firebird_tpu.config import env_knob
+
+            def f():
+                return env_knob("FIREBIRD_NOT_REGISTERED")
+        """})
+    res = run_lint(root)
+    hits = by_rule(res, "knob-unregistered-read")
+    assert len(hits) == 1 and "FIREBIRD_NOT_REGISTERED" in hits[0].message
+
+
+def test_knob_registry_required(tmp_path):
+    root = build_repo(tmp_path, {
+        "firebird_tpu/config.py": "X = 1\n",
+    })
+    res = run_lint(root)
+    assert len(by_rule(res, "knob-no-registry")) == 1
+
+
+# ---------------------------------------------------------------------------
+# metrics-contract
+# ---------------------------------------------------------------------------
+
+METRIC_DOCS = """
+    # obs
+
+    | Metric | Kind | Meaning |
+    |---|---|---|
+    | `good_total` | counter | documented and registered |
+    | `vanished_seconds` | histogram | documented but no code registers it |
+
+    Prose mention: `prose_documented` gauge.
+"""
+
+
+def test_metric_rules(tmp_path):
+    root = build_repo(tmp_path, {
+        "docs/OBSERVABILITY.md": METRIC_DOCS,
+        "firebird_tpu/work.py": """
+            from firebird_tpu.obs.metrics import counter, gauge, histogram
+
+            def f():
+                counter("good_total", help="fine").add(1)
+                counter("Bad-Name").add(1)                   # metric-name
+                gauge("queue_total").set(2)                  # total-suffix
+                gauge("prose_documented", help="h").set(1)
+                histogram("undoc_seconds", help="h").observe(1)
+        """})
+    res = run_lint(root)
+    name = by_rule(res, "metric-name")
+    assert len(name) == 1 and "Bad-Name" in name[0].message
+    suffix = by_rule(res, "metric-total-suffix")
+    assert len(suffix) == 1 and "queue_total" in suffix[0].message
+    # Bad-Name is rejected before further checks; queue_total is the
+    # only surviving instrument registered with no help anywhere.
+    helps = {f.message.split("'")[1] for f in by_rule(res, "metric-help")}
+    assert helps == {"queue_total"}
+    undoc = {f.message.split("'")[1]
+             for f in by_rule(res, "metric-undocumented")}
+    assert undoc == {"queue_total", "undoc_seconds"}
+    stale = by_rule(res, "metric-doc-stale")
+    assert len(stale) == 1 and "vanished_seconds" in stale[0].message
+
+
+def test_metric_dynamic_name_matches_doc_wildcard(tmp_path):
+    root = build_repo(tmp_path, {
+        "docs/OBSERVABILITY.md": """
+            | Metric | Kind | Meaning |
+            |---|---|---|
+            | `stream_*` | gauge | per-run streaming summary values |
+        """,
+        "firebird_tpu/s.py": """
+            from firebird_tpu.obs.metrics import gauge
+
+            def put(k, v):
+                gauge(f"stream_{k}", help="summary value").set(v)
+        """})
+    res = run_lint(root)
+    assert "metric-undocumented" not in rules_hit(res)
+    assert "metric-doc-stale" not in rules_hit(res)
+
+
+# ---------------------------------------------------------------------------
+# thread-ownership
+# ---------------------------------------------------------------------------
+
+def test_ownership_unguarded_attr(tmp_path):
+    root = build_repo(tmp_path, {"mod.py": """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []  # guarded-by: _lock
+
+            def ok(self):
+                with self._lock:
+                    self._q.append(1)
+
+            def ok_locked(self):
+                self._q.append(2)
+
+            def bad(self):
+                return len(self._q)
+    """})
+    res = run_lint(root)
+    hits = by_rule(res, "ownership-unguarded-attr")
+    assert len(hits) == 1 and "W.bad" in hits[0].message
+
+
+def test_ownership_nested_def_resets_lock_context(tmp_path):
+    # A closure handed to a thread does not inherit the enclosing
+    # `with self._lock:` — access inside it must re-acquire.
+    root = build_repo(tmp_path, {"mod.py": """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []  # guarded-by: _lock
+
+            def spawn(self):
+                with self._lock:
+                    def worker():
+                        self._q.append(1)
+                    return worker
+    """})
+    res = run_lint(root)
+    assert len(by_rule(res, "ownership-unguarded-attr")) == 1
+
+
+def test_ownership_globals(tmp_path):
+    root = build_repo(tmp_path, {"mod.py": """
+        import threading
+
+        _lock = threading.Lock()
+        _state = None  # guarded-by: _lock
+        _latch = False
+
+        def ok():
+            global _state
+            with _lock:
+                _state = 1
+
+        def bad_annotated():
+            global _state
+            _state = 2
+
+        def bad_unannotated():
+            global _latch
+            _latch = True
+
+        def ok_under_some_lock():
+            global _latch
+            with _lock:
+                _latch = True
+    """})
+    res = run_lint(root)
+    g = by_rule(res, "ownership-unguarded-global")
+    assert len(g) == 1 and "bad_annotated" in g[0].message
+    m = by_rule(res, "ownership-global-mutation")
+    assert len(m) == 1 and "bad_unannotated" in m[0].message
+
+
+def test_ownership_annotation_on_first_body_line_is_not_an_exemption(tmp_path):
+    # A `# guarded-by:` on a method's FIRST statement must not turn the
+    # whole method into a caller-holds-lock helper — only annotations on
+    # the def/signature lines (or a *_locked name) do that.
+    root = build_repo(tmp_path, {"mod.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._v = 0  # guarded-by: _lock
+
+            def bump(self):
+                self._v += 1  # guarded-by: _lock
+
+            def held(self):  # guarded-by: _lock
+                self._v += 1
+    """})
+    res = run_lint(root)
+    a = by_rule(res, "ownership-unguarded-attr")
+    assert len(a) == 1 and "bump" in a[0].message
+
+
+def test_ownership_annotation_on_continuation_line(tmp_path):
+    # A black-wrapped assignment puts the `# guarded-by:` comment on the
+    # continuation line, not stmt.lineno — it must still bind.
+    root = build_repo(tmp_path, {"mod.py": """
+        import threading
+        import collections
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._entries: collections.OrderedDict = \\
+                    collections.OrderedDict()  # guarded-by: _lock
+
+            def bad(self):
+                return self._entries.get(1)
+    """})
+    res = run_lint(root)
+    a = by_rule(res, "ownership-unguarded-attr")
+    assert len(a) == 1 and "_entries" in a[0].message
+
+
+def test_ownership_nested_global_does_not_leak_to_outer_locals(tmp_path):
+    # A nested def's `global x` must not make the OUTER function's local
+    # `x` look like a global mutation, and the nested mutation must be
+    # reported exactly once (attributed to the nested def).
+    root = build_repo(tmp_path, {"mod.py": """
+        def outer():
+            x = 1
+
+            def inner():
+                global x
+                x = 2
+            return x
+    """})
+    res = run_lint(root)
+    m = by_rule(res, "ownership-global-mutation")
+    assert len(m) == 1
+    assert "inner" in m[0].message and "outer" not in m[0].message
+
+
+# ---------------------------------------------------------------------------
+# engine: suppressions, baseline, filtering, parse errors, CLI
+# ---------------------------------------------------------------------------
+
+BAD_JIT = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x.item()
+"""
+
+
+def test_suppression_line_and_file(tmp_path):
+    root = build_repo(tmp_path, {
+        "a.py": """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x.item()  # firebird-lint: disable=hotpath-host-sync
+        """,
+        "b.py": """
+            # firebird-lint: disable-file=hotpath-host-sync
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x.item()
+
+            @jax.jit
+            def g(x):
+                return x.tolist()
+        """})
+    res = run_lint(root)
+    assert not res.findings
+    assert res.suppressed == 3
+    assert res.clean
+
+
+def test_suppression_inside_string_literal_is_inert(tmp_path):
+    # Prose QUOTING the suppression syntax (help text, a docstring) must
+    # not disable rules — only a real comment token does.
+    root = build_repo(tmp_path, {"a.py": '''
+        import jax
+
+        HELP = "silence with '# firebird-lint: disable-file=hotpath-host-sync'"
+
+        @jax.jit
+        def f(x):
+            """Docs: use `# guarded-by: _lock` and
+            `# firebird-lint: disable=hotpath-host-sync` as needed."""
+            return x.item()
+    '''})
+    res = run_lint(root)
+    assert len(by_rule(res, "hotpath-host-sync")) == 1
+    assert res.suppressed == 0
+
+
+def test_suppression_is_rule_scoped(tmp_path):
+    root = build_repo(tmp_path, {"a.py": """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:  # firebird-lint: disable=hotpath-host-sync
+                return x.item()
+            return x
+    """})
+    res = run_lint(root)
+    # The wrong rule id in the comment suppresses nothing on that line's
+    # branch finding; the .item() on the NEXT line is untouched anyway.
+    assert len(by_rule(res, "hotpath-traced-branch")) == 1
+    assert len(by_rule(res, "hotpath-host-sync")) == 1
+
+
+def test_baseline_roundtrip_absorbs_then_surfaces_regression(tmp_path):
+    root = build_repo(tmp_path, {"a.py": BAD_JIT})
+    first = run_lint(root)
+    assert len(first.new) == 1
+
+    bpath = str(tmp_path / "lint_baseline.json")
+    Baseline().save(bpath, first.findings)
+    reloaded = Baseline.load(bpath)
+    assert len(reloaded) == 1
+
+    # Same findings: absorbed, run is clean.
+    again = run_lint(root, baseline=reloaded)
+    assert again.clean and len(again.known) == 1 and not again.new
+
+    # A second identical violation exceeds the baseline count: new.
+    build_repo(tmp_path, {"b.py": BAD_JIT})
+    worse = run_lint(root, baseline=Baseline.load(bpath))
+    assert len(worse.new) == 1 and len(worse.known) == 1
+    assert not worse.clean
+
+
+def test_baseline_fingerprint_is_line_independent(tmp_path):
+    root = build_repo(tmp_path, {"a.py": BAD_JIT})
+    bpath = str(tmp_path / "b.json")
+    Baseline().save(bpath, run_lint(root).findings)
+    # Shift the finding down 20 lines: still absorbed.
+    build_repo(tmp_path, {"a.py": "# pad\n" * 20 + textwrap.dedent(BAD_JIT)})
+    res = run_lint(root, baseline=Baseline.load(bpath))
+    assert res.clean
+
+
+def test_baseline_rejects_unknown_schema(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"schema": "nope/9", "findings": {}}))
+    with pytest.raises(ValueError):
+        Baseline.load(str(p))
+
+
+def test_rule_filtering_by_family_and_glob(tmp_path):
+    root = build_repo(tmp_path, {
+        "a.py": BAD_JIT,
+        "mod.py": """
+            import threading
+
+            _lock = threading.Lock()
+
+            def f():
+                global _g
+                _g = 1
+        """})
+    both = run_lint(root)
+    assert {"hotpath-host-sync",
+            "ownership-global-mutation"} <= rules_hit(both)
+    fam = run_lint(root, only=["thread-ownership"])
+    assert rules_hit(fam) == {"ownership-global-mutation"}
+    glob = run_lint(root, only=["hotpath-*"])
+    assert rules_hit(glob) == {"hotpath-host-sync"}
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    root = build_repo(tmp_path, {"broken.py": "def f(:\n"})
+    res = run_lint(root)
+    assert len(res.parse_errors) == 1
+    assert not res.clean
+
+
+def test_tests_and_pycache_excluded(tmp_path):
+    root = build_repo(tmp_path, {
+        "tests/test_x.py": BAD_JIT,
+        "__pycache__/junk.py": BAD_JIT,
+    })
+    res = run_lint(root)
+    assert res.files_scanned == 0 and res.clean
+
+
+def test_cli_exit_codes_update_baseline_and_json(tmp_path):
+    root = build_repo(tmp_path, {"a.py": BAD_JIT})
+    bpath = str(tmp_path / "lint_baseline.json")
+    jpath = str(tmp_path / "out" / "lint_report.json")
+    argv = ["--root", root, "--baseline", bpath]
+
+    assert engine.main(argv + ["--json", jpath]) == 1
+    doc = json.loads((tmp_path / "out" / "lint_report.json").read_text())
+    assert doc["schema"] == engine.REPORT_SCHEMA
+    assert doc["clean"] is False and doc["new_count"] == 1
+    assert doc["per_rule"] == {"hotpath-host-sync": 1}
+
+    assert engine.main(argv + ["--update-baseline"]) == 0
+    assert engine.main(argv + ["--json", jpath]) == 0
+    doc = json.loads((tmp_path / "out" / "lint_report.json").read_text())
+    assert doc["clean"] is True and doc["baselined_count"] == 1
+
+    # --no-baseline surfaces the grandfathered finding again.
+    assert engine.main(argv + ["--no-baseline"]) == 1
+
+
+def test_update_baseline_with_rules_filter_keeps_other_families(tmp_path):
+    # --rules narrows what a run REPORTS, never what --update-baseline
+    # RECORDS: refreshing one family must not drop the other families'
+    # grandfathered slots from the committed file.
+    root = build_repo(tmp_path, {
+        "a.py": BAD_JIT,
+        "mod.py": """
+            def f():
+                global _g
+                _g = 1
+        """})
+    bpath = str(tmp_path / "lint_baseline.json")
+    argv = ["--root", root, "--baseline", bpath]
+
+    assert engine.main(argv + ["--rules", "hotpath-*",
+                               "--update-baseline"]) == 0
+    doc = json.loads((tmp_path / "lint_baseline.json").read_text())
+    assert len(doc["findings"]) == 2          # both families recorded
+    assert engine.main(argv) == 0             # plain run stays clean
+
+
+def test_update_baseline_refreshes_json_report(tmp_path):
+    # --update-baseline --json must write the POST-update state (all
+    # findings absorbed), not leave a stale failing report for bench.
+    root = build_repo(tmp_path, {"a.py": BAD_JIT})
+    bpath = str(tmp_path / "lint_baseline.json")
+    jpath = str(tmp_path / "lint_report.json")
+    argv = ["--root", root, "--baseline", bpath, "--json", jpath]
+
+    assert engine.main(argv) == 1          # stale report: clean=false
+    assert engine.main(argv + ["--update-baseline"]) == 0
+    doc = json.loads((tmp_path / "lint_report.json").read_text())
+    assert doc["clean"] is True and doc["baselined_count"] == 1
+
+
+def test_update_baseline_refuses_parse_errors(tmp_path):
+    # An unparseable file ran zero rules — grandfathering that snapshot
+    # would silently hide the breakage until the next plain run.
+    root = build_repo(tmp_path, {"a.py": BAD_JIT, "broken.py": "def f(:\n"})
+    bpath = str(tmp_path / "lint_baseline.json")
+    assert engine.main(["--root", root, "--baseline", bpath,
+                        "--update-baseline"]) == 1
+    assert not (tmp_path / "lint_baseline.json").exists()
+
+
+def test_rule_catalog_is_populated():
+    engine._load_families()
+    assert {"hotpath-host-sync", "knob-unregistered-read",
+            "metric-doc-stale", "ownership-unguarded-attr"} \
+        <= set(engine.RULE_DOCS)
+    assert all(engine.RULE_DOCS.values())
+
+
+# ---------------------------------------------------------------------------
+# self-check: the real repo is clean modulo the committed baseline
+# ---------------------------------------------------------------------------
+
+def test_repo_lints_clean_modulo_committed_baseline():
+    root = engine.default_root()
+    bl = Baseline.load(engine.os.path.join(root, "lint_baseline.json"))
+    res = run_lint(root, baseline=bl)
+    assert res.files_scanned > 50
+    assert not res.parse_errors
+    assert res.clean, "new findings:\n" + "\n".join(str(f) for f in res.new)

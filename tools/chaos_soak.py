@@ -32,6 +32,8 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
 sys.path.insert(0, HERE)
 
+from firebird_tpu.config import env_knob  # noqa: E402
+
 ACQ = "1995-01-01/1996-06-01"
 N_CHIPS = 4
 CHUNK = 2
@@ -161,7 +163,7 @@ def main() -> int:
             "store_identical_after_resume": True,
             "quarantine_drained": True,
         }
-        art_dir = os.environ.get("FIREBIRD_CHAOS_DIR", "/tmp/fb_chaos")
+        art_dir = env_knob("FIREBIRD_CHAOS_DIR")
         os.makedirs(art_dir, exist_ok=True)
         art = os.path.join(art_dir, "chaos_report.json")
         with open(art, "w") as f:

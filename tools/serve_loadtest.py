@@ -38,6 +38,10 @@ import time
 import urllib.error
 import urllib.request
 
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+from firebird_tpu.config import env_knob  # noqa: E402
+
 ARTIFACT_SCHEMA = "firebird-serve-loadtest/1"
 
 
@@ -144,7 +148,7 @@ def run_loadtest(base_url: str, paths: list[str], *, concurrency: int = 8,
         "hit_rate": round(dh / (dh + dm), 4) if (dh + dm) > 0 else None,
         "status_counts": dict(sorted(status_counts.items())),
     }
-    out_dir = out_dir or os.environ.get("FIREBIRD_SERVE_DIR", "/tmp/fb_serve")
+    out_dir = out_dir or env_knob("FIREBIRD_SERVE_DIR")
     os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, "serve_loadtest.json")
     tmp = path + ".tmp"

@@ -32,6 +32,10 @@ import subprocess
 import sys
 import time
 
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+from firebird_tpu.config import env_knob  # noqa: E402
+
 X, Y = 542000, 1650000            # tile h=20 v=11
 # Full ARD archive (VERDICT r2 #3: the r2 soak's 1-year window could not
 # initialize a model — MEOW_SIZE obs over INIT_DAYS — so every row was a
@@ -125,8 +129,7 @@ def main() -> int:
             return 2
         mode = rec or explicit_mode
     else:
-        mode = explicit_mode or os.environ.get("FIREBIRD_VARIOGRAM",
-                                               "adjusted")
+        mode = explicit_mode or env_knob("FIREBIRD_VARIOGRAM")
         if mode not in ("plain", "adjusted"):
             print(f"bad variogram mode {mode!r} (FIREBIRD_VARIOGRAM)",
                   file=sys.stderr)
