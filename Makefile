@@ -10,8 +10,8 @@ Y ?= 1650000
 ACQUIRED ?= 1982-01-01/2017-12-31
 
 .PHONY: install lint test bench obs-smoke pipeline-smoke chaos-smoke \
-        serve-smoke compact-smoke image db-up db-schema db-test db-down \
-        changedetection classification clean
+        serve-smoke compact-smoke postmortem-smoke image db-up db-schema \
+        db-test db-down changedetection classification clean
 
 install:
 	pip install -e . --no-build-isolation
@@ -64,6 +64,14 @@ chaos-smoke:
 # (RPS, p50/p95/p99, hit rate) written + folded by bench.py.
 serve-smoke:
 	python tools/serve_smoke.py
+
+# Crash flight-recorder check (docs/OBSERVABILITY.md "Flight recorder"):
+# a subprocess run SIGTERM'd mid-batch must die with real SIGTERM
+# semantics AND leave a parseable postmortem.json (per-thread event
+# rings, breaker/quarantine state, config fingerprint), and `--resume`
+# must recover the store row-for-row identical to an uninterrupted run.
+postmortem-smoke:
+	python tools/postmortem_smoke.py
 
 # Active-lane compaction check (docs/ROOFLINE.md "Occupancy"): the same
 # synthetic tile with compaction on vs off — asserts the stores are

@@ -164,12 +164,19 @@ def _fleet_obs_fold() -> dict:
     if rep is None:
         return {}
     # The full document would dwarf the bench artifact; keep the
-    # operator-relevant identity + scale block.
+    # operator-relevant identity + scale block, plus the deep-dive
+    # verdicts: the SLO evaluation and the device-time attribution of
+    # any profile windows the run captured (obs/profiling.py — the
+    # per-phase split bench rounds were blind to through r01-r05).
+    prof = rep.get("profile") or {}
     return {"fleet_obs_report": {
         "run": rep.get("run", {}),
         "fleet": rep.get("fleet"),
         "counters": rep.get("metrics", {}).get("counters", {}),
         "run_counters": rep.get("run_counters", {}),
+        "slo": rep.get("slo"),
+        "profile": {"windows": len(prof.get("windows", ())),
+                    "device_time": prof.get("device_time")},
     }}
 
 
@@ -217,6 +224,14 @@ def _lint_fold() -> dict:
     suppressed totals (docs/STATIC_ANALYSIS.md)."""
     return _artifact_fold("lint_report", "FIREBIRD_LINT_DIR",
                           "lint_report.json")
+
+
+def _postmortem_fold() -> dict:
+    """`make postmortem-smoke` evidence (tools/postmortem_smoke.py): the
+    flight recorder's SIGTERM'd-run bundle validity + row-identical
+    resume report."""
+    return _artifact_fold("postmortem_smoke", "FIREBIRD_POSTMORTEM_DIR",
+                          "postmortem_smoke.json")
 
 
 def measure(cpu_only: bool) -> None:
@@ -688,6 +703,9 @@ def measure(cpu_only: bool) -> None:
             # Last `make lint` summary (contract-checker clean flag +
             # per-rule counts) when the linter ran on this host.
             **_lint_fold(),
+            # Last postmortem-smoke evidence (SIGTERM'd run leaves a
+            # valid flight-recorder bundle + row-identical resume).
+            **_postmortem_fold(),
             "streaming_pixels_per_sec": round(stream_rate, 1),
             **s2_detail,
             **hard_detail,
@@ -704,18 +722,67 @@ def measure(cpu_only: bool) -> None:
     print(json.dumps(scrub_artifact(out)))
 
 
-def probe_accelerator(timeout: float = 300.0) -> dict:
+class _ProbeFailed(Exception):
+    """Internal: carries a failed probe's health block through the retry
+    policy (the policy retries exceptions; the probe returns dicts)."""
+
+    def __init__(self, health: dict):
+        super().__init__(health["reason"])
+        self.health = health
+
+
+def probe_accelerator(timeout: float = 300.0, retries: int = 2,
+                      sleep=None) -> dict:
     """Cheap health check before the full accelerator attempt: the tunnel
     to the chip can hang indefinitely (even jax.devices() blocks), and the
     full attempt's budget is an hour — a tiny device round-trip under a
     short timeout decides whether that budget is worth spending.
+
+    The tunnel is FLAKY, not just up-or-down (BENCH_r05 declared a CPU
+    fallback off one hung attempt): each failed probe — timeout, crash,
+    or a cpu-only backend (which is what a dead tunnel's plugin-init
+    failure looks like from inside jax) — retries through the shared
+    :class:`firebird_tpu.retry.RetryPolicy` with decorrelated-jitter
+    backoff before the fallback is declared.  ``sleep`` is injectable
+    for tests.
 
     Returns the structured ``tunnel_health`` block the bench artifact
     embeds instead of a raw log tail: ``ok`` (probe passed), ``rc``
     (probe exit code, None on timeout), ``backend`` (the platform the
     probe reached, when any), ``reason`` (short, ANSI-stripped
     diagnosis: 'ok' / 'timeout after Ns' / 'cpu-only backend' / the
-    probe's last stderr line)."""
+    probe's last stderr line), and ``attempts`` — every attempt's
+    {ok, rc, backend, reason} history, so a flaky-then-ok tunnel is
+    visible in the artifact instead of erased by its own recovery."""
+    from firebird_tpu.obs import logger
+    from firebird_tpu.retry import RetryPolicy
+
+    attempts: list[dict] = []
+
+    def once() -> dict:
+        h = _probe_once(timeout)
+        attempts.append(dict(h))
+        if not h["ok"]:
+            raise _ProbeFailed(h)
+        return h
+
+    policy = RetryPolicy(max(int(retries), 0), base=2.0, cap=20.0,
+                         sleep=sleep,
+                         counter_name="tunnel_probe_retries",
+                         counter_help=("accelerator tunnel probe attempts "
+                                       "retried before a CPU fallback was "
+                                       "declared"))
+    try:
+        health = policy.run(logger("bench"), "accelerator tunnel probe",
+                            once)
+    except _ProbeFailed as e:
+        health = e.health
+    health["attempts"] = attempts
+    return health
+
+
+def _probe_once(timeout: float) -> dict:
+    """ONE probe child: device round-trip under a hard timeout."""
     code = ("import sys, jax, jax.numpy as jnp\n"
             "d = jax.devices()[0]\n"
             "print('PROBE_PLATFORM', d.platform)\n"

@@ -241,3 +241,137 @@ def check_metrics(ctx: LintContext) -> None:
             ctx.emit("metric-doc-stale", rel, line,
                      f"docs table declares {pat!r} but no code "
                      "registers it")
+
+
+# ---------------------------------------------------------------------------
+# Span names: call sites vs obs.report.SPAN_NAMES vs the docs span table
+# ---------------------------------------------------------------------------
+
+REPORT_MODULE = "firebird_tpu/obs/report.py"
+SPAN_DOC_FILE = "docs/OBSERVABILITY.md"
+
+
+def collect_span_sites(ctx: LintContext) -> list[Site]:
+    """Every ``tracing.span("name", ...)`` call site (literal or
+    f-string first arg) outside the tracer's own module."""
+    sites = []
+    for src in ctx.sources:
+        if not src.relpath.startswith("firebird_tpu/"):
+            continue
+        if src.relpath == "firebird_tpu/obs/tracing.py":
+            continue  # the span() factory itself
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not ((isinstance(f, ast.Name) and f.id == "span")
+                    or (isinstance(f, ast.Attribute) and f.attr == "span")):
+                continue
+            named = _name_arg(node)
+            if named is None:
+                continue  # Match.span() etc: no literal name argument
+            name, dynamic = named
+            sites.append(Site("span", name, dynamic, src, node.lineno,
+                              False))
+    return sites
+
+
+def _report_tuple(ctx: LintContext, var: str) -> dict[str, int]:
+    """A literal tuple-of-strings assignment in obs/report.py parsed
+    from source (the KNOBS pattern): name -> line, empty when absent."""
+    src = ctx.source(REPORT_MODULE)
+    if src is None:
+        return {}
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == var \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            return {e.value: e.lineno for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+    return {}
+
+
+def doc_span_table(ctx: LintContext) -> dict[str, tuple[str, int]]:
+    """Rows of the OBSERVABILITY.md span table (second cell literally
+    ``span``): name -> (file, line)."""
+    out: dict[str, tuple[str, int]] = {}
+    text = ctx.read_text(SPAN_DOC_FILE)
+    if text is None:
+        return out
+    for i, line in enumerate(text.splitlines(), start=1):
+        if not line.lstrip().startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if len(cells) < 2 or cells[1] != "span":
+            continue
+        for tok in _CODE_SPAN_RE.findall(cells[0]):
+            tok = tok.strip()
+            if _METRIC_TOKEN_RE.fullmatch(tok):
+                out.setdefault(tok, (SPAN_DOC_FILE, i))
+    return out
+
+
+@rule("metrics-contract", {
+    "span-unregistered":
+        "span call site uses a name missing from obs.report.SPAN_NAMES",
+    "span-dead":
+        "SPAN_NAMES declares a span with no call site left",
+    "span-undocumented":
+        "declared span missing from the OBSERVABILITY.md span table",
+    "span-doc-stale":
+        "docs span table row with no SPAN_NAMES entry behind it",
+})
+def check_spans(ctx: LintContext) -> None:
+    """Span names agree three ways — call sites, the SPAN_NAMES catalog
+    (which DRIVER_SPAN_NAMES must subset), and the docs span table —
+    in both directions, the metric-table pattern: a new span cannot
+    ship undocumented and a renamed one cannot leave a stale row."""
+    declared = _report_tuple(ctx, "SPAN_NAMES")
+    if not declared:
+        return  # fixture repos without the catalog don't enforce spans
+    sites = collect_span_sites(ctx)
+    docs = doc_span_table(ctx)
+
+    seen: set[str] = set()
+    for s in sites:
+        if s.name in seen:
+            continue
+        seen.add(s.name)
+        if s.dynamic:
+            if not any(_pattern_match(s.name, d) for d in declared):
+                ctx.emit("span-unregistered", s.src, s.line,
+                         f"dynamic span name {s.name!r} matches no "
+                         "SPAN_NAMES entry (obs/report.py)")
+            continue
+        if s.name not in declared:
+            ctx.emit("span-unregistered", s.src, s.line,
+                     f"span {s.name!r} is not declared in "
+                     "obs.report.SPAN_NAMES (obs/report.py)")
+
+    live = {s.name for s in sites}
+    for name, line in sorted(declared.items()):
+        if not any(_pattern_match(name, n) or _pattern_match(n, name)
+                   for n in live):
+            ctx.emit("span-dead", REPORT_MODULE, line,
+                     f"SPAN_NAMES declares {name!r} but no call site "
+                     "opens that span")
+        if not any(_pattern_match(p, name) for p in docs):
+            ctx.emit("span-undocumented", REPORT_MODULE, line,
+                     f"span {name!r} is missing from the "
+                     f"{SPAN_DOC_FILE} span table")
+    # DRIVER_SPAN_NAMES is the driver-stage subset of the catalog — an
+    # entry outside SPAN_NAMES means the two tuples drifted apart.
+    for name, line in sorted(_report_tuple(ctx,
+                                           "DRIVER_SPAN_NAMES").items()):
+        if name not in declared:
+            ctx.emit("span-unregistered", REPORT_MODULE, line,
+                     f"DRIVER_SPAN_NAMES entry {name!r} is not in "
+                     "SPAN_NAMES")
+    for pat, (rel, line) in sorted(docs.items()):
+        if not any(_pattern_match(pat, n) or _pattern_match(n, pat)
+                   for n in declared):
+            ctx.emit("span-doc-stale", rel, line,
+                     f"docs span table declares {pat!r} but "
+                     "SPAN_NAMES has no such span")

@@ -70,8 +70,18 @@ def entrypoint():
                    "e.g. 'ingest:p=0.05,seed=7;store:after=40,brownout=3' "
                    "(docs/ROBUSTNESS.md); overrides FIREBIRD_FAULTS — "
                    "off (no injection, no proxies) when neither is set")
+@click.option("--profile", default=None, type=float,
+              help="capture ONE automatic device-profile window of this "
+                   "many seconds starting at the first dispatched batch "
+                   "(artifact under <store dir>/device_profile/; further "
+                   "windows via POST /profile on the ops endpoint); "
+                   "overrides FIREBIRD_PROFILE — see docs/OBSERVABILITY.md")
+@click.option("--slo", default=None,
+              help="SLO spec 'name=target;...' evaluated at /slo and in "
+                   "the obs report (objectives: batch_p95, serve_p99, "
+                   "freshness; '0' disables); overrides FIREBIRD_SLO")
 def changedetection(x, y, acquired, number, chunk_size, resume, trace,
-                    ops_port, compile_cache, faults):
+                    ops_port, compile_cache, faults, profile, slo):
     """Run change detection for a tile and save results to the store."""
     from firebird_tpu.config import Config
     from firebird_tpu.driver import core
@@ -86,7 +96,8 @@ def changedetection(x, y, acquired, number, chunk_size, resume, trace,
     overrides = {k: v for k, v in
                  (("trace", trace), ("ops_port", ops_port),
                   ("compile_cache", compile_cache),
-                  ("faults", faults)) if v is not None}
+                  ("faults", faults), ("profile", profile),
+                  ("slo", slo)) if v is not None}
     return core.changedetection(
         x=x, y=y,
         acquired=acquired or dates.default_acquired(),
@@ -166,7 +177,13 @@ def save(bounds, product_names, product_dates, acquired, clip):
                    "--compile-cache)")
 @click.option("--faults", default=None,
               help="fault-injection plan (see changedetection --faults)")
-def stream(x, y, acquired, number, trace, ops_port, compile_cache, faults):
+@click.option("--profile", default=None, type=float,
+              help="auto device-profile window seconds (see "
+                   "changedetection --profile)")
+@click.option("--slo", default=None,
+              help="SLO spec (see changedetection --slo)")
+def stream(x, y, acquired, number, trace, ops_port, compile_cache, faults,
+           profile, slo):
     """Streaming incremental change detection (no reference equivalent —
     its only mode is full reruns, ccdc/pyccd.py:171-183).  First run per
     chip bootstraps batch detection and a state checkpoint; later runs
@@ -179,7 +196,8 @@ def stream(x, y, acquired, number, trace, ops_port, compile_cache, faults):
     overrides = {k: v for k, v in
                  (("trace", trace), ("ops_port", ops_port),
                   ("compile_cache", compile_cache),
-                  ("faults", faults)) if v is not None}
+                  ("faults", faults), ("profile", profile),
+                  ("slo", slo)) if v is not None}
     return sdrv.stream(
         x=x, y=y, acquired=acquired, number=number,
         cfg=Config.from_env(**overrides) if overrides else None)

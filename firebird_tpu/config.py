@@ -124,6 +124,12 @@ KNOBS = (
     Knob(name="FIREBIRD_OBS_MERGE_TIMEOUT", field="obs_merge_timeout",
          default="30",
          help="seconds process 0 waits for host report shards"),
+    Knob(name="FIREBIRD_PROFILE", field="profile",
+         help="auto device-profile window seconds at first batch (0 off)"),
+    Knob(name="FIREBIRD_SLO", field="slo",
+         help="SLO spec name=target;... (empty = defaults, 0 disables)"),
+    Knob(name="FIREBIRD_FLIGHTREC", field="flightrec", default="128",
+         help="crash flight-recorder ring size per thread (0 off)"),
     # ---- serving layer (Config-backed) ----
     Knob(name="FIREBIRD_SERVE_PORT", field="serve_port",
          help="firebird serve listen port"),
@@ -193,6 +199,8 @@ KNOBS = (
          help="compact-smoke artifact directory"),
     Knob(name="FIREBIRD_SERVE_DIR", default="/tmp/fb_serve",
          help="serve-loadtest artifact directory"),
+    Knob(name="FIREBIRD_POSTMORTEM_DIR", default="/tmp/fb_postmortem",
+         help="postmortem-smoke artifact directory"),
     Knob(name="FIREBIRD_LINT_DIR", default="/tmp/fb_lint",
          readers=("Makefile",), internal=True,
          help="lint-report artifact directory (make lint)"),
@@ -332,6 +340,26 @@ class Config:
     # before merging what arrived (FIREBIRD_OBS_MERGE_TIMEOUT).
     obs_merge_timeout: float = 30.0
 
+    # On-demand device profiling (obs/profiling.py): > 0 arms ONE
+    # automatic jax.profiler capture window of this many seconds,
+    # starting at the run's first dispatched batch (steady-state
+    # kernels, not bring-up compile).  POST /profile?seconds=N on the
+    # ops endpoint captures further windows on demand; artifacts land
+    # under <store dir>/device_profile/.  0 (default) arms nothing.
+    profile: float = 0.0
+
+    # Declared service-level objectives (obs/slo.py), evaluated against
+    # the live histograms at /slo and in every obs_report.json:
+    # "name=target;..." with targets in seconds ("" = the default spec,
+    # "0" disables evaluation).  Known objectives: batch_p95, serve_p99,
+    # freshness.
+    slo: str = ""
+
+    # Crash flight recorder (obs/flightrec.py): per-thread ring size of
+    # recent spans/logs/progress marks dumped to postmortem.json on
+    # unhandled exception, watchdog stall, or SIGTERM.  0 disarms.
+    flightrec: int = 128
+
     # Active-lane compaction in the CCD event loop (FIREBIRD_COMPACT,
     # default on): dense-prefix lane permutation + per-block skip guards
     # + bucketed re-entry for the long tail, so loop cost tracks the
@@ -426,6 +454,19 @@ class Config:
             raise ValueError("FIREBIRD_OBS_MERGE_TIMEOUT must be >= 0 "
                              "seconds (0 = merge whatever already "
                              f"arrived), got {self.obs_merge_timeout}")
+        if self.profile < 0:
+            raise ValueError("FIREBIRD_PROFILE must be >= 0 seconds "
+                             f"(0 = no auto window), got {self.profile}")
+        if self.flightrec < 0:
+            raise ValueError("FIREBIRD_FLIGHTREC must be >= 0 "
+                             f"(0 = disarmed), got {self.flightrec}")
+        # Parse the SLO spec now (the FIREBIRD_FAULTS fail-fast
+        # rationale): a typo'd objective silently evaluating nothing is
+        # worse than a crash at bring-up.  "" and "0" are both valid.
+        if self.slo and self.slo != "0":
+            from firebird_tpu.obs import slo as _slo
+
+            _slo.parse_spec(self.slo)
         if not 0 < self.serve_port <= 65535:
             raise ValueError("FIREBIRD_SERVE_PORT must be a valid TCP "
                              f"port, got {self.serve_port}")
@@ -489,6 +530,9 @@ class Config:
             stall_sec=float(e.get("FIREBIRD_STALL_SEC", cls.stall_sec)),
             obs_merge_timeout=float(e.get("FIREBIRD_OBS_MERGE_TIMEOUT",
                                           cls.obs_merge_timeout)),
+            profile=float(e.get("FIREBIRD_PROFILE", cls.profile)),
+            slo=e.get("FIREBIRD_SLO", cls.slo),
+            flightrec=int(e.get("FIREBIRD_FLIGHTREC", cls.flightrec)),
             compact=e.get("FIREBIRD_COMPACT", "1") not in ("", "0"),
             pipeline_depth=int(e.get("FIREBIRD_PIPELINE_DEPTH",
                                      cls.pipeline_depth)),

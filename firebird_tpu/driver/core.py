@@ -39,7 +39,9 @@ from firebird_tpu.config import Config
 from firebird_tpu.driver import quarantine as qlib
 from firebird_tpu.ingest import ChipmunkSource, FileSource, SyntheticSource, pack
 from firebird_tpu.obs import Counters, jsonlog, logger
+from firebird_tpu.obs import flightrec
 from firebird_tpu.obs import metrics as obs_metrics
+from firebird_tpu.obs import profiling as obs_profiling
 from firebird_tpu.obs import report as obs_report
 from firebird_tpu.obs import server as obs_server
 from firebird_tpu.obs import tracing
@@ -148,19 +150,39 @@ def start_ops(cfg: Config, run_id: str, kind: str, *, chips_total: int,
     port bind fails, everything already started is torn down before the
     error propagates — a half-up ops surface must not outlive the raise.
     """
+    import os
+
     jsonlog.set_run_context(run_id=run_id, process_index=_process_index())
     obs_report.clear_stale_artifacts(cfg)
     record_topology_metrics()
     watchdog = None
     server = None
     try:
+        # Crash flight recorder (FIREBIRD_FLIGHTREC ring size; 0 off):
+        # armed for the run so an unhandled exception, watchdog stall,
+        # or SIGTERM leaves postmortem.json next to the store.
+        if cfg.flightrec > 0:
+            flightrec.arm(flightrec.postmortem_path(cfg),
+                          ring=cfg.flightrec, run_id=run_id,
+                          fingerprint=qlib.config_fingerprint(cfg))
+        # On-demand device profiler: POST /profile windows land next to
+        # the store; FIREBIRD_PROFILE=<seconds> arms an automatic window
+        # at the first dispatched batch.  Memory-backend runs have no
+        # artifact dir and get no profiler (the endpoint answers 503).
+        profiler = None
+        art_dir = qlib._artifact_dir(cfg)
+        if art_dir is not None:
+            profiler = obs_profiling.set_active(obs_profiling.DeviceProfiler(
+                os.path.join(art_dir, "device_profile")))
+            if cfg.profile > 0:
+                profiler.arm_auto(cfg.profile)
         if cfg.stall_sec > 0:
             watchdog = obs_watchdog.Watchdog(cfg.stall_sec).start()
         status = obs_server.set_status(obs_server.RunStatus(
             run_id, kind, chips_total=chips_total, counters=counters,
             watchdog=watchdog, run=run_block, mesh_up=_mesh_ready(),
             pipeline_depth=cfg.pipeline_depth, quarantine=quarantine,
-            breaker=breaker))
+            breaker=breaker, profiler=profiler, slo_spec=cfg.slo))
         if cfg.ops_port > 0:
             server = obs_server.start_ops_server(cfg.ops_port, status,
                                                  host=cfg.ops_host)
@@ -172,15 +194,25 @@ def start_ops(cfg: Config, run_id: str, kind: str, *, chips_total: int,
 
 def stop_ops(server, watchdog) -> None:
     """Tear down :func:`start_ops` state; never raises — ops teardown
-    must not mask a run's real outcome."""
+    must not mask a run's real outcome.  Called from the drivers'
+    ``finally``: when the run is unwinding on an exception, the flight
+    recorder dumps its postmortem BEFORE disarming (the excepthook would
+    otherwise fire after the recorder is gone)."""
+    import sys
+
+    if sys.exc_info()[0] is not None:
+        flightrec.dump_if_armed("unhandled_exception", sys.exc_info()[1])
     try:
         if server is not None:
             server.close()
         if watchdog is not None:
             watchdog.stop()
+        obs_profiling.close_active()
     except Exception as e:
         logger("change-detection").error("ops teardown failed: %s", e)
     finally:
+        obs_profiling.set_active(None)
+        flightrec.disarm()
         obs_server.clear_status()
         jsonlog.clear_run_context()
 
@@ -772,11 +804,16 @@ def write_batch_frames(packed, host_seg, n_real, *, writer, counters=None):
 
 def drain_batch(seg, packed, n_real, *, writer, counters, dtype=None,
                 sharding: str = "auto", pad_to: int | None = None,
-                compact: bool | None = None):
+                compact: bool | None = None, ctx=None):
     """Fetch one batch's results to the host, format, and queue writes
     (the egress half of ref core.detect, core.py:69-72) — results cross
     D2H as one bulk :func:`fetch_results` transfer and format through the
     vectorized :func:`write_batch_frames` path.
+
+    ``ctx`` is the batch's :class:`~firebird_tpu.obs.tracing.TraceContext`
+    — this function runs on the drain executor, so the context must
+    cross the thread hop explicitly; everything below (spans, the queued
+    writes, the drain histogram's exemplar, log lines) parents to it.
 
     Also the capacity backstop for the driver's asynchronous dispatch
     (detect_batch defaults check_capacity=False): if any pixel closed
@@ -784,31 +821,36 @@ def drain_batch(seg, packed, n_real, *, writer, counters, dtype=None,
     here through the same (sharded-aware) dispatch with the capacity
     check on — rare enough that the synchronous re-run does not matter."""
     cap = seg.seg_meta.shape[-2]                   # [.., P, S, 6] -> S
-    with tracing.span("drain", chips=n_real), obs_metrics.timer() as tm:
-        # Capacity probe BEFORE the bulk fetch: n_segments alone is a few
-        # hundred KB, so an overflowed batch never pays a full-result
-        # transfer whose buffers are about to be discarded (and the d2h
-        # telemetry counts only the one real bulk fetch).
-        worst = int(np.asarray(seg.n_segments).max())
-        if worst > cap:
-            logger("pyccd").info(
-                "segment capacity %d overflowed on drain (deepest pixel "
-                "closed %d); recomputing the batch", cap, worst)
-            obs_metrics.counter("capacity_redispatches").inc()
-            seg, _ = detect_batch(packed, dtype or seg.seg_meta.dtype,
-                                  sharding, pad_to=pad_to,
-                                  check_capacity=True, compact=compact,
-                                  max_segments=min(
-                                      2 * cap,
-                                      kernel.capacity_bound(packed)))
-        host = fetch_results(seg)
-        # Occupancy telemetry: the event loop's per-round active/paid
-        # lane capture feeds kernel_round_active_fraction and the
-        # compaction counters (the batch results are on the host anyway).
-        kernel.record_occupancy(host)
-        write_batch_frames(packed, host, n_real, writer=writer,
-                           counters=counters)
-    obs_metrics.histogram("pipeline_drain_seconds").observe(tm.elapsed)
+    with tracing.activate(ctx):
+        with tracing.span("drain", chips=n_real), obs_metrics.timer() as tm:
+            # Capacity probe BEFORE the bulk fetch: n_segments alone is a
+            # few hundred KB, so an overflowed batch never pays a
+            # full-result transfer whose buffers are about to be discarded
+            # (and the d2h telemetry counts only the one real bulk fetch).
+            worst = int(np.asarray(seg.n_segments).max())
+            if worst > cap:
+                logger("pyccd").info(
+                    "segment capacity %d overflowed on drain (deepest pixel "
+                    "closed %d); recomputing the batch", cap, worst)
+                obs_metrics.counter("capacity_redispatches").inc()
+                seg, _ = detect_batch(packed, dtype or seg.seg_meta.dtype,
+                                      sharding, pad_to=pad_to,
+                                      check_capacity=True, compact=compact,
+                                      max_segments=min(
+                                          2 * cap,
+                                          kernel.capacity_bound(packed)))
+            host = fetch_results(seg)
+            # Occupancy telemetry: the event loop's per-round active/paid
+            # lane capture feeds kernel_round_active_fraction and the
+            # compaction counters (results are on the host anyway).
+            kernel.record_occupancy(host)
+            write_batch_frames(packed, host, n_real, writer=writer,
+                               counters=counters)
+        obs_metrics.histogram("pipeline_drain_seconds").observe(tm.elapsed)
+        # In-context completion line: with FIREBIRD_LOG_FORMAT=json this
+        # carries the batch id, joining the drain to its spans/exemplars.
+        logger("change-detection").debug(
+            "batch drained: %d chips in %.3fs", n_real, tm.elapsed)
     # Forward-progress beat: a drained batch is the watchdog's liveness
     # unit and /progress's batches_done tick (no-op when no run registered).
     obs_server.batch_done(n_real)
@@ -853,59 +895,80 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log,
             cf.ThreadPoolExecutor(max_workers=1) as prefetch_ex, \
             cf.ThreadPoolExecutor(max_workers=1) as drain_ex:
 
-        def fetch_one(xy):
-            try:
-                with obs_metrics.timer() as tm:
-                    chip = _with_retries(
-                        cfg, log, f"chip ({xy[0]},{xy[1]}) fetch",
-                        lambda: source.chip(xy[0], xy[1], acquired),
-                        policy=policy)
-            except Exception as e:
-                # Per-chip isolation: dead-letter the poisoned chip and
-                # let the rest of the batch proceed — `--resume` drains
-                # the quarantine once the cause clears.
-                log.error(
-                    "chip (%s,%s) failed after retries (%s: %s); "
-                    "quarantined — its chunk continues without it",
-                    xy[0], xy[1], type(e).__name__, e)
-                if quarantine is not None:
-                    quarantine.record(xy, e,
-                                      attempts=cfg.fetch_retries + 1)
-                return None
-            obs_metrics.histogram("ingest_chip_seconds").observe(tm.elapsed)
-            return chip
+        def fetch_one(xy, ctx=None):
+            # The chip pool's threads are outside the prefetch thread's
+            # context scope — the batch context crosses this hop
+            # explicitly too, so per-chip latency exemplars and failure
+            # log lines carry the batch id.
+            with tracing.activate(ctx):
+                try:
+                    with obs_metrics.timer() as tm:
+                        chip = _with_retries(
+                            cfg, log, f"chip ({xy[0]},{xy[1]}) fetch",
+                            lambda: source.chip(xy[0], xy[1], acquired),
+                            policy=policy)
+                except Exception as e:
+                    # Per-chip isolation: dead-letter the poisoned chip
+                    # and let the rest of the batch proceed — `--resume`
+                    # drains the quarantine once the cause clears.
+                    log.error(
+                        "chip (%s,%s) failed after retries (%s: %s); "
+                        "quarantined — its chunk continues without it",
+                        xy[0], xy[1], type(e).__name__, e)
+                    if quarantine is not None:
+                        quarantine.record(xy, e,
+                                          attempts=cfg.fetch_retries + 1)
+                    return None
+                obs_metrics.histogram(
+                    "ingest_chip_seconds").observe(tm.elapsed)
+                return chip
 
-        def prepare_batch(bids):
+        # ONE TraceContext per batch, minted here and carried EXPLICITLY
+        # across the three thread hops (prefetch stage -> main-thread
+        # dispatch -> drain executor -> writer queue): every span, JSON
+        # log line, and histogram exemplar those threads record parents
+        # to the same <run_id>/b<seq> id.
+        run_id = jsonlog.get_run_context().get("run_id")
+        ctxs = [tracing.TraceContext(tracing.new_batch_id(run_id),
+                                     run_id=run_id) for _ in batches]
+
+        def prepare_batch(bids, ctx):
             """fetch -> pack -> device staging, all on the prefetch
             thread: by the time the main thread picks the batch up, its
             arrays are already resident under the run's sharding.
             Returns (surviving chip ids, StagedBatch), or None when every
             chip of the batch was quarantined."""
-            with tracing.span("fetch", chips=len(bids)), \
-                    obs_metrics.timer() as tm:
-                chips = list(chips_ex.map(fetch_one, bids))
-            obs_metrics.histogram("pipeline_fetch_seconds").observe(tm.elapsed)
-            keep = [(cid, ch) for cid, ch in zip(bids, chips)
-                    if ch is not None]
-            if not keep:
-                return None
-            with tracing.span("pack", chips=len(keep)), \
-                    obs_metrics.timer() as tm:
-                packed = pack([ch for _, ch in keep], bucket=cfg.obs_bucket,
-                              max_obs=cfg.max_obs)
-            obs_metrics.histogram("pipeline_pack_seconds").observe(tm.elapsed)
-            return [cid for cid, _ in keep], \
-                stage_batch(packed, dtype, cfg.device_sharding,
-                            pad_to=pad_to)
+            with tracing.activate(ctx):
+                with tracing.span("fetch", chips=len(bids)), \
+                        obs_metrics.timer() as tm:
+                    chips = list(chips_ex.map(
+                        lambda xy: fetch_one(xy, ctx), bids))
+                obs_metrics.histogram(
+                    "pipeline_fetch_seconds").observe(tm.elapsed)
+                keep = [(cid, ch) for cid, ch in zip(bids, chips)
+                        if ch is not None]
+                if not keep:
+                    return None
+                with tracing.span("pack", chips=len(keep)), \
+                        obs_metrics.timer() as tm:
+                    packed = pack([ch for _, ch in keep],
+                                  bucket=cfg.obs_bucket,
+                                  max_obs=cfg.max_obs)
+                obs_metrics.histogram(
+                    "pipeline_pack_seconds").observe(tm.elapsed)
+                return [cid for cid, _ in keep], \
+                    stage_batch(packed, dtype, cfg.device_sharding,
+                                pad_to=pad_to)
 
-        nxt = prefetch_ex.submit(prepare_batch, batches[0]) \
+        nxt = prefetch_ex.submit(prepare_batch, batches[0], ctxs[0]) \
             if batches else None
         drains: list[cf.Future] = []
         processed: list = []
         for i in range(len(batches)):
             obs_server.set_stage("fetch")
             prep = nxt.result()
-            nxt = (prefetch_ex.submit(prepare_batch, batches[i + 1])
+            nxt = (prefetch_ex.submit(prepare_batch, batches[i + 1],
+                                      ctxs[i + 1])
                    if i + 1 < len(batches) else None)
             if prep is None:
                 continue                 # whole batch quarantined
@@ -914,15 +977,16 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log,
             # (check_capacity=False keeps it async); compute shows up as
             # the gap before the matching drain span closes.
             obs_server.set_stage("dispatch")
-            with tracing.span("dispatch", chips=staged.n_real), \
-                    obs_metrics.timer() as tm:
-                seg, n_real = detect_batch(staged.packed, dtype,
-                                           cfg.device_sharding,
-                                           pad_to=pad_to, staged=staged,
-                                           donate=_should_donate(),
-                                           compact=cfg.compact)
-            obs_metrics.histogram(
-                "pipeline_dispatch_seconds").observe(tm.elapsed)
+            with tracing.activate(ctxs[i]):
+                with tracing.span("dispatch", chips=staged.n_real), \
+                        obs_metrics.timer() as tm:
+                    seg, n_real = detect_batch(staged.packed, dtype,
+                                               cfg.device_sharding,
+                                               pad_to=pad_to, staged=staged,
+                                               donate=_should_donate(),
+                                               compact=cfg.compact)
+                obs_metrics.histogram(
+                    "pipeline_dispatch_seconds").observe(tm.elapsed)
             # /readyz flips here: mesh up + first batch dispatched means
             # compile/bring-up are behind us and the run is steady-state.
             obs_server.batch_dispatched()
@@ -930,7 +994,7 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log,
                 drain_batch, seg, staged.packed, n_real, writer=writer,
                 counters=counters, dtype=dtype,
                 sharding=cfg.device_sharding, pad_to=pad_to,
-                compact=cfg.compact))
+                compact=cfg.compact, ctx=ctxs[i]))
             processed.extend(kept)
             # Bound in-flight batches to cfg.pipeline_depth (the one
             # computing + depth-1 draining): input donation frees each

@@ -254,81 +254,94 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
         # drain goes through the shared bulk-egress helpers (one
         # device_get + vectorized batch_frames) — one code path, one test
         # surface, for both drivers.
+        # Per-batch TraceContext, carried across the prefetch hop (the
+        # batch driver's contract, driver/core.py detect_chunk): spans,
+        # queued writes, and JSON log lines of one bootstrap batch all
+        # parent to one <run_id>/b<seq> id.
+        ctxs = [tracing.TraceContext(tracing.new_batch_id(run_id),
+                                     run_id=run_id) for _ in batches]
         with cf.ThreadPoolExecutor(
                 max_workers=max(cfg.input_parallelism, 1)) as ex, \
                 cf.ThreadPoolExecutor(max_workers=1) as prefetch_ex:
 
-            def prepare(bids):
-                with tracing.span("fetch", chips=len(bids)), \
-                        obs_metrics.timer() as tm:
-                    fetched = list(ex.map(lambda c: fetch_chip(c, acquired),
-                                          bids))
-                obs_metrics.histogram(
-                    "pipeline_fetch_seconds").observe(tm.elapsed)
-                # fetch_chip already logged/quarantined each dropped chip.
-                keep = [(cid, ch) for cid, ch in zip(bids, fetched)
-                        if ch is not None]
-                if not keep:
-                    return None
-                with tracing.span("pack", chips=len(keep)), \
-                        obs_metrics.timer() as tm:
-                    p = pack([ch for _, ch in keep], bucket=cfg.obs_bucket,
-                             max_obs=cfg.max_obs)
-                obs_metrics.histogram(
-                    "pipeline_pack_seconds").observe(tm.elapsed)
-                return keep, dcore.stage_batch(
-                    p, jnp.float32, cfg.device_sharding, pad_to=pad_to)
+            def prepare(bids, ctx):
+                with tracing.activate(ctx):
+                    with tracing.span("fetch", chips=len(bids)), \
+                            obs_metrics.timer() as tm:
+                        fetched = list(ex.map(
+                            lambda c: fetch_chip(c, acquired), bids))
+                    obs_metrics.histogram(
+                        "pipeline_fetch_seconds").observe(tm.elapsed)
+                    # fetch_chip already logged/quarantined each dropped
+                    # chip.
+                    keep = [(cid, ch) for cid, ch in zip(bids, fetched)
+                            if ch is not None]
+                    if not keep:
+                        return None
+                    with tracing.span("pack", chips=len(keep)), \
+                            obs_metrics.timer() as tm:
+                        p = pack([ch for _, ch in keep],
+                                 bucket=cfg.obs_bucket,
+                                 max_obs=cfg.max_obs)
+                    obs_metrics.histogram(
+                        "pipeline_pack_seconds").observe(tm.elapsed)
+                    return keep, dcore.stage_batch(
+                        p, jnp.float32, cfg.device_sharding, pad_to=pad_to)
 
-            nxt = prefetch_ex.submit(prepare, batches[0]) \
+            nxt = prefetch_ex.submit(prepare, batches[0], ctxs[0]) \
                 if batches else None
             for i in range(len(batches)):
                 prep = nxt.result()
-                nxt = (prefetch_ex.submit(prepare, batches[i + 1])
+                nxt = (prefetch_ex.submit(prepare, batches[i + 1],
+                                          ctxs[i + 1])
                        if i + 1 < len(batches) else None)
                 if prep is None:
                     continue
                 keep, staged = prep
-                with tracing.span("dispatch", chips=staged.n_real), \
-                        obs_metrics.timer() as tm:
-                    # capacity check ON (synchronous retry): staged args
-                    # may be re-dispatched, so they are NOT donated.
-                    seg, n_real = dcore.detect_batch(
-                        staged.packed, jnp.float32, cfg.device_sharding,
-                        pad_to=pad_to, check_capacity=True, staged=staged,
-                        compact=cfg.compact)
-                obs_metrics.histogram(
-                    "pipeline_dispatch_seconds").observe(tm.elapsed)
-                obs_server.batch_dispatched()
-                with tracing.span("drain", chips=n_real), \
-                        obs_metrics.timer() as tm:
-                    host = dcore.fetch_results(seg)
-                    kernel.record_occupancy(host)
-                    dcore.write_batch_frames(staged.packed, host, n_real,
-                                             writer=writer)
-                    for c in range(n_real):
-                        cid = keep[c][0]
-                        one = kernel.chip_slice(host, c)
-                        st = incremental.StreamState.from_chip(one)
-                        sday, curqa = _tail_identity(one)
-                        T = int(staged.packed.n_obs[c])
-                        side = dict(
-                            sday=sday, curqa=curqa,
-                            anchor=np.float64(staged.packed.dates[c][0]),
-                            horizon=np.float64(
-                                staged.packed.dates[c][T - 1]))
-                        summary["bootstrapped"] += 1
-                        counters.add("chips")
-                        save_state(_state_path(sdir, cid), st, side)
-                        quarantine.discard(cid)
-                        summary["pixels_need_batch"] += int(
-                            np.asarray(st.needs_batch).sum())
-                obs_metrics.histogram(
-                    "pipeline_drain_seconds").observe(tm.elapsed)
+                with tracing.activate(ctxs[i]):
+                    with tracing.span("dispatch", chips=staged.n_real), \
+                            obs_metrics.timer() as tm:
+                        # capacity check ON (synchronous retry): staged
+                        # args may be re-dispatched, so NOT donated.
+                        seg, n_real = dcore.detect_batch(
+                            staged.packed, jnp.float32,
+                            cfg.device_sharding, pad_to=pad_to,
+                            check_capacity=True, staged=staged,
+                            compact=cfg.compact)
+                    obs_metrics.histogram(
+                        "pipeline_dispatch_seconds").observe(tm.elapsed)
+                    obs_server.batch_dispatched()
+                    with tracing.span("drain", chips=n_real), \
+                            obs_metrics.timer() as tm:
+                        host = dcore.fetch_results(seg)
+                        kernel.record_occupancy(host)
+                        dcore.write_batch_frames(staged.packed, host,
+                                                 n_real, writer=writer)
+                        for c in range(n_real):
+                            cid = keep[c][0]
+                            one = kernel.chip_slice(host, c)
+                            st = incremental.StreamState.from_chip(one)
+                            sday, curqa = _tail_identity(one)
+                            T = int(staged.packed.n_obs[c])
+                            side = dict(
+                                sday=sday, curqa=curqa,
+                                anchor=np.float64(staged.packed.dates[c][0]),
+                                horizon=np.float64(
+                                    staged.packed.dates[c][T - 1]))
+                            summary["bootstrapped"] += 1
+                            counters.add("chips")
+                            save_state(_state_path(sdir, cid), st, side)
+                            quarantine.discard(cid)
+                            summary["pixels_need_batch"] += int(
+                                np.asarray(st.needs_batch).sum())
+                    obs_metrics.histogram(
+                        "pipeline_drain_seconds").observe(tm.elapsed)
                 obs_server.batch_done(n_real)
 
         # --- update: apply only acquisitions past each chip's horizon ---
         obs_server.set_stage("update")
-        for cid in upd:
+
+        def update_one(cid) -> None:
             path = _state_path(sdir, cid)
             st, side = load_state(path)
             horizon = float(side["horizon"])
@@ -370,6 +383,15 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
             counters.add("chips")
             if tuple(int(v) for v in cid) not in failed_cids:
                 quarantine.discard(cid)
+
+        for cid in upd:
+            # The stream's update unit of work is a chip: one
+            # TraceContext each, so the delta fetch, publish write, and
+            # any failure log line join on one id (the batch driver's
+            # per-batch contract at chip granularity).
+            with tracing.activate(tracing.TraceContext(
+                    tracing.new_batch_id(run_id), run_id=run_id)):
+                update_one(cid)
             # Per-chip progress beat: updates are host-cheap, so the
             # watchdog's liveness unit here is a processed chip.
             obs_server.batch_done(1)

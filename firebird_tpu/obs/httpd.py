@@ -26,6 +26,8 @@ import json
 import threading
 from urllib.parse import parse_qs, urlsplit
 
+from firebird_tpu.obs import tracing
+
 
 class JsonHandler(http.server.BaseHTTPRequestHandler):
     """Request handler base: subclasses implement ``_route(path, query)``
@@ -45,8 +47,15 @@ class JsonHandler(http.server.BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
-        for k, v in (headers or {}).items():
+        headers = headers or {}
+        for k, v in headers.items():
             self.send_header(k, str(v))
+        # Trace propagation: a response produced under a TraceContext
+        # (serve mints one per request) echoes its id, so a client can
+        # join its slow call to server-side spans/exemplars/logs.
+        ctx = tracing.current_context()
+        if ctx is not None and "X-Firebird-Trace" not in headers:
+            self.send_header("X-Firebird-Trace", ctx.batch_id)
         self.end_headers()
         self.wfile.write(body)
 
@@ -56,9 +65,33 @@ class JsonHandler(http.server.BaseHTTPRequestHandler):
                    "application/json", headers)
 
     def do_GET(self):  # noqa: N802 (stdlib handler naming)
+        self._dispatch_safely(self._route)
+
+    def do_POST(self):  # noqa: N802 (stdlib handler naming)
+        # Drain any request body first: leaving it unread desyncs the
+        # HTTP/1.1 keep-alive stream for the client's next request.
+        # Bodies past a sane bound aren't drained (nothing here takes a
+        # payload) — the connection is closed after the response instead,
+        # so a capped drain can never leave stray bytes to be parsed as
+        # the next request line.
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            if n > (1 << 20):
+                self.close_connection = True
+            else:
+                while n > 0:
+                    chunk = self.rfile.read(min(n, 1 << 16))
+                    if not chunk:
+                        break
+                    n -= len(chunk)
+        except (ValueError, OSError):
+            pass
+        self._dispatch_safely(self._route_post)
+
+    def _dispatch_safely(self, route) -> None:
         parts = urlsplit(self.path)
         try:
-            self._route(parts.path, parse_qs(parts.query))
+            route(parts.path, parse_qs(parts.query))
         except BrokenPipeError:
             pass                       # client went away mid-response
         except Exception as e:         # a broken endpoint must report, not
@@ -70,6 +103,11 @@ class JsonHandler(http.server.BaseHTTPRequestHandler):
 
     def _route(self, path: str, query: dict) -> None:
         raise NotImplementedError
+
+    def _route_post(self, path: str, query: dict) -> None:
+        """Default POST surface: nothing accepts writes unless a
+        subclass says so (the ops server's /profile does)."""
+        self._send_json(405, {"error": f"POST not supported on {path!r}"})
 
 
 class Httpd(http.server.ThreadingHTTPServer):

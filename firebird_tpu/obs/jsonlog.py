@@ -24,6 +24,8 @@ import socket
 import threading
 import time
 
+from firebird_tpu.obs import tracing
+
 HOST = socket.gethostname()
 
 _lock = threading.Lock()
@@ -78,6 +80,13 @@ class JsonFormatter(logging.Formatter):
             "run_id": ctx["run_id"],
             "process_id": ctx["process_index"],
         }
+        # Batch-scoped parent id: a line logged from inside a unit of
+        # work (any thread that activated the batch's TraceContext —
+        # prefetch, dispatch, drain, writer) joins to its spans and
+        # exemplars on one key (obs/tracing.py).
+        tctx = tracing.current_context()
+        if tctx is not None:
+            out["batch"] = tctx.batch_id
         if record.exc_info:
             out["exc"] = self.formatException(record.exc_info)
         return json.dumps(out, default=str)

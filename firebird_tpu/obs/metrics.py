@@ -22,6 +22,13 @@ import os
 import threading
 import time
 
+from firebird_tpu.obs import tracing as _tracing
+
+# Exemplars kept per histogram: the slowest observations' trace
+# identities (batch id + span id), so a hot p99 in a report links to the
+# exact batch/trace that caused it instead of an anonymous bucket count.
+EXEMPLAR_SLOTS = 4
+
 # Fixed latency buckets (seconds): spans sub-millisecond packs up to
 # multi-minute XLA compiles.  Fixed — not adaptive — so percentiles are
 # comparable across runs and the exposition is a stable schema.
@@ -107,18 +114,29 @@ class Histogram:
         self._count = 0  # guarded-by: _lock
         self._min = float("inf")  # guarded-by: _lock
         self._max = float("-inf")  # guarded-by: _lock
+        # Slowest-observation exemplars [(value, {batch, span_id}), ...],
+        # descending, at most EXEMPLAR_SLOTS.
+        self._exemplars: list = []  # guarded-by: _lock
 
     def observe(self, v: float) -> None:
         if not metrics_enabled():
             return
         v = float(v)
         i = bisect.bisect_left(self.buckets, v)
+        # Exemplar resolved OUTSIDE the lock (one thread-local read; None
+        # when no TraceContext is active — e.g. registry unit tests).
+        ex = _tracing.exemplar()
         with self._lock:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
             self._min = min(self._min, v)
             self._max = max(self._max, v)
+            if ex is not None and (len(self._exemplars) < EXEMPLAR_SLOTS
+                                   or v > self._exemplars[-1][0]):
+                self._exemplars.append((v, ex))
+                self._exemplars.sort(key=lambda t: -t[0])
+                del self._exemplars[EXEMPLAR_SLOTS:]
 
     def observe_many(self, values) -> None:
         """Bulk observe: vectorized binning + ONE lock acquisition for
@@ -177,6 +195,9 @@ class Histogram:
                    # (merge_histogram_snapshots).
                    "bucket_bounds": list(self.buckets),
                    "bucket_counts": list(self._counts)}
+            if self._exemplars:
+                out["exemplars"] = [dict(ex, value=round(v, 6))
+                                    for v, ex in self._exemplars]
         out.update({"p50": self.quantile(0.50), "p95": self.quantile(0.95),
                     "p99": self.quantile(0.99)})
         return out
@@ -445,6 +466,10 @@ def merge_histogram_snapshots(snaps: list[dict]) -> dict:
     live = [s for s in snaps if s.get("count", 0) > 0]
     if not live:
         return {"count": 0}
+    # Exemplars union across shards, slowest-first, re-bounded — a fleet
+    # report's p99 exemplar should be the fleet's slowest batch.
+    exemplars = sorted((e for s in live for e in s.get("exemplars", ())),
+                       key=lambda e: -e.get("value", 0.0))[:EXEMPLAR_SLOTS]
     bounds = live[0].get("bucket_bounds")
     same = bounds is not None and \
         all(s.get("bucket_bounds") == bounds for s in live)
@@ -456,7 +481,10 @@ def merge_histogram_snapshots(snaps: list[dict]) -> dict:
         h._sum = float(sum(s["sum"] for s in live))
         h._min = min(s["min"] for s in live)
         h._max = max(s["max"] for s in live)
-        return h.snapshot()
+        out = h.snapshot()
+        if exemplars:
+            out["exemplars"] = exemplars
+        return out
     total = sum(s["count"] for s in live)
     out = {"count": total, "sum": float(sum(s["sum"] for s in live)),
            "min": min(s["min"] for s in live),
@@ -467,6 +495,8 @@ def merge_histogram_snapshots(snaps: list[dict]) -> dict:
         vals = [(s[q], s["count"]) for s in live if s.get(q) is not None]
         out[q] = (sum(v * c for v, c in vals) / sum(c for _, c in vals)
                   if vals else None)
+    if exemplars:
+        out["exemplars"] = exemplars
     return out
 
 

@@ -1,0 +1,288 @@
+"""On-demand device profiling: windowed jax.profiler captures mid-run.
+
+The host span tracer (obs/tracing.py) says *that* a batch was slow; it
+cannot say *where on the device* the time went — and every bench round
+r01-r05 ran blind on exactly that question (the reference had the same
+gap: no throughput numbers anywhere, PAPER.md §6).  The LASP CPU→GPU
+port (PAPERS.md) attributes every optimization step to profiler-measured
+kernel phases *before* touching code; this module makes that workflow a
+one-request operation on a live run:
+
+- ``FIREBIRD_PROFILE=<seconds>`` (``Config.profile``) arms an automatic
+  window that starts at the run's FIRST dispatched batch — steady-state
+  kernels, not bring-up compile noise.
+- ``POST /profile?seconds=N`` on the ops endpoint (obs/server.py)
+  captures a window on demand at any point mid-run.
+
+Each window wraps ``jax.profiler.start_trace``/``stop_trace`` around a
+bounded wait and writes the standard XLA/TensorBoard artifact
+(``.trace.json.gz`` + xplane) under ``<store dir>/device_profile/
+window_<n>/`` — linkable from the run's other artifacts, loadable in
+Perfetto/TensorBoard.  The Chrome-trace half is then parsed for
+**per-phase device-time attribution**: event durations bucketed into
+the CCD loop's phases (fit / monitor / compaction) by kernel-name
+pattern, folded into ``obs_report.json`` (``profile`` block — structure
+always present, zeros allowed on backends whose op names match nothing)
+and from there into bench artifacts.
+
+``Config.profile_dir`` (FIREBIRD_PROFILE_DIR) remains the whole-run
+capture; this module is the *windowed* complement a multi-hour run
+needs (a full-run device trace of a tile run is gigabytes).
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import threading
+import time
+
+from firebird_tpu.obs import metrics as obs_metrics
+from firebird_tpu.obs import tracing
+
+# Kernel-name patterns -> CCD event-loop phase.  Matched as lowercase
+# substrings against every trace event name; first phase wins, anything
+# unmatched lands in "other".  Zeros are legitimate (the lax CPU path
+# fuses phases into opaque while-loop ops) — the structure is the
+# contract, the split fills in where the lowering preserves names
+# (Pallas kernels, named HLO ops on TPU).
+PHASE_PATTERNS = (
+    ("fit", ("lasso", "gram", "cd_step", "lstsq", "fit")),
+    ("monitor", ("monitor", "score", "peek", "tmask")),
+    ("compaction", ("compact", "permut", "scatter", "cumsum", "sort")),
+)
+PHASES = tuple(name for name, _ in PHASE_PATTERNS) + ("other",)
+
+
+def empty_attribution(source: str = "none") -> dict:
+    out = {f"{p}_ms": 0.0 for p in PHASES}
+    out.update({"total_ms": 0.0, "events": 0, "source": source})
+    return out
+
+
+def attribute_phases(trace_dir: str) -> dict:
+    """Per-phase device-time split of a captured window.
+
+    Walks the window directory for the ``.trace.json.gz`` files jax's
+    profiler writes (``plugins/profile/<ts>/<host>.trace.json.gz``),
+    sums complete-event durations by PHASE_PATTERNS, and returns the
+    attribution dict (milliseconds).  Unreadable/absent traces return
+    the zero structure with ``source`` saying why.
+    """
+    paths = sorted(glob.glob(os.path.join(trace_dir, "**",
+                                          "*.trace.json.gz"),
+                             recursive=True))
+    if not paths:
+        return empty_attribution("no-trace-files")
+    out = empty_attribution("trace")
+    for path in paths:
+        try:
+            with gzip.open(path, "rt", errors="replace") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for ev in doc.get("traceEvents", ()):
+            if not isinstance(ev, dict) or ev.get("ph") != "X":
+                continue
+            dur_ms = float(ev.get("dur", 0.0)) / 1e3
+            name = str(ev.get("name", "")).lower()
+            phase = "other"
+            for p, pats in PHASE_PATTERNS:
+                if any(s in name for s in pats):
+                    phase = p
+                    break
+            out[f"{phase}_ms"] += dur_ms
+            out["total_ms"] += dur_ms
+            out["events"] += 1
+    for p in PHASES:
+        out[f"{p}_ms"] = round(out[f"{p}_ms"], 3)
+    out["total_ms"] = round(out["total_ms"], 3)
+    return out
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture window is already in flight (jax allows one trace at a
+    time per process)."""
+
+
+class DeviceProfiler:
+    """Windowed device-trace capture for one run.
+
+    ``outdir`` is the artifact root (``<store dir>/device_profile``);
+    each window writes ``window_<n>/`` under it.  One window at a time —
+    jax.profiler is a process singleton.
+    """
+
+    def __init__(self, outdir: str):
+        self.outdir = os.path.abspath(outdir)
+        self._lock = threading.Lock()
+        self._busy = False  # guarded-by: _lock
+        self._n = 0  # guarded-by: _lock
+        self._windows: list[dict] = []  # guarded-by: _lock
+        self._auto_seconds = 0.0  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- capture -------------------------------------------------------------
+
+    def window(self, seconds: float, block: bool = False) -> dict:
+        """Start one capture window of ``seconds`` (bounded 0.05..600).
+        Raises :class:`ProfilerBusy` when one is already in flight.
+        ``block=True`` runs the capture synchronously (tests, tools);
+        the default returns immediately and captures on a daemon thread.
+        """
+        seconds = min(max(float(seconds), 0.05), 600.0)
+        with self._lock:
+            if self._busy:
+                raise ProfilerBusy("a profile window is already capturing")
+            self._busy = True
+            n = self._n
+            self._n += 1
+        info = {"window": n, "seconds": seconds,
+                "dir": os.path.join(self.outdir, f"window_{n:02d}"),
+                # UTC with designator — the written_at/generated_at
+                # convention, so windows correlate across artifacts.
+                "started_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime())}
+        if block:
+            self._capture(info)
+            return info
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._capture, args=(info,),
+            name="firebird-profile", daemon=True)
+        self._thread.start()
+        return info
+
+    def _capture(self, info: dict) -> None:
+        try:
+            import jax
+
+            os.makedirs(info["dir"], exist_ok=True)
+            with tracing.span("profile", seconds=info["seconds"]):
+                jax.profiler.start_trace(info["dir"])
+                try:
+                    # Interruptible wait: close() ends an in-flight
+                    # window early instead of leaking a started trace.
+                    self._stop.wait(info["seconds"])
+                finally:
+                    jax.profiler.stop_trace()
+            info["attribution"] = attribute_phases(info["dir"])
+            info["trace_files"] = len(glob.glob(
+                os.path.join(info["dir"], "**", "*"), recursive=True))
+            obs_metrics.counter(
+                "profile_windows",
+                help="on-demand device-profile windows captured").inc()
+        except Exception as e:
+            # A broken profiler (unsupported backend, concurrent trace)
+            # must cost the operator a diagnosable record, not the run.
+            info["error"] = f"{type(e).__name__}: {e}"
+            info["attribution"] = empty_attribution("error")
+            from firebird_tpu.obs import logger
+            logger("change-detection").warning(
+                "device-profile window failed: %s", info["error"])
+        finally:
+            with self._lock:
+                self._windows.append(info)
+                self._busy = False
+
+    # -- FIREBIRD_PROFILE auto window ---------------------------------------
+
+    def arm_auto(self, seconds: float) -> None:
+        """Arm a one-shot window that starts at the first dispatched
+        batch (obs/server.py's ``batch_dispatched`` hook) — steady-state
+        kernels, not bring-up compile."""
+        with self._lock:
+            self._auto_seconds = float(seconds)
+
+    def maybe_start_auto(self) -> None:
+        with self._lock:
+            seconds, self._auto_seconds = self._auto_seconds, 0.0
+        if seconds > 0:
+            try:
+                self.window(seconds)
+            except ProfilerBusy:
+                pass
+
+    # -- reads / teardown ----------------------------------------------------
+
+    def summary(self) -> dict:
+        """The report's ``profile`` block: windows so far + device-time
+        totals across them (structure matches :func:`report_block`)."""
+        with self._lock:
+            windows = [dict(w) for w in self._windows]
+            busy = self._busy
+        # Provenance must survive aggregation: 'trace' only when a
+        # window REALLY parsed trace files — every-window-failed reports
+        # 'error' (all zeros + 'trace' would mask a broken profiler as a
+        # healthy capture that attributed nothing).
+        sources = {w.get("attribution", {}).get("source") for w in windows}
+        device_time = empty_attribution(
+            "trace" if "trace" in sources
+            else "error" if ("error" in sources
+                             or "no-trace-files" in sources)
+            else "none")
+        for w in windows:
+            a = w.get("attribution")
+            if not a:
+                continue
+            for p in PHASES:
+                device_time[f"{p}_ms"] = round(
+                    device_time[f"{p}_ms"] + a.get(f"{p}_ms", 0.0), 3)
+            device_time["total_ms"] = round(
+                device_time["total_ms"] + a.get("total_ms", 0.0), 3)
+            device_time["events"] += a.get("events", 0)
+        return {"windows": windows, "in_flight": busy,
+                "device_time": device_time, "dir": self.outdir}
+
+    def close(self, timeout: float = 10.0) -> None:
+        """End any in-flight window early and collect it — called before
+        the report is written so a run's last window is never lost."""
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout)
+        self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# Process-global slot (one run's profiler; obs/report reads it)
+# ---------------------------------------------------------------------------
+
+# Mutated by start_ops/stop_ops on the run-owning thread; readers grab
+# the reference once (the obs/server._status discipline).
+_active: DeviceProfiler | None = None
+
+
+def set_active(prof: DeviceProfiler | None) -> DeviceProfiler | None:
+    global _active
+    _active = prof  # firebird-lint: disable=ownership-global-mutation
+    return prof
+
+
+def active() -> DeviceProfiler | None:
+    return _active
+
+
+def close_active() -> None:
+    """Flush an in-flight window (never raises) — obs.report.finish_run
+    calls this before building the report so the artifact carries the
+    final window's attribution."""
+    prof = _active
+    if prof is not None:
+        try:
+            prof.close()
+        except Exception:
+            pass
+
+
+def report_block() -> dict:
+    """The obs_report ``profile`` block — ALWAYS structurally present
+    (the acceptance contract: zeros allowed, structure never absent)."""
+    prof = _active
+    if prof is None:
+        return {"windows": [], "in_flight": False,
+                "device_time": empty_attribution("none"), "dir": None}
+    return prof.summary()

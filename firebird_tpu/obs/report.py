@@ -33,20 +33,59 @@ DRIVER_STAGE_HISTOGRAMS = (
 )
 DRIVER_SPAN_NAMES = ("fetch", "pack", "stage", "dispatch", "drain", "d2h")
 
+# THE span-name catalog: every tracing.span(...) call site in the
+# codebase must use a name declared here, and every declared name must
+# still have a call site — firebird-lint's span-name rules check both
+# directions against this literal AND the OBSERVABILITY.md span table
+# (the metric-table pattern), so a new span cannot ship undocumented
+# and a renamed one cannot leave a stale row.  Keep it a literal tuple:
+# the linter parses it from source.
+SPAN_NAMES = (
+    "d2h",
+    "dispatch",
+    "drain",
+    "fetch",
+    "first_dispatch",
+    "pack",
+    "profile",
+    "publish",
+    "stage",
+    "store_flush",
+    "store_write",
+    "warm_compile",
+)
+
 
 def build_report(*, registry=None, tracer=None, run: dict | None = None,
                  run_counters: dict | None = None) -> dict:
     """Assemble the report dict from live objects (no I/O)."""
     from firebird_tpu.obs import metrics as m
+    from firebird_tpu.obs import profiling
+    from firebird_tpu.obs import server as obs_server
+    from firebird_tpu.obs import slo as slomod
 
     reg = registry if registry is not None else m.get_registry()
+    metrics = reg.snapshot()
+    # SLO + device-profile blocks are structurally ALWAYS present (the
+    # obs-smoke contract): no-data objectives report ok=null, a run
+    # without profile windows reports the zero attribution.
+    st = obs_server.current()
+    wd_snap = None
+    spec = None
+    if st is not None:
+        spec = getattr(st, "slo_spec", None)
+        if st.watchdog is not None:
+            wd_snap = st.watchdog.snapshot()
     rep = {
         "schema": SCHEMA,
         "generated_at": datetime.datetime.now(
             datetime.timezone.utc).isoformat(timespec="seconds"),
         "run": run or {},
-        "metrics": reg.snapshot(),
+        "metrics": metrics,
         "spans": tracer.summary() if tracer is not None else {},
+        "slo": slomod.evaluate_snapshot(metrics, watchdog=wd_snap,
+                                        spec=spec),
+        "profile": profiling.report_block(),
     }
     if run_counters:
         rep["run_counters"] = run_counters
@@ -231,6 +270,41 @@ def merge_reports(reports: list[dict]) -> dict:
         s["total_ms"] = round(s["total_ms"], 3)
         s["max_ms"] = round(s["max_ms"], 3)
     out["spans"] = spans
+    # SLO: RE-evaluated over the merged histograms (per-host verdicts
+    # cannot be combined — a fleet p99 is not any host's p99); the first
+    # shard's spec wins (every host of a fleet launch shares one config).
+    from firebird_tpu.obs import slo as slomod
+
+    specs = [r.get("slo", {}).get("spec") for r in reports
+             if r.get("slo")]
+    out["slo"] = slomod.evaluate_snapshot(
+        out["metrics"], spec=specs[0] if specs else None)
+    # Device-profile attribution sums across hosts; windows concatenate
+    # (each already names its host-local artifact directory).
+    from firebird_tpu.obs import profiling
+
+    prof = {"windows": [], "in_flight": False,
+            "device_time": profiling.empty_attribution("none"), "dir": None}
+    sources = set()
+    for r in reports:
+        p = r.get("profile")
+        if not p:
+            continue
+        prof["windows"].extend(p.get("windows", ()))
+        dt = p.get("device_time") or {}
+        sources.add(dt.get("source"))
+        for k, v in dt.items():
+            if isinstance(v, (int, float)):
+                prof["device_time"][k] = round(
+                    prof["device_time"].get(k, 0) + v, 3)
+    # Shard provenance survives the merge: any real capture -> 'trace';
+    # otherwise any failed shard -> 'error' (a fleet whose every
+    # profiler broke must not read as one that never profiled).
+    if "trace" in sources:
+        prof["device_time"]["source"] = "trace"
+    elif "error" in sources:
+        prof["device_time"]["source"] = "error"
+    out["profile"] = prof
     rcs = [r["run_counters"] for r in reports if r.get("run_counters")]
     if rcs:
         merged: dict = {}
@@ -353,9 +427,12 @@ def finish_run(cfg, *, tracer=None, run: dict | None = None,
     obs_report.json per cfg.obs_report policy.  Returns {artifact: path}
     for the paths actually written.  Never raises — a failed telemetry
     write must not fail a run whose results already landed."""
-    from firebird_tpu.obs import logger, tracing
+    from firebird_tpu.obs import logger, profiling, tracing
 
     log = logger("change-detection")
+    # Flush any in-flight device-profile window FIRST so the report's
+    # profile block carries its attribution (never raises).
+    profiling.close_active()
     out = {}
     # Independent try blocks: an unwritable trace path must not also
     # drop the report (or vice versa) when its own path is writable.

@@ -55,7 +55,8 @@ class RunStatus:
     def __init__(self, run_id: str, kind: str, *, chips_total: int = 0,
                  counters=None, watchdog=None, run: dict | None = None,
                  mesh_up: bool = True, pipeline_depth: int = 2,
-                 quarantine=None, breaker=None):
+                 quarantine=None, breaker=None, profiler=None,
+                 slo_spec: str | None = None):
         self.run_id = run_id
         self.kind = kind
         self.chips_total = int(chips_total)
@@ -66,6 +67,10 @@ class RunStatus:
         # (retry.CircuitBreaker) — both optional, both only *read* here.
         self.quarantine = quarantine
         self.breaker = breaker
+        # Deep-dive hooks: the run's device profiler (POST /profile,
+        # obs/profiling.py) and its SLO spec (/slo, obs/slo.py).
+        self.profiler = profiler
+        self.slo_spec = slo_spec
         self.run = dict(run or {})
         self.pipeline_depth = max(int(pipeline_depth), 1)
         self._lock = threading.Lock()
@@ -80,6 +85,8 @@ class RunStatus:
     def set_stage(self, name: str) -> None:
         with self._lock:
             self._stage = name
+        from firebird_tpu.obs import flightrec
+        flightrec.mark("stage", stage=name)
 
     def mark_mesh_up(self) -> None:
         with self._lock:
@@ -90,14 +97,24 @@ class RunStatus:
         with self._lock:
             self._first_batch = True
             self._batches_dispatched += 1
+            n = self._batches_dispatched
             self._record_inflight()
+        from firebird_tpu.obs import flightrec
+        flightrec.mark("batch_dispatched", n=n)
+        # FIREBIRD_PROFILE's auto window starts HERE: the first dispatch
+        # means steady-state kernels, not bring-up compile.
+        if self.profiler is not None:
+            self.profiler.maybe_start_auto()
 
     def batch_done(self, units: int = 1) -> None:
         """A batch finished draining — forward progress; beats the
         watchdog."""
         with self._lock:
             self._batches_done += 1
+            n = self._batches_done
             self._record_inflight()
+        from firebird_tpu.obs import flightrec
+        flightrec.mark("batch_done", n=n, units=units)
         if self.watchdog is not None:
             self.watchdog.beat(units)
 
@@ -131,6 +148,13 @@ class RunStatus:
         """The /progress 'degraded' sub-document (docs/ROBUSTNESS.md)."""
         from firebird_tpu.obs import metrics as obs_metrics
 
+        # Recent rolling-window throughput-drop events (timestamp, the
+        # window rate, the threshold it crossed): the slow-leak signal
+        # was only COUNTED before — the events themselves belong in the
+        # degraded view an operator actually reads.
+        drops: list = []
+        if self.watchdog is not None:
+            drops = self.watchdog.snapshot().get("throughput_drops", [])
         return {
             "active": self.degraded(),
             "chips_quarantined": (len(self.quarantine)
@@ -140,6 +164,7 @@ class RunStatus:
             "faults_injected": obs_metrics.counter("faults_injected").value,
             "retries": obs_metrics.counter("fetch_retries").value
             + obs_metrics.counter("store_write_retries").value,
+            "throughput_drops": drops,
         }
 
     @staticmethod
@@ -290,10 +315,65 @@ class _OpsHandler(httpd.JsonHandler):
                 run_counters=(st.counters.snapshot()
                               if st is not None and st.counters is not None
                               else None)))
+        elif path == "/slo":
+            from firebird_tpu.obs import slo as slomod
+            self._send_json(200, slomod.evaluate_snapshot(
+                obs_metrics.get_registry().snapshot(),
+                watchdog=(st.watchdog.snapshot()
+                          if st is not None and st.watchdog is not None
+                          else None),
+                spec=st.slo_spec if st is not None else None))
+        elif path == "/profile":
+            # GET reports the windows captured so far (POST starts one).
+            from firebird_tpu.obs import profiling
+            prof = st.profiler if st is not None else None
+            if prof is None:
+                prof_active = profiling.active()
+                if prof_active is None:
+                    self._send_json(503, {"error": "no profiler for this "
+                                                   "run (memory backend?)"})
+                    return
+                prof = prof_active
+            self._send_json(200, prof.summary())
         else:
             self._send_json(404, {"error": f"unknown path {path!r}",
                                   "paths": ["/healthz", "/readyz", "/metrics",
-                                            "/progress", "/report"]})
+                                            "/progress", "/report", "/slo",
+                                            "/profile"]})
+
+    def _route_post(self, path: str, query: dict) -> None:
+        from firebird_tpu.obs import profiling
+
+        st = self.server.status if self.server.status is not None \
+            else current()
+        if path != "/profile":
+            super()._route_post(path, query)
+            return
+        prof = st.profiler if st is not None else None
+        if prof is None:
+            prof = profiling.active()
+        if prof is None:
+            self._send_json(503, {"error": "no profiler for this run "
+                                           "(memory backend?)"})
+            return
+        import math
+
+        try:
+            seconds = float((query.get("seconds") or ["3"])[0])
+        except ValueError:
+            self._send_json(400, {"error": "seconds must be a number"})
+            return
+        if not math.isfinite(seconds):
+            # nan slips through min/max clamping (Event.wait(nan) raises
+            # after a real trace started) and inf isn't a window.
+            self._send_json(400, {"error": "seconds must be finite"})
+            return
+        try:
+            info = prof.window(seconds)
+        except profiling.ProfilerBusy as e:
+            self._send_json(409, {"error": str(e)})
+            return
+        self._send_json(202, dict(info, started=True))
 
 
 class OpsServer(httpd.Httpd):
@@ -326,5 +406,5 @@ def start_ops_server(port: int, status: RunStatus | None = None,
     from firebird_tpu.obs import logger
     logger("change-detection").info(
         "ops endpoint up on %s:%d (/healthz /readyz /metrics /progress "
-        "/report)", host, srv.port)
+        "/report /slo; POST /profile)", host, srv.port)
     return srv

@@ -1,0 +1,124 @@
+"""SLO tracking: declared objectives evaluated against live histograms.
+
+The obs stack records every latency but nothing *judges* them — an
+operator watching ``/progress`` must remember what "healthy" looks like
+for each number.  This module makes the objectives declarations: a spec
+(``FIREBIRD_SLO`` / ``Config.slo``) names each objective and its
+target, evaluation reads the SAME metric snapshots the report and
+``/metrics`` expose, and the verdict is served live at ``/slo``
+(obs/server.py) and summarized in every ``obs_report.json`` (fleet
+merges re-evaluate over the merged histograms).
+
+Objectives (the spec grammar is ``name=target;name=target``; targets
+are seconds):
+
+``batch_p95``
+    p95 of ``pipeline_drain_seconds`` — the steady-state batch wall
+    time as the drain thread sees it (device wait + egress; dispatch is
+    asynchronous so this histogram is where a slow batch shows up).
+``serve_p99``
+    p99 of ``serve_request_seconds`` — the query layer's tail latency,
+    admission wait included.
+``freshness``
+    Seconds since the last drained batch (the watchdog's
+    ``last_beat_age_sec``) — the liveness half of an alerting-grade
+    freshness promise: results are at most this stale.
+
+An objective whose metric has no data reports ``ok: null`` ("no_data")
+rather than passing or failing — a serve SLO must not fail a batch run
+that never served a request.  ``FIREBIRD_SLO=0`` disables evaluation.
+"""
+
+from __future__ import annotations
+
+DEFAULT_SPEC = "batch_p95=30;serve_p99=2;freshness=600"
+
+# name -> (kind, metric/field, stat, description)
+OBJECTIVES = {
+    "batch_p95": ("histogram", "pipeline_drain_seconds", "p95",
+                  "steady-state batch seconds (device wait + egress, p95)"),
+    "serve_p99": ("histogram", "serve_request_seconds", "p99",
+                  "serve /v1 request seconds (admission wait incl., p99)"),
+    "freshness": ("watchdog", "last_beat_age_sec", None,
+                  "seconds since the last drained batch"),
+}
+
+
+def parse_spec(spec: str) -> list[tuple[str, float]]:
+    """``"batch_p95=30;serve_p99=2"`` -> [(name, target), ...].
+
+    Raises ValueError on unknown objective names or unparseable targets
+    — Config validates at construction (the FIREBIRD_FAULTS fail-fast
+    rationale: a typo'd spec silently evaluating nothing is worse than
+    a crash at bring-up).
+    """
+    out: list[tuple[str, float]] = []
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, target = part.partition("=")
+        name = name.strip()
+        if not sep:
+            raise ValueError(f"SLO objective {part!r} is not name=target")
+        if name not in OBJECTIVES:
+            raise ValueError(
+                f"unknown SLO objective {name!r}; known: "
+                f"{sorted(OBJECTIVES)}")
+        try:
+            t = float(target)
+        except ValueError as e:
+            raise ValueError(
+                f"SLO target {target!r} for {name!r} is not a number"
+            ) from e
+        if t <= 0:
+            raise ValueError(f"SLO target for {name!r} must be > 0, got {t}")
+        out.append((name, t))
+    return out
+
+
+def evaluate_snapshot(metrics: dict, watchdog: dict | None = None,
+                      spec: str | None = None) -> dict:
+    """Evaluate the spec against a metrics *snapshot* (the JSON form —
+    ``MetricsRegistry.snapshot()`` or a report's ``metrics`` block, so
+    live endpoints, per-host shards, and merged fleet reports all
+    evaluate identically).  ``watchdog`` is a watchdog snapshot for the
+    freshness objective (None: no_data).
+
+    Returns ``{"spec", "ok", "violations", "objectives": [...]}`` —
+    ``ok`` is True only when no evaluated objective is violated
+    (no_data objectives neither pass nor fail).
+    """
+    if spec is None or spec == "":
+        spec = DEFAULT_SPEC
+    if spec == "0":
+        return {"spec": "0", "ok": True, "violations": 0, "objectives": []}
+    objectives = []
+    violations = 0
+    hists = (metrics or {}).get("histograms", {})
+    for name, target in parse_spec(spec):
+        kind, key, stat, desc = OBJECTIVES[name]
+        value = None
+        if kind == "histogram":
+            h = hists.get(key) or {}
+            if h.get("count", 0) > 0:
+                value = h.get(stat)
+        else:                            # watchdog field
+            if watchdog is not None:
+                value = watchdog.get(key)
+        ok = None if value is None else bool(value <= target)
+        if ok is False:
+            violations += 1
+        obj = {"name": name, "target_sec": target, "value_sec": value,
+               "ok": ok, "description": desc}
+        if kind == "histogram":
+            obj["metric"] = key
+            obj["stat"] = stat
+            # Exemplars turn a violated latency objective into a lead:
+            # the exact batch/span ids behind the slowest observations.
+            ex = (hists.get(key) or {}).get("exemplars")
+            if ex and ok is False:
+                obj["exemplars"] = ex
+        objectives.append(obj)
+    return {"spec": spec, "ok": violations == 0, "violations": violations,
+            "objectives": objectives}

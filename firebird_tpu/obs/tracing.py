@@ -19,6 +19,9 @@ Perfetto (ui.perfetto.dev) and chrome://tracing.
 
 from __future__ import annotations
 
+import contextlib
+import dataclasses
+import itertools
 import json
 import os
 import threading
@@ -40,21 +43,112 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
-class _Span:
-    __slots__ = ("_tracer", "_name", "_args", "_t0")
+# ---------------------------------------------------------------------------
+# Cross-thread trace propagation: the per-batch/per-request TraceContext
+# ---------------------------------------------------------------------------
+#
+# The pipeline's unit of work crosses FOUR threads (prefetch stage ->
+# main-thread dispatch -> drain executor -> writer worker), so a
+# thread-local alone cannot correlate one batch's spans and log lines.
+# The drivers therefore mint ONE TraceContext per batch (per request in
+# serve/api.py) and carry it EXPLICITLY across each thread hop; each
+# thread activates it around the work it does for that batch, and
+# everything recorded while it is active — spans (the ``batch`` arg),
+# JSON log lines (obs/jsonlog.py), histogram exemplars
+# (obs/metrics.py), flight-recorder events (obs/flightrec.py) — parents
+# to the same batch id.
 
-    def __init__(self, tracer: "Tracer", name: str, args: dict):
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One unit of work's identity: ``batch_id`` is globally unique
+    (``<run_id>/b<seq>`` in the drivers, ``req-<hex>`` in serve)."""
+
+    batch_id: str
+    run_id: str | None = None
+
+
+class _Tls(threading.local):
+    ctx: TraceContext | None = None
+    last_span_id: int = 0
+
+
+_tls = _Tls()
+
+# Span ids are minted process-wide (not per tracer) so exemplars and
+# flight-recorder events can reference spans even when no tracer runs.
+_span_ids = itertools.count(1)
+_batch_seq = itertools.count()
+
+
+def new_batch_id(run_id: str | None) -> str:
+    """Mint the next batch id for a run: ``<run_id>/b<seq>`` (seq is
+    process-wide, so ids stay unique across chunks and drivers)."""
+    return f"{run_id or 'run'}/b{next(_batch_seq)}"
+
+
+def current_context() -> TraceContext | None:
+    """The TraceContext active on THIS thread (None outside any unit of
+    work)."""
+    return _tls.ctx
+
+
+@contextlib.contextmanager
+def activate(ctx: TraceContext | None):
+    """Make ``ctx`` the calling thread's active context for the block.
+    ``None`` is accepted (no-op) so call sites can thread an optional
+    context without branching."""
+    prev = _tls.ctx
+    if ctx is not None:
+        _tls.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.ctx = prev
+
+
+def exemplar() -> dict | None:
+    """The histogram-exemplar payload for the current thread: the active
+    batch id plus the most recently closed span's id — "the slow p99
+    sample WAS this batch/span".  None outside any context (histograms
+    then record no exemplar)."""
+    ctx = _tls.ctx
+    if ctx is None:
+        return None
+    out = {"batch": ctx.batch_id}
+    if _tls.last_span_id:
+        out["span_id"] = _tls.last_span_id
+    return out
+
+
+class _Span:
+    __slots__ = ("_tracer", "_name", "_args", "_t0", "_ctx")
+
+    def __init__(self, tracer: "Tracer | None", name: str, args: dict):
         self._tracer = tracer
         self._name = name
         self._args = args
 
     def __enter__(self):
+        self._ctx = _tls.ctx
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        self._tracer._record(self._name, self._t0,
-                             time.perf_counter() - self._t0, self._args)
+        dur = time.perf_counter() - self._t0
+        sid = next(_span_ids)
+        _tls.last_span_id = sid
+        args = self._args
+        ctx = self._ctx
+        if ctx is not None:
+            args = dict(args, batch=ctx.batch_id, span_id=sid)
+        else:
+            args = dict(args, span_id=sid) if args else {"span_id": sid}
+        if self._tracer is not None:
+            self._tracer._record(self._name, self._t0, dur, args)
+        rec = _recorder
+        if rec is not None:
+            rec.span_event(self._name, dur * 1e3,
+                           ctx.batch_id if ctx is not None else None)
         return False
 
 
@@ -148,6 +242,19 @@ class Tracer:
 
 _active: Tracer | None = None
 
+# The crash flight recorder's span feed (obs/flightrec.py installs it
+# while armed): spans record into the per-thread event rings even when
+# no tracer is running, so a postmortem bundle has recent spans to show.
+_recorder = None
+
+
+def set_recorder(rec) -> None:
+    """Install/clear the flight-recorder span sink (None clears)."""
+    global _recorder
+    # Single-reference swap from the run-owning thread (arm/disarm);
+    # span exits read the reference once.
+    _recorder = rec  # firebird-lint: disable=ownership-global-mutation
+
 
 def active() -> Tracer | None:
     return _active
@@ -176,9 +283,10 @@ def stop() -> Tracer | None:
 
 
 def span(name: str, **args):
-    """A span against the active tracer; a shared no-op when disabled."""
+    """A span against the active tracer (and the armed flight recorder);
+    a shared no-op when both are off."""
     t = _active
-    if t is None:
+    if t is None and _recorder is None:
         return _NULL_SPAN
     return _Span(t, name, args)
 

@@ -115,9 +115,17 @@ class Watchdog:
             if not self._in_drop:
                 self._in_drop = True
                 obs_metrics.counter("watchdog_throughput_drop_total").inc()
+                # Wall-clock timestamp + the threshold that was crossed:
+                # the event is read post-hoc from /progress's degraded
+                # block and the flight-recorder bundle, where a bare
+                # monotonic offset is meaningless.  UTC with designator
+                # — the written_at/generated_at artifact convention.
                 self._drop_events.append({
+                    "at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime()),
                     "at_sec": now, "recent_per_sec": recent_rate,
-                    "baseline_per_sec": baseline})
+                    "baseline_per_sec": baseline,
+                    "threshold_per_sec": self.drop_frac * baseline})
         else:
             self._in_drop = False
 
@@ -130,18 +138,28 @@ class Watchdog:
         optional background thread — the stall counter increments exactly
         once per stall episode regardless of how often either polls."""
         now = self._clock() if now is None else now
+        declared = None
         with self._lock:
             deadline = self.stall_sec if self._beat_count \
                 else self.stall_sec * self.grace_factor
             if not self._stalled and now - self._last_beat > deadline:
                 self._stalled = True
+                declared = now - self._last_beat
                 obs_metrics.counter("watchdog_stall_total").inc()
                 from firebird_tpu.obs import logger
                 logger("change-detection").error(
                     "watchdog: no batch completed in %.1fs (deadline %.1fs%s)"
-                    " — run stalled", now - self._last_beat, deadline,
+                    " — run stalled", declared, deadline,
                     "" if self._beat_count else ", bring-up grace")
-            return self._stalled
+            stalled = self._stalled
+        if declared is not None:
+            # Flight-recorder trigger OUTSIDE the lock: the postmortem
+            # bundle reads this watchdog's own snapshot(), which takes
+            # the lock again.  Dumps the rings while every wedged
+            # thread's recent events are still in them (no-op disarmed).
+            from firebird_tpu.obs import flightrec
+            flightrec.on_stall(declared, deadline)
+        return stalled
 
     @property
     def stalled(self) -> bool:

@@ -44,11 +44,12 @@ histogram, ``serve_requests_total`` + per-endpoint counters,
 from __future__ import annotations
 
 import io
+import uuid
 
 import numpy as np
 
 from firebird_tpu import grid
-from firebird_tpu.obs import httpd, logger
+from firebird_tpu.obs import httpd, logger, tracing
 from firebird_tpu.obs import metrics as obs_metrics
 from firebird_tpu.serve.cache import LRUCache, StoreGenerations, watch_store
 from firebird_tpu.serve.flight import (AdmissionControl, DeadlineExceeded,
@@ -391,40 +392,52 @@ class _ServeHandler(httpd.JsonHandler):
     def _v1(self, svc: ServeService, path: str, query: dict) -> None:
         from firebird_tpu.serve.flight import Deadline
 
-        with obs_metrics.timer() as tm:
-            try:
-                # The deadline starts at ARRIVAL: queue wait + compute
-                # share one budget, so the documented worst case holds.
-                deadline = Deadline(svc.admission.deadline_sec)
-                with svc.admission.admit(deadline):
-                    self._dispatch(svc, path, query, deadline)
-                    status = "ok"
-            except Overload as e:
-                status = "rejected"
-                self._send_json(
-                    429, {"error": str(e)},
-                    {"Retry-After": f"{e.retry_after_sec:.0f}"})
-            except DeadlineExceeded as e:
-                status = "deadline"
-                self._send_json(504, {"error": str(e)})
-            except StoreDegraded as e:
-                status = "degraded"
-                self._send_json(
-                    503, {"error": str(e), "degraded": True},
-                    {"Retry-After": f"{e.retry_after_sec:.0f}"})
-            except StoreError as e:
-                status = "store_error"
-                self._send_json(503, {"error": str(e)})
-            except BadRequest as e:
-                status = "bad_request"
-                self._send_json(400, {"error": str(e)})
-            except NotFound as e:
-                status = "not_found"
-                self._send_json(404, {"error": str(e)})
-        obs_metrics.histogram(
-            "serve_request_seconds",
-            help="end-to-end /v1 request latency (admission wait "
-                 "included)").observe(tm.elapsed)
+        # One TraceContext per request (the drivers' per-batch contract
+        # at request granularity): every span, log line, and histogram
+        # exemplar below carries this id, and httpd._send echoes it to
+        # the client as X-Firebird-Trace — a slow call joins to its
+        # server-side trace on one key.  Requests coalesced by
+        # single-flight each keep their OWN id (the context is
+        # thread-local; only the leader's thread runs the fill).
+        ctx = tracing.TraceContext(f"req-{uuid.uuid4().hex[:12]}")
+        with tracing.activate(ctx):
+            with obs_metrics.timer() as tm:
+                try:
+                    # The deadline starts at ARRIVAL: queue wait +
+                    # compute share one budget, so the documented worst
+                    # case holds.
+                    deadline = Deadline(svc.admission.deadline_sec)
+                    with svc.admission.admit(deadline):
+                        self._dispatch(svc, path, query, deadline)
+                        status = "ok"
+                except Overload as e:
+                    status = "rejected"
+                    self._send_json(
+                        429, {"error": str(e)},
+                        {"Retry-After": f"{e.retry_after_sec:.0f}"})
+                except DeadlineExceeded as e:
+                    status = "deadline"
+                    self._send_json(504, {"error": str(e)})
+                except StoreDegraded as e:
+                    status = "degraded"
+                    self._send_json(
+                        503, {"error": str(e), "degraded": True},
+                        {"Retry-After": f"{e.retry_after_sec:.0f}"})
+                except StoreError as e:
+                    status = "store_error"
+                    self._send_json(503, {"error": str(e)})
+                except BadRequest as e:
+                    status = "bad_request"
+                    self._send_json(400, {"error": str(e)})
+                except NotFound as e:
+                    status = "not_found"
+                    self._send_json(404, {"error": str(e)})
+            # Observed INSIDE the activation: the latency histogram's
+            # exemplars carry this request's trace id.
+            obs_metrics.histogram(
+                "serve_request_seconds",
+                help="end-to-end /v1 request latency (admission wait "
+                     "included)").observe(tm.elapsed)
         obs_metrics.counter(
             "serve_requests_total", help="/v1 requests served").inc()
         if status != "ok":

@@ -68,21 +68,28 @@ class AsyncWriter:
             if item is None:
                 q.task_done()
                 return
-            table, frame = item
+            table, frame, ctx = item
             try:
                 with self._lock:
                     poisoned = self._error is not None
                 if not poisoned:
-                    with tracing.span("store_write", table=table), \
-                            obs_metrics.timer() as tm:
-                        if self.retry is not None:
-                            self.retry.run(
-                                log, f"store write to {table}",
-                                lambda: self.store.write(table, frame))
-                        else:
-                            self.store.write(table, frame)
-                    obs_metrics.histogram(
-                        "store_write_seconds").observe(tm.elapsed)
+                    # The enqueueing thread's TraceContext rides the
+                    # queue item: this write's span, exemplar, and any
+                    # log line parent to the BATCH that produced the
+                    # frame, not to an anonymous writer thread.  The
+                    # observe stays INSIDE the activation so the
+                    # histogram exemplar sees the batch id.
+                    with tracing.activate(ctx):
+                        with tracing.span("store_write", table=table), \
+                                obs_metrics.timer() as tm:
+                            if self.retry is not None:
+                                self.retry.run(
+                                    log, f"store write to {table}",
+                                    lambda: self.store.write(table, frame))
+                            else:
+                                self.store.write(table, frame)
+                        obs_metrics.histogram(
+                            "store_write_seconds").observe(tm.elapsed)
                     obs_metrics.counter(
                         "store_rows_written",
                         help="rows landed in the results store").inc(
@@ -120,13 +127,15 @@ class AsyncWriter:
                 sum(q.qsize() for q in self._qs))
 
     def write(self, table: str, frame: dict, key=None) -> None:
-        """Queue a frame.  Frames sharing ``key`` keep submission order."""
+        """Queue a frame.  Frames sharing ``key`` keep submission order.
+        The caller's TraceContext (if any) is captured with the frame and
+        re-activated around the backend write on the worker thread."""
         err = self._pop_error()
         if err is not None:
             raise err
         self._check_alive()
         i = (hash(key) if key is not None else next(self._rr)) % len(self._qs)
-        self._qs[i].put((table, frame))
+        self._qs[i].put((table, frame, tracing.current_context()))
         self._update_depth()
 
     def flush(self) -> None:

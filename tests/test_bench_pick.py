@@ -206,9 +206,13 @@ def test_probe_accelerator_structured_health_on_cpu_host(monkeypatch):
     hangs with the tunnel — the exact condition the probe guards);
     test_probe_accelerator_live is the real-probe integration rung."""
     _fake_probe_run(monkeypatch, 1, "PROBE_PLATFORM cpu\n")
-    h = probe_accelerator(timeout=5.0)
+    h = probe_accelerator(timeout=5.0, retries=0)
     assert h == {"ok": False, "rc": 1, "backend": "cpu",
-                 "reason": "cpu-only backend (no accelerator visible)"}
+                 "reason": "cpu-only backend (no accelerator visible)",
+                 "attempts": [
+                     {"ok": False, "rc": 1, "backend": "cpu",
+                      "reason": "cpu-only backend (no accelerator "
+                                "visible)"}]}
 
 
 def test_probe_accelerator_crash_reason_is_ansi_stripped(monkeypatch):
@@ -216,7 +220,7 @@ def test_probe_accelerator_crash_reason_is_ansi_stripped(monkeypatch):
     stripped — never an empty or ANSI-laden diagnosis."""
     _fake_probe_run(monkeypatch, 134, "",
                     "boot log line\n\x1b[31mSIGABRT in \\x1b[2mpjrt\n")
-    h = probe_accelerator(timeout=5.0)
+    h = probe_accelerator(timeout=5.0, retries=0)
     assert h["ok"] is False and h["rc"] == 134 and h["backend"] is None
     assert h["reason"]
     assert "\x1b" not in h["reason"] and "x1b" not in h["reason"]
@@ -227,12 +231,52 @@ def test_probe_accelerator_ok_path(monkeypatch):
     _fake_probe_run(monkeypatch, 0,
                     "PROBE_PLATFORM tpu\nPROBE_OK tpu\n")
     h = probe_accelerator(timeout=5.0)
-    assert h == {"ok": True, "rc": 0, "backend": "tpu", "reason": "ok"}
+    assert h == {"ok": True, "rc": 0, "backend": "tpu", "reason": "ok",
+                 "attempts": [{"ok": True, "rc": 0, "backend": "tpu",
+                               "reason": "ok"}]}
+
+
+def test_probe_accelerator_retries_flaky_tunnel(monkeypatch):
+    """A hung first attempt followed by a healthy one must NOT declare a
+    CPU fallback: the probe retries with backoff (injectable sleep) and
+    the attempt history records the flake — the BENCH_r05 satellite."""
+    import subprocess as sp
+
+    import bench as bench_mod
+
+    calls = {"n": 0}
+
+    def flaky(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise sp.TimeoutExpired(cmd="probe", timeout=5)
+        return sp.CompletedProcess(a, 0, "PROBE_PLATFORM tpu\nPROBE_OK tpu\n")
+
+    monkeypatch.setattr(bench_mod.subprocess, "run", flaky)
+    slept = []
+    h = probe_accelerator(timeout=5.0, retries=2, sleep=slept.append)
+    assert h["ok"] is True and h["backend"] == "tpu"
+    assert len(h["attempts"]) == 2
+    assert h["attempts"][0]["ok"] is False
+    assert "timeout" in h["attempts"][0]["reason"]
+    assert h["attempts"][1]["ok"] is True
+    assert slept and all(s > 0 for s in slept)
+
+
+def test_probe_accelerator_exhausted_retries_report_last_failure(
+        monkeypatch):
+    """Every attempt failing declares the fallback with the LAST failure
+    as the verdict and the full history in ``attempts``."""
+    _fake_probe_run(monkeypatch, 1, "PROBE_PLATFORM cpu\n")
+    h = probe_accelerator(timeout=5.0, retries=2, sleep=lambda s: None)
+    assert h["ok"] is False and h["backend"] == "cpu"
+    assert len(h["attempts"]) == 3
+    assert all(not a["ok"] for a in h["attempts"])
 
 
 @pytest.mark.slow  # real python -c child imports jax (seconds; hangs with the tunnel down until the probe timeout)
 def test_probe_accelerator_live():
-    h = probe_accelerator(timeout=240.0)
+    h = probe_accelerator(timeout=240.0, retries=0)
     assert set(h) >= {"ok", "rc", "backend", "reason"}
     assert isinstance(h["ok"], bool)
     if not h["ok"]:
@@ -252,6 +296,8 @@ def test_probe_timeout_reports_hung_tunnel(monkeypatch):
         raise sp.TimeoutExpired(cmd="probe", timeout=kw.get("timeout", 1))
 
     monkeypatch.setattr(bench_mod.subprocess, "run", hang)
-    h = bench_mod.probe_accelerator(timeout=5.0)
+    h = bench_mod.probe_accelerator(timeout=5.0, retries=0)
     assert h == {"ok": False, "rc": None, "backend": None,
-                 "reason": "timeout after 5s (tunnel hung)"}
+                 "reason": "timeout after 5s (tunnel hung)",
+                 "attempts": [{"ok": False, "rc": None, "backend": None,
+                               "reason": "timeout after 5s (tunnel hung)"}]}

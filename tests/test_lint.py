@@ -339,6 +339,96 @@ def test_metric_dynamic_name_matches_doc_wildcard(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# metrics-contract: span names (call sites vs SPAN_NAMES vs docs table)
+# ---------------------------------------------------------------------------
+
+def test_span_rules_both_directions(tmp_path):
+    root = build_repo(tmp_path, {
+        "firebird_tpu/obs/report.py": """
+            SPAN_NAMES = ("fetch", "ghost")
+            DRIVER_SPAN_NAMES = ("fetch", "rogue")
+        """,
+        "firebird_tpu/work.py": """
+            from firebird_tpu.obs import tracing
+
+            def f():
+                with tracing.span("fetch", chips=2):
+                    pass
+                with tracing.span("mystery"):
+                    pass
+        """,
+        "docs/OBSERVABILITY.md": """
+            | Span | Kind | Where |
+            |---|---|---|
+            | `fetch` | span | documented and declared |
+            | `stale_span` | span | documented but undeclared |
+        """})
+    res = run_lint(root)
+    unreg = {f.message.split("'")[1]
+             for f in by_rule(res, "span-unregistered")}
+    # the undeclared call site AND the DRIVER_SPAN_NAMES drift
+    assert unreg == {"mystery", "rogue"}
+    dead = by_rule(res, "span-dead")
+    assert len(dead) == 1 and "ghost" in dead[0].message
+    undoc = by_rule(res, "span-undocumented")
+    assert len(undoc) == 1 and "ghost" in undoc[0].message
+    stale = by_rule(res, "span-doc-stale")
+    assert len(stale) == 1 and "stale_span" in stale[0].message
+
+
+def test_span_rules_clean_and_skip_without_catalog(tmp_path):
+    # agreement in all three places -> no findings
+    root = build_repo(tmp_path, {
+        "firebird_tpu/obs/report.py": 'SPAN_NAMES = ("drain",)\n',
+        "firebird_tpu/w.py": """
+            from firebird_tpu.obs import tracing
+
+            def f():
+                with tracing.span("drain"):
+                    pass
+        """,
+        "docs/OBSERVABILITY.md": """
+            | Span | Kind | Where |
+            |---|---|---|
+            | `drain` | span | fine |
+        """})
+    res = run_lint(root)
+    assert not {r for r in rules_hit(res) if r.startswith("span-")}
+    # a repo without the SPAN_NAMES catalog does not enforce spans at
+    # all (fixture repos for other families keep linting hermetically)
+    root2 = build_repo(tmp_path / "b", {
+        "firebird_tpu/w.py": """
+            from firebird_tpu.obs import tracing
+
+            def f():
+                with tracing.span("anything"):
+                    pass
+        """})
+    res2 = run_lint(root2)
+    assert not {r for r in rules_hit(res2) if r.startswith("span-")}
+
+
+def test_span_match_span_method_without_name_is_ignored(tmp_path):
+    # re.Match.span() and friends: no literal name argument, no finding
+    root = build_repo(tmp_path, {
+        "firebird_tpu/obs/report.py": 'SPAN_NAMES = ("drain",)\n',
+        "docs/OBSERVABILITY.md": "| `drain` | span | fine |\n",
+        "firebird_tpu/w.py": """
+            import re
+            from firebird_tpu.obs import tracing
+
+            def f(m: re.Match, nm):
+                a, b = m.span()
+                with tracing.span(nm):       # non-literal: not checkable
+                    pass
+                with tracing.span("drain"):
+                    pass
+        """})
+    res = run_lint(root)
+    assert not {r for r in rules_hit(res) if r.startswith("span-")}
+
+
+# ---------------------------------------------------------------------------
 # thread-ownership
 # ---------------------------------------------------------------------------
 

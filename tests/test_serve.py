@@ -489,6 +489,27 @@ def test_http_coalesced_cold_miss(served):
     assert obs_metrics.counter("serve_product_computes").value == 1
 
 
+def test_request_trace_ids_survive_coalescing(served):
+    """Every /v1 request runs under its own TraceContext and echoes it as
+    X-Firebird-Trace — including single-flight followers, which must keep
+    their OWN ids (the context is thread-local; only the leader's thread
+    runs the fill), not inherit the leader's."""
+    svc, store, base = served
+    path = f"/v1/product/ccd?cx={CX}&cy={CY}&date={DATE}"
+    with concurrent.futures.ThreadPoolExecutor(8) as ex:
+        results = [f.result()
+                   for f in [ex.submit(_get, base, path) for _ in range(8)]]
+    assert [code for code, _, _ in results] == [200] * 8
+    assert obs_metrics.counter("serve_product_computes").value == 1
+    ids = [headers["X-Firebird-Trace"] for _, _, headers in results]
+    assert all(i.startswith("req-") for i in ids)
+    assert len(set(ids)) == 8             # coalescing never merges identities
+    # the latency histogram picked up request exemplars, not batch ids
+    snap = obs_metrics.histogram("serve_request_seconds").snapshot()
+    assert any(e["batch"].startswith("req-")
+               for e in snap.get("exemplars", ()))
+
+
 def test_http_degraded_healthz(fresh_metrics):
     svc, store = make_service(
         breaker=CircuitBreaker(1, cooldown_sec=60.0, name="serve-store"))

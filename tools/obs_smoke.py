@@ -9,8 +9,13 @@ parse, pass ``validate_trace``, and contain every pipeline span name
 (DRIVER_SPAN_NAMES, incl. the stage/d2h staging-egress spans); the
 obs_report.json must pass ``validate_report`` and carry every
 DRIVER_STAGE_HISTOGRAMS stage key; and the live ``/progress`` chip
-totals must agree with the final report.  Exits non-zero on any
-violation — the CI-greppable proof that the telemetry layer still wires
+totals must agree with the final report.  The deep-dive layer rides the
+same run: one ``POST /profile?seconds=N`` window is captured mid-run and
+must leave a device-trace artifact + per-phase attribution in the
+report's ``profile`` block (zeros allowed on the CPU backend, structure
+always present), ``/slo`` must answer live, and the report's ``slo``
+block must have evaluated the batch objective against real data.  Exits
+non-zero on any violation — the CI-greppable proof that the telemetry layer still wires
 through every pipeline stage and that the live ops surface serves during
 a real run.
 """
@@ -51,6 +56,17 @@ def _get(base: str, path: str, timeout: float = 2.0):
         return None, b""
 
 
+def _post(base: str, path: str, timeout: float = 2.0):
+    try:
+        req = urllib.request.Request(base + path, data=b"", method="POST")
+        r = urllib.request.urlopen(req, timeout=timeout)
+        return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+    except Exception:
+        return None, b""
+
+
 def main() -> int:
     from firebird_tpu.config import Config
     from firebird_tpu.driver import core
@@ -82,13 +98,22 @@ def main() -> int:
         driver.start()
 
         # Poll the live surface while the run is in flight; keep the last
-        # good sample of each endpoint.
+        # good sample of each endpoint.  As soon as the endpoint answers,
+        # fire ONE windowed device-profile capture (POST /profile) so the
+        # final report must carry its attribution — the on-demand
+        # profiling acceptance path.
         live: dict = {}
+        posted: dict = {}
         while driver.is_alive():
-            for p in ("/healthz", "/readyz", "/metrics", "/progress"):
+            for p in ("/healthz", "/readyz", "/metrics", "/progress",
+                      "/slo"):
                 code, body = _get(base, p)
                 if code is not None:
                     live[p] = (code, body)
+            if "started" not in posted and "/healthz" in live:
+                code, body = _post(base, "/profile?seconds=0.2")
+                if code == 202:
+                    posted["started"] = json.loads(body)
             time.sleep(0.05)
         driver.join()
 
@@ -126,6 +151,48 @@ def main() -> int:
             print(f"obs-smoke: {e}", file=sys.stderr)
             return 1
 
+        # --- deep-dive layer: POST /profile + /slo + report blocks ---
+        if "started" not in posted:
+            print("obs-smoke: POST /profile never got a 202 during the run",
+                  file=sys.stderr)
+            return 1
+        prof = rep.get("profile")
+        if not prof or not prof.get("windows"):
+            print(f"obs-smoke: report profile block has no windows: {prof}",
+                  file=sys.stderr)
+            return 1
+        from firebird_tpu.obs.profiling import PHASES
+        dt = prof.get("device_time") or {}
+        missing = [f"{p}_ms" for p in PHASES if f"{p}_ms" not in dt]
+        if missing or "total_ms" not in dt:
+            print(f"obs-smoke: device_time attribution incomplete "
+                  f"(missing {missing}): {dt}", file=sys.stderr)
+            return 1
+        win = prof["windows"][0]
+        if "error" in win or not os.path.isdir(win["dir"]) \
+                or win.get("trace_files", 0) < 1:
+            print(f"obs-smoke: profile window left no device-trace "
+                  f"artifact: {win}", file=sys.stderr)
+            return 1
+        if "/slo" not in live or live["/slo"][0] != 200:
+            print(f"obs-smoke: /slo never answered 200 "
+                  f"({live.get('/slo', ('never', b''))[0]})",
+                  file=sys.stderr)
+            return 1
+        slo_rep = rep.get("slo")
+        if not slo_rep or "objectives" not in slo_rep:
+            print(f"obs-smoke: report slo block malformed: {slo_rep}",
+                  file=sys.stderr)
+            return 1
+        # The driver drained batches, so the batch objective must have
+        # evaluated against real data (ok True/False, not no-data null).
+        batch = [o for o in slo_rep["objectives"]
+                 if o["name"] == "batch_p95"]
+        if not batch or batch[0]["ok"] is None:
+            print(f"obs-smoke: batch_p95 objective never evaluated: "
+                  f"{slo_rep['objectives']}", file=sys.stderr)
+            return 1
+
         # The live surface and the final artifact must tell one story:
         # same run, same chip totals.
         prog = json.loads(live["/progress"][1])
@@ -147,7 +214,10 @@ def main() -> int:
               f"{len(rep['metrics']['histograms'])} stage histograms, "
               f"counters {rep['metrics']['counters']}, "
               f"live progress {prog['chips_done']}/{prog['chips_total']} "
-              f"chips at stage {prog['stage']!r}")
+              f"chips at stage {prog['stage']!r}, "
+              f"profile window {win['trace_files']} trace files "
+              f"({dt['total_ms']:.1f} device-ms attributed), "
+              f"slo ok={slo_rep['ok']}")
     return 0
 
 

@@ -1,0 +1,226 @@
+"""Postmortem smoke (``make postmortem-smoke``): a killed run explains
+itself.
+
+The end-to-end proof behind the crash flight recorder
+(firebird_tpu/obs/flightrec.py).  Three runs over the same synthetic
+tile:
+
+clean
+    No interference — the reference store.
+victim
+    The same tile in a SUBPROCESS, SIGTERM'd mid-batch (as soon as the
+    first batch's rows land while later batches are still in flight —
+    exactly what a preempted soak or an impatient supervisor does).
+    Asserts the process died with real SIGTERM semantics AND left a
+    parseable ``postmortem.json`` next to the store: schema, reason
+    ``sigterm``, run id + config fingerprint, per-thread event rings
+    with real events in them, and the run's progress/degraded state
+    (breaker + quarantine + watchdog throughput-drop events).
+resume
+    ``--resume`` against the victim store: asserts the run completes and
+    the final store is **row-for-row identical** to the clean run — a
+    SIGTERM costs a rerun of in-flight work, never results.
+
+Writes ``postmortem_smoke.json`` under FIREBIRD_POSTMORTEM_DIR (folded
+into bench artifacts by bench.py) and exits non-zero on any violation.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, HERE)
+
+from firebird_tpu.config import env_knob  # noqa: E402
+
+ACQ = "1995-01-01/1996-06-01"
+N_CHIPS = 4
+CHUNK = 2
+KILL_WAIT_SEC = 600.0     # first-batch wait: covers a cold XLA compile
+
+
+def _cfg(store_path: str):
+    from firebird_tpu.config import Config
+
+    return Config(store_backend="sqlite", store_path=store_path,
+                  source_backend="synthetic", chips_per_batch=1,
+                  device_sharding="off", dtype="float64", fetch_retries=0,
+                  stall_sec=120.0)
+
+
+def _src():
+    from firebird_tpu.ingest import SyntheticSource
+
+    return SyntheticSource(seed=9, start="1995-01-01", end="1998-01-01",
+                           cloud_frac=0.1)
+
+
+def _run(store_path: str, resume: bool = False):
+    from firebird_tpu.driver import core
+
+    return core.changedetection(x=100, y=200, acquired=ACQ, number=N_CHIPS,
+                                chunk_size=CHUNK, cfg=_cfg(store_path),
+                                source=_src(), resume=resume)
+
+
+def _segment_rows(store_path: str, keyspace: str) -> int:
+    """Committed segment-row count, read from a throwaway connection (0
+    when the store doesn't exist yet)."""
+    try:
+        from firebird_tpu.store import SqliteStore
+
+        return len(SqliteStore(store_path, keyspace).read("segment")["px"])
+    except Exception:
+        return 0
+
+
+def _victim_main(store_path: str) -> int:
+    """Child mode: run the tile and exit — the parent kills us."""
+    _run(store_path)
+    return 0
+
+
+def main() -> int:
+    from firebird_tpu.store import SqliteStore
+    from tools.chaos_soak import store_rows
+
+    with tempfile.TemporaryDirectory(prefix="fb_postmortem_") as tmp:
+        # ---- clean reference run --------------------------------------
+        clean_path = os.path.join(tmp, "clean", "pm.db")
+        os.makedirs(os.path.dirname(clean_path), exist_ok=True)
+        done = _run(clean_path)
+        if len(done) != N_CHIPS:
+            print(f"postmortem-smoke: clean run processed "
+                  f"{len(done)}/{N_CHIPS}", file=sys.stderr)
+            return 1
+        cfg = _cfg(clean_path)
+        clean = store_rows(SqliteStore(clean_path, cfg.keyspace()))
+
+        # ---- victim: SIGTERM mid-batch --------------------------------
+        victim_path = os.path.join(tmp, "victim", "pm.db")
+        os.makedirs(os.path.dirname(victim_path), exist_ok=True)
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--victim",
+             victim_path],
+            cwd=HERE, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        t0 = time.monotonic()
+        keyspace = _cfg(victim_path).keyspace()
+        while time.monotonic() - t0 < KILL_WAIT_SEC:
+            if child.poll() is not None:
+                print("postmortem-smoke: victim finished before the first "
+                      "batch could be observed — nothing was mid-batch to "
+                      f"kill (rc={child.returncode})", file=sys.stderr)
+                return 1
+            if _segment_rows(victim_path, keyspace) > 0:
+                break                      # first batch landed, more in flight
+            time.sleep(0.25)
+        else:
+            child.kill()
+            print(f"postmortem-smoke: victim produced no rows within "
+                  f"{KILL_WAIT_SEC:.0f}s", file=sys.stderr)
+            return 1
+        child.send_signal(signal.SIGTERM)
+        try:
+            rc = child.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            print("postmortem-smoke: victim ignored SIGTERM for 60s "
+                  "(flight-recorder dump wedged?)", file=sys.stderr)
+            return 1
+        if rc != -signal.SIGTERM and rc != 128 + signal.SIGTERM:
+            print(f"postmortem-smoke: victim exited rc={rc}, expected real "
+                  "SIGTERM death (the handler must re-raise, not swallow)",
+                  file=sys.stderr)
+            return 1
+
+        # ---- the bundle -----------------------------------------------
+        pm_path = os.path.join(os.path.dirname(victim_path),
+                               "postmortem.json")
+        if not os.path.exists(pm_path):
+            print(f"postmortem-smoke: no {pm_path} after SIGTERM",
+                  file=sys.stderr)
+            return 1
+        with open(pm_path) as f:
+            pm = json.load(f)
+        errs = []
+        if pm.get("schema") != "firebird-postmortem/1":
+            errs.append(f"schema {pm.get('schema')!r}")
+        if "sigterm" not in pm.get("reasons", []):
+            errs.append(f"reasons {pm.get('reasons')} lack 'sigterm'")
+        if not pm.get("run_id"):
+            errs.append("empty run_id")
+        if not pm.get("config_fingerprint"):
+            errs.append("empty config_fingerprint")
+        threads = pm.get("threads") or {}
+        rings = {name: ring for name, ring in threads.items() if ring}
+        if not rings:
+            errs.append(f"no per-thread event rings ({sorted(threads)})")
+        if not any(ev.get("kind") == "span"
+                   for ring in rings.values() for ev in ring):
+            errs.append("no span events in any ring")
+        if not any(ev.get("kind") == "mark"
+                   for ring in rings.values() for ev in ring):
+            errs.append("no progress marks in any ring")
+        prog = pm.get("progress") or {}
+        deg = prog.get("degraded")
+        if not isinstance(deg, dict) or "breaker" not in deg \
+                or "chips_quarantined" not in deg \
+                or "throughput_drops" not in deg:
+            errs.append(f"progress.degraded incomplete: {deg}")
+        if pm.get("metrics") is None:
+            errs.append("no metrics snapshot")
+        if errs:
+            print(f"postmortem-smoke: bundle invalid: {'; '.join(errs)}",
+                  file=sys.stderr)
+            return 1
+
+        # ---- resume: row-identical recovery ---------------------------
+        done = _run(victim_path, resume=True)
+        if len(done) != N_CHIPS:
+            print(f"postmortem-smoke: resume completed "
+                  f"{len(done)}/{N_CHIPS}", file=sys.stderr)
+            return 1
+        resumed = store_rows(SqliteStore(victim_path, keyspace))
+        for table in ("chip", "pixel", "segment"):
+            if clean[table] != resumed[table]:
+                print(f"postmortem-smoke: {table} rows differ after resume "
+                      f"(clean {len(clean[table])} vs "
+                      f"{len(resumed[table])})", file=sys.stderr)
+                return 1
+
+        report = {
+            "schema": "firebird-postmortem-smoke/1",
+            "chips": N_CHIPS,
+            "victim_rc": rc,
+            "reasons": pm["reasons"],
+            "threads_with_events": sorted(rings),
+            "events_total": sum(len(r) for r in rings.values()),
+            "breaker": deg.get("breaker"),
+            "chips_quarantined": deg.get("chips_quarantined"),
+            "rows": {t: len(clean[t]) for t in clean},
+            "store_identical_after_resume": True,
+        }
+        art_dir = env_knob("FIREBIRD_POSTMORTEM_DIR")
+        os.makedirs(art_dir, exist_ok=True)
+        art = os.path.join(art_dir, "postmortem_smoke.json")
+        with open(art, "w") as f:
+            json.dump(report, f, indent=1)
+        print("postmortem-smoke OK: victim died rc="
+              f"{rc} leaving {report['events_total']} ring events across "
+              f"{len(rings)} threads, breaker={report['breaker']!r}, "
+              f"store identical after resume "
+              f"({sum(report['rows'].values())} rows); artifact {art}")
+    return 0
+
+
+if __name__ == "__main__":
+    if "--victim" in sys.argv:
+        sys.exit(_victim_main(sys.argv[sys.argv.index("--victim") + 1]))
+    sys.exit(main())
