@@ -10,8 +10,9 @@ Y ?= 1650000
 ACQUIRED ?= 1982-01-01/2017-12-31
 
 .PHONY: install lint test bench obs-smoke pipeline-smoke chaos-smoke \
-        serve-smoke compact-smoke postmortem-smoke image db-up db-schema \
-        db-test db-down changedetection classification clean
+        fleet-smoke serve-smoke compact-smoke postmortem-smoke image \
+        db-up db-schema db-test db-down changedetection classification \
+        clean
 
 install:
 	pip install -e . --no-build-isolation
@@ -55,6 +56,15 @@ pipeline-smoke:
 # the final store is row-for-row identical to a clean run.
 chaos-smoke:
 	python tools/chaos_soak.py
+
+# Fleet-queue chaos check (docs/ROBUSTNESS.md "Fleet scheduling"): a
+# multi-tile plan drained by worker subprocesses with one SIGKILLed
+# mid-lease and one heartbeat-partitioned (lease:p=1 zombie) — asserts
+# the survivors drain every job, the zombie's stale-fence writes are
+# rejected (counter nonzero, zero accepted), and the merged store is
+# row-identical to a clean single-worker run.
+fleet-smoke:
+	python tools/fleet_chaos.py
 
 # Serving-layer check (docs/SERVING.md): tiny synthetic run into a
 # sqlite store, then the query API on an ephemeral port — every endpoint

@@ -226,6 +226,14 @@ def _lint_fold() -> dict:
                           "lint_report.json")
 
 
+def _fleet_fold() -> dict:
+    """`make fleet-smoke` evidence (tools/fleet_chaos.py): the queue's
+    kill/partition drill — jobs drained, stale-fence rejections, and the
+    merged-store row-identity verdict."""
+    return _artifact_fold("fleet_chaos", "FIREBIRD_FLEET_DIR",
+                          "fleet_chaos.json")
+
+
 def _postmortem_fold() -> dict:
     """`make postmortem-smoke` evidence (tools/postmortem_smoke.py): the
     flight recorder's SIGTERM'd-run bundle validity + row-identical
@@ -694,6 +702,9 @@ def measure(cpu_only: bool) -> None:
             # Last chaos-smoke evidence (faults absorbed, store equality
             # after resume) when a run left its artifact on this host.
             **_chaos_fold(),
+            # Last fleet-smoke evidence (SIGKILL/partition drill: queue
+            # drained, zero stale-fence writes accepted) when one ran.
+            **_fleet_fold(),
             # Last serve-loadtest evidence (read-path RPS/latency/hit
             # rate) when the serving layer was exercised on this host.
             **_serve_fold(),

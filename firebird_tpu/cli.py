@@ -418,6 +418,39 @@ def status(x, y):
                              "errors": dict(sorted(errors.items()))}
     else:
         out["quarantine"] = {"path": qpath, "chips": 0, "errors": {}}
+    # Fleet view (docs/ROBUSTNESS.md "Fleet scheduling"): when a fleet
+    # queue sits next to this store, surface its depth by job type and
+    # state, the active leases (age + holder host), and the dead-letter
+    # ledger — the "how is my FLEET doing" half of this command.
+    try:
+        from firebird_tpu.fleet import FleetQueue, queue_path
+
+        fpath = queue_path(cfg)
+    except ValueError:
+        fpath = None            # memory backend without FIREBIRD_FLEET_DB
+    if fpath is not None and _os.path.exists(fpath):
+        # Guarded like /progress's fleet block: a corrupt/locked/
+        # read-only queue db must degrade THIS diagnostic command's
+        # fleet section, not crash the store/quarantine output above.
+        try:
+            fq = FleetQueue(fpath, lease_sec=cfg.fleet_lease_sec)
+            try:
+                s = fq.status()
+            finally:
+                fq.close()
+            out["fleet"] = {
+                "path": fpath,
+                "jobs": s["jobs"],
+                "by_type": s["by_type"],
+                "blocked": s["blocked"],
+                "leases": s["leases"],
+                "dead": len(s["dead"]),
+                "dead_errors": s["dead_errors"],
+                "fence_rejects": s["fence_rejects"],
+            }
+        except Exception as e:
+            out["fleet"] = {"path": fpath,
+                            "error": f"{type(e).__name__}: {e}"}
     if x is not None:
         tile = grid.tile(x, y)
         cids = [tuple(int(v) for v in c) for c in grid.chips(tile)]
@@ -427,6 +460,132 @@ def status(x, y):
             "chips_total": len(cids),
         }
     click.echo(_json.dumps(out, indent=1))
+
+
+@entrypoint.group()
+def fleet():
+    """Crash-tolerant multi-host work queue (docs/ROBUSTNESS.md "Fleet
+    scheduling"): enqueue a tile plan once, run `firebird fleet work` on
+    N hosts, and the lease/heartbeat/fence protocol makes worker death,
+    zombies, and partitions boring."""
+
+
+@fleet.command("enqueue")
+@click.option("--tile", "-t", "tiles", multiple=True, required=True,
+              help="x,y projection point inside a tile; repeat for a "
+                   "multi-tile plan (any point inside the tile works — "
+                   "`firebird tiles` emits candidates)")
+@click.option("--acquired", "-a", required=False, default=None)
+@click.option("--number", "-n", required=False, default=2500, type=int,
+              help="chips per tile (testing)")
+@click.option("--chunk-size", "-c", required=False, default=500, type=int,
+              help="chips per detect job — the re-delivery granularity: "
+                   "a dead worker forfeits at most one chunk")
+@click.option("--msday", "-s", required=False, default=None, type=int,
+              help="with --meday: also enqueue a classify job per tile, "
+                   "blocked on that tile's detection")
+@click.option("--meday", "-e", required=False, default=None, type=int)
+@click.option("--products", "-p", "product_names", multiple=True,
+              help="with --product-dates: enqueue product jobs per tile, "
+                   "blocked on the latest upstream stage")
+@click.option("--product-dates", "-d", multiple=True)
+@click.option("--max-attempts", required=False, default=None, type=int,
+              help="per-job attempt budget before dead-lettering; "
+                   "overrides FIREBIRD_FLEET_MAX_ATTEMPTS")
+def fleet_enqueue(tiles, acquired, number, chunk_size, msday, meday,
+                  product_names, product_dates, max_attempts):
+    """Enqueue a dependency-ordered multi-tile plan on the shared queue."""
+    import json as _json
+
+    from firebird_tpu.config import Config
+    from firebird_tpu.fleet import enqueue_tile_plan, make_queue
+
+    cfg = Config.from_env()
+    queue = make_queue(cfg)
+    try:
+        summary = enqueue_tile_plan(
+            queue, _parse_bounds(tiles),
+            acquired=acquired or dates.default_acquired(), number=number,
+            chunk_size=chunk_size, msday=msday, meday=meday,
+            products=product_names, product_dates=product_dates,
+            max_attempts=max_attempts or cfg.fleet_max_attempts)
+        click.echo(_json.dumps({"queue": queue.path, **summary}, indent=1))
+    finally:
+        queue.close()
+
+
+@fleet.command("work")
+@click.option("--max-jobs", required=False, default=None, type=int,
+              help="exit after this many executed jobs")
+@click.option("--until-drained", is_flag=True, default=False,
+              help="poll until every job is done or dead (default: exit "
+                   "when nothing is claimable)")
+@click.option("--poll", required=False, default=1.0, type=float,
+              help="idle claim-poll interval, seconds")
+@click.option("--ops-port", default=None, type=int,
+              help="live ops endpoints for this worker (adds a `fleet` "
+                   "block to /progress); overrides FIREBIRD_OPS_PORT")
+def fleet_work(max_jobs, until_drained, poll, ops_port):
+    """Run one fleet worker against the shared queue until it drains."""
+    import json as _json
+
+    from firebird_tpu.config import Config
+    from firebird_tpu.driver import core
+    from firebird_tpu.fleet import FleetWorker, make_queue
+
+    apply_platform()
+    overrides = {"ops_port": ops_port} if ops_port is not None else {}
+    cfg = Config.from_env(**overrides)
+    core.setup_compile_cache(cfg)
+    queue = make_queue(cfg)
+    worker = FleetWorker(cfg, queue, poll_sec=poll)
+    _, srv, wd = worker.start_ops()
+    try:
+        summary = worker.run(max_jobs=max_jobs,
+                             until_drained=until_drained)
+    finally:
+        core.stop_ops(srv, wd)
+        queue.close()
+    click.echo(_json.dumps(summary, indent=1))
+    if summary.get("wedged"):
+        raise SystemExit(4)
+
+
+@fleet.command("status")
+def fleet_status():
+    """Inspect the shared queue: depth by job type/state, active leases
+    with age and holder, dead letters with error classes, and the
+    stale-fence rejection tally."""
+    import json as _json
+
+    from firebird_tpu.config import Config
+    from firebird_tpu.fleet import make_queue
+
+    queue = make_queue(Config.from_env())
+    try:
+        click.echo(_json.dumps(queue.status(), indent=1))
+    finally:
+        queue.close()
+
+
+@fleet.command("requeue")
+@click.argument("job_id", required=False, default=None, type=int)
+@click.option("--dead", is_flag=True, default=False,
+              help="requeue EVERY dead-lettered job")
+def fleet_requeue(job_id, dead):
+    """Return dead-lettered jobs to the queue with a fresh attempt
+    budget (one JOB_ID, or all of them with --dead)."""
+    from firebird_tpu.config import Config
+    from firebird_tpu.fleet import make_queue
+
+    if (job_id is None) == (not dead):
+        raise click.BadParameter("pass a JOB_ID or --dead (not both)")
+    queue = make_queue(Config.from_env())
+    try:
+        n = queue.requeue(job_id)
+    finally:
+        queue.close()
+    click.echo(f"{n} job(s) requeued")
 
 
 @entrypoint.command(context_settings=dict(

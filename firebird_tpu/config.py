@@ -130,6 +130,18 @@ KNOBS = (
          help="SLO spec name=target;... (empty = defaults, 0 disables)"),
     Knob(name="FIREBIRD_FLIGHTREC", field="flightrec", default="128",
          help="crash flight-recorder ring size per thread (0 off)"),
+    # ---- fleet work queue (Config-backed; docs/ROBUSTNESS.md) ----
+    Knob(name="FIREBIRD_FLEET_DB", field="fleet_db",
+         help="fleet job-queue sqlite path (default: fleet.db next to "
+              "the store)"),
+    Knob(name="FIREBIRD_FLEET_LEASE_SEC", field="fleet_lease_sec",
+         help="job lease length (seconds) before a silent worker's job "
+              "re-delivers"),
+    Knob(name="FIREBIRD_FLEET_HEARTBEAT_SEC", field="fleet_heartbeat_sec",
+         help="worker heartbeat cadence (seconds; 0 = lease/4)"),
+    Knob(name="FIREBIRD_FLEET_MAX_ATTEMPTS", field="fleet_max_attempts",
+         help="job attempts (failures or expired leases) before "
+              "dead-lettering"),
     # ---- serving layer (Config-backed) ----
     Knob(name="FIREBIRD_SERVE_PORT", field="serve_port",
          help="firebird serve listen port"),
@@ -201,6 +213,8 @@ KNOBS = (
          help="serve-loadtest artifact directory"),
     Knob(name="FIREBIRD_POSTMORTEM_DIR", default="/tmp/fb_postmortem",
          help="postmortem-smoke artifact directory"),
+    Knob(name="FIREBIRD_FLEET_DIR", default="/tmp/fb_fleet",
+         help="fleet-chaos artifact directory"),
     Knob(name="FIREBIRD_LINT_DIR", default="/tmp/fb_lint",
          readers=("Makefile",), internal=True,
          help="lint-report artifact directory (make lint)"),
@@ -384,6 +398,25 @@ class Config:
     # (driver.core.warm_start).
     compile_cache: str = ""
 
+    # ---- fleet work queue (firebird_tpu.fleet; docs/ROBUSTNESS.md) ----
+    # Queue database path (FIREBIRD_FLEET_DB); "" derives fleet.db next
+    # to the results store (the quarantine.json placement rule).
+    fleet_db: str = ""
+
+    # Lease length: a job whose worker goes silent this long re-delivers
+    # to the next claimer.  Shorter leases re-deliver crashed work
+    # faster but tolerate less heartbeat jitter before a healthy worker
+    # reads as dead.
+    fleet_lease_sec: float = 30.0
+
+    # Heartbeat cadence; 0 (default) derives lease/4 — three missable
+    # beats of margin before the lease expires.
+    fleet_heartbeat_sec: float = 0.0
+
+    # Attempts (failures or expired leases) a job gets before it
+    # dead-letters instead of crash-looping the fleet.
+    fleet_max_attempts: int = 3
+
     # ---- serving layer (firebird_tpu.serve; docs/SERVING.md) ----
     # `firebird serve` port (FIREBIRD_SERVE_PORT).  Unlike ops_port this
     # is only read by the serve command — nothing auto-binds it.
@@ -467,6 +500,22 @@ class Config:
             from firebird_tpu.obs import slo as _slo
 
             _slo.parse_spec(self.slo)
+        if self.fleet_lease_sec <= 0:
+            raise ValueError("FIREBIRD_FLEET_LEASE_SEC must be > 0 "
+                             f"seconds, got {self.fleet_lease_sec}")
+        if self.fleet_heartbeat_sec < 0:
+            raise ValueError("FIREBIRD_FLEET_HEARTBEAT_SEC must be >= 0 "
+                             "(0 = lease/4), got "
+                             f"{self.fleet_heartbeat_sec}")
+        if 0 < self.fleet_lease_sec <= self.fleet_heartbeat_sec:
+            raise ValueError(
+                "FIREBIRD_FLEET_HEARTBEAT_SEC must be shorter than the "
+                f"lease ({self.fleet_lease_sec}s), got "
+                f"{self.fleet_heartbeat_sec} — a worker that beats "
+                "slower than its lease expires is always a zombie")
+        if self.fleet_max_attempts < 1:
+            raise ValueError("FIREBIRD_FLEET_MAX_ATTEMPTS must be >= 1, "
+                             f"got {self.fleet_max_attempts}")
         if not 0 < self.serve_port <= 65535:
             raise ValueError("FIREBIRD_SERVE_PORT must be a valid TCP "
                              f"port, got {self.serve_port}")
@@ -537,6 +586,13 @@ class Config:
             pipeline_depth=int(e.get("FIREBIRD_PIPELINE_DEPTH",
                                      cls.pipeline_depth)),
             compile_cache=e.get("FIREBIRD_COMPILE_CACHE", cls.compile_cache),
+            fleet_db=e.get("FIREBIRD_FLEET_DB", cls.fleet_db),
+            fleet_lease_sec=float(e.get("FIREBIRD_FLEET_LEASE_SEC",
+                                        cls.fleet_lease_sec)),
+            fleet_heartbeat_sec=float(e.get("FIREBIRD_FLEET_HEARTBEAT_SEC",
+                                            cls.fleet_heartbeat_sec)),
+            fleet_max_attempts=int(e.get("FIREBIRD_FLEET_MAX_ATTEMPTS",
+                                         cls.fleet_max_attempts)),
             serve_port=int(e.get("FIREBIRD_SERVE_PORT", cls.serve_port)),
             serve_host=e.get("FIREBIRD_SERVE_HOST", cls.serve_host),
             serve_cache_entries=int(e.get("FIREBIRD_SERVE_CACHE_ENTRIES",

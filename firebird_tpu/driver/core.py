@@ -137,7 +137,8 @@ def record_topology_metrics() -> None:
 
 
 def start_ops(cfg: Config, run_id: str, kind: str, *, chips_total: int,
-              counters, run_block: dict, quarantine=None, breaker=None):
+              counters, run_block: dict, quarantine=None, breaker=None,
+              fleet=None):
     """Bring up the run's live ops surface (shared by both drivers).
 
     Registers the run context for JSON logs, clears stale report shards
@@ -182,7 +183,8 @@ def start_ops(cfg: Config, run_id: str, kind: str, *, chips_total: int,
             run_id, kind, chips_total=chips_total, counters=counters,
             watchdog=watchdog, run=run_block, mesh_up=_mesh_ready(),
             pipeline_depth=cfg.pipeline_depth, quarantine=quarantine,
-            breaker=breaker, profiler=profiler, slo_spec=cfg.slo))
+            breaker=breaker, profiler=profiler, slo_spec=cfg.slo,
+            fleet=fleet))
         if cfg.ops_port > 0:
             server = obs_server.start_ops_server(cfg.ops_port, status,
                                                  host=cfg.ops_host)
@@ -965,6 +967,13 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log,
         drains: list[cf.Future] = []
         processed: list = []
         for i in range(len(batches)):
+            # Fence-loss fast abort (fleet jobs): a NonRetryable error
+            # pending in the writer means every further write will
+            # reject — stop paying for batches whose output cannot land
+            # instead of discovering it at the final flush.
+            err = getattr(writer, "peek_error", lambda: None)()
+            if isinstance(err, retrylib.NonRetryable):
+                raise err
             obs_server.set_stage("fetch")
             prep = nxt.result()
             nxt = (prefetch_ex.submit(prepare_batch, batches[i + 1],
@@ -1006,6 +1015,54 @@ def detect_chunk(cids, *, source, writer, acquired, cfg, counters, log,
         for f in drains:
             f.result()
     return processed
+
+
+def run_chunk(chunk, *, source, writer, acquired, cfg, counters, log,
+              policy=None, quarantine=None, reraise=False):
+    """One chunk end-to-end — detect, flush, redeem dead letters — with
+    the chunk-level failure backstop.  THE unit of fleet work: the batch
+    driver's per-chunk loop body and a fleet ``detect`` job
+    (fleet/worker.py) are this same function, so quarantine semantics
+    cannot drift between single-process and fleet execution.
+
+    ``reraise=False`` (the driver loop) swallows the chunk failure after
+    dead-lettering its chips (core.py:115-124 semantics — later chunks
+    continue); ``reraise=True`` (a fleet job) re-raises so the queue's
+    attempt accounting sees the failure.  A ``NonRetryable`` error
+    (fencing rejection) always propagates WITHOUT dead-lettering: the
+    job's chips are a successor's responsibility, not owed work.
+    Returns the chip ids processed ([] on a swallowed failure)."""
+    try:
+        processed = detect_chunk(
+            chunk, source=source, writer=writer, acquired=acquired,
+            cfg=cfg, counters=counters, log=log, policy=policy,
+            quarantine=quarantine)
+        obs_server.set_stage("flush")
+        writer.flush()  # a chunk counts once its rows landed
+        if quarantine is not None:
+            quarantine.discard_many(processed)  # redeemed letters
+        return processed
+    except retrylib.NonRetryable:
+        raise
+    except Exception as e:
+        # Chunk-level failure isolation (core.py:115-124) is the
+        # BACKSTOP behind per-chip quarantine (ingest failures never
+        # reach here anymore): a kernel or store error still fails the
+        # chunk, but its chips are dead-lettered so `--resume` (or a
+        # re-delivered fleet job) knows exactly what is owed instead of
+        # rediscovering it by store diff.
+        obs_metrics.counter("chunk_failures").inc()
+        log.error("chunk failed (%d chips): %s", len(chunk), e)
+        if quarantine is not None:
+            held = quarantine.chip_ids()
+            quarantine.record_many(
+                [c for c in chunk
+                 if tuple(int(v) for v in c) not in held],
+                e, attempts=1, stage="chunk")
+        if reraise:
+            raise
+        traceback.print_exc()
+        return []
 
 
 def changedetection(x, y, acquired: str | None = None, number: int = 2500,
@@ -1120,30 +1177,10 @@ def changedetection(x, y, acquired: str | None = None, number: int = 2500,
     try:
         with prof:
             for chunk in chunks:
-                try:
-                    processed = detect_chunk(
-                        chunk, source=source, writer=writer,
-                        acquired=acquired, cfg=cfg, counters=counters,
-                        log=log, policy=policy, quarantine=quarantine)
-                    obs_server.set_stage("flush")
-                    writer.flush()  # a chunk counts once its rows landed
-                    done.extend(processed)
-                    quarantine.discard_many(processed)  # redeemed letters
-                except Exception as e:
-                    # Chunk-level failure isolation (core.py:115-124) is
-                    # now the BACKSTOP behind per-chip quarantine (ingest
-                    # failures never reach here anymore): a kernel or
-                    # store error still fails the chunk, but its chips are
-                    # dead-lettered so `--resume` knows exactly what is
-                    # owed instead of rediscovering it by store diff.
-                    obs_metrics.counter("chunk_failures").inc()
-                    log.error("chunk failed (%d chips): %s", len(chunk), e)
-                    traceback.print_exc()
-                    held = quarantine.chip_ids()
-                    quarantine.record_many(
-                        [c for c in chunk
-                         if tuple(int(v) for v in c) not in held],
-                        e, attempts=1, stage="chunk")
+                done.extend(run_chunk(
+                    chunk, source=source, writer=writer,
+                    acquired=acquired, cfg=cfg, counters=counters,
+                    log=log, policy=policy, quarantine=quarantine))
     finally:
         obs_server.set_stage("finalize")
         writer.close()

@@ -72,6 +72,25 @@ def _now_iso() -> str:
         datetime.timezone.utc).isoformat(timespec="seconds")
 
 
+def atomic_write_json(path: str, doc: dict) -> None:
+    """Crash-atomic JSON write: temp file -> flush -> fsync ->
+    ``os.replace``.  A SIGKILL (or power cut, with the fsync) at ANY
+    instant leaves either the old file or the new one — never a torn
+    half-document that would block ``--resume`` behind a JSON parse
+    error.  The temp name carries the pid so concurrent fleet workers
+    sharing one artifact directory cannot stomp each other's temp file
+    mid-rename."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 class Quarantine:
     """The dead-letter manifest: chip id -> error class + attempt history.
 
@@ -113,8 +132,9 @@ class Quarantine:
         """Dead-letter one chip.  Repeated failures of the same chip
         (across runs or chunks) append to its attempt history rather
         than overwriting it — the manifest shows the whole story."""
+        key = _key(cid)
         with self._lock:
-            e = self._entries.setdefault(_key(cid), {
+            e = self._entries.setdefault(key, {
                 "cx": int(cid[0]), "cy": int(cid[1]), "history": []})
             e["error"] = type(error).__name__
             e["message"] = str(error)[:_MSG_LIMIT]
@@ -122,7 +142,9 @@ class Quarantine:
             e["history"].append({
                 "at": _now_iso(), "run_id": self.run_id,
                 "error": type(error).__name__, "attempts": int(attempts)})
-            self._save_locked()
+            entry = dict(e)
+            self._mutate_disk_locked(
+                lambda chips: chips.__setitem__(key, entry))
         obs_metrics.counter(
             "chips_quarantined",
             help="chips dead-lettered to quarantine.json").inc()
@@ -134,20 +156,23 @@ class Quarantine:
 
     def discard(self, cid) -> bool:
         """Remove a chip that has since landed; True when it was held."""
+        key = _key(cid)
         with self._lock:
-            held = self._entries.pop(_key(cid), None) is not None
+            held = self._entries.pop(key, None) is not None
             if held:
-                self._save_locked()
+                self._mutate_disk_locked(
+                    lambda chips: chips.pop(key, None))
         return held
 
     def discard_many(self, cids) -> int:
-        n = 0
+        keys = [_key(cid) for cid in cids]
         with self._lock:
-            for cid in cids:
-                n += self._entries.pop(_key(cid), None) is not None
-            if n:
-                self._save_locked()
-        return n
+            gone = [k for k in keys if self._entries.pop(k, None)
+                    is not None]
+            if gone:
+                self._mutate_disk_locked(
+                    lambda chips: [chips.pop(k, None) for k in gone])
+        return len(gone)
 
     def chip_ids(self) -> set[tuple[int, int]]:
         with self._lock:
@@ -162,28 +187,55 @@ class Quarantine:
             return {"schema": QUARANTINE_SCHEMA, "updated_at": _now_iso(),
                     "run_id": self.run_id, "chips": dict(self._entries)}
 
-    def _save_locked(self) -> None:
+    def _mutate_disk_locked(self, mutate) -> None:
+        """Apply ONE mutation to the on-disk manifest as a
+        load-freshest -> mutate -> atomic-write under an exclusive
+        flock.  Concurrent fleet workers share quarantine.json; a
+        whole-file dump of this process's in-memory view would silently
+        erase entries another worker recorded since our load (the
+        classic lost update) — folding each mutation into the freshest
+        disk state keeps every worker's dead letters.  Caller holds
+        self._lock (thread side); the flock is the process side."""
         if self.path is None:
             return
-        doc = {"schema": QUARANTINE_SCHEMA, "updated_at": _now_iso(),
-               "run_id": self.run_id, "chips": self._entries}
+        import fcntl
         try:
-            d = os.path.dirname(self.path)
-            if d:
-                os.makedirs(d, exist_ok=True)
-            tmp = self.path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(doc, f, indent=1)
-            os.replace(tmp, self.path)
+            fd = os.open(self.path + ".lock",
+                         os.O_CREAT | os.O_RDWR, 0o644)
+        except OSError as e:
+            from firebird_tpu.obs import logger
+            logger("change-detection").error(
+                "quarantine manifest lock failed: %s", e)
+            return
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            chips: dict = {}
+            if os.path.exists(self.path):
+                try:
+                    with open(self.path) as f:
+                        doc = json.load(f)
+                    if doc.get("schema") == QUARANTINE_SCHEMA:
+                        chips = dict(doc.get("chips", {}))
+                except (OSError, ValueError):
+                    pass          # torn file: rebuilt from this mutation
+            mutate(chips)
+            atomic_write_json(self.path, {
+                "schema": QUARANTINE_SCHEMA, "updated_at": _now_iso(),
+                "run_id": self.run_id, "chips": chips})
         except OSError as e:
             # The ledger must never fail the run it exists to protect.
             from firebird_tpu.obs import logger
             logger("change-detection").error(
                 "quarantine manifest write failed: %s", e)
+        finally:
+            os.close(fd)          # closing the fd releases the flock
 
     def save(self) -> None:
+        """Fold this ledger's entries into the on-disk manifest (no
+        deletions — discards already wrote through)."""
         with self._lock:
-            self._save_locked()
+            mine = {k: dict(v) for k, v in self._entries.items()}
+            self._mutate_disk_locked(lambda chips: chips.update(mine))
 
 
 # ---------------------------------------------------------------------------
@@ -202,9 +254,12 @@ def config_fingerprint(cfg) -> str:
 
 
 def write_manifest(cfg, *, acquired: str, run_id: str,
-                   tile: dict | None = None) -> str | None:
+                   tile: dict | None = None,
+                   fence: int | None = None) -> str | None:
     """Pin this run's identity next to the store (atomic write).
-    Returns the path, or None for the memory backend."""
+    Returns the path, or None for the memory backend.  ``fence`` stamps
+    the fleet lease's fencing token (fleet/queue.py) so the manifest
+    records which lease last owned the store's output."""
     path = manifest_path(cfg)
     if path is None:
         return None
@@ -216,16 +271,70 @@ def write_manifest(cfg, *, acquired: str, run_id: str,
                       "keyspace": cfg.keyspace()}}
     if tile:
         doc["tile"] = {"h": tile.get("h"), "v": tile.get("v")}
+    if fence is not None:
+        doc["fence"] = int(fence)
     try:
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1)
-        os.replace(tmp, path)
+        atomic_write_json(path, doc)
     except OSError as e:
         from firebird_tpu.obs import logger
         logger("change-detection").error("run manifest write failed: %s", e)
         return None
     return path
+
+
+def stamp_manifest_fence(cfg, fence: int, *, run_id: str,
+                         acquired: str | None = None) -> str | None:
+    """Record the highest fencing token seen into ``run_manifest.json``.
+
+    Monotonic: the read-compare-write runs under an exclusive
+    ``flock`` on a sidecar lock file, so concurrent fleet workers
+    stamping the same store serialize — a stamper holding a LOWER token
+    cannot interleave past the compare and roll a higher one back (the
+    write itself stays ``atomic_write_json``, so a crash mid-stamp still
+    leaves a complete document).  A stamp at or below the stored token
+    is a no-op.  Creates a fresh manifest when none exists and
+    ``acquired`` is known; returns the path, or None when nothing was
+    written."""
+    path = manifest_path(cfg)
+    if path is None:
+        return None
+    import fcntl
+
+    try:
+        lock_fd = os.open(path + ".lock", os.O_CREAT | os.O_RDWR, 0o644)
+    except OSError as e:
+        from firebird_tpu.obs import logger
+        logger("change-detection").error(
+            "manifest fence stamp failed (lock): %s", e)
+        return None
+    try:
+        fcntl.flock(lock_fd, fcntl.LOCK_EX)
+        doc = None
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                doc = None
+        if doc is None:
+            if acquired is None:
+                return None
+            return write_manifest(cfg, acquired=acquired, run_id=run_id,
+                                  fence=fence)
+        if int(doc.get("fence") or -1) >= int(fence):
+            return path
+        doc["fence"] = int(fence)
+        doc["written_at"] = _now_iso()
+        try:
+            atomic_write_json(path, doc)
+        except OSError as e:
+            from firebird_tpu.obs import logger
+            logger("change-detection").error(
+                "manifest fence stamp failed: %s", e)
+            return None
+        return path
+    finally:
+        os.close(lock_fd)       # closing the fd releases the flock
 
 
 class ResumeMismatch(ValueError):

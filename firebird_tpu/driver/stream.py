@@ -61,11 +61,15 @@ def _state_path(sdir: str, cid) -> str:
 
 
 def save_state(path: str, st: incremental.StreamState, side: dict) -> None:
-    """Atomic checkpoint write (tmp + rename, the crash-safe idiom)."""
+    """Atomic checkpoint write (tmp + rename, the crash-safe idiom).
+    The temp name carries the pid: a fleet zombie and its successor can
+    both be writing the same chip's checkpoint (fleet/worker.py designs
+    for exactly that overlap), and a SHARED temp would interleave two
+    writers into one corrupt .npz before the rename publishes it."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     arrs = {f: np.asarray(getattr(st, f)) for f in _STATE_FIELDS}
     arrs.update({k: np.asarray(side[k]) for k in _SIDE_FIELDS})
-    tmp = path + ".tmp"
+    tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **arrs)
     os.replace(tmp, path)
@@ -147,13 +151,19 @@ def publish_frame(packed, st: incremental.StreamState, side: dict) -> dict:
 
 
 def stream(x, y, acquired: str | None = None, number: int = 2500,
-           cfg: Config | None = None, source=None, store=None) -> dict:
+           cfg: Config | None = None, source=None, store=None,
+           reset_metrics: bool = True) -> dict:
     """Streaming incremental change detection over one tile.
 
     First run per chip bootstraps (batch detect + checkpoint); later runs
     apply only acquisitions newer than the checkpoint horizon.  Returns a
     summary dict: chips bootstrapped/updated, observations applied, and
     pixels flagged for the cold-path batch rerun.
+
+    ``reset_metrics=False`` keeps the caller's metrics registry: a fleet
+    worker (fleet/worker.py) hosts MANY jobs in one process, and a
+    stream job must not wipe the worker's fleet counters the way a
+    standalone run wipes the previous run's telemetry.
     """
     cfg = cfg or Config.from_env()
     acquired = acquired or dt.default_acquired()
@@ -164,7 +174,8 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
     # stops it).
     run_id = dcore.fleet_run_id()            # one id for the whole fleet
     jsonlog.set_run_context(run_id=run_id)   # setup log lines carry it too
-    obs_metrics.reset_registry()
+    if reset_metrics:
+        obs_metrics.reset_registry()
     # Compile-warm startup, same contract as the batch driver.  The
     # bootstrap dispatches at float32 with the capacity check ON (no
     # donation), so the warm shape must match that variant.

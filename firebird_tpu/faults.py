@@ -22,6 +22,10 @@ scope target            what the injector wraps
 ``aux``                 ``aux_source.aux``
 ``store``               ``store.write`` (the backend, under the writer)
 ``writer``              ``AsyncWriter.write`` (the enqueue seam)
+``lease``               fleet-worker lease heartbeats (fleet/worker.py): an
+                        injected failure drops the beat, so the lease ages
+                        toward expiry — ``lease:p=1`` models a worker
+                        partitioned from the queue (a zombie)
 ======================  =====================================================
 
 ======================  =====================================================
@@ -56,7 +60,7 @@ import zlib
 
 from firebird_tpu.obs import metrics as obs_metrics
 
-TARGETS = ("ingest", "aux", "store", "writer")
+TARGETS = ("ingest", "aux", "store", "writer", "lease")
 _KINDS = ("ioerror", "timeout", "conn")
 
 

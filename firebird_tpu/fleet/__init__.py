@@ -1,0 +1,14 @@
+"""Crash-tolerant fleet scheduling: a lease/heartbeat/fence job queue
+(fleet.queue), the worker loop that drains it (fleet.worker), and the
+multi-tile plan builder (fleet.plan).  docs/ROBUSTNESS.md "Fleet
+scheduling" is the operator story; tools/fleet_chaos.py is the proof."""
+
+from firebird_tpu.fleet.queue import (FencedStore, FleetQueue, Lease,
+                                      LeaseLost, StaleFence, queue_path)
+from firebird_tpu.fleet.worker import FleetWorker, make_queue
+from firebird_tpu.fleet.plan import enqueue_tile_plan
+
+__all__ = [
+    "FencedStore", "FleetQueue", "Lease", "LeaseLost", "StaleFence",
+    "queue_path", "FleetWorker", "make_queue", "enqueue_tile_plan",
+]

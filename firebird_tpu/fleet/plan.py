@@ -1,0 +1,81 @@
+"""Plan builder: a multi-tile campaign as one dependency-ordered queue.
+
+Turns the reference's deploy loop (one Spark job per conus.csv row) into
+queue entries: per tile, the chip enumeration splits into ``detect``
+chunk jobs, an optional ``classify`` job blocked on ALL of that tile's
+detection (it unblocks the moment the last chunk acks — cross-stage
+scheduling, not phase barriers across the fleet), and optional
+``product`` jobs blocked on the classify (or directly on detection when
+no classification is requested).  ``firebird fleet enqueue`` is the CLI
+face; tools/fleet_chaos.py drives it headless.
+"""
+
+from __future__ import annotations
+
+from firebird_tpu import grid
+from firebird_tpu.fleet.queue import FleetQueue
+from firebird_tpu.utils.fn import partition_all, take
+
+
+def enqueue_tile_plan(queue: FleetQueue, tiles, *, acquired: str,
+                      number: int = 2500, chunk_size: int = 500,
+                      msday: int | None = None, meday: int | None = None,
+                      products=(), product_dates=(),
+                      max_attempts: int = 3) -> dict:
+    """Enqueue a campaign over ``tiles`` (an iterable of (x, y) points,
+    one per tile).  Returns a summary: job ids by stage and totals.
+
+    ``chunk_size`` is the detect-job granularity — smaller chunks mean
+    finer re-delivery (a dead worker forfeits less work) at the cost of
+    more queue traffic; it is the lease-time analog of the driver's
+    failure-isolation chunk."""
+    if (msday is None) != (meday is None):
+        raise ValueError("classification needs both msday and meday")
+    if bool(products) != bool(product_dates):
+        raise ValueError("product jobs need both products and "
+                         "product_dates")
+    summary: dict = {"tiles": 0, "detect": [], "classify": [],
+                     "product": []}
+    for x, y in tiles:
+        t = grid.tile(x=x, y=y)
+        cids = list(take(number, grid.chips(t)))
+        detect_ids = []
+        for chunk in partition_all(max(int(chunk_size), 1), cids):
+            jid = queue.enqueue(
+                "detect",
+                {"x": x, "y": y, "acquired": acquired,
+                 "tile": {"h": t["h"], "v": t["v"]},
+                 "cids": [[int(cx), int(cy)] for cx, cy in chunk]},
+                max_attempts=max_attempts)
+            detect_ids.append(jid)
+        summary["detect"].extend(detect_ids)
+        downstream = detect_ids
+        if msday is not None:
+            jid = queue.enqueue(
+                "classify",
+                {"x": x, "y": y, "acquired": acquired,
+                 "msday": int(msday), "meday": int(meday),
+                 "number": int(number)},
+                depends_on=detect_ids, max_attempts=max_attempts)
+            summary["classify"].append(jid)
+            downstream = [jid]
+        if products:
+            # Bounds = bbox of the chips this plan actually detects
+            # (chip ids ARE in-cell upper-left projection points), so
+            # products.save covers the same area as the upstream stages
+            # — a single [x, y] point would cover ONE chip of a
+            # 2500-chip tile.
+            xs = [float(c[0]) for c in cids]
+            ys = [float(c[1]) for c in cids]
+            jid = queue.enqueue(
+                "product",
+                {"bounds": [[min(xs), max(ys)], [max(xs), min(ys)]],
+                 "products": list(products),
+                 "product_dates": list(product_dates),
+                 "acquired": acquired},
+                depends_on=downstream, max_attempts=max_attempts)
+            summary["product"].append(jid)
+        summary["tiles"] += 1
+    summary["jobs"] = (len(summary["detect"]) + len(summary["classify"])
+                       + len(summary["product"]))
+    return summary

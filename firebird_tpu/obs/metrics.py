@@ -280,6 +280,19 @@ METRIC_HELP = {
     "serve_requests_tile": "/v1/tile requests served",
     "serve_deadline_exceeded_total":
         "requests past their deadline (504)",
+    "fleet_jobs_claimed": "fleet jobs claimed (leased) by workers",
+    "fleet_jobs_acked": "fleet jobs completed and acked",
+    "fleet_jobs_requeued":
+        "fleet jobs returned to the queue (lease expiry or retryable "
+        "failure)",
+    "fleet_jobs_dead":
+        "fleet jobs dead-lettered after their attempt budget",
+    "fleet_jobs_lost":
+        "jobs abandoned after lease loss (zombie fenced off its output)",
+    "fleet_fence_rejected":
+        "operations rejected for a stale fencing token",
+    "fleet_lease_age_seconds": "age of this worker's current fleet lease",
+    "fleet_job_seconds_*": "fleet job execution wall time by job type",
 }
 
 

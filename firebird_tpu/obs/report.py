@@ -46,6 +46,7 @@ SPAN_NAMES = (
     "drain",
     "fetch",
     "first_dispatch",
+    "fleet_job",
     "pack",
     "profile",
     "publish",

@@ -56,7 +56,7 @@ class RunStatus:
                  counters=None, watchdog=None, run: dict | None = None,
                  mesh_up: bool = True, pipeline_depth: int = 2,
                  quarantine=None, breaker=None, profiler=None,
-                 slo_spec: str | None = None):
+                 slo_spec: str | None = None, fleet=None):
         self.run_id = run_id
         self.kind = kind
         self.chips_total = int(chips_total)
@@ -71,6 +71,10 @@ class RunStatus:
         # obs/profiling.py) and its SLO spec (/slo, obs/slo.py).
         self.profiler = profiler
         self.slo_spec = slo_spec
+        # Fleet view provider (fleet workers pass FleetWorker.fleet_block):
+        # a zero-arg callable returning the queue/worker snapshot dict
+        # rendered as /progress's "fleet" block; None for non-fleet runs.
+        self.fleet = fleet
         self.run = dict(run or {})
         self.pipeline_depth = max(int(pipeline_depth), 1)
         self._lock = threading.Lock()
@@ -220,9 +224,23 @@ class RunStatus:
             },
             "counters": counters,
             "degraded": self.degraded_block(),
+            "fleet": self._fleet_block(),
             "watchdog": (self.watchdog.snapshot()
                          if self.watchdog is not None else None),
         }
+
+    def _fleet_block(self) -> dict | None:
+        """The /progress 'fleet' sub-document: queue depths by type and
+        state, active leases with age/holder, dead-letter classes, and
+        this worker's tallies (docs/ROBUSTNESS.md "Fleet scheduling").
+        None for non-fleet runs; a snapshot failure must not take the
+        whole progress endpoint down with it."""
+        if self.fleet is None:
+            return None
+        try:
+            return self.fleet()
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
 
 
 # Mutation under _status_lock; the per-batch hook reads (set_stage,

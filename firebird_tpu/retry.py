@@ -35,6 +35,15 @@ import time
 
 from firebird_tpu.obs import metrics as obs_metrics
 
+class NonRetryable(Exception):
+    """Base for errors the retry loop must re-raise IMMEDIATELY: another
+    attempt cannot help, and the failure says nothing about the health
+    of the service behind the breaker.  The canonical case is a fencing
+    rejection (fleet.queue.StaleFence) — a lease that expired stays
+    expired, and retrying a zombie's write would just hammer the store
+    with more rejections while delaying the worker's abandon path."""
+
+
 # Gauge encoding for breaker_state (docs/ROBUSTNESS.md).
 CLOSED, HALF_OPEN, OPEN = 0, 1, 2
 _STATE_NAMES = {CLOSED: "closed", HALF_OPEN: "half_open", OPEN: "open"}
@@ -260,6 +269,10 @@ class RetryPolicy:
                 self.breaker.acquire(self._sleep or time.sleep)
             try:
                 result = fn()
+            except NonRetryable:
+                # Not a transient failure and not a service-health signal:
+                # no retry, no budget spend, no breaker strike.
+                raise
             except Exception as e:
                 if self.breaker is not None:
                     self.breaker.record_failure()

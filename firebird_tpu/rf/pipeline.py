@@ -135,12 +135,18 @@ def classify_chip(model, seg: dict, aux: dict, cx: int, cy: int) -> dict | None:
 
 def classify_tile(x, y, *, msday: int, meday: int, acquired: str,
                   cfg: Config | None = None, source=None, aux_source=None,
-                  store=None, number: int | None = None, **train_kw):
+                  store=None, number: int | None = None, writer=None,
+                  **train_kw):
     """Full classification driver (core.py:156-251, completed).
 
     Trains on the 3x3 neighborhood, persists the model under the tile key,
     scores every real segment of the center tile and upserts rfrawp.
     Returns the trained model, or None when no training features exist.
+
+    ``writer`` lets a caller supply its own egress (a fleet classify job
+    passes a retry-wrapped AsyncWriter over a fenced store, so a zombie
+    worker's predictions reject like any other stale-fence write); the
+    default builds a plain AsyncWriter over ``store`` and closes it.
     """
     name = "random-forest-classification"
     log = logger(name)
@@ -160,7 +166,8 @@ def classify_tile(x, y, *, msday: int, meday: int, acquired: str,
     cids = grid.classification(x, y)
     if number is not None:
         cids = list(take(number, cids))
-    writer = AsyncWriter(store)
+    own_writer = writer is None
+    writer = writer if writer is not None else AsyncWriter(store)
     have = store.chip_ids("segment")
     try:
         for cx, cy in cids:
@@ -180,6 +187,12 @@ def classify_tile(x, y, *, msday: int, meday: int, acquired: str,
             counters.add("chips")
             counters.add("segments", len(updated["sday"]))
     finally:
-        writer.close()
+        # A caller-supplied writer outlives this call (the fleet worker
+        # closes it after the queue ack decision); flush so the rfrawp
+        # upserts are landed — not merely queued — before returning.
+        if own_writer:
+            writer.close()
+        else:
+            writer.flush()
         log.info("classification complete: %s", counters.snapshot())
     return model

@@ -114,6 +114,17 @@ class AsyncWriter:
             err, self._error = self._error, None
         return err
 
+    def peek_error(self) -> Exception | None:
+        """The pending write error WITHOUT clearing it (write()/flush()
+        still raise it).  The driver's chunk loop polls this between
+        batches (driver/core.py detect_chunk): a stale-fence rejection
+        (retry.NonRetryable) sitting here means a fleet job's lease is
+        gone and every further write will reject, so the loop abandons
+        the remaining compute instead of discovering the loss at the
+        final flush."""
+        with self._lock:
+            return self._error
+
     def _check_alive(self) -> None:
         if not all(t.is_alive() for t in self._threads):
             raise RuntimeError("async writer thread is dead")

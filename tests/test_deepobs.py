@@ -305,7 +305,12 @@ def test_profiler_single_window_at_a_time_and_early_close(tmp_path):
     prof.window(60.0)                     # async; would run a minute
     with pytest.raises(profiling.ProfilerBusy):
         prof.window(1.0)
-    prof.close(timeout=30.0)              # interrupts the wait
+    # Generous join bound: on a contended host the capture thread's
+    # start/stop_trace can take tens of seconds to get scheduled, and a
+    # timed-out join here reads as a lost window (observed flake under
+    # full-suite load).  The join returns the moment the thread ends,
+    # so the typical cost is unchanged.
+    prof.close(timeout=240.0)             # interrupts the wait
     s = prof.summary()
     assert len(s["windows"]) == 1 and not s["in_flight"]
 

@@ -403,6 +403,66 @@ def test_manifest_missing_warns_and_proceeds(tmp_path):
     assert any("no run manifest" in ln for ln in log.lines)
 
 
+def test_truncated_manifest_warns_and_proceeds(tmp_path):
+    """SIGKILL-mid-write regression: a torn run_manifest.json must not
+    block --resume with a JSON parse error — the unreadable-manifest
+    path warns and proceeds on the pre-manifest assumption."""
+    cfg = Config(store_backend="sqlite",
+                 store_path=str(tmp_path / "fb.db"))
+    path = qlib.write_manifest(cfg, acquired=ACQ, run_id="r1")
+    with open(path) as f:
+        text = f.read()
+    with open(path, "w") as f:
+        f.write(text[: len(text) // 2])       # torn half-document
+    log = _Log()
+    qlib.check_resume(cfg, acquired="2001-01-01/2002-01-01", log=log)
+    assert any("unreadable run manifest" in ln for ln in log.lines)
+
+
+def test_truncated_quarantine_loads_empty_with_warning(tmp_path):
+    """Same regression for quarantine.json: a torn dead-letter manifest
+    starts empty (warned) instead of crashing the resume that exists to
+    drain it."""
+    path = str(tmp_path / "quarantine.json")
+    q = qlib.Quarantine(path)
+    q.record((3, 4), IOError("x"), attempts=1)
+    with open(path) as f:
+        text = f.read()
+    with open(path, "w") as f:
+        f.write(text[: len(text) // 2])
+    q2 = qlib.Quarantine.load(path)
+    assert len(q2) == 0                       # empty, not an exception
+    q2.record((5, 6), IOError("y"), attempts=1)   # and usable again
+    assert qlib.Quarantine.load(path).chip_ids() == {(5, 6)}
+
+
+def test_quarantine_concurrent_instances_never_lose_entries(tmp_path):
+    """Fleet regression: two workers share one quarantine.json through
+    separate Quarantine instances.  Each mutation folds into the
+    freshest on-disk state under a file lock, so one worker's record
+    cannot erase the other's (whole-file dump = lost update)."""
+    path = str(tmp_path / "quarantine.json")
+    a = qlib.Quarantine(path, run_id="worker-a")
+    b = qlib.Quarantine(path, run_id="worker-b")
+    a.record((1, 1), IOError("a's letter"), attempts=1)
+    b.record((2, 2), IOError("b's letter"), attempts=1)   # must not wipe (1,1)
+    assert qlib.Quarantine.load(path).chip_ids() == {(1, 1), (2, 2)}
+    # discard is write-through too: a's discard deletes only its chip
+    assert a.discard((1, 1))
+    assert qlib.Quarantine.load(path).chip_ids() == {(2, 2)}
+
+
+def test_atomic_write_json_replaces_and_leaves_no_temp(tmp_path):
+    """The shared write-temp -> fsync -> os.replace helper behind both
+    manifests: the target is always a complete document and the
+    pid-suffixed temp never survives."""
+    path = str(tmp_path / "doc.json")
+    qlib.atomic_write_json(path, {"v": 1})
+    qlib.atomic_write_json(path, {"v": 2})
+    assert json.load(open(path)) == {"v": 2}
+    assert os.listdir(tmp_path) == ["doc.json"]
+
+
 # ---------------------------------------------------------------------------
 # Degraded ops surface
 # ---------------------------------------------------------------------------

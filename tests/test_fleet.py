@@ -1,0 +1,627 @@
+"""Fleet work queue: lease/heartbeat/fence scheduling, deterministically.
+
+Every lease-protocol test drives an injectable clock — no sleeps: lease
+expiry, zombie fencing, dead-lettering, and dependency gating are all
+exact clock arithmetic.  The end-to-end test proves the headline
+contract at small scale: a plan drained through fleet workers produces a
+store row-identical to the direct single-process driver run.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from firebird_tpu.config import Config
+from firebird_tpu.fleet import (FencedStore, FleetQueue, FleetWorker,
+                                LeaseLost, StaleFence, enqueue_tile_plan,
+                                queue_path)
+from firebird_tpu.obs import metrics as obs_metrics
+
+# Matches test_driver/test_faults: the 1-chip f64 kernel shape is
+# already jit-cached by the time this file runs in a full-suite pass.
+ACQ = "1995-01-01/1997-06-01"
+
+
+class Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture
+def clock():
+    return Clock()
+
+
+@pytest.fixture
+def queue(tmp_path, clock):
+    q = FleetQueue(str(tmp_path / "fleet.db"), lease_sec=30.0, clock=clock)
+    yield q
+    q.close()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    obs_metrics.reset_registry()
+    yield
+    obs_metrics.reset_registry()
+
+
+# ---------------------------------------------------------------------------
+# Queue protocol
+# ---------------------------------------------------------------------------
+
+def test_enqueue_claim_ack_roundtrip(queue):
+    a = queue.enqueue("detect", {"cids": [[1, 2]]})
+    b = queue.enqueue("detect", {"cids": [[3, 4]]})
+    lease = queue.claim("w1")
+    assert lease.job_id == a and lease.payload == {"cids": [[1, 2]]}
+    assert lease.fence == 1 and lease.attempts == 1
+    lease2 = queue.claim("w2")
+    assert lease2.job_id == b and lease2.fence == 2   # monotonic tokens
+    queue.ack(lease)
+    queue.ack(lease2)
+    assert queue.claim("w1") is None
+    assert queue.counts() == {"pending": 0, "leased": 0, "done": 2,
+                              "dead": 0}
+    assert queue.drained()
+    hist = [h["event"] for h in queue.job(a)["history"]]
+    assert hist == ["enqueued", "claimed", "acked"]
+
+
+def test_enqueue_validates(queue):
+    with pytest.raises(ValueError, match="job_type"):
+        queue.enqueue("mine-bitcoin", {})
+    with pytest.raises(ValueError, match="max_attempts"):
+        queue.enqueue("detect", {}, max_attempts=0)
+    with pytest.raises(ValueError, match="unknown job ids"):
+        queue.enqueue("detect", {}, depends_on=[999])
+
+
+def test_dependencies_gate_claims(queue):
+    d1 = queue.enqueue("detect", {"n": 1})
+    d2 = queue.enqueue("detect", {"n": 2})
+    c = queue.enqueue("classify", {}, depends_on=[d1, d2])
+    assert queue.job(c)["depends_on"] == [d1, d2]
+    l1 = queue.claim("w")
+    l2 = queue.claim("w")
+    assert {l1.job_id, l2.job_id} == {d1, d2}
+    assert queue.claim("w") is None          # classify still blocked
+    assert queue.status()["blocked"] == 1
+    queue.ack(l1)
+    assert queue.claim("w") is None          # one dep is not all deps
+    queue.ack(l2)
+    lc = queue.claim("w")                    # unblocks on the LAST ack
+    assert lc is not None and lc.job_id == c
+
+
+def test_lease_expiry_requeues_with_history(queue, clock):
+    jid = queue.enqueue("detect", {"n": 1}, max_attempts=5)
+    stale = queue.claim("w1")
+    clock.advance(31.0)                       # past lease_sec=30
+    fresh = queue.claim("w2")                 # re-delivery
+    assert fresh.job_id == jid
+    assert fresh.fence == stale.fence + 1 and fresh.attempts == 2
+    events = [h["event"] for h in queue.job(jid)["history"]]
+    assert events == ["enqueued", "claimed", "lease_expired", "claimed"]
+    # the zombie's protocol ops all reject
+    with pytest.raises(LeaseLost):
+        queue.heartbeat(stale)
+    with pytest.raises(StaleFence):
+        queue.ack(stale)
+    with pytest.raises(StaleFence):
+        queue.fail(stale, RuntimeError("late report"))
+    assert queue.fence_rejects() == 3
+    # the successor is untouched by the zombie's noise
+    queue.heartbeat(fresh)
+    queue.ack(fresh)
+    assert queue.job(jid)["state"] == "done"
+    assert obs_metrics.counter("fleet_jobs_requeued").value == 1
+
+
+def test_heartbeat_extends_lease(queue, clock):
+    queue.enqueue("detect", {})
+    lease = queue.claim("w")
+    clock.advance(20.0)
+    queue.heartbeat(lease)                    # extends to t+20+30
+    clock.advance(20.0)                       # t+40 < t+50: still live
+    assert queue.fence_valid(lease.job_id, lease.fence)
+    queue.ack(lease)
+    assert queue.job(lease.job_id)["state"] == "done"
+
+
+def test_expired_unreclaimed_lease_is_invalid(queue, clock):
+    """Fencing is symmetric: once the lease lapses, writes AND ack both
+    reject even before anyone re-claims — a zombie can never slip output
+    in during the gap between expiry and re-delivery."""
+    queue.enqueue("detect", {})
+    lease = queue.claim("w")
+    clock.advance(31.0)
+    assert not queue.fence_valid(lease.job_id, lease.fence)
+    with pytest.raises(StaleFence):
+        queue.ack(lease)
+
+
+class _RecordingStore:
+    def __init__(self):
+        self.writes = []
+
+    def write(self, table, frame):
+        self.writes.append((table, frame))
+        return 1
+
+    def chip_ids(self, table="segment"):
+        return set()
+
+
+def test_zombie_write_fencing(queue, clock):
+    jid = queue.enqueue("detect", {})
+    stale = queue.claim("w1")
+    inner = _RecordingStore()
+    fenced = FencedStore(inner, queue, stale)
+    fenced.write("chip", {"cx": [1]})         # live lease: passes through
+    assert len(inner.writes) == 1
+    clock.advance(31.0)
+    fresh = queue.claim("w2")                 # successor owns the job now
+    with pytest.raises(StaleFence):
+        fenced.write("chip", {"cx": [1]})
+    assert len(inner.writes) == 1             # zero stale writes accepted
+    assert queue.fence_rejects("write") == 1
+    assert queue.fence_rejects() == 1
+    # reads pass through untouched (fencing is write-side only)
+    assert fenced.chip_ids() == set()
+    succ = FencedStore(inner, queue, fresh)
+    succ.write("chip", {"cx": [1]})
+    assert len(inner.writes) == 2
+    queue.ack(fresh)
+    assert queue.job(jid)["state"] == "done"
+
+
+def test_fail_requeues_then_dead_letters(queue, clock):
+    jid = queue.enqueue("detect", {}, max_attempts=2)
+    lease = queue.claim("w")
+    assert queue.fail(lease, RuntimeError("boom")) == "pending"
+    lease = queue.claim("w")
+    assert lease.attempts == 2
+    assert queue.fail(lease, ValueError("worse")) == "dead"
+    assert queue.claim("w") is None
+    st = queue.status()
+    assert st["jobs"]["dead"] == 1
+    assert st["dead_errors"] == {"ValueError": 1}
+    assert st["dead"][0]["job"] == jid and st["dead"][0]["attempts"] == 2
+    # operator revival: fresh attempt budget, claimable again
+    assert queue.requeue(jid) == 1
+    lease = queue.claim("w")
+    assert lease is not None and lease.attempts == 1
+    queue.ack(lease)
+
+
+def test_expired_lease_crashloop_dead_letters(queue, clock):
+    """A payload that kills its worker every time must not wedge the
+    fleet: the attempt budget counts expired leases too."""
+    queue.enqueue("detect", {}, max_attempts=1)
+    queue.claim("w1")
+    clock.advance(31.0)
+    assert queue.claim("w2") is None          # dead-lettered, not re-leased
+    st = queue.status()
+    assert st["jobs"]["dead"] == 1
+    assert st["dead_errors"] == {"LeaseExpired": 1}
+    # an expiry that dead-letters was never RE-delivered: the requeue
+    # counter must not move (only the dead counter does)
+    assert obs_metrics.counter("fleet_jobs_requeued").value == 0
+    assert obs_metrics.counter("fleet_jobs_dead").value == 1
+
+
+def test_wedged_is_one_atomic_snapshot(queue, clock):
+    """wedged() verdicts: blocked-behind-dead with nothing leased is
+    wedged; blocked behind a LIVE lease or claimable work is not."""
+    assert not queue.wedged()                 # empty queue: just drained
+    d = queue.enqueue("detect", {}, max_attempts=1)
+    c = queue.enqueue("classify", {}, depends_on=[d])
+    assert not queue.wedged()                 # d is claimable
+    lease = queue.claim("w")
+    assert not queue.wedged()                 # d leased: progress possible
+    queue.fail(lease, RuntimeError("boom"))   # max_attempts=1 -> dead
+    assert queue.wedged()                     # c blocked behind dead d
+    queue.requeue(d)
+    assert not queue.wedged()                 # revived: claimable again
+    assert c
+
+
+def test_status_lease_view(queue, clock):
+    queue.enqueue("detect", {})
+    queue.claim("host-a:123")
+    clock.advance(10.0)
+    st = queue.status()
+    (lease,) = st["leases"]
+    assert lease["owner"] == "host-a:123"
+    assert lease["age_sec"] == 10.0
+    assert lease["expires_in_sec"] == 20.0
+    assert st["by_type"]["detect"]["leased"] == 1
+    assert st["fence_rejects"] == 0 and st["fence_rejects_by_op"] == {}
+
+
+def test_queue_survives_reopen(tmp_path, clock):
+    """The queue IS the durable state: a second FleetQueue over the same
+    file (a restarted worker) sees jobs, leases, and the fence seq."""
+    path = str(tmp_path / "fleet.db")
+    q1 = FleetQueue(path, lease_sec=30.0, clock=clock)
+    jid = q1.enqueue("detect", {"n": 1})
+    lease = q1.claim("w1")
+    q2 = FleetQueue(path, lease_sec=30.0, clock=clock)
+    assert q2.job(jid)["state"] == "leased"
+    clock.advance(31.0)
+    fresh = q2.claim("w2")
+    assert fresh.fence == lease.fence + 1     # seq continues across opens
+    q2.ack(fresh)
+    assert q1.job(jid)["state"] == "done"
+    q1.close()
+    q2.close()
+
+
+def test_queue_path_rules(tmp_path):
+    cfg = Config(store_backend="sqlite",
+                 store_path=str(tmp_path / "fb.db"))
+    assert queue_path(cfg) == str(tmp_path / "fleet.db")
+    cfg = Config(store_backend="sqlite", store_path=str(tmp_path / "fb.db"),
+                 fleet_db=str(tmp_path / "q" / "explicit.db"))
+    assert queue_path(cfg) == str(tmp_path / "q" / "explicit.db")
+    with pytest.raises(ValueError, match="FIREBIRD_FLEET_DB"):
+        queue_path(Config(store_backend="memory"))
+
+
+def test_fleet_config_validation():
+    with pytest.raises(ValueError, match="FLEET_LEASE"):
+        Config(fleet_lease_sec=0)
+    with pytest.raises(ValueError, match="FLEET_HEARTBEAT"):
+        Config(fleet_heartbeat_sec=-1)
+    with pytest.raises(ValueError, match="shorter than the lease"):
+        Config(fleet_lease_sec=5.0, fleet_heartbeat_sec=5.0)
+    with pytest.raises(ValueError, match="FLEET_MAX_ATTEMPTS"):
+        Config(fleet_max_attempts=0)
+    cfg = Config.from_env(env={"FIREBIRD_FLEET_DB": "/x/q.db",
+                               "FIREBIRD_FLEET_LEASE_SEC": "7",
+                               "FIREBIRD_FLEET_HEARTBEAT_SEC": "2",
+                               "FIREBIRD_FLEET_MAX_ATTEMPTS": "9"})
+    assert (cfg.fleet_db, cfg.fleet_lease_sec, cfg.fleet_heartbeat_sec,
+            cfg.fleet_max_attempts) == ("/x/q.db", 7.0, 2.0, 9)
+
+
+def test_stale_fence_is_nonretryable():
+    """A fencing rejection must short-circuit the retry policy: no
+    backoff sleeps, no budget spend, no breaker strike."""
+    from firebird_tpu import retry as retrylib
+
+    sleeps = []
+    budget = retrylib.RetryBudget(10)
+    breaker = retrylib.CircuitBreaker(1, cooldown_sec=30.0,
+                                      clock=lambda: 0.0)
+    policy = retrylib.RetryPolicy(3, budget=budget, breaker=breaker,
+                                  sleep=sleeps.append)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise StaleFence("fenced")
+
+    class _Log:
+        def warning(self, *a):
+            pass
+
+    with pytest.raises(StaleFence):
+        policy.run(_Log(), "op", fn)
+    assert len(calls) == 1 and sleeps == []
+    assert budget.spent == 0
+    assert breaker.state == retrylib.CLOSED
+
+
+# ---------------------------------------------------------------------------
+# Plan builder
+# ---------------------------------------------------------------------------
+
+def test_plan_builder_dependencies(queue):
+    plan = enqueue_tile_plan(
+        queue, [(100.0, 200.0), (150100.0, 200.0)], acquired=ACQ,
+        number=4, chunk_size=2, msday=724204, meday=735598,
+        products=["seglength"], product_dates=["1996-01-01"])
+    assert plan["tiles"] == 2
+    assert len(plan["detect"]) == 4           # 2 chunks x 2 tiles
+    assert len(plan["classify"]) == 2 and len(plan["product"]) == 2
+    assert plan["jobs"] == 8
+    # per tile: classify depends on its 2 detect chunks, product on the
+    # classify — cross-stage edges, not a fleet-wide phase barrier
+    c0 = queue.job(plan["classify"][0])
+    assert c0["depends_on"] == plan["detect"][:2]
+    p0 = queue.job(plan["product"][0])
+    assert p0["depends_on"] == [plan["classify"][0]]
+    # every detect payload carries its chunk's chip ids + the acquired
+    d0 = queue.job(plan["detect"][0])
+    assert d0["payload"]["acquired"] == ACQ
+    assert len(d0["payload"]["cids"]) == 2
+    # the product job's bounds cover the SAME chips the detect stage
+    # enqueued (a single tile point would cover one chip of the area)
+    from firebird_tpu.products import covering_chips
+    detected = {tuple(c) for j in plan["detect"][:2]
+                for c in map(tuple, queue.job(j)["payload"]["cids"])}
+    covered = set(covering_chips(
+        [tuple(b) for b in p0["payload"]["bounds"]]))
+    assert detected <= covered
+
+
+def test_plan_builder_validates(queue):
+    with pytest.raises(ValueError, match="msday"):
+        enqueue_tile_plan(queue, [(0, 0)], acquired=ACQ, msday=1)
+    with pytest.raises(ValueError, match="product_dates"):
+        enqueue_tile_plan(queue, [(0, 0)], acquired=ACQ,
+                          products=["seglength"])
+
+
+# ---------------------------------------------------------------------------
+# Worker loop (toy handlers; injectable sleep — no waits)
+# ---------------------------------------------------------------------------
+
+def _worker(cfg, queue, handlers, **kw):
+    return FleetWorker(cfg, queue, worker_id="t:1", handlers=handlers,
+                       sleep=lambda s: None, **kw)
+
+
+def test_worker_drains_toy_jobs(tmp_path, queue):
+    cfg = Config(store_backend="sqlite", store_path=str(tmp_path / "s.db"))
+    ran = []
+    w = _worker(cfg, queue, {"detect": lambda p, lease: ran.append(p["n"])})
+    queue.enqueue("detect", {"n": 1})
+    queue.enqueue("detect", {"n": 2})
+    summary = w.run(until_drained=True)
+    assert ran == [1, 2]
+    assert summary["executed"] == 2 and summary["acked"] == 2
+    assert summary["queue"]["done"] == 2 and not summary["wedged"]
+    assert obs_metrics.counter("fleet_jobs_claimed").value == 2
+    assert obs_metrics.counter("fleet_jobs_acked").value == 2
+    # per-job-type latency histogram recorded under the dynamic name
+    assert obs_metrics.histogram(
+        "fleet_job_seconds_detect").snapshot()["count"] == 2
+
+
+def test_worker_failure_requeues_then_dead_letters(tmp_path, queue):
+    cfg = Config(store_backend="sqlite", store_path=str(tmp_path / "s.db"))
+
+    def boom(p, lease):
+        raise RuntimeError("handler exploded")
+
+    queue.enqueue("detect", {}, max_attempts=2)
+    w = _worker(cfg, queue, {"detect": boom})
+    summary = w.run(until_drained=True)
+    assert summary["requeued"] == 1 and summary["dead"] == 1
+    assert summary["queue"]["dead"] == 1 and not summary["wedged"]
+
+
+def test_worker_abandons_on_stale_fence(tmp_path, queue, clock):
+    """A StaleFence out of the handler (a fenced write rejecting) is an
+    abandon, not a failure: no fail() report, the successor's queue
+    state is untouched, and the loss is counted."""
+    cfg = Config(store_backend="sqlite", store_path=str(tmp_path / "s.db"))
+    jid = queue.enqueue("detect", {})
+
+    def zombie(p, lease):
+        clock.advance(31.0)                   # lease lapses mid-job
+        queue.claim("successor")              # and a successor re-claims
+        raise StaleFence("write rejected")
+
+    w = _worker(cfg, queue, {"detect": zombie})
+    summary = w.run(max_jobs=1)
+    assert summary["lost"] == 1 and summary["acked"] == 0
+    job = queue.job(jid)
+    assert job["state"] == "leased" and job["owner"] == "successor"
+    assert obs_metrics.counter("fleet_jobs_lost").value == 1
+
+
+def test_worker_unknown_job_type_dead_letters(tmp_path, queue):
+    cfg = Config(store_backend="sqlite", store_path=str(tmp_path / "s.db"))
+    queue.enqueue("product", {}, max_attempts=1)
+    w = _worker(cfg, queue, {"detect": lambda p, lease: None})
+    summary = w.run(until_drained=True)
+    assert summary["dead"] == 1
+    assert queue.status()["dead_errors"] == {"ValueError": 1}
+
+
+def test_worker_wedge_detection(tmp_path, queue):
+    """pending jobs blocked behind a dead dependency + nothing leased =
+    polling can never finish; until_drained exits wedged instead of
+    spinning forever."""
+    cfg = Config(store_backend="sqlite", store_path=str(tmp_path / "s.db"))
+    d = queue.enqueue("detect", {}, max_attempts=1)
+    queue.enqueue("classify", {}, depends_on=[d])
+
+    def boom(p, lease):
+        raise RuntimeError("dead upstream")
+
+    w = _worker(cfg, queue, {"detect": boom})
+    summary = w.run(until_drained=True)
+    assert summary["wedged"]
+    assert summary["queue"] == {"pending": 1, "leased": 0, "done": 0,
+                                "dead": 1}
+
+
+def test_worker_beat_paths(tmp_path, queue, clock):
+    """One heartbeat attempt: True extends, False (injected lease fault
+    — the partition model) skips so the lease just ages, None on loss."""
+    cfg = Config(store_backend="sqlite", store_path=str(tmp_path / "s.db"),
+                 faults="lease:p=1")
+    queue.enqueue("detect", {})
+    w = _worker(cfg, queue, {})
+    lease = queue.claim(w.worker_id)
+    expires0 = queue.job(lease.job_id)["lease_expires"]
+    assert w._beat(lease) is False            # injected: beat dropped
+    assert queue.job(lease.job_id)["lease_expires"] == expires0
+    w2 = _worker(Config(store_backend="sqlite",
+                        store_path=str(tmp_path / "s.db")), queue, {})
+    clock.advance(5.0)
+    assert w2._beat(lease) is True            # healthy: lease extended
+    assert queue.job(lease.job_id)["lease_expires"] == expires0 + 5.0
+    assert obs_metrics.gauge("fleet_lease_age_seconds").value == 5.0
+    clock.advance(31.0)
+    assert w2._beat(lease) is None            # lapsed: lost
+
+
+def test_worker_heartbeat_thread_stops_cleanly(tmp_path, queue):
+    cfg = Config(store_backend="sqlite", store_path=str(tmp_path / "s.db"),
+                 fleet_heartbeat_sec=0.01)
+    queue.enqueue("detect", {"n": 1})
+    w = FleetWorker(cfg, queue, worker_id="t:1",
+                    handlers={"detect": lambda p, lease: None})
+    w.run(max_jobs=1)
+    assert not any(t.name.startswith("fleet-heartbeat")
+                   for t in threading.enumerate())
+
+
+def test_lease_fault_plan_grammar():
+    from firebird_tpu import faults as faultlib
+
+    plan = faultlib.FaultPlan.parse("lease:p=1")
+    inj = plan.injector("lease")
+    with pytest.raises(faultlib.InjectedFault):
+        inj.fire()
+    with pytest.raises(ValueError, match="chip="):
+        faultlib.FaultPlan.parse("lease:chip=1:2")
+
+
+def test_runstatus_fleet_block():
+    from firebird_tpu.obs import server as obs_server
+
+    st = obs_server.RunStatus("r", "fleet-worker",
+                              fleet=lambda: {"jobs": {"pending": 3}})
+    assert st.progress()["fleet"] == {"jobs": {"pending": 3}}
+    assert obs_server.RunStatus("r", "x").progress()["fleet"] is None
+    boom = obs_server.RunStatus(
+        "r", "x", fleet=lambda: (_ for _ in ()).throw(RuntimeError("db")))
+    assert "RuntimeError" in boom.progress()["fleet"]["error"]
+
+
+def test_worker_fleet_block_shape(tmp_path, queue):
+    cfg = Config(store_backend="sqlite", store_path=str(tmp_path / "s.db"))
+    queue.enqueue("detect", {"n": 1})
+    w = _worker(cfg, queue, {"detect": lambda p, lease: None})
+    w.run(max_jobs=1)
+    block = w.fleet_block()
+    assert block["jobs"]["done"] == 1
+    assert block["worker"]["id"] == "t:1"
+    assert block["worker"]["tallies"]["acked"] == 1
+    assert block["worker"]["current_job"] is None
+
+
+def test_manifest_fence_stamp_is_monotonic(tmp_path):
+    from firebird_tpu.driver import quarantine as qlib
+
+    cfg = Config(store_backend="sqlite", store_path=str(tmp_path / "s.db"))
+    # no manifest + no acquired: nothing to create
+    assert qlib.stamp_manifest_fence(cfg, 1, run_id="r") is None
+    path = qlib.stamp_manifest_fence(cfg, 5, run_id="r", acquired=ACQ)
+    doc = json.load(open(path))
+    assert doc["fence"] == 5 and doc["acquired"] == ACQ
+    qlib.stamp_manifest_fence(cfg, 3, run_id="r")      # stale: no-op
+    assert json.load(open(path))["fence"] == 5
+    qlib.stamp_manifest_fence(cfg, 9, run_id="r")      # newer: climbs
+    assert json.load(open(path))["fence"] == 9
+
+
+@pytest.mark.slow
+def test_detect_job_acks_minus_dead_letters(tmp_path, queue):
+    """A detect job whose chip exhausts its fetch retries acks (the job
+    ran; per-chip quarantine is the record) and the dead letter SURVIVES
+    the job's redeem sweep — regression: the worker used to discard the
+    whole payload's entries, erasing letters recorded seconds earlier."""
+    from firebird_tpu import grid
+    from firebird_tpu.driver import quarantine as qlib
+    from firebird_tpu.utils.fn import take
+
+    cids = list(take(2, grid.chips(grid.tile(x=100, y=200))))
+    poisoned = tuple(int(v) for v in cids[0])
+    cfg = Config(store_backend="sqlite",
+                 store_path=str(tmp_path / "fb.db"),
+                 source_backend="synthetic", chips_per_batch=1,
+                 device_sharding="off", dtype="float64", fetch_retries=0,
+                 faults=f"ingest:chip={poisoned[0]}:{poisoned[1]}")
+    queue.enqueue("detect", {
+        "x": 100, "y": 200, "acquired": ACQ,
+        "cids": [[int(a), int(b)] for a, b in cids]})
+    w = FleetWorker(cfg, queue, worker_id="t:1", sleep=lambda s: None)
+    summary = w.run(until_drained=True)
+    assert summary["acked"] == 1              # the job completed...
+    q = qlib.Quarantine.load(qlib.quarantine_path(cfg))
+    assert q.chip_ids() == {poisoned}         # ...minus its dead letter
+
+
+# ---------------------------------------------------------------------------
+# End to end: fleet-drained plan == direct driver run
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fleet_detect_jobs_match_direct_run(tmp_path):
+    """Two detect jobs drained by a fleet worker (real handlers, fenced
+    store, real queue) produce segment coverage identical to the direct
+    single-process changedetection run — the merged-store half of the
+    fleet-smoke acceptance at unit scale (tier-1 budget: slow-marked;
+    `make fleet-smoke` proves the same contract with real processes)."""
+    from firebird_tpu.driver import core
+    from firebird_tpu.store import SqliteStore
+
+    def cfg_for(sub):
+        return Config(store_backend="sqlite",
+                      store_path=str(tmp_path / sub / "fb.db"),
+                      source_backend="synthetic", chips_per_batch=1,
+                      device_sharding="off", dtype="float64",
+                      fleet_db=str(tmp_path / sub / "queue.db"))
+
+    direct_cfg = cfg_for("direct")
+    os.makedirs(tmp_path / "direct", exist_ok=True)
+    done = core.changedetection(x=100, y=200, acquired=ACQ, number=2,
+                                chunk_size=1, cfg=direct_cfg)
+    assert len(done) == 2
+
+    fleet_cfg = cfg_for("fleet")
+    os.makedirs(tmp_path / "fleet", exist_ok=True)
+    queue = FleetQueue(queue_path(fleet_cfg), lease_sec=300.0)
+    plan = enqueue_tile_plan(queue, [(100.0, 200.0)], acquired=ACQ,
+                             number=2, chunk_size=1)
+    assert len(plan["detect"]) == 2
+    w = FleetWorker(fleet_cfg, queue, worker_id="t:1",
+                    sleep=lambda s: None)
+    summary = w.run(until_drained=True)
+    assert summary["acked"] == 2 and summary["queue"]["done"] == 2
+
+    def rows(cfg):
+        store = SqliteStore(cfg.store_path, cfg.keyspace())
+        out = {}
+        for table in ("chip", "pixel", "segment"):
+            frame = store.read(table)
+            cols = sorted(frame)
+            n = len(frame[cols[0]]) if cols else 0
+            out[table] = sorted(
+                json.dumps([(c, frame[c][i]) for c in cols],
+                           sort_keys=True) for i in range(n))
+        store.close()
+        return out
+
+    assert rows(direct_cfg) == rows(fleet_cfg)
+    # the manifest carries the last owning lease's fencing token
+    from firebird_tpu.driver import quarantine as qlib
+    doc = json.load(open(qlib.manifest_path(fleet_cfg)))
+    assert doc["fence"] >= 1 and doc["acquired"] == ACQ
+    # re-delivery fast path: re-running the same plan skips stored chips
+    queue2 = FleetQueue(queue_path(fleet_cfg), lease_sec=300.0)
+    enqueue_tile_plan(queue2, [(100.0, 200.0)], acquired=ACQ,
+                      number=2, chunk_size=1)
+    w2 = FleetWorker(fleet_cfg, queue2, worker_id="t:2",
+                     sleep=lambda s: None)
+    s2 = w2.run(until_drained=True)
+    assert s2["acked"] == 2
+    assert rows(direct_cfg) == rows(fleet_cfg)
+    queue.close()
+    queue2.close()

@@ -9,6 +9,7 @@ rerun); a third run with the same range is a no-op.
 """
 
 import glob
+import json
 
 import numpy as np
 import pytest
@@ -143,3 +144,41 @@ def test_sharded_bootstrap_multi_chip(tmp_path):
                      cfg=cfg, source=src, store=mk())
     assert s2["bootstrapped"] == 0 and s2["updated"] == 2
     assert s2["obs_applied"] >= 80          # ~46 new acquisitions per chip
+
+
+@pytest.mark.slow
+def test_stream_quarantine_branch_and_drain(tmp_path):
+    """The stream driver's per-chip isolation (the branch chaos never
+    exercised): a poisoned chip is dead-lettered to quarantine.json
+    without failing the run, the other chip bootstraps normally, and the
+    next stream run (poison cleared) drains the quarantine."""
+    from firebird_tpu import grid
+    from firebird_tpu.driver import quarantine as qlib
+    from firebird_tpu.utils.fn import take
+
+    cids = list(take(2, grid.chips(grid.tile(x=100, y=200))))
+    poisoned = tuple(int(v) for v in cids[0])
+    cfg = Config(store_backend="sqlite", store_path=str(tmp_path / "s.db"),
+                 stream_dir=str(tmp_path / "state"),
+                 source_backend="synthetic", chips_per_batch=1,
+                 fetch_retries=0,
+                 faults=f"ingest:chip={poisoned[0]}:{poisoned[1]}")
+    src = StepSource()
+    mk = lambda: open_store(cfg.store_backend, cfg.store_path,
+                            cfg.keyspace())
+    s1 = sdrv.stream(100, 200, acquired="1995-01-01/1998-12-31", number=2,
+                     cfg=cfg, source=src, store=mk())
+    assert s1["bootstrapped"] == 1 and s1["quarantined"] == 1
+    qpath = qlib.quarantine_path(cfg)
+    doc = json.load(open(qpath))
+    assert doc["chips"][f"{poisoned[0]},{poisoned[1]}"]["stage"] == "stream"
+    assert len(glob.glob(f"{cfg.stream_dir}/state_*.npz")) == 1
+
+    # poison cleared: the missing chip bootstraps, the landed one
+    # updates, and the dead letter drains
+    healed = Config(**{**cfg.__dict__, "faults": ""})
+    s2 = sdrv.stream(100, 200, acquired="1995-01-01/1998-12-31", number=2,
+                     cfg=healed, source=src, store=mk())
+    assert s2["bootstrapped"] == 1 and s2["quarantined"] == 0
+    assert len(qlib.Quarantine.load(qpath)) == 0
+    assert len(glob.glob(f"{cfg.stream_dir}/state_*.npz")) == 2
