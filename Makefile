@@ -10,9 +10,9 @@ Y ?= 1650000
 ACQUIRED ?= 1982-01-01/2017-12-31
 
 .PHONY: install lint test bench obs-smoke pipeline-smoke chaos-smoke \
-        fleet-smoke serve-smoke compact-smoke postmortem-smoke image \
-        db-up db-schema db-test db-down changedetection classification \
-        clean
+        fleet-smoke serve-smoke compact-smoke postmortem-smoke \
+        alert-smoke image db-up db-schema db-test db-down \
+        changedetection classification clean
 
 install:
 	pip install -e . --no-build-isolation
@@ -27,9 +27,12 @@ lint:
 	  --json "$${FIREBIRD_LINT_DIR:-/tmp/fb_lint}/lint_report.json"
 
 # The default verify path runs the contract checker first: a knob/metric/
-# hotpath/ownership drift fails the build before the (slower) test suite.
+# hotpath/ownership drift fails the build before the (slower) test suite —
+# then the alerting end-to-end drill (the smoke tier's representative:
+# it exercises stream + serve + fleet queue together under chaos).
 test: lint
 	python -m pytest tests/ -x -q
+	$(MAKE) alert-smoke
 
 bench:
 	python bench.py
@@ -89,6 +92,16 @@ postmortem-smoke:
 # and wasted lane-rounds dropped at least 2x; artifact folded by bench.py.
 compact-smoke:
 	python tools/compact_smoke.py
+
+# Alerting end-to-end drill (docs/ALERTS.md): a streaming run over a
+# step-change archive with injected ingest faults and a SIGKILL
+# mid-stream — asserts zero lost alerts, zero duplicates after the
+# resume, webhook delivery catching up from its durable cursor, repair
+# jobs enqueued once per broken chip and drained by a fleet worker, and
+# an evaluated acquisition→alert-visible freshness SLO in the artifact
+# (folded by bench.py).
+alert-smoke:
+	python tools/alert_soak.py
 
 image:
 	docker build -f deploy/Dockerfile -t firebird .

@@ -242,6 +242,14 @@ def _postmortem_fold() -> dict:
                           "postmortem_smoke.json")
 
 
+def _alert_fold() -> dict:
+    """`make alert-smoke` evidence (tools/alert_soak.py): exactly-once
+    alerting through SIGKILL + resume, webhook cursor catch-up, repair
+    drain, and the evaluated alert_freshness SLO."""
+    return _artifact_fold("alert_soak", "FIREBIRD_ALERT_DIR",
+                          "alert_soak.json")
+
+
 def measure(cpu_only: bool) -> None:
     if cpu_only:
         import jax
@@ -717,6 +725,9 @@ def measure(cpu_only: bool) -> None:
             # Last postmortem-smoke evidence (SIGTERM'd run leaves a
             # valid flight-recorder bundle + row-identical resume).
             **_postmortem_fold(),
+            # Last alert-smoke evidence (exactly-once alerting through
+            # SIGKILL, webhook catch-up, repair drain, freshness SLO).
+            **_alert_fold(),
             "streaming_pixels_per_sec": round(stream_rate, 1),
             **s2_detail,
             **hard_detail,

@@ -1,0 +1,302 @@
+"""Durable, append-only alert log: one record per confirmed break.
+
+The reference system only replays the archive — a detected break lands
+in Cassandra and waits for the next product run (PAPER.md §0).  This
+module is the producer half of the near-real-time alerting loop
+(ROADMAP item 5): the streaming driver appends one durable record the
+moment a tail break confirms (``StreamState.break_day`` 0→>0), and the
+feed side (alerts/feed.py) pushes it to subscribers within seconds —
+the durable-event-log + subscriber-feed architecture big astronomical
+survey pipelines use for transient alerts (PAPERS.md).
+
+Design rules, inherited from the fleet queue (fleet/queue.py — the same
+no-external-services deployment weight):
+
+- **sqlite next to the store.**  ``alerts.db`` via :func:`alert_db_path`
+  (the fleet.db placement rule); WAL so the serving layer's readers and
+  the stream's writer coexist.
+- **Monotonic cursor.**  The rowid IS the cursor: ``since(cursor)``
+  returns records with ``id > cursor`` in id order, so a consumer that
+  remembers its last id never misses or re-reads a record.
+- **Exactly-once emission.**  Records are UNIQUE on
+  ``(px, py, break_day)``: a stream resume re-applying the same
+  acquisitions, or a fleet re-delivering a stream job, re-emits the
+  same logical alert and the log ignores it (``alert_deduped_total``).
+  A pixel whose repair lands and whose tail breaks AGAIN carries a new
+  ``break_day`` — a genuinely new alert, not a duplicate.
+- **Durable subscriber cursors.**  Webhook subscribers live in the same
+  database with their delivery cursor; delivery crash-resumes from the
+  cursor, never from "the beginning" or "now".
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import sqlite3
+import threading
+
+from firebird_tpu.obs import metrics as obs_metrics
+
+ALERT_SCHEMA = "firebird-alert-log/1"
+
+# A since() page bound: cursor pagination makes any depth reachable,
+# one page must not balloon a response or an SSE write burst.
+MAX_PAGE = 10_000
+
+
+def alert_db_path(cfg) -> str | None:
+    """The alert log for a config: ``cfg.alert_db`` when set, else
+    ``alerts.db`` next to the results store (the fleet.db placement
+    rule).  None — alerting disabled — for the memory backend without
+    an explicit path: unlike the fleet queue this is an optional side
+    product, so no-location degrades to off rather than raising."""
+    if cfg.alert_db:
+        return cfg.alert_db
+    from firebird_tpu.driver import quarantine as qlib
+
+    d = qlib._artifact_dir(cfg)
+    return None if d is None else os.path.join(d, "alerts.db")
+
+
+def _now_iso() -> str:
+    return datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+
+
+class AlertLog:
+    """The durable alert log + subscriber registry.  Thread-safe within
+    a process (one guarded connection) and process-safe across the
+    stream writer and serve readers (WAL + short transactions)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._con = sqlite3.connect(  # guarded-by: _lock
+            path, timeout=60, isolation_level=None,
+            check_same_thread=False)
+        self._create()
+        # Depth tracked incrementally: one COUNT(*) at open, then +=
+        # per append — a per-append full-table count would make hot-path
+        # emission O(total log size).  Other writers' appends are
+        # invisible to this tally; status()/count() stay exact.
+        self._depth = self.count()  # guarded-by: _lock (int += only)
+
+    def _create(self) -> None:
+        with self._lock:
+            con = self._con
+            con.execute("PRAGMA journal_mode=WAL")
+            con.execute("PRAGMA synchronous=NORMAL")
+            con.execute("BEGIN IMMEDIATE")
+            try:
+                con.execute(
+                    "CREATE TABLE IF NOT EXISTS alerts ("
+                    " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+                    " cx INTEGER NOT NULL, cy INTEGER NOT NULL,"
+                    " px INTEGER NOT NULL, py INTEGER NOT NULL,"
+                    " break_day REAL NOT NULL,"
+                    " score REAL, magnitude REAL,"
+                    " run_id TEXT, detected_at TEXT,"
+                    " UNIQUE (px, py, break_day))")
+                con.execute(
+                    "CREATE INDEX IF NOT EXISTS idx_alerts_chip "
+                    "ON alerts (cx, cy)")
+                con.execute(
+                    "CREATE TABLE IF NOT EXISTS subscribers ("
+                    " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+                    " url TEXT NOT NULL UNIQUE,"
+                    " cursor INTEGER NOT NULL DEFAULT 0,"
+                    " created TEXT, last_ok TEXT,"
+                    " failures INTEGER NOT NULL DEFAULT 0)")
+                con.execute(
+                    "CREATE TABLE IF NOT EXISTS meta ("
+                    " key TEXT PRIMARY KEY, value TEXT)")
+                con.execute(
+                    "INSERT OR IGNORE INTO meta (key, value) "
+                    "VALUES ('schema', ?)", (ALERT_SCHEMA,))
+                con.execute("COMMIT")
+            except BaseException:
+                con.execute("ROLLBACK")
+                raise
+
+    # -- producer side ------------------------------------------------------
+
+    def append(self, records, *, run_id: str | None = None) -> tuple[int,
+                                                                     int]:
+        """Append alert records in ONE transaction; returns (inserted,
+        deduped).  Each record: dict with cx, cy, px, py, break_day and
+        optional score / magnitude.  Records whose (px, py, break_day)
+        key already exists are ignored — stream resume and fleet
+        re-delivery are exactly-once."""
+        records = list(records)
+        if not records:
+            return 0, 0
+        now = _now_iso()
+        inserted = 0
+        with self._lock:
+            con = self._con
+            con.execute("BEGIN IMMEDIATE")
+            try:
+                for r in records:
+                    cur = con.execute(
+                        "INSERT OR IGNORE INTO alerts (cx, cy, px, py, "
+                        "break_day, score, magnitude, run_id, detected_at)"
+                        " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                        (int(r["cx"]), int(r["cy"]), int(r["px"]),
+                         int(r["py"]), float(r["break_day"]),
+                         float(r.get("score", 1.0)),
+                         float(r.get("magnitude", 0.0)), run_id, now))
+                    inserted += cur.rowcount
+                con.execute("COMMIT")
+            except BaseException:
+                con.execute("ROLLBACK")
+                raise
+            self._depth += inserted
+            depth = self._depth
+        deduped = len(records) - inserted
+        if inserted:
+            obs_metrics.counter(
+                "alert_emitted_total",
+                help="confirmed-break alerts appended to the durable "
+                     "log").inc(inserted)
+        if deduped:
+            obs_metrics.counter(
+                "alert_deduped_total",
+                help="alert re-emissions ignored by the (pixel, "
+                     "break_day) unique key (resume / re-delivery)").inc(
+                deduped)
+        obs_metrics.gauge(
+            "alert_log_depth",
+            help="total records in the durable alert log (as this "
+                 "writer has seen it)").set(depth)
+        return inserted, deduped
+
+    # -- consumer side ------------------------------------------------------
+
+    def since(self, cursor: int = 0, *, limit: int = 1000,
+              bbox=None, t0=None, t1=None) -> list[dict]:
+        """Records with ``id > cursor`` in id order (the resume
+        contract).  ``bbox`` is (minx, miny, maxx, maxy) over the pixel
+        projection coords; ``t0``/``t1`` are ISO dates bounding
+        ``break_day``."""
+        from firebird_tpu.utils import dates as dt
+
+        limit = max(1, min(int(limit), MAX_PAGE))
+        sql = ("SELECT id, cx, cy, px, py, break_day, score, magnitude, "
+               "run_id, detected_at FROM alerts WHERE id > ?")
+        args: list = [int(cursor)]
+        if bbox is not None:
+            minx, miny, maxx, maxy = (float(v) for v in bbox)
+            sql += " AND px >= ? AND px <= ? AND py >= ? AND py <= ?"
+            args += [minx, maxx, miny, maxy]
+        if t0 is not None:
+            sql += " AND break_day >= ?"
+            args.append(float(dt.to_ordinal(t0)))
+        if t1 is not None:
+            sql += " AND break_day <= ?"
+            args.append(float(dt.to_ordinal(t1)))
+        sql += " ORDER BY id LIMIT ?"
+        args.append(limit)
+        with self._lock:
+            rows = self._con.execute(sql, args).fetchall()
+        out = []
+        for (rid, cx, cy, px, py, bday, score, mag, run_id,
+             detected_at) in rows:
+            out.append({
+                "id": int(rid), "cx": int(cx), "cy": int(cy),
+                "px": int(px), "py": int(py),
+                "break_day": float(bday),
+                "break_date": dt.to_iso(int(bday)),
+                "score": score, "magnitude": mag,
+                "run_id": run_id, "detected_at": detected_at})
+        return out
+
+    def latest_cursor(self) -> int:
+        with self._lock:
+            row = self._con.execute("SELECT MAX(id) FROM alerts").fetchone()
+        return int(row[0]) if row and row[0] is not None else 0
+
+    def count(self) -> int:
+        with self._lock:
+            return int(self._con.execute(
+                "SELECT COUNT(*) FROM alerts").fetchone()[0])
+
+    # -- subscribers --------------------------------------------------------
+
+    def subscribe(self, url: str, *, cursor: int | None = None) -> int:
+        """Register a webhook subscriber; returns its id.  Idempotent on
+        url (re-registering keeps the existing durable cursor).  A new
+        subscriber's cursor defaults to 0 — full catch-up from the log's
+        beginning; pass ``cursor`` to start elsewhere (e.g.
+        ``latest_cursor()`` for new-alerts-only)."""
+        if not url or "://" not in url:
+            raise ValueError(f"subscriber url must be absolute, got {url!r}")
+        with self._lock:
+            con = self._con
+            con.execute("BEGIN IMMEDIATE")
+            try:
+                con.execute(
+                    "INSERT OR IGNORE INTO subscribers (url, cursor, "
+                    "created) VALUES (?, ?, ?)",
+                    (url, int(cursor or 0), _now_iso()))
+                sid = con.execute(
+                    "SELECT id FROM subscribers WHERE url = ?",
+                    (url,)).fetchone()[0]
+                con.execute("COMMIT")
+            except BaseException:
+                con.execute("ROLLBACK")
+                raise
+        return int(sid)
+
+    def subscribers(self) -> list[dict]:
+        latest = self.latest_cursor()
+        with self._lock:
+            rows = self._con.execute(
+                "SELECT id, url, cursor, created, last_ok, failures "
+                "FROM subscribers ORDER BY id").fetchall()
+        return [{"id": int(i), "url": u, "cursor": int(c),
+                 "lag": max(latest - int(c), 0), "created": cr,
+                 "last_ok": ok, "failures": int(f)}
+                for i, u, c, cr, ok, f in rows]
+
+    def advance(self, sub_id: int, cursor: int) -> None:
+        """Move a subscriber's durable delivery cursor FORWARD (a crashed
+        deliverer restarting with stale state cannot rewind a successor's
+        progress — the fencing discipline, cursor-shaped)."""
+        with self._lock:
+            self._con.execute(
+                "UPDATE subscribers SET cursor = ?, last_ok = ?, "
+                "failures = 0 WHERE id = ? AND cursor < ?",
+                (int(cursor), _now_iso(), int(sub_id), int(cursor)))
+
+    def record_failure(self, sub_id: int) -> None:
+        with self._lock:
+            self._con.execute(
+                "UPDATE subscribers SET failures = failures + 1 "
+                "WHERE id = ?", (int(sub_id),))
+
+    def unsubscribe(self, sub_id: int) -> bool:
+        with self._lock:
+            cur = self._con.execute(
+                "DELETE FROM subscribers WHERE id = ?", (int(sub_id),))
+        return cur.rowcount > 0
+
+    # -- operator surface ---------------------------------------------------
+
+    def status(self) -> dict:
+        """The alerts view: log depth, latest cursor, per-subscriber
+        delivery lag — rendered by ``firebird status`` and the
+        ``/progress`` alerts block."""
+        return {
+            "path": self.path,
+            "depth": self.count(),
+            "latest_cursor": self.latest_cursor(),
+            "subscribers": self.subscribers(),
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            self._con.close()

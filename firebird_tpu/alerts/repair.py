@@ -1,0 +1,125 @@
+"""Automatic cold-path repair: broken pixels become fleet jobs.
+
+A stream-confirmed break freezes the pixel (``StreamState.needs_batch``)
+until a full batch rerun re-initializes a fresh segment after the break
+— before this module that was a COUNT in the stream summary an operator
+had to notice and act on.  Now the streaming driver rolls the flagged
+pixels up per chip and enqueues idempotent ``repair`` jobs on the PR 9
+fleet queue (fleet/plan.enqueue_repairs — at most one open job per
+chip), and any ``firebird fleet work`` worker executes them through
+:func:`repair_chip`:
+
+- batch re-detection of the chip over the job's full acquired range,
+  republished through the normal keyed-upsert save path (so the repair
+  is byte-identical to what a scheduled cold-path rerun would write,
+  magnitudes included);
+- a FRESH stream checkpoint seeded from the batch result — break_day
+  clears, the pixel is live again, and a SECOND break on the repaired
+  tail alerts under its new break_day (the (pixel, break_day) dedup key
+  treats it as a new event, not a duplicate).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from firebird_tpu.obs import logger
+
+log = logger("alerts")
+
+
+def schedule_repairs(cfg, needs: dict, *, acquired: str,
+                     run_id: str | None = None) -> list[int]:
+    """Enqueue repair jobs for ``needs`` ({(cx, cy): flagged pixels});
+    returns the new job ids.  Opens the config's fleet queue; a config
+    with no file-backed queue location (memory store, no
+    FIREBIRD_FLEET_DB) schedules nothing — the count-only summary still
+    reports the debt."""
+    from firebird_tpu.fleet.plan import enqueue_repairs
+    from firebird_tpu.fleet.queue import FleetQueue, queue_path
+
+    chips = {c: n for c, n in needs.items() if n > 0}
+    if not chips:
+        return []
+    try:
+        path = queue_path(cfg)
+    except ValueError as e:
+        log.warning("repair scheduling skipped: %s", e)
+        return []
+    queue = FleetQueue(path, lease_sec=cfg.fleet_lease_sec)
+    try:
+        return enqueue_repairs(queue, chips, acquired=acquired,
+                               max_attempts=cfg.fleet_max_attempts,
+                               run_id=run_id)
+    finally:
+        queue.close()
+
+
+def repair_chip(cfg, cid, acquired: str, *, source=None, store=None,
+                fence_guard=None) -> dict:
+    """Cold-path repair of ONE chip: batch re-detection + fresh stream
+    checkpoint.  Returns a summary (pixels re-flagged after the rerun is
+    normally 0 — a still-breaking tail re-alerts on its next stream
+    update instead).
+
+    ``fence_guard``: zero-arg callable invoked immediately before the
+    checkpoint save; the fleet worker passes a fence check that raises
+    StaleFence so a zombie whose lease lapsed cannot overwrite a LIVE
+    checkpoint with its stale seed (store writes are fenced by
+    FencedStore; the .npz is the other output).  The check-then-write
+    window is one atomic rename wide — the FencedStore discipline."""
+    import jax.numpy as jnp
+
+    from firebird_tpu import retry as retrylib
+    from firebird_tpu.ccd import kernel
+    from firebird_tpu.ccd.incremental import StreamState
+    from firebird_tpu.driver import core as dcore
+    from firebird_tpu.driver import stream as sdrv
+    from firebird_tpu.ingest import pack
+    from firebird_tpu.store import AsyncWriter, open_store
+
+    cx, cy = int(cid[0]), int(cid[1])
+    source = source or dcore.make_source(cfg)
+    own_store = store is None
+    if store is None:
+        store = open_store(cfg.store_backend, cfg.store_path,
+                           cfg.keyspace())
+    writer = AsyncWriter(store, retry=retrylib.RetryPolicy.for_store(cfg))
+    try:
+        chip = source.chip(cx, cy, acquired)
+        if not chip.dates.shape[0]:
+            raise ValueError(
+                f"repair of chip ({cx},{cy}): no acquisitions in "
+                f"{acquired}")
+        packed = pack([chip], bucket=cfg.obs_bucket, max_obs=cfg.max_obs)
+        # Synchronous single-chip dispatch, capacity check ON — the
+        # stream bootstrap's kernel contract, so the republished rows
+        # and the reseeded checkpoint match what a bootstrap would have
+        # produced over the same range.
+        seg, n_real = dcore.detect_batch(
+            packed, jnp.float32, "off", check_capacity=True,
+            compact=cfg.compact)
+        host = dcore.fetch_results(seg)
+        dcore.write_batch_frames(packed, host, n_real, writer=writer)
+        one = kernel.chip_slice(host, 0)
+        st = StreamState.from_chip(one)
+        sday, curqa = sdrv._tail_identity(one)
+        T = int(packed.n_obs[0])
+        side = dict(sday=sday, curqa=curqa,
+                    anchor=np.float64(packed.dates[0][0]),
+                    horizon=np.float64(packed.dates[0][T - 1]))
+        if fence_guard is not None:
+            fence_guard()
+        sdrv.save_state(
+            sdrv._state_path(sdrv.state_dir(cfg), (cx, cy)), st, side)
+        writer.flush()
+        summary = {"chip": [cx, cy],
+                   "obs": T,
+                   "active": int(np.asarray(st.active).sum()),
+                   "still_flagged": int(np.asarray(st.needs_batch).sum())}
+        log.info("repaired chip (%d,%d): %s", cx, cy, summary)
+        return summary
+    finally:
+        writer.close()
+        if own_store:
+            store.close()

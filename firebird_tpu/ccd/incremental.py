@@ -82,9 +82,10 @@ class StreamState:
             a, last.reshape((P,) + (1,) * (a.ndim - 1)), axis=1)[:, 0]
         return cls(
             coefs=gather(seg.seg_coef), rmse=gather(seg.seg_rmse),
-            # copy: step() donates its state, and a donated alias of the
-            # caller's batch result would invalidate seg.vario on devices
-            # that honor donation.
+            # copy: decouples the stream state from the caller's batch
+            # result (step() no longer donates — see the jit note below —
+            # but an alias into seg.vario is still a liability if
+            # donation ever returns).
             vario=jnp.array(seg.vario, copy=True),
             nobs=meta[:, 5].astype(jnp.int32),
             # chprob on an END segment is n_exceed / PEEK_SIZE.
@@ -116,13 +117,21 @@ def design_row(t_new: float, anchor: float, dtype=np.float32) -> np.ndarray:
         np.array([t_new]), anchor, params.MAX_COEFS)[0].astype(dtype)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("sensor",))
+# NO buffer donation here, deliberately: a donated multi-leaf pytree arg
+# round-tripped through the persistent compilation cache loses its
+# input-output aliasing on deserialization in this jaxlib — the SECOND
+# process to run a cached step computed garbage break days (year 25270)
+# and corrupted the heap (glibc "corrupted double-linked list", SIGSEGV/
+# SIGABRT), found by tools/alert_soak.py's kill/resume drill.  The copy
+# this costs is ~5 MB per [P]-wide step on the host-cheap update path —
+# nothing next to a wrong break day published as an alert.
+@functools.partial(jax.jit, static_argnames=("sensor",))
 def step(state: StreamState, x_row, y_new, qa_new, t_new, *,
          sensor=LANDSAT_ARD) -> StreamState:
     """Advance every pixel's open segment by one acquisition.
 
     Args:
-        state: StreamState [P, ...] (donated; the update happens in place).
+        state: StreamState [P, ...].
         x_row: [8] design row for t_new (design_row()).
         y_new: [P, B] new spectral values (same band order as the kernel).
         qa_new: [P] int32 bit-packed QA.

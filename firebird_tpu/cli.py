@@ -342,10 +342,15 @@ def serve(port, host, cache_entries, cache_dir, no_compute):
     /v1/product/<name>?cx=&cy=&date=, /v1/tile/<name>?bounds=&date=,
     plus /healthz and /metrics.  Cold product requests compute through
     the products.save path (once per key, coalesced) and persist, so the
-    store warms as it serves.  See docs/SERVING.md."""
+    store warms as it serves.  When the store has an alert log next to
+    it, the change-alert feed mounts too: /v1/alerts (cursor pull),
+    /v1/alerts/stream (SSE push), /v1/alerts/webhooks (POST registers a
+    subscriber; delivery runs in the background from each subscriber's
+    durable cursor).  See docs/SERVING.md and docs/ALERTS.md."""
     import signal
     import threading
 
+    from firebird_tpu.alerts import AlertFeed, AlertLog, alert_db_path
     from firebird_tpu.config import Config
     from firebird_tpu.serve import api as serve_api
     from firebird_tpu.store import open_store
@@ -361,8 +366,25 @@ def serve(port, host, cache_entries, cache_dir, no_compute):
     if bind_port is None:
         bind_port = cfg.serve_port
     store = open_store(cfg.store_backend, cfg.store_path, cfg.keyspace())
+    # Mount the alert feed when this store has an alert log behind it
+    # (docs/ALERTS.md): /v1/alerts endpoints + background webhook
+    # delivery.  Unavailable/corrupt log degrades to a serve layer
+    # without alerts, not a dead server.
+    feed = None
+    if cfg.alerts_enabled:
+        apath = alert_db_path(cfg)
+        if apath is not None:
+            try:
+                feed = AlertFeed(AlertLog(apath), cfg)
+                feed.deliverer.start()
+            except Exception as e:
+                click.echo(f"WARNING: alert log {apath} unavailable "
+                           f"({type(e).__name__}: {e}); serving without "
+                           "/v1/alerts", err=True)
+                feed = None
     service = serve_api.ServeService(store, cfg,
-                                     compute_on_miss=not no_compute)
+                                     compute_on_miss=not no_compute,
+                                     alerts=feed)
     srv = serve_api.start_serve_server(bind_port, service,
                                        host=cfg.serve_host)
     click.echo(f"serving {cfg.store_backend}:{cfg.store_path} "
@@ -374,6 +396,8 @@ def serve(port, host, cache_entries, cache_dir, no_compute):
         stop.wait()
     finally:
         srv.close()
+        if feed is not None:
+            feed.close()
         store.close()
 
 
@@ -383,8 +407,10 @@ def serve(port, host, cache_entries, cache_dir, no_compute):
 @click.option("--y", "-y", required=False, default=None, type=float)
 def status(x, y):
     """Inspect the configured results store: per-table row counts, chips
-    with stored segments, quarantine state, and (with -x/-y) one tile's
-    completion — the operational view behind `changedetection --resume`."""
+    with stored segments, quarantine state, the fleet queue, the alert
+    log (depth, cursor, subscriber lag, open repair jobs), and (with
+    -x/-y) one tile's completion — the operational view behind
+    `changedetection --resume`."""
     import collections
     import json as _json
     import os as _os
@@ -451,6 +477,33 @@ def status(x, y):
         except Exception as e:
             out["fleet"] = {"path": fpath,
                             "error": f"{type(e).__name__}: {e}"}
+    # Alerts view (docs/ALERTS.md): log depth, latest cursor, per-
+    # subscriber delivery lag, and the open repair-job count — guarded
+    # like the fleet view: a locked/corrupt alert db degrades THIS
+    # section, not the store/quarantine/fleet output above.
+    from firebird_tpu.alerts import AlertLog, alert_db_path
+
+    apath = alert_db_path(cfg)
+    if apath is not None and _os.path.exists(apath):
+        try:
+            al = AlertLog(apath)
+            try:
+                s = al.status()
+            finally:
+                al.close()
+            by_type = (out.get("fleet") or {}).get("by_type") or {}
+            rep = by_type.get("repair", {})
+            out["alerts"] = {
+                "path": apath,
+                "depth": s["depth"],
+                "latest_cursor": s["latest_cursor"],
+                "subscribers": s["subscribers"],
+                "open_repair_jobs": int(rep.get("pending", 0))
+                + int(rep.get("leased", 0)),
+            }
+        except Exception as e:
+            out["alerts"] = {"path": apath,
+                             "error": f"{type(e).__name__}: {e}"}
     if x is not None:
         tile = grid.tile(x, y)
         cids = [tuple(int(v) for v in c) for c in grid.chips(tile)]

@@ -142,6 +142,19 @@ KNOBS = (
     Knob(name="FIREBIRD_FLEET_MAX_ATTEMPTS", field="fleet_max_attempts",
          help="job attempts (failures or expired leases) before "
               "dead-lettering"),
+    # ---- alerting (Config-backed; docs/ALERTS.md) ----
+    Knob(name="FIREBIRD_ALERTS", field="alerts_enabled", default="1",
+         help="0 disables alerting: stream emission AND the serve "
+              "layer's /v1/alerts mount"),
+    Knob(name="FIREBIRD_ALERT_DB", field="alert_db",
+         help="durable alert-log sqlite path (default: alerts.db next "
+              "to the store)"),
+    Knob(name="FIREBIRD_ALERT_REPAIR", field="alert_repair", default="1",
+         help="0 disables automatic cold-path repair scheduling on the "
+              "fleet queue"),
+    Knob(name="FIREBIRD_ALERT_WEBHOOK_TIMEOUT",
+         field="alert_webhook_timeout",
+         help="webhook delivery HTTP timeout (seconds)"),
     # ---- serving layer (Config-backed) ----
     Knob(name="FIREBIRD_SERVE_PORT", field="serve_port",
          help="firebird serve listen port"),
@@ -215,6 +228,8 @@ KNOBS = (
          help="postmortem-smoke artifact directory"),
     Knob(name="FIREBIRD_FLEET_DIR", default="/tmp/fb_fleet",
          help="fleet-chaos artifact directory"),
+    Knob(name="FIREBIRD_ALERT_DIR", default="/tmp/fb_alerts",
+         help="alert-soak artifact directory"),
     Knob(name="FIREBIRD_LINT_DIR", default="/tmp/fb_lint",
          readers=("Makefile",), internal=True,
          help="lint-report artifact directory (make lint)"),
@@ -417,6 +432,32 @@ class Config:
     # dead-letters instead of crash-looping the fleet.
     fleet_max_attempts: int = 3
 
+    # ---- alerting (firebird_tpu.alerts; docs/ALERTS.md) ----
+    # Alerting (FIREBIRD_ALERTS, default on): a confirmed tail break
+    # appends one durable record to the alert log next to the store,
+    # deduped on (pixel, break_day), and `firebird serve` mounts the
+    # /v1/alerts feed over it.  Off, breaks still publish to the
+    # segment table and repair scheduling still runs (FIREBIRD_ALERT_
+    # REPAIR is independent) — only the alert feed goes dark, on both
+    # the emitting and the serving side.
+    alerts_enabled: bool = True
+
+    # Alert-log sqlite path (FIREBIRD_ALERT_DB); "" derives alerts.db
+    # next to the results store (the fleet.db placement rule).  The
+    # memory store backend has no "next to": alerting silently disables
+    # unless a path is set explicitly.
+    alert_db: str = ""
+
+    # Automatic cold-path repair (FIREBIRD_ALERT_REPAIR, default on):
+    # pixels flagged needs_batch roll up per chip into idempotent
+    # `repair` jobs on the fleet queue — at most one open job per chip —
+    # instead of a count an operator reads.
+    alert_repair: bool = True
+
+    # Webhook delivery HTTP timeout in seconds
+    # (FIREBIRD_ALERT_WEBHOOK_TIMEOUT).
+    alert_webhook_timeout: float = 10.0
+
     # ---- serving layer (firebird_tpu.serve; docs/SERVING.md) ----
     # `firebird serve` port (FIREBIRD_SERVE_PORT).  Unlike ops_port this
     # is only read by the serve command — nothing auto-binds it.
@@ -516,6 +557,9 @@ class Config:
         if self.fleet_max_attempts < 1:
             raise ValueError("FIREBIRD_FLEET_MAX_ATTEMPTS must be >= 1, "
                              f"got {self.fleet_max_attempts}")
+        if self.alert_webhook_timeout <= 0:
+            raise ValueError("FIREBIRD_ALERT_WEBHOOK_TIMEOUT must be > 0 "
+                             f"seconds, got {self.alert_webhook_timeout}")
         if not 0 < self.serve_port <= 65535:
             raise ValueError("FIREBIRD_SERVE_PORT must be a valid TCP "
                              f"port, got {self.serve_port}")
@@ -593,6 +637,13 @@ class Config:
                                             cls.fleet_heartbeat_sec)),
             fleet_max_attempts=int(e.get("FIREBIRD_FLEET_MAX_ATTEMPTS",
                                          cls.fleet_max_attempts)),
+            alerts_enabled=e.get("FIREBIRD_ALERTS", "1") not in ("", "0"),
+            alert_db=e.get("FIREBIRD_ALERT_DB", cls.alert_db),
+            alert_repair=e.get("FIREBIRD_ALERT_REPAIR", "1")
+            not in ("", "0"),
+            alert_webhook_timeout=float(
+                e.get("FIREBIRD_ALERT_WEBHOOK_TIMEOUT",
+                      cls.alert_webhook_timeout)),
             serve_port=int(e.get("FIREBIRD_SERVE_PORT", cls.serve_port)),
             serve_host=e.get("FIREBIRD_SERVE_HOST", cls.serve_host),
             serve_cache_entries=int(e.get("FIREBIRD_SERVE_CACHE_ENTRIES",

@@ -15,8 +15,15 @@ operational:
   step each), and re-publish the open tail segments' rows — same sday key,
   advanced eday/chprob — as keyed upserts.
 - **repair**: pixels whose tail broke are only re-initialized by a batch
-  rerun (``StreamState.needs_batch``); the summary reports their count so
-  operators know when to schedule the cold path.
+  rerun (``StreamState.needs_batch``); they roll up per chip into
+  idempotent ``repair`` jobs on the fleet queue (alerts/repair.py — at
+  most one open job per chip), and the summary still reports the count.
+- **alerting**: a tail break confirmed by an update (``break_day``
+  0→>0) appends one durable record to the alert log
+  (firebird_tpu.alerts, docs/ALERTS.md) BEFORE the checkpoint saves —
+  a crash between the two re-applies the delta on resume and the
+  (pixel, break_day) dedup key absorbs the re-emission, so alerts are
+  exactly-once and never lost.
 
 Checkpoint contents are the StreamState arrays plus the tail segments'
 identity (sday, curqa), the design anchor, and the horizon (last ingested
@@ -27,11 +34,14 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 import os
+import time
 
 import jax.numpy as jnp
 import numpy as np
 
 from firebird_tpu import grid
+from firebird_tpu.alerts import log as alerts_log
+from firebird_tpu.alerts import repair as alerts_repair
 from firebird_tpu.ccd import format as ccdformat
 from firebird_tpu.ccd import harmonic, incremental, kernel, params
 from firebird_tpu.ccd.sensor import LANDSAT_ARD
@@ -150,6 +160,66 @@ def publish_frame(packed, st: incremental.StreamState, side: dict) -> dict:
     return frame
 
 
+def _new_break_records(packed, st: incremental.StreamState,
+                       bday0: np.ndarray, anchor: float) -> list[dict]:
+    """Alert records for the pixels whose tail break confirmed in THIS
+    update pass (``break_day`` 0→>0 against the pre-update snapshot).
+
+    ``score`` is the confirmation change probability (n_exceed /
+    PEEK_SIZE — 1.0 at confirm).  ``magnitude`` is the rmse/vario-
+    normalized detection-band residual of each pixel's newest USABLE
+    observation (QA clear/water, in sensor range — the step()'s own
+    triage; a cloudy or fill-padded last acquisition must not publish a
+    garbage magnitude) against the frozen tail model — a provisional
+    deviation scale; the cold-path batch rerun computes the canonical
+    per-band residual medians (the publish_frame magnitude contract).
+    Pixels with no usable observation in the window report 0.0.
+    """
+    sensor = packed.sensor
+    bday1 = np.asarray(st.break_day, np.float64)
+    newly = (bday0 <= 0) & (bday1 > 0)
+    idx = np.nonzero(newly)[0]
+    if not idx.size:
+        return []
+    cx, cy = (int(v) for v in packed.cids[0])
+    coords = packed.pixel_coords(0)[idx]
+    score = np.asarray(st.n_exceed, np.float64)[idx] / params.PEEK_SIZE
+    T = int(packed.n_obs[0])
+    t = packed.dates[0][:T].astype(np.float64)
+    qa = packed.qas[0][idx, :T].astype(np.int64)               # [N, T]
+    fill = (qa >> params.QA_FILL_BIT) & 1 == 1
+    usable = ((((qa >> params.QA_CLEAR_BIT) & 1 == 1)
+               | ((qa >> params.QA_WATER_BIT) & 1 == 1)) & ~fill)
+    y = packed.spectra[0][:, idx, :T].astype(np.float64)       # [B, N, T]
+    opt = list(sensor.optical_bands)
+    usable &= np.all((y[opt] > params.OPTICAL_MIN)
+                     & (y[opt] < params.OPTICAL_MAX), axis=0)
+    if sensor.thermal_bands:
+        th = list(sensor.thermal_bands)
+        usable &= np.all((y[th] > params.THERMAL_MIN)
+                         & (y[th] < params.THERMAL_MAX), axis=0)
+    any_usable = usable.any(axis=1)                            # [N]
+    last_t = np.where(any_usable,
+                      T - 1 - np.argmax(usable[:, ::-1], axis=1), 0)
+    n_arange = np.arange(idx.shape[0])
+    y_last = y[:, n_arange, last_t].T                          # [N, B]
+    x_rows = harmonic.design_matrix(t, anchor,
+                                    params.MAX_COEFS)[last_t]  # [N, 8]
+    coefs = np.asarray(st.coefs, np.float64)[idx]
+    pred = np.einsum("nbc,nc->nb", coefs, x_rows)
+    den = np.maximum(np.asarray(st.rmse, np.float64),
+                     np.asarray(st.vario, np.float64))[idx]
+    det = list(sensor.detection_bands)
+    rel = (y_last - pred)[:, det] / np.maximum(den[:, det], 1e-9)
+    magnitude = np.where(any_usable,
+                         np.sqrt(np.mean(rel ** 2, axis=1)), 0.0)
+    return [{"cx": cx, "cy": cy,
+             "px": int(coords[n, 0]), "py": int(coords[n, 1]),
+             "break_day": float(bday1[i]), "score": float(score[n]),
+             "magnitude": float(magnitude[n])}
+            for n, i in enumerate(idx)]
+
+
 def stream(x, y, acquired: str | None = None, number: int = 2500,
            cfg: Config | None = None, source=None, store=None,
            reset_metrics: bool = True) -> dict:
@@ -187,13 +257,32 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
     source, store, writer, policy, breaker, quarantine = \
         dcore.robustness_setup(cfg, run_id, source=source, store=store)
     sdir = state_dir(cfg)
+    # The durable alert log (firebird_tpu.alerts): None when alerting is
+    # off or the store has no file-backed "next to".  An unopenable log
+    # degrades alerting, never detection — breaks still publish to the
+    # segment table either way.
+    alog = None
+    if cfg.alerts_enabled:
+        apath = alerts_log.alert_db_path(cfg)
+        if apath is not None:
+            try:
+                alog = alerts_log.AlertLog(apath)
+            except Exception as e:
+                log.error("alert log %s unavailable (%s: %s) — alert "
+                          "emission disabled for this run", apath,
+                          type(e).__name__, e)
 
     tile = grid.tile(x=x, y=y)
     cids = dcore.host_shard(list(take(number, grid.chips(tile))))
-    log.info("streaming tile h=%s v=%s: %d chips (acquired %s, state %s)",
-             tile["h"], tile["v"], len(cids), acquired, sdir)
+    log.info("streaming tile h=%s v=%s: %d chips (acquired %s, state %s, "
+             "alerts %s)", tile["h"], tile["v"], len(cids), acquired,
+             sdir, alog.path if alog is not None else "off")
     summary = dict(bootstrapped=0, updated=0, obs_applied=0,
-                   pixels_need_batch=0)
+                   pixels_need_batch=0, alerts_emitted=0,
+                   alerts_deduped=0, repair_jobs_enqueued=0)
+    # Per-chip needs_batch rollup: the update loop fills it (serial), the
+    # repair scheduler turns it into fleet jobs at end of run.
+    needs_by_chip: dict = {}
 
     # Chips whose fetch failed THIS run: a just-quarantined chip must not
     # be drained by the success path below (set add/membership is
@@ -245,7 +334,13 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
     counters = obs_metrics.Counters()
     _, ops_srv, wd = dcore.start_ops(
         cfg, run_id, "stream", chips_total=len(cids), counters=counters,
-        run_block=run_block, quarantine=quarantine, breaker=breaker)
+        run_block=run_block, quarantine=quarantine, breaker=breaker,
+        alerts=(None if alog is None else lambda: dict(
+            alog.status(),
+            run={k: summary[k] for k in ("alerts_emitted",
+                                         "alerts_deduped",
+                                         "pixels_need_batch",
+                                         "repair_jobs_enqueued")})))
     tracer = tracing.start(run_id=run_id) \
         if tracing.wants_trace(cfg.trace) else None
     counters.start()   # rate clock from first productive work, not setup
@@ -353,6 +448,7 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
         obs_server.set_stage("update")
 
         def update_one(cid) -> None:
+            t_seen = time.monotonic()   # the freshness-SLO clock start
             path = _state_path(sdir, cid)
             st, side = load_state(path)
             horizon = float(side["horizon"])
@@ -370,6 +466,10 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                 t = p.dates[0][:T].astype(np.float64)
                 new_idx = np.nonzero(t > horizon)[0]
                 anchor = float(side["anchor"])
+                # Pre-update break snapshot: the 0→>0 transition against
+                # it is what emits alerts (host copy, immune to whatever
+                # the step loop does to the state's buffers).
+                bday0 = np.array(np.asarray(st.break_day), np.float64)
                 for ti in new_idx:
                     x_row = jnp.asarray(
                         incremental.design_row(float(t[ti]), anchor))
@@ -380,6 +480,25 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                                           float(t[ti]), sensor=p.sensor)
                 if new_idx.size:
                     side = dict(side, horizon=np.float64(t[-1]))
+                    # Alert BEFORE the checkpoint saves: a crash in the
+                    # window between them re-applies this delta on
+                    # resume and the (pixel, break_day) dedup absorbs
+                    # the re-emission — the reverse order would LOSE the
+                    # alert (horizon advanced, delta never re-fetched).
+                    if alog is not None:
+                        recs = _new_break_records(p, st, bday0, anchor)
+                        if recs:
+                            with tracing.span("alert", chip=tuple(cid),
+                                              alerts=len(recs)):
+                                ins, dup = alog.append(recs, run_id=run_id)
+                            obs_metrics.histogram(
+                                "alert_visible_seconds",
+                                help="stream-update ingest start to "
+                                     "durable alert commit (the "
+                                     "alert_freshness SLO feed)").observe(
+                                time.monotonic() - t_seen)
+                            summary["alerts_emitted"] += ins
+                            summary["alerts_deduped"] += dup
                     with tracing.span("publish", chip=tuple(cid)), \
                             obs_metrics.timer() as tm:
                         writer.write("segment", publish_frame(p, st, side),
@@ -389,8 +508,10 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                         "stream_publish_seconds").observe(tm.elapsed)
                     summary["updated"] += 1
                     summary["obs_applied"] += int(new_idx.size)
-            summary["pixels_need_batch"] += int(
-                np.asarray(st.needs_batch).sum())
+            n_need = int(np.asarray(st.needs_batch).sum())
+            summary["pixels_need_batch"] += n_need
+            if n_need:
+                needs_by_chip[tuple(int(v) for v in cid)] = n_need
             counters.add("chips")
             if tuple(int(v) for v in cid) not in failed_cids:
                 quarantine.discard(cid)
@@ -406,11 +527,32 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
             # Per-chip progress beat: updates are host-cheap, so the
             # watchdog's liveness unit here is a processed chip.
             obs_server.batch_done(1)
+        # Cold-path repair scheduling (alerts/repair.py): the flagged
+        # pixels become idempotent fleet jobs — at most one open job per
+        # chip — instead of a count an operator has to act on.  A
+        # scheduling failure degrades to the count-only summary.
+        obs_metrics.gauge(
+            "repair_pixels_pending",
+            help="pixels flagged needs_batch awaiting a cold-path "
+                 "repair").set(sum(needs_by_chip.values()))
+        # Independent of the alert LOG: FIREBIRD_ALERTS=0 darkens the
+        # feed, not the cold-path repair loop (docs/ALERTS.md knobs).
+        if cfg.alert_repair and needs_by_chip:
+            try:
+                jids = alerts_repair.schedule_repairs(
+                    cfg, needs_by_chip, acquired=acquired, run_id=run_id)
+                summary["repair_jobs_enqueued"] = len(jids)
+            except Exception as e:
+                log.error("repair scheduling failed (%s: %s) — "
+                          "needs_batch debt stays count-only",
+                          type(e).__name__, e)
         obs_server.set_stage("flush")
         writer.flush()
     finally:
         obs_server.set_stage("finalize")
         writer.close()
+        if alog is not None:
+            alog.close()
         if warm is not None:       # collect warm-compile counters if done
             warm.join(timeout=5.0)
         summary["quarantined"] = len(quarantine)

@@ -6,9 +6,10 @@ scheduling" is the operator story; tools/fleet_chaos.py is the proof."""
 from firebird_tpu.fleet.queue import (FencedStore, FleetQueue, Lease,
                                       LeaseLost, StaleFence, queue_path)
 from firebird_tpu.fleet.worker import FleetWorker, make_queue
-from firebird_tpu.fleet.plan import enqueue_tile_plan
+from firebird_tpu.fleet.plan import enqueue_repairs, enqueue_tile_plan
 
 __all__ = [
     "FencedStore", "FleetQueue", "Lease", "LeaseLost", "StaleFence",
-    "queue_path", "FleetWorker", "make_queue", "enqueue_tile_plan",
+    "queue_path", "FleetWorker", "make_queue", "enqueue_repairs",
+    "enqueue_tile_plan",
 ]

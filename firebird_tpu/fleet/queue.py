@@ -57,7 +57,7 @@ QUEUE_SCHEMA = "firebird-fleet-queue/1"
 PENDING, LEASED, DONE, DEAD = "pending", "leased", "done", "dead"
 STATES = (PENDING, LEASED, DONE, DEAD)
 
-JOB_TYPES = ("detect", "stream", "classify", "product")
+JOB_TYPES = ("detect", "stream", "classify", "product", "repair")
 
 # Exception text kept in job history is for diagnosis, not a log archive
 # (the quarantine.py discipline).
@@ -549,6 +549,68 @@ class FleetQueue:
                 raise
         return (ready is None and int(rows.get(LEASED, 0)) == 0
                 and int(rows.get(PENDING, 0)) > 0)
+
+    def enqueue_unique_chip(self, job_type: str, payload: dict, *,
+                            max_attempts: int = 3) -> int | None:
+        """Enqueue a chip-keyed job ONLY if no open (pending/leased) job
+        of ``job_type`` already names the same (cx, cy) — the check and
+        the insert in ONE transaction, so two schedulers racing (a
+        zombie stream worker and its successor both reaching end-of-run
+        repair scheduling) cannot both slip past a read-then-insert
+        window.  Returns the new job id, or None when an open job
+        already covers the chip."""
+        if job_type not in JOB_TYPES:
+            raise ValueError(
+                f"job_type must be one of {JOB_TYPES}, got {job_type!r}")
+        if max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {max_attempts}")
+        chip = (int(payload["cx"]), int(payload["cy"]))
+        now = self._clock()
+        jid = None
+        with self._lock:
+            con = self._con
+            con.execute("BEGIN IMMEDIATE")
+            try:
+                rows = con.execute(
+                    "SELECT payload FROM jobs WHERE job_type = ? AND "
+                    "state IN ('pending', 'leased')",
+                    (job_type,)).fetchall()
+                taken = any(
+                    (int(p.get("cx", 1 << 62)), int(p.get("cy", 1 << 62)))
+                    == chip for (p,) in
+                    ((json.loads(r[0]),) for r in rows))
+                if not taken:
+                    cur = con.execute(
+                        "INSERT INTO jobs (job_type, payload, state, "
+                        "max_attempts, history, created, updated) VALUES "
+                        "(?, ?, 'pending', ?, ?, ?, ?)",
+                        (job_type, json.dumps(payload), int(max_attempts),
+                         json.dumps([{"event": "enqueued",
+                                      "at": _now_iso()}]), now, now))
+                    jid = int(cur.lastrowid)
+                con.execute("COMMIT")
+            except BaseException:
+                con.execute("ROLLBACK")
+                raise
+        return jid
+
+    def open_jobs(self, job_type: str) -> dict:
+        """{(cx, cy): job_id} of OPEN (pending or leased) jobs of
+        ``job_type`` whose payload names a chip — the idempotence index
+        behind repair scheduling: a chip with an open repair job is not
+        re-enqueued, while a done/dead one may be (a re-broken pixel is
+        a new debt, not a duplicate)."""
+        with self._lock:
+            rows = self._con.execute(
+                "SELECT id, payload FROM jobs WHERE job_type = ? AND "
+                "state IN ('pending', 'leased')", (job_type,)).fetchall()
+        out: dict = {}
+        for jid, payload in rows:
+            p = json.loads(payload)
+            if "cx" in p and "cy" in p:
+                out[(int(p["cx"]), int(p["cy"]))] = int(jid)
+        return out
 
     def job(self, job_id: int) -> dict | None:
         """One job's full record (payload + history), for inspection."""

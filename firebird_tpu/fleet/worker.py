@@ -92,6 +92,7 @@ class FleetWorker:
             "stream": self._run_stream,
             "classify": self._run_classify,
             "product": self._run_product,
+            "repair": self._run_repair,
         }
         self.counters = Counters()
         # Worker-local tallies: the obs registry resets when a job runs
@@ -363,6 +364,33 @@ class FleetWorker:
                 number=payload.get("number"))
         finally:
             writer.close()
+            raw.close()
+
+    def _run_repair(self, payload: dict, lease: Lease) -> None:
+        """Cold-path repair of one needs_batch chip (alerts/repair.py):
+        batch re-detection + a fresh stream checkpoint, BOTH outputs
+        fenced — store rows through FencedStore, the checkpoint .npz
+        through a fence check right before its atomic save, so a zombie
+        whose lease lapsed cannot rewind a successor's (or a live
+        stream's) checkpoint.  Idempotent by construction — a
+        re-delivered repair recomputes the same deterministic result
+        over the same acquired range."""
+        from firebird_tpu.alerts import repair as repairlib
+
+        def fence_guard() -> None:
+            if not self.queue.fence_valid(lease.job_id, lease.fence):
+                self.queue.record_fence_reject(lease, op="write")
+                raise StaleFence(
+                    f"repair checkpoint save rejected: job "
+                    f"{lease.job_id} fence {lease.fence} is stale")
+
+        raw, fenced = self._fenced_store(lease)
+        try:
+            repairlib.repair_chip(
+                self.cfg, (payload["cx"], payload["cy"]),
+                payload["acquired"], store=fenced,
+                fence_guard=fence_guard)
+        finally:
             raw.close()
 
     def _run_product(self, payload: dict, lease: Lease) -> None:

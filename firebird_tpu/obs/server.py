@@ -56,7 +56,7 @@ class RunStatus:
                  counters=None, watchdog=None, run: dict | None = None,
                  mesh_up: bool = True, pipeline_depth: int = 2,
                  quarantine=None, breaker=None, profiler=None,
-                 slo_spec: str | None = None, fleet=None):
+                 slo_spec: str | None = None, fleet=None, alerts=None):
         self.run_id = run_id
         self.kind = kind
         self.chips_total = int(chips_total)
@@ -75,6 +75,10 @@ class RunStatus:
         # a zero-arg callable returning the queue/worker snapshot dict
         # rendered as /progress's "fleet" block; None for non-fleet runs.
         self.fleet = fleet
+        # Alerts view provider (the stream driver passes a zero-arg
+        # callable over its AlertLog.status): /progress's "alerts"
+        # block; None for runs without an alert log.
+        self.alerts = alerts
         self.run = dict(run or {})
         self.pipeline_depth = max(int(pipeline_depth), 1)
         self._lock = threading.Lock()
@@ -225,9 +229,22 @@ class RunStatus:
             "counters": counters,
             "degraded": self.degraded_block(),
             "fleet": self._fleet_block(),
+            "alerts": self._alerts_block(),
             "watchdog": (self.watchdog.snapshot()
                          if self.watchdog is not None else None),
         }
+
+    def _alerts_block(self) -> dict | None:
+        """The /progress 'alerts' sub-document: alert-log depth, latest
+        cursor, per-subscriber delivery lag, plus this run's emission
+        tallies (docs/ALERTS.md).  None for runs without an alert log; a
+        snapshot failure degrades this block, never /progress itself."""
+        if self.alerts is None:
+            return None
+        try:
+            return self.alerts()
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
 
     def _fleet_block(self) -> dict | None:
         """The /progress 'fleet' sub-document: queue depths by type and

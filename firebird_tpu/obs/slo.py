@@ -31,7 +31,8 @@ that never served a request.  ``FIREBIRD_SLO=0`` disables evaluation.
 
 from __future__ import annotations
 
-DEFAULT_SPEC = "batch_p95=30;serve_p99=2;freshness=600"
+DEFAULT_SPEC = ("batch_p95=30;serve_p99=2;freshness=600;"
+                "alert_freshness=60")
 
 # name -> (kind, metric/field, stat, description)
 OBJECTIVES = {
@@ -41,6 +42,14 @@ OBJECTIVES = {
                   "serve /v1 request seconds (admission wait incl., p99)"),
     "freshness": ("watchdog", "last_beat_age_sec", None,
                   "seconds since the last drained batch"),
+    # The alerting-grade promise (docs/ALERTS.md): a new acquisition's
+    # confirmed break is VISIBLE on the alert feed within the target —
+    # measured from the stream's per-chip ingest start to the durable
+    # alert-log commit (the record is feed-servable the instant it
+    # commits; alert_visible_seconds in driver/stream.py).
+    "alert_freshness": ("histogram", "alert_visible_seconds", "p95",
+                        "acquisition ingest -> alert-visible seconds "
+                        "(stream update start to durable commit, p95)"),
 }
 
 

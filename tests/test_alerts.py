@@ -1,0 +1,442 @@
+"""Near-real-time alerting: log, feed, webhooks, SSE, repair (docs/ALERTS.md).
+
+Pure-unit coverage of the alerting loop's parts; the streaming driver's
+end-to-end emission rides the existing stream-driver fixture
+(test_stream_driver.py) and the chaos proof is `make alert-smoke`.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from firebird_tpu.alerts.feed import AlertFeed, WebhookDeliverer, parse_bbox
+from firebird_tpu.alerts.log import AlertLog
+from firebird_tpu.config import Config
+from firebird_tpu.utils import dates as dt
+
+
+def rec(px, py, day, **kw):
+    return dict({"cx": 100, "cy": 200, "px": px, "py": py,
+                 "break_day": float(day)}, **kw)
+
+
+@pytest.fixture
+def alog(tmp_path):
+    al = AlertLog(str(tmp_path / "alerts.db"))
+    yield al
+    al.close()
+
+
+# ---------------------------------------------------------------------------
+# the durable log
+# ---------------------------------------------------------------------------
+
+def test_append_dedupe_and_cursor(alog):
+    ins, dup = alog.append([rec(1, 2, 728000), rec(3, 4, 728000)],
+                           run_id="r1")
+    assert (ins, dup) == (2, 0)
+    # re-delivery of the same logical alerts: exactly-once
+    ins, dup = alog.append([rec(1, 2, 728000), rec(5, 6, 728016)])
+    assert (ins, dup) == (1, 1)
+    assert alog.count() == 3
+    # cursor resume: strictly increasing ids (gaps allowed — a deduped
+    # insert may burn a rowid), no misses, no re-reads
+    page = alog.since(0, limit=2)
+    assert len(page) == 2 and page[0]["id"] < page[1]["id"]
+    rest = alog.since(page[-1]["id"])
+    assert len(rest) == 1 and rest[0]["id"] > page[-1]["id"]
+    assert rest[0]["id"] == alog.latest_cursor()
+    assert alog.since(alog.latest_cursor()) == []
+
+
+def test_rebreak_same_pixel_new_day_is_new_alert(alog):
+    """The satellite edge: a repaired pixel whose tail breaks AGAIN
+    must emit a second alert under the new break_day — dedup is on
+    (pixel, break_day), not on pixel."""
+    assert alog.append([rec(7, 8, 728000)]) == (1, 0)
+    # repair lands, tail breaks again later: NEW key, second alert
+    assert alog.append([rec(7, 8, 728200)]) == (1, 0)
+    # the original day stays a duplicate forever
+    assert alog.append([rec(7, 8, 728000)]) == (0, 1)
+    days = [r["break_day"] for r in alog.since(0)
+            if (r["px"], r["py"]) == (7, 8)]
+    assert days == [728000.0, 728200.0]
+
+
+def test_since_filters(alog):
+    alog.append([rec(100, 200, dt.to_ordinal("1999-06-01")),
+                 rec(130, 170, dt.to_ordinal("2000-06-01")),
+                 rec(900, 900, dt.to_ordinal("1999-06-01"))])
+    got = alog.since(0, bbox=(90, 150, 150, 210))
+    assert {(r["px"], r["py"]) for r in got} == {(100, 200), (130, 170)}
+    got = alog.since(0, t0="2000-01-01")
+    assert [r["px"] for r in got] == [130]
+    got = alog.since(0, t1="1999-12-31")
+    assert {r["px"] for r in got} == {100, 900}
+    assert got[0]["break_date"] == "1999-06-01"
+
+
+def test_subscribers_idempotent_and_monotonic(alog):
+    sid = alog.subscribe("http://h/hook")
+    assert alog.subscribe("http://h/hook") == sid   # idempotent on url
+    alog.append([rec(1, 1, 1000), rec(2, 2, 1000)])
+    assert alog.subscribers()[0]["lag"] == 2
+    alog.advance(sid, 2)
+    assert alog.subscribers()[0] == dict(alog.subscribers()[0], cursor=2,
+                                         lag=0)
+    alog.advance(sid, 1)                            # rewind rejected
+    assert alog.subscribers()[0]["cursor"] == 2
+    with pytest.raises(ValueError):
+        alog.subscribe("not-a-url")
+
+
+def test_status_and_parse_bbox(alog):
+    alog.append([rec(1, 1, 1000)])
+    alog.subscribe("http://h/hook")
+    s = alog.status()
+    assert s["depth"] == 1 and s["latest_cursor"] == 1
+    assert s["subscribers"][0]["lag"] == 1
+    assert parse_bbox("1,2,3.5,4") == (1.0, 2.0, 3.5, 4.0)
+    with pytest.raises(ValueError):
+        parse_bbox("1,2,3")
+
+
+# ---------------------------------------------------------------------------
+# webhook delivery: durable cursor, retries, catch-up
+# ---------------------------------------------------------------------------
+
+def test_webhook_delivery_cursor_catchup(alog):
+    cfg = Config(store_backend="memory")
+    alog.append([rec(i, i, 1000 + i) for i in range(10)])
+    sid = alog.subscribe("http://h/hook")
+    got = []
+
+    def post(url, body, timeout):
+        got.append(json.loads(body))
+        return 200
+
+    d1 = WebhookDeliverer(alog, cfg, post=post, sleep=lambda s: None)
+    assert d1.deliver_once(batch=4, max_batches=1) == 4   # partial, "dies"
+    assert alog.subscribers()[0]["cursor"] == 4           # durable
+    # a fresh incarnation resumes from the cursor: remainder only
+    d2 = WebhookDeliverer(alog, cfg, post=post, sleep=lambda s: None)
+    assert d2.deliver_once(batch=4) == 6
+    ids = [a["id"] for doc in got for a in doc["alerts"]]
+    assert ids == list(range(1, 11))                      # exactly once
+    assert alog.subscribers()[0]["lag"] == 0
+    # new alerts after catch-up deliver incrementally
+    alog.append([rec(99, 99, 2000)])
+    assert d2.deliver_once() == 1
+    assert got[-1]["alerts"][0]["px"] == 99
+    assert sid == 1
+
+
+def test_webhook_failure_holds_cursor(alog):
+    cfg = Config(store_backend="memory", fetch_retries=1)
+    alog.append([rec(i, i, 1000 + i) for i in range(3)])
+    alog.subscribe("http://dead/hook")
+    calls = []
+
+    def post(url, body, timeout):
+        calls.append(url)
+        raise OSError("connection refused")
+
+    d = WebhookDeliverer(alog, cfg, post=post, sleep=lambda s: None)
+    assert d.deliver_once() == 0
+    assert len(calls) == 2                  # 1 + fetch_retries attempts
+    sub = alog.subscribers()[0]
+    assert sub["cursor"] == 0 and sub["failures"] == 1
+    # receiver heals: the held batch redelivers in full
+    d._post = lambda url, body, timeout: 200
+    assert d.deliver_once() == 3
+    assert alog.subscribers()[0]["lag"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the serve mount: pull, SSE, webhook registration
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def served(tmp_path):
+    from firebird_tpu.serve import api as serve_api
+    from firebird_tpu.store import open_store
+
+    cfg = Config(store_backend="memory", serve_deadline_sec=5.0)
+    store = open_store("memory", "", cfg.keyspace())
+    alog = AlertLog(str(tmp_path / "alerts.db"))
+    alog.append([rec(100 + i, 200 - i, 728000 + 16 * i, score=1.0,
+                     magnitude=2.5) for i in range(5)], run_id="t")
+    service = serve_api.ServeService(store, cfg,
+                                     alerts=AlertFeed(alog, cfg))
+    srv = serve_api.start_serve_server(0, service, host="127.0.0.1")
+    yield f"http://127.0.0.1:{srv.port}", alog
+    srv.close()
+    alog.close()
+    store.close()
+
+
+def _get(url):
+    r = urllib.request.urlopen(url, timeout=10)
+    return r.status, json.loads(r.read())
+
+
+def test_alerts_pull_endpoint(served):
+    base, _ = served
+    code, doc = _get(base + "/v1/alerts?since=0")
+    assert code == 200 and len(doc["alerts"]) == 5
+    assert doc["cursor"] == doc["latest"] == 5
+    a = doc["alerts"][0]
+    assert a["px"] == 100 and a["break_date"] == dt.to_iso(728000)
+    # cursor paging
+    code, doc = _get(base + "/v1/alerts?since=3")
+    assert [r["id"] for r in doc["alerts"]] == [4, 5]
+    # bbox + time filters are servable
+    code, doc = _get(base + "/v1/alerts?since=0&bbox=100,199,101,200")
+    assert {r["px"] for r in doc["alerts"]} == {100, 101}
+    code, doc = _get(base + "/v1/alerts?since=0&t1="
+                     + dt.to_iso(728000 + 16))
+    assert len(doc["alerts"]) == 2
+    # malformed bbox / dates are a 400, not a 500 (and on the SSE path
+    # a bad date must be rejected BEFORE stream headers go out)
+    for bad in ("/v1/alerts?since=0&bbox=1,2",
+                "/v1/alerts?since=0&t0=garbage",
+                "/v1/alerts/stream?since=0&t1=garbage"):
+        try:
+            urllib.request.urlopen(base + bad, timeout=10)
+            assert False, f"expected 400 for {bad}"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+
+def test_alerts_404_without_log(tmp_path):
+    from firebird_tpu.serve import api as serve_api
+    from firebird_tpu.store import open_store
+
+    cfg = Config(store_backend="memory")
+    store = open_store("memory", "", cfg.keyspace())
+    service = serve_api.ServeService(store, cfg)     # alerts=None
+    srv = serve_api.start_serve_server(0, service, host="127.0.0.1")
+    try:
+        for path in ("/v1/alerts?since=0", "/v1/alerts/webhooks"):
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{path}", timeout=10)
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+    finally:
+        srv.close()
+        store.close()
+
+
+def test_webhook_registration_endpoint(served):
+    base, alog = served
+    req = urllib.request.Request(
+        base + "/v1/alerts/webhooks?url=http://h/hook&since=2",
+        method="POST")
+    code, doc = (lambda r: (r.status, json.loads(r.read())))(
+        urllib.request.urlopen(req, timeout=10))
+    assert code == 200 and doc["latest"] == 5
+    # idempotent re-registration keeps the durable cursor
+    urllib.request.urlopen(urllib.request.Request(
+        base + "/v1/alerts/webhooks?url=http://h/hook", method="POST"),
+        timeout=10)
+    code, doc = _get(base + "/v1/alerts/webhooks")
+    assert len(doc["subscribers"]) == 1
+    assert doc["subscribers"][0]["cursor"] == 2
+    assert doc["subscribers"][0]["lag"] == 3
+
+
+def test_sse_stream_replay_and_live(served):
+    base, alog = served
+    r = urllib.request.urlopen(base + "/v1/alerts/stream?since=0",
+                               timeout=10)
+    assert r.headers["Content-Type"] == "text/event-stream"
+    events, ids = [], []
+    # live append mid-session from another thread
+    threading.Timer(0.1, lambda: alog.append([rec(999, 999, 730000)])).start()
+    while len(events) < 6:
+        line = r.readline()
+        assert line, "server closed before all events arrived"
+        if line.startswith(b"data:"):
+            events.append(json.loads(line[5:].strip()))
+        elif line.startswith(b"id:"):
+            ids.append(int(line[3:].strip()))
+    r.close()
+    assert [e["id"] for e in events] == [1, 2, 3, 4, 5, 6]
+    assert ids == [1, 2, 3, 4, 5, 6]       # SSE id: == cursor, resumable
+    assert events[-1]["px"] == 999         # the live one arrived too
+    # resume from the last seen cursor: only what follows
+    r = urllib.request.urlopen(base + "/v1/alerts/stream?since=5",
+                               timeout=10)
+    line = b""
+    while not line.startswith(b"data:"):
+        line = r.readline()
+    r.close()
+    assert json.loads(line[5:].strip())["id"] == 6
+
+
+# ---------------------------------------------------------------------------
+# repair scheduling: at most one open job per chip
+# ---------------------------------------------------------------------------
+
+def test_enqueue_repairs_idempotent(tmp_path):
+    from firebird_tpu.fleet.plan import enqueue_repairs
+    from firebird_tpu.fleet.queue import FleetQueue
+
+    q = FleetQueue(str(tmp_path / "fleet.db"))
+    try:
+        ids = enqueue_repairs(q, {(100, 200): 50, (400, 200): 7},
+                              acquired="1995-01-01/2000-12-31")
+        assert len(ids) == 2
+        job = q.job(ids[0])
+        assert job["job_type"] == "repair" and job["payload"]["pixels"] == 50
+        # the same debt re-rolled: both chips have OPEN jobs -> no dupes
+        assert enqueue_repairs(q, {(100, 200): 50, (400, 200): 7},
+                               acquired="x") == []
+        # a LEASED job still counts as open
+        lease = q.claim("w1")
+        assert enqueue_repairs(q, {(lease.payload["cx"],
+                                    lease.payload["cy"]): 50},
+                               acquired="x") == []
+        # once the repair lands, a NEW break may re-enqueue the chip
+        q.ack(lease)
+        again = enqueue_repairs(
+            q, {(lease.payload["cx"], lease.payload["cy"]): 3},
+            acquired="x")
+        assert len(again) == 1
+        assert q.open_jobs("repair") != {}
+    finally:
+        q.close()
+
+
+def test_schedule_repairs_memory_backend_degrades(tmp_path):
+    from firebird_tpu.alerts.repair import schedule_repairs
+
+    cfg = Config(store_backend="memory")      # no queue location
+    assert schedule_repairs(cfg, {(1, 2): 3},
+                            acquired="1995-01-01/2000-12-31") == []
+    cfg = Config(store_backend="sqlite",
+                 store_path=str(tmp_path / "s.db"))
+    jids = schedule_repairs(cfg, {(1, 2): 3},
+                            acquired="1995-01-01/2000-12-31")
+    assert len(jids) == 1
+    assert schedule_repairs(cfg, {(1, 2): 3}, acquired="x") == []
+
+
+# ---------------------------------------------------------------------------
+# incremental re-break: two breaks, two distinct alert keys
+# ---------------------------------------------------------------------------
+
+def test_incremental_rebreak_emits_second_key(alog):
+    import jax.numpy as jnp
+
+    from firebird_tpu.ccd import incremental, params
+
+    P, B = 1, 7
+
+    def fresh_state():
+        return incremental.StreamState(
+            coefs=jnp.zeros((P, B, 8), jnp.float32),
+            rmse=jnp.ones((P, B), jnp.float32),
+            vario=jnp.ones((P, B), jnp.float32),
+            nobs=jnp.full(P, 20, jnp.int32),
+            n_exceed=jnp.zeros(P, jnp.int32),
+            end_day=jnp.full(P, 727990.0, jnp.float32),
+            exceed_day0=jnp.zeros(P, jnp.float32),
+            break_day=jnp.zeros(P, jnp.float32),
+            active=jnp.ones(P, bool))
+
+    def drive_to_break(st, day0):
+        for k in range(params.PEEK_SIZE):
+            day = day0 + 16 * k
+            st = incremental.step(
+                st, jnp.asarray(incremental.design_row(day, 727000.0)),
+                jnp.full((P, B), 5000.0, jnp.float32),
+                jnp.full(P, 1 << params.QA_CLEAR_BIT, jnp.int32),
+                float(day))
+        return st
+
+    st = drive_to_break(fresh_state(), 728000.0)
+    b1 = float(np.asarray(st.break_day)[0])
+    assert b1 == 728000.0                    # dated at the first exceed
+    assert alog.append([rec(10, 20, b1)]) == (1, 0)
+    # repair reseeds the state (break_day cleared), the tail breaks
+    # again LATER: a new break_day, a new alert — not swallowed by dedup
+    st2 = drive_to_break(fresh_state(), 728300.0)
+    b2 = float(np.asarray(st2.break_day)[0])
+    assert b2 == 728300.0 and b2 != b1
+    assert alog.append([rec(10, 20, b2)]) == (1, 0)
+    # while a re-emission of either break stays exactly-once
+    assert alog.append([rec(10, 20, b1), rec(10, 20, b2)]) == (0, 2)
+
+
+# ---------------------------------------------------------------------------
+# the freshness SLO leg
+# ---------------------------------------------------------------------------
+
+def test_alert_freshness_objective():
+    from firebird_tpu.obs import slo as slomod
+
+    metrics = {"histograms": {"alert_visible_seconds":
+                              {"count": 4, "p95": 12.5}}}
+    out = slomod.evaluate_snapshot(metrics, spec="alert_freshness=60")
+    (obj,) = out["objectives"]
+    assert obj["name"] == "alert_freshness" and obj["ok"] is True
+    assert obj["value_sec"] == 12.5
+    out = slomod.evaluate_snapshot(metrics, spec="alert_freshness=5")
+    assert out["ok"] is False and out["violations"] == 1
+    # default spec carries the leg; no data neither passes nor fails
+    out = slomod.evaluate_snapshot({"histograms": {}})
+    by = {o["name"]: o for o in out["objectives"]}
+    assert by["alert_freshness"]["ok"] is None
+    assert Config(slo="alert_freshness=30").slo    # validates at construction
+
+
+# ---------------------------------------------------------------------------
+# operator surface: firebird status alerts view
+# ---------------------------------------------------------------------------
+
+def test_status_alerts_view(tmp_path):
+    from click.testing import CliRunner
+
+    from firebird_tpu import cli
+    from firebird_tpu.alerts.log import alert_db_path
+    from firebird_tpu.fleet.plan import enqueue_repairs
+    from firebird_tpu.fleet.queue import FleetQueue
+
+    env = {"FIREBIRD_STORE_BACKEND": "sqlite",
+           "FIREBIRD_STORE_PATH": str(tmp_path / "s.db")}
+    cfg = Config.from_env(env=env)
+    # seed a store file, an alert log with a lagging subscriber, and an
+    # open repair job on the fleet queue next to it
+    from firebird_tpu.store import open_store
+
+    open_store("sqlite", cfg.store_path, cfg.keyspace()).close()
+    al = AlertLog(alert_db_path(cfg))
+    al.append([rec(1, 1, 728000), rec(2, 2, 728000)])
+    al.subscribe("http://h/hook")
+    al.close()
+    q = FleetQueue(str(tmp_path / "fleet.db"))
+    enqueue_repairs(q, {(100, 200): 9}, acquired="a")
+    q.close()
+    env["FIREBIRD_FLEET_DB"] = str(tmp_path / "fleet.db")
+    res = CliRunner().invoke(cli.entrypoint, ["status"], env=env)
+    assert res.exit_code == 0, res.output
+    out = json.loads(res.output)
+    assert out["alerts"]["depth"] == 2
+    assert out["alerts"]["latest_cursor"] == 2
+    assert out["alerts"]["subscribers"][0]["lag"] == 2
+    assert out["alerts"]["open_repair_jobs"] == 1
+
+    # a corrupt alert db degrades the section, not the command
+    with open(alert_db_path(cfg), "wb") as f:
+        f.write(b"not a database")
+    res = CliRunner().invoke(cli.entrypoint, ["status"], env=env)
+    assert res.exit_code == 0, res.output
+    out = json.loads(res.output)
+    assert "error" in out["alerts"]
+    assert out["tables"] is not None       # the store view survived

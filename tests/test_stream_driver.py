@@ -95,6 +95,52 @@ def test_published_rows_reflect_stream(runs):
     assert (bday[broke] <= "1999-07-01").all()
 
 
+def test_alerts_emitted_exactly_once_and_repair_scheduled(runs):
+    """The alerting loop over the same runs: the update pass that
+    confirmed the step change must emit one durable alert per broken
+    pixel (docs/ALERTS.md), the no-op rerun must emit nothing (and
+    dedup nothing — no delta means no re-emission), and the needs_batch
+    debt must be exactly ONE open repair job on the fleet queue."""
+    from firebird_tpu.alerts import AlertLog, alert_db_path
+    from firebird_tpu.fleet import FleetQueue, queue_path
+
+    cfg, s1, s2, s3, _ = runs
+    assert s1["alerts_emitted"] == 0            # bootstrap never alerts
+    assert s2["alerts_emitted"] >= 9000
+    assert s2["alerts_deduped"] == 0
+    assert s3["alerts_emitted"] == 0 and s3["alerts_deduped"] == 0
+    al = AlertLog(alert_db_path(cfg))
+    try:
+        assert al.count() == s2["alerts_emitted"]
+        recs = al.since(0, limit=10)
+    finally:
+        al.close()
+    from firebird_tpu.ingest.packer import CHIP_SIDE, PIXEL_SIZE_M
+
+    side_m = CHIP_SIDE * PIXEL_SIZE_M
+    for r in recs:
+        # dated like the published bday rows: the first exceeding 1999
+        # acquisition, scored at confirmation, with a live magnitude,
+        # and pixel coords landing inside the record's own chip
+        assert r["break_date"].startswith("1999")
+        assert r["score"] == 1.0
+        assert r["magnitude"] > 1.0
+        assert r["cx"] <= r["px"] < r["cx"] + side_m
+        assert r["cy"] - side_m < r["py"] <= r["cy"]
+    # one open repair job for the one broken chip — scheduled by s2,
+    # skipped (not duplicated) by s3's re-roll of the same debt
+    assert s2["repair_jobs_enqueued"] == 1
+    assert s3["repair_jobs_enqueued"] == 0
+    q = FleetQueue(queue_path(cfg))
+    try:
+        assert q.counts()["pending"] == 1
+        (job_cid,) = q.open_jobs("repair")
+        job = q.job(q.open_jobs("repair")[job_cid])
+        assert job["payload"]["pixels"] == s3["pixels_need_batch"]
+    finally:
+        q.close()
+
+
 def test_checkpoint_roundtrip(tmp_path):
     import jax.numpy as jnp
 

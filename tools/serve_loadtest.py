@@ -23,6 +23,14 @@ Usage (standalone):
 The first --hot N paths form the hot set hit with probability
 --hot-frac; the rest are the cold tail.  ``run_loadtest`` is importable
 (tools/serve_smoke.py drives it in-process).
+
+The alerts scenario (--sse N): while the closed-loop workers drive the
+request paths (include ``/v1/alerts?since=0`` among them for the
+cursor-poll half), N side threads each hold one ``/v1/alerts/stream``
+SSE subscription open for the duration of the run and count the events
+and keep-alive comments they receive — so the artifact carries the
+alert feed's RPS/percentiles next to the other endpoints plus an
+``sse`` block proving the push path delivered under load.
 """
 
 from __future__ import annotations
@@ -69,12 +77,78 @@ def _scrape_cache_counters(base_url: str, timeout: float) -> tuple[int, int]:
     return out[0], out[1]
 
 
+class _SseSubscriber(threading.Thread):
+    """One long-lived /v1/alerts/stream subscription: reads SSE lines
+    until the server closes its window or :meth:`close` cuts the
+    connection, counting events and keep-alive comments.
+
+    Reads are BLOCKING on purpose — a socket timeout mid-read leaves
+    CPython's buffered HTTPResponse in an undefined state (readline
+    never returns data again, silently), so polling with short
+    timeouts "works" only when events outrace the first timeout.  The
+    server's 250 ms keep-alive comments bound each blocking read, and
+    the main thread ends the session by closing the response."""
+
+    def __init__(self, base_url: str, path: str, timeout: float):
+        super().__init__(daemon=True)
+        self.url = base_url + path
+        self.timeout = timeout
+        self.events = 0
+        self.comments = 0
+        self.error: str | None = None
+        self._resp = None
+        self._closed = False
+
+    def run(self) -> None:
+        try:
+            r = urllib.request.urlopen(self.url, timeout=self.timeout)
+        except (OSError, urllib.error.URLError) as e:
+            self.error = f"connect: {e}"
+            return
+        self._resp = r
+        try:
+            while True:
+                line = r.readline()
+                if not line:
+                    return             # server closed its window
+                if line.startswith(b"data:"):
+                    self.events += 1
+                elif line.startswith(b":"):
+                    self.comments += 1
+        except (OSError, ValueError) as e:
+            # close() cutting the session is the normal end; anything
+            # else (incl. the socket timeout — the server keeps the
+            # stream warm with 250 ms keep-alives, so a silent gap this
+            # long means it stalled) is a recorded failure, not a
+            # silent undercount.
+            if not self._closed:
+                self.error = f"read: {type(e).__name__}: {e}"
+        finally:
+            try:
+                r.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        """End the subscription: closing the response unblocks the
+        reader thread's blocking readline."""
+        self._closed = True
+        r = self._resp
+        if r is not None:
+            try:
+                r.close()
+            except OSError:
+                pass
+
+
 def run_loadtest(base_url: str, paths: list[str], *, concurrency: int = 8,
                  requests: int = 200, hot: int = 1, hot_frac: float = 0.8,
                  seed: int = 0, timeout: float = 30.0,
-                 out_dir: str | None = None) -> dict:
+                 out_dir: str | None = None, sse: int = 0,
+                 sse_path: str = "/v1/alerts/stream?since=0") -> dict:
     """Drive ``requests`` total requests at ``concurrency`` and return
-    (and write) the artifact dict."""
+    (and write) the artifact dict.  ``sse`` > 0 additionally holds that
+    many live /v1/alerts/stream subscriptions open for the run."""
     if not paths:
         raise ValueError("loadtest needs at least one --path")
     hot = max(min(hot, len(paths)), 0)
@@ -115,6 +189,10 @@ def run_loadtest(base_url: str, paths: list[str], *, concurrency: int = 8,
                 latencies.append(dt)
                 status_counts[str(code)] = status_counts.get(str(code), 0) + 1
 
+    subscribers = [_SseSubscriber(base_url, sse_path, timeout)
+                   for _ in range(max(int(sse), 0))]
+    for s in subscribers:
+        s.start()
     t_start = time.monotonic()
     threads = [threading.Thread(target=worker, args=(i,), daemon=True)
                for i in range(max(int(concurrency), 1))]
@@ -123,6 +201,14 @@ def run_loadtest(base_url: str, paths: list[str], *, concurrency: int = 8,
     for t in threads:
         t.join()
     elapsed = max(time.monotonic() - t_start, 1e-9)
+    # A short drain window for in-flight pushes (a warm run can finish
+    # before the server stream thread flushes), then cut the sessions.
+    drain_until = time.monotonic() + 3.0
+    for s in subscribers:
+        s.join(timeout=max(drain_until - time.monotonic(), 0.1))
+    for s in subscribers:
+        s.close()
+        s.join(timeout=5)
 
     h1, m1 = _scrape_cache_counters(base_url, timeout)
     dh, dm = h1 - h0, m1 - m0
@@ -148,6 +234,13 @@ def run_loadtest(base_url: str, paths: list[str], *, concurrency: int = 8,
         "hit_rate": round(dh / (dh + dm), 4) if (dh + dm) > 0 else None,
         "status_counts": dict(sorted(status_counts.items())),
     }
+    if subscribers:
+        artifact["sse"] = {
+            "subscribers": len(subscribers),
+            "events": sum(s.events for s in subscribers),
+            "comments": sum(s.comments for s in subscribers),
+            "errors": [s.error for s in subscribers if s.error],
+        }
     out_dir = out_dir or env_knob("FIREBIRD_SERVE_DIR")
     os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, "serve_loadtest.json")
@@ -174,13 +267,19 @@ def main() -> int:
                     help="probability a request draws from the hot set")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--sse", type=int, default=0,
+                    help="hold this many live /v1/alerts/stream SSE "
+                         "subscriptions open for the run")
+    ap.add_argument("--sse-path", default="/v1/alerts/stream?since=0")
     args = ap.parse_args()
     artifact = run_loadtest(
         args.url.rstrip("/"), args.path, concurrency=args.concurrency,
         requests=args.requests, hot=args.hot, hot_frac=args.hot_frac,
-        seed=args.seed, timeout=args.timeout)
+        seed=args.seed, timeout=args.timeout, sse=args.sse,
+        sse_path=args.sse_path)
     print(json.dumps(artifact, indent=1))
-    return 0 if artifact["errors"] == 0 else 1
+    sse_errors = (artifact.get("sse") or {}).get("errors", [])
+    return 0 if artifact["errors"] == 0 and not sse_errors else 1
 
 
 if __name__ == "__main__":

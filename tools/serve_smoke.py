@@ -16,8 +16,10 @@ store, in five acts:
    the serve_product_computes obs counter);
 4. repeat requests prove serve_cache_hits > 0;
 5. the closed-loop loadtest (tools/serve_loadtest.py) runs a hot/cold
-   mix against the live server and its artifact carries RPS +
-   p50/p95/p99 + hit-rate, and bench.py's _serve_fold picks it up.
+   mix — including the /v1/alerts cursor poll and one live SSE
+   subscriber over a seeded alert log — against the live server and its
+   artifact carries RPS + p50/p95/p99 + hit-rate + the SSE event count,
+   and bench.py's _serve_fold picks it up.
 
 Exits non-zero on any violation.
 """
@@ -71,8 +73,18 @@ def main() -> int:
                               cloud_frac=0.1)
 
         # -- act 1: the write path feeds the store the serve layer reads --
+        from firebird_tpu.alerts import AlertFeed, AlertLog, alert_db_path
+
         store = open_store(cfg.store_backend, cfg.store_path, cfg.keyspace())
-        service = serve_api.ServeService(store, cfg)
+        # A small alert log next to the store so the alerts scenario
+        # (cursor poll + SSE subscriber) runs against real records.
+        alog = AlertLog(alert_db_path(cfg))
+        alog.append([{"cx": 100, "cy": 200, "px": 100 + 30 * i,
+                      "py": 200 - 30 * i, "break_day": 728000 + i,
+                      "score": 1.0, "magnitude": 3.5}
+                     for i in range(8)], run_id="serve-smoke")
+        service = serve_api.ServeService(store, cfg,
+                                         alerts=AlertFeed(alog, cfg))
         done = core.changedetection(x=100, y=200, acquired=ACQ, number=2,
                                     chunk_size=2, cfg=cfg, source=src,
                                     store=service.watched_store())
@@ -134,6 +146,11 @@ def main() -> int:
             if pix["products"]["seglength"] != want:
                 return fail(f"/v1/pixel seglength {pix['products']} != "
                             f"raster[{row},{col}]={want}")
+            code, body = get(base, "/v1/alerts?since=0")
+            alerts = json.loads(body)
+            if code != 200 or len(alerts["alerts"]) != 8 \
+                    or alerts["cursor"] != alerts["latest"]:
+                return fail(f"/v1/alerts: {code} {body!r}")
             bounds = "&".join(f"bounds={x},{y}" for x, y in cids)
             code, body = get(base, f"/v1/tile/seglength?{bounds}&date={DATE}"
                                    f"&format=npy")
@@ -170,14 +187,22 @@ def main() -> int:
                 [f"/v1/segments?cx={cx}&cy={cy}",
                  f"/v1/product/seglength?cx={cx}&cy={cy}&date={DATE}",
                  f"/v1/pixel?x={cx + 45}&y={cy - 45}&date={DATE}",
+                 "/v1/alerts?since=0",
                  cold],
-                concurrency=8, requests=200, hot=2, hot_frac=0.8, seed=7)
+                concurrency=8, requests=200, hot=2, hot_frac=0.8, seed=7,
+                sse=1)
             for k in ("rps", "p50_ms", "p95_ms", "p99_ms", "hit_rate"):
                 if artifact.get(k) is None:
                     return fail(f"loadtest artifact missing {k}: {artifact}")
             if artifact["errors"]:
                 return fail(f"loadtest saw {artifact['errors']} errors: "
                             f"{artifact['status_counts']}")
+            sse = artifact.get("sse") or {}
+            # since=0 replays the log to the live subscriber: all 8
+            # records must arrive over SSE during the load.
+            if sse.get("subscribers") != 1 or sse.get("events", 0) < 8 \
+                    or sse.get("errors"):
+                return fail(f"SSE alerts scenario: {sse}")
             import bench
             fold = bench._serve_fold()
             if "serve_loadtest" not in fold:
@@ -185,6 +210,7 @@ def main() -> int:
                             "loadtest artifact")
         finally:
             srv.close()
+            alog.close()
             store.close()
 
         print("serve-smoke OK: "
