@@ -11,7 +11,7 @@ ACQUIRED ?= 1982-01-01/2017-12-31
 
 .PHONY: install lint test bench obs-smoke pipeline-smoke chaos-smoke \
         fleet-smoke serve-smoke compact-smoke postmortem-smoke \
-        alert-smoke image db-up db-schema db-test db-down \
+        alert-smoke wire-smoke image db-up db-schema db-test db-down \
         changedetection classification clean
 
 install:
@@ -92,6 +92,14 @@ postmortem-smoke:
 # and wasted lane-rounds dropped at least 2x; artifact folded by bench.py.
 compact-smoke:
 	python tools/compact_smoke.py
+
+# Wire-diet regression probe (docs/ROOFLINE.md "Wire budget"): one
+# staged batch on CPU — asserts every staged ingress plane is integer
+# (no float h2d), the egress tables are int-coded and decode bit-exactly,
+# and the packed drain is measurably smaller than the raw f32 fetch;
+# artifact folded by bench.py.
+wire-smoke:
+	python tools/wire_probe.py
 
 # Alerting end-to-end drill (docs/ALERTS.md): a streaming run over a
 # step-change archive with injected ingest faults and a SIGKILL

@@ -63,6 +63,44 @@ def format_records(cx, cy, px, py, dates, ccdresult) -> list[dict]:
 
 
 # ---------------------------------------------------------------------------
+# Int-coded egress decode (the host half of kernel.pack_egress)
+# ---------------------------------------------------------------------------
+
+def decode_egress(tables: dict, T: int):
+    """Host-fetched int egress tables -> a float32 host ChipSegments,
+    bit-exact against the raw f32 drain (the kernel.pack_egress coding
+    contract): integer meta columns widen exactly (< 2^24), the
+    count-coded chprob column re-runs the kernel's own f32 division,
+    the bitcast planes reinterpret in place (zero-copy views), and the
+    bitpacked mask unpacks to ``T`` columns.  Segment planes come back
+    at the PACKED depth ``s_eff`` — every consumer reads capacity from
+    ``seg_meta.shape[-2]``, and the drain's capacity probe guarantees no
+    pixel closed more than ``s_eff`` segments, so frames are identical
+    to the full-capacity result."""
+    from firebird_tpu.ccd import kernel as _kernel
+
+    f32 = lambda a: np.ascontiguousarray(
+        np.asarray(a, np.int32)).view(np.float32)
+    meta_i = np.asarray(tables["meta"], np.int32)
+    meta = meta_i.astype(np.float32)
+    meta[..., 3] = meta_i[..., 3].astype(np.float32) \
+        / np.float32(params.PEEK_SIZE)
+    mask = np.unpackbits(np.asarray(tables["mask"], np.uint8),
+                         axis=-1, count=T).astype(bool)
+    opt = {f: (np.asarray(tables[f]) if f in tables else None)
+           for f in ("rounds", "round_counts", "occupancy", "compactions")}
+    vario = f32(tables["vario"]) if "vario" in tables else None
+    return _kernel.ChipSegments(
+        n_segments=np.asarray(tables["n_segments"]),
+        seg_meta=meta, seg_rmse=f32(tables["rmse"]),
+        seg_mag=f32(tables["mag"]), seg_coef=f32(tables["coef"]),
+        mask=mask, procedure=np.asarray(tables["procedure"]),
+        rounds=opt["rounds"], vario=vario,
+        round_counts=opt["round_counts"], occupancy=opt["occupancy"],
+        compactions=opt["compactions"])
+
+
+# ---------------------------------------------------------------------------
 # Vectorized chip-level frames
 # ---------------------------------------------------------------------------
 

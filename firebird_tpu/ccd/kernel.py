@@ -26,8 +26,11 @@ Every round's heavy math is a handful of [P,T]x[T,8] matmuls (MXU) plus
 fixed-iteration coordinate descent on [P,7,8] Gram systems; the number of
 rounds equals the deepest pixel's event count (typically a few dozen), not
 the series length.  The dates grid — and therefore the design matrix — is
-shared chip-wide, which is what makes the batching work; harmonic phases
-are computed on the host in float64 (see harmonic.design_matrix).
+shared chip-wide, which is what makes the batching work; the wire path
+builds the designs ON DEVICE from the int32 day ordinals (device_designs;
+an exact-integer phase reduction keeps the phase argument bit-identical
+to the host float64 spec in harmonic.design_matrix), so nothing float
+crosses the h2d wire at all.
 
 Batching over chips is a vmap; sharding over devices is a NamedSharding on
 the chip axis (firebird_tpu.parallel).
@@ -1511,17 +1514,62 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
 # Host-facing API
 # ---------------------------------------------------------------------------
 
-def _detect_batch_wire(Xs, Xts, t, valid, Y_i16, qa_u16, *, dtype,
+def device_designs(days, n_obs, dtype):
+    """The harmonic design matrices, built ON DEVICE from the int32 wire.
+
+    ``days`` [C, T] int32 ordinal days (0-padded past ``n_obs`` [C] int32)
+    -> (Xs [C,T,8], Xts [C,T,5], ts [C,T] float, valids [C,T] bool), the
+    four host-prepared float planes :func:`prep_batch` used to ship.  The
+    design is tiny next to the spectra, but building it here removes the
+    last float ingress planes entirely (the wire is all-integer, which
+    ``tools/wire_probe.py`` pins) and moves the per-chip host float64
+    trig off the staging thread.
+
+    Numerics: the phase uses ``t mod 365.25 == ((4t) mod 1461) / 4`` —
+    exact integer arithmetic (4t < 2^23 for any ordinal day), so the
+    phase argument is bit-identical to the host float64 ``np.mod`` for
+    integer dates in EITHER dtype; ``yr`` subtracts the int anchor before
+    widening, so it is exact too.  Only the trig itself is evaluated in
+    the compute dtype instead of float64-then-cast, which bounds the
+    device-vs-host design difference at trig ulp (~1e-7 relative in f32,
+    ~1e-16 in f64) — far inside the measured f32 oracle-parity envelope
+    (tests/test_wire.py pins the tolerance; docs/DIVERGENCE.md)."""
+    f = jnp.dtype(dtype)
+    days = days.astype(jnp.int32)
+    C, T = days.shape
+    valid = jnp.arange(T)[None, :] < n_obs[:, None]
+    quarter = jnp.mod(4 * days, 1461)                          # int, exact
+    ph = jnp.asarray(params.OMEGA, f) \
+        * (quarter.astype(f) * jnp.asarray(0.25, f))
+    anchor = jnp.where(n_obs > 0, days[:, 0], 0)
+    yr = (days - anchor[:, None]).astype(f) / jnp.asarray(365.25, f)
+    one = jnp.ones_like(yr)
+    c1, s1 = jnp.cos(ph), jnp.sin(ph)
+    c2, s2 = jnp.cos(2 * ph), jnp.sin(2 * ph)
+    c3, s3 = jnp.cos(3 * ph), jnp.sin(3 * ph)
+    X = jnp.stack([one, yr, c1, s1, c2, s2, c3, s3], axis=-1)
+    Xt = jnp.stack([one, c1, s1, c2, s2], axis=-1)
+    # Padding rows contribute nothing (build_designs' zeroing rule).
+    X = jnp.where(valid[..., None], X, 0)
+    Xt = jnp.where(valid[..., None], Xt, 0)
+    return X, Xt, days.astype(f), valid
+
+
+def _detect_batch_wire(days_i32, n_obs_i32, Y_i16, qa_wire, *, dtype,
                        wcap=None, sensor=LANDSAT_ARD,
                        max_segments=MAX_SEGMENTS, compact=None):
-    """Batch detect from wire dtypes: spectra/QA arrive as int16/uint16 and
-    widen on device — halves host->device transfer vs shipping float32, and
-    the core keeps a wire-dtype resident copy so the Pallas fit path reads
-    int16 from HBM (docs/ROOFLINE.md item 1).  ``compact`` (static) is
-    the active-lane-compaction override (None = FIREBIRD_COMPACT at
-    trace time)."""
-    return _detect_batch_core(Xs, Xts, t, valid, Y_i16,
-                              qa_u16.astype(jnp.int32), wcap=wcap,
+    """Batch detect from the all-integer wire: spectra ride int16, QA
+    uint8/uint16, and the day ordinals ride int32 — the harmonic design
+    matrices, the float date grid, and the validity mask are built on
+    device by :func:`device_designs` inside this jitted prologue, so NO
+    float plane crosses host->device at all (docs/ROOFLINE.md "Wire
+    budget").  The core widens the spectra on device and keeps a
+    wire-dtype resident copy so the Pallas fit path reads int16 from HBM.
+    ``compact`` (static) is the active-lane-compaction override (None =
+    FIREBIRD_COMPACT at trace time)."""
+    Xs, Xts, ts, valids = device_designs(days_i32, n_obs_i32, dtype)
+    return _detect_batch_core(Xs, Xts, ts, valids, Y_i16,
+                              qa_wire.astype(jnp.int32), wcap=wcap,
                               sensor=sensor, max_segments=max_segments,
                               dtype=dtype, compact=compact)
 
@@ -1537,7 +1585,7 @@ _WIRE_STATICS = ("dtype", "wcap", "sensor", "max_segments", "compact")
 # one HLO module name — persistent cache entries stay shared/valid.)
 _detect_batch_wire_donated = jax.jit(_detect_batch_wire,
                                      static_argnames=_WIRE_STATICS,
-                                     donate_argnums=(4, 5))
+                                     donate_argnums=(2, 3))
 _detect_batch_wire = jax.jit(_detect_batch_wire,
                              static_argnames=_WIRE_STATICS)
 # Donated compiles emit jax's "Some donated buffers were not usable"
@@ -1651,6 +1699,22 @@ def working_set_bytes(T: int, W: int | None = None,
     return int(wire + widened + pt_temps + onehot + bufs)
 
 
+def result_bytes(T: int, S: int = MAX_SEGMENTS, sensor=LANDSAT_ARD,
+                 dtype_bytes: int = 4) -> int:
+    """Device bytes one chip's ChipSegments result pins until its drain.
+
+    The pipeline-depth term of batch auto-sizing
+    (driver.core.auto_chips_per_batch): each in-flight batch beyond the
+    one computing holds its FULL-CAPACITY result buffers on device until
+    the drain thread fetches them — the egress diet shrinks what crosses
+    the wire, not this residency — so depth must be budgeted against
+    HBM explicitly."""
+    P, B, K = sensor.pixels, sensor.n_bands, params.MAX_COEFS
+    per_px = S * (6 + 2 * B + B * K) * dtype_bytes   # meta+rmse+mag+coef
+    per_px += T + (B + 2) * dtype_bytes              # mask + vario + ints
+    return int(P * per_px)
+
+
 def record_first_call(key: tuple, fn):
     """First-call capture per compiled shape (jit compiles synchronously
     inside the first dispatch; warm-cache enqueues are sub-ms, so the
@@ -1754,18 +1818,47 @@ def capacity_retry(dispatch, read_worst, S: int, bound: int):
         S = min(2 * S, bound)
 
 
+def wire_qa8() -> bool:
+    """Whether staging ships the QA plane as uint8 (FIREBIRD_WIRE_QA8,
+    default on) — half the uint16 plane, the second-largest h2d term
+    after the spectra.  Lossless for the kernel: the QA triage reads bits
+    0–5 only (params.QA_*_BIT), all inside the low byte.  Read at
+    staging time; the wire dtype is part of the jit key, so both modes
+    keep their own compiled program."""
+    from firebird_tpu.config import env_knob
+
+    return env_knob("FIREBIRD_WIRE_QA8") not in ("", "0")
+
+
+def wire_qa_dtype():
+    """The staged QA plane's wire dtype under the current knobs."""
+    return np.uint8 if wire_qa8() else np.uint16
+
+
+def wire_args(packed) -> tuple:
+    """The host-side ``_detect_batch_wire`` argument tuple (numpy, wire
+    dtypes, all integer): day ordinals int32, n_obs int32, spectra int16,
+    QA uint8/uint16 (:func:`wire_qa_dtype`).  Shared by stage_packed,
+    the sharded stager, bench, and the tools so the wire contract has
+    one definition."""
+    return (np.asarray(packed.dates, np.int32),
+            np.asarray(packed.n_obs, np.int32),
+            np.asarray(packed.spectra, np.int16),
+            np.asarray(packed.qas).astype(wire_qa_dtype()))
+
+
 def stage_packed(packed, dtype) -> tuple:
     """Host->device staging of a PackedChips batch: the wire-dtype
     ``_detect_batch_wire`` argument tuple as device arrays, blocking until
-    the transfer lands.  Split out of :func:`detect_packed` so the
-    driver's prefetch thread can ship batch i+1's H2D while batch i
-    computes (driver.core.stage_batch); the main thread then dispatches
-    with ``staged=``."""
+    the transfer lands.  Every staged plane is integer (int32 days +
+    counts, int16 spectra, uint8/uint16 QA — :func:`wire_args`); the
+    float designs/date grid/validity mask are built on device by the
+    jitted prologue (:func:`device_designs`).  Split out of
+    :func:`detect_packed` so the driver's prefetch thread can ship batch
+    i+1's H2D while batch i computes (driver.core.stage_batch); the main
+    thread then dispatches with ``staged=``."""
     ensure_x64(dtype)
-    Xs, Xts, valid = prep_batch(packed)
-    args = (jnp.asarray(Xs, dtype), jnp.asarray(Xts, dtype),
-            jnp.asarray(packed.dates, dtype=dtype), jnp.asarray(valid),
-            jnp.asarray(packed.spectra), jnp.asarray(packed.qas))
+    args = tuple(jnp.asarray(a) for a in wire_args(packed))
     jax.block_until_ready(args)
     return args
 
@@ -1775,7 +1868,9 @@ def aot_compile(avatars, *, dtype, wcap, sensor=LANDSAT_ARD,
                 compact: bool | None = None):
     """AOT lower+compile the wire-dtype batch program for a shape WITHOUT
     running it (``avatars`` are jax.ShapeDtypeStructs in the
-    ``_detect_batch_wire`` argument order).  With the persistent
+    ``_detect_batch_wire`` argument order: days int32 [C,T], n_obs int32
+    [C], spectra int16 [C,B,P,T], QA uint8/uint16 [C,P,T] — must match
+    :func:`wire_args`' dtypes or the warm entry misses).  With the persistent
     compilation cache on, the serialized executable is what the first
     real dispatch of the same shape deserializes instead of compiling —
     the driver's background warm start (driver.core.warm_start).
@@ -1829,6 +1924,77 @@ def detect_packed(packed, dtype=jnp.float32,
     return capacity_retry(dispatch,
                           lambda seg: int(np.asarray(seg.n_segments).max()),
                           max_segments, capacity_bound(packed))
+
+
+# ---------------------------------------------------------------------------
+# Int-coded egress: the d2h half of the wire diet (docs/ROOFLINE.md
+# "Wire budget").  ChipSegments drains as float32 planes sized for the
+# WORST-CASE segment capacity; the store's row values are integers or
+# exact functions of the f32 bits, so the drain can cross the wire as
+# integer tables sliced to the batch's OBSERVED segment depth — decoded
+# bit-exactly on the host (ccd.format.decode_egress), store rows
+# byte-identical to the raw-f32 drain (tests/test_wire.py golden).
+# ---------------------------------------------------------------------------
+
+def wire_egress_enabled() -> bool:
+    """Whether batch drains cross d2h as int-coded tables
+    (FIREBIRD_WIRE_EGRESS, default on; f32 results only — the f64
+    bit-parity path keeps the raw drain).  Read per drain, not per
+    trace: the packing program is a separate jit."""
+    from firebird_tpu.config import env_knob
+
+    return env_knob("FIREBIRD_WIRE_EGRESS") not in ("", "0")
+
+
+def egress_bucket(worst: int, S: int) -> int:
+    """The packed egress segment depth: the observed deepest pixel's
+    close count rounded up to a power of two (few compiled packing
+    shapes), capped at the result buffers' capacity ``S``."""
+    w = max(int(worst), 1)
+    return min(1 << (w - 1).bit_length(), S)
+
+
+@functools.partial(jax.jit, static_argnames=("s_eff",))
+def pack_egress(seg: ChipSegments, s_eff: int) -> dict:
+    """Device-side egress packing of a batched f32 ChipSegments: every
+    table integer-dtyped, segment planes sliced to ``s_eff`` slots.
+
+    Codings (all lossless — the golden test requires store rows
+    byte-identical to the raw f32 drain):
+
+    - ``meta`` [C,P,s_eff,6] int32: sday/eday/bday/curqa/nobs are exact
+      small integers in f32 (ordinal days < 2^24), rint-coded; the
+      chprob column is count-coded as ``rint(chprob * PEEK_SIZE)`` —
+      chprob is always k/PEEK_SIZE or 1.0, and the host decode re-runs
+      the same f32 division the kernel performed, reproducing the f32
+      value bit-exactly.
+    - ``rmse``/``mag``/``coef``/``vario``: f32 bitcast to int32 (free,
+      and it keeps the d2h contract checkable: no float leaves).
+    - ``mask``: bitpacked along T (8x).
+    - counters/diagnostics (n_segments, procedure, rounds, round_counts,
+      occupancy, compactions) are already integer and pass through.
+
+    ``s_eff`` (static; :func:`egress_bucket` of the drain's capacity
+    probe) is what buys the big cut: the f32 drain ships S=10 slots per
+    pixel while the observed depth is typically 1-2.
+    """
+    sl = lambda a: a[:, :, :s_eff]
+    bc = lambda a: lax.bitcast_convert_type(a, jnp.int32)
+    meta = sl(seg.seg_meta)
+    meta_i = jnp.rint(meta).astype(jnp.int32)
+    meta_i = meta_i.at[..., 3].set(
+        jnp.rint(meta[..., 3] * params.PEEK_SIZE).astype(jnp.int32))
+    out = dict(n_segments=seg.n_segments, procedure=seg.procedure,
+               meta=meta_i, rmse=bc(sl(seg.seg_rmse)),
+               mag=bc(sl(seg.seg_mag)), coef=bc(sl(seg.seg_coef)),
+               mask=jnp.packbits(seg.mask, axis=-1))
+    for f in ("rounds", "round_counts", "occupancy", "compactions"):
+        v = getattr(seg, f)
+        if v is not None:
+            out[f] = v
+    if seg.vario is not None:
+        out["vario"] = bc(seg.vario)
+    return out
 
 
 def chip_slice(seg: ChipSegments, c: int, to_host: bool = False) -> ChipSegments:

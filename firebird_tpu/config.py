@@ -186,6 +186,11 @@ KNOBS = (
          help="bucket fraction that triggers loop re-entry"),
     Knob(name="FIREBIRD_PALLAS", default="0",
          help="Pallas kernel component selection (0/1/comma list)"),
+    Knob(name="FIREBIRD_WIRE_QA8", default="1",
+         help="ship the staged QA plane as uint8 (0: full uint16)"),
+    Knob(name="FIREBIRD_WIRE_EGRESS", default="1",
+         help="drain batches as int-coded tables sliced to observed "
+              "segment depth (0: raw float32 drain)"),
     Knob(name="FIREBIRD_VARIOGRAM", default="adjusted",
          help="variogram mode: adjusted | plain"),
     # ---- process-wide switches read before/without a Config ----
@@ -230,6 +235,8 @@ KNOBS = (
          help="fleet-chaos artifact directory"),
     Knob(name="FIREBIRD_ALERT_DIR", default="/tmp/fb_alerts",
          help="alert-soak artifact directory"),
+    Knob(name="FIREBIRD_WIRE_DIR", default="/tmp/fb_wire",
+         help="wire-smoke artifact directory"),
     Knob(name="FIREBIRD_LINT_DIR", default="/tmp/fb_lint",
          readers=("Makefile",), internal=True,
          help="lint-report artifact directory (make lint)"),
@@ -400,10 +407,17 @@ class Config:
 
     # Max device batches in flight (the one computing + draining ones).
     # 2 is the classic double-buffer; deeper keeps the device busier when
-    # egress is slow — affordable because staged inputs are donated to
-    # the dispatch (driver/core.py detect_chunk), so depth pins only
-    # result buffers.
-    pipeline_depth: int = 2
+    # egress is slow — staged inputs are donated to the dispatch
+    # (driver/core.py detect_chunk), so depth pins only result buffers.
+    # Default 3 since the wire diet made transfer/compute overlap the
+    # e2e lever (docs/ROOFLINE.md "Wire budget").  NOTE: each in-flight
+    # batch holds its FULL-capacity device result buffers until drained
+    # (kernel.result_bytes; the egress diet shrinks the wire, not this
+    # residency) — auto batch sizing budgets depth explicitly
+    # (auto_chips_per_batch), but a manually pinned chips_per_batch
+    # tuned tight against HBM at depth 2 should either shrink the batch
+    # or set FIREBIRD_PIPELINE_DEPTH=2.
+    pipeline_depth: int = 3
 
     # Persistent XLA compilation cache directory (FIREBIRD_COMPILE_CACHE /
     # --compile-cache); "" disables.  With it set, every compiled kernel

@@ -355,12 +355,20 @@ def auto_chips_per_batch(cfg: Config, acquired: str, device=None) -> int:
     if not limit:
         return fallback
     t_est = estimate_obs(acquired, cfg)
-    per = kernel.working_set_bytes(t_est, dtype_bytes=4 if cfg.dtype ==
-                                   "float32" else 8)
+    dtype_bytes = 4 if cfg.dtype == "float32" else 8
+    per = kernel.working_set_bytes(t_est, dtype_bytes=dtype_bytes)
+    # Pipeline-depth residency: each in-flight batch beyond the one
+    # computing pins its full-capacity result buffers until its drain
+    # (the egress diet shrinks the wire, NOT this residency), so the
+    # deeper default depth must shrink the batch, not blow HBM.
+    per += (max(cfg.pipeline_depth, 1) - 1) * kernel.result_bytes(
+        t_est, dtype_bytes=dtype_bytes)
     n = max(int(limit * 0.6 / per), 1)
     logger("change-detection").info(
-        "auto chips_per_batch: T~%d, %.2f GB/chip against %.1f GB device "
-        "limit -> %d chips/batch", t_est, per / 1e9, limit / 1e9, n)
+        "auto chips_per_batch: T~%d, %.2f GB/chip (incl. depth-%d "
+        "in-flight results) against %.1f GB device limit -> %d "
+        "chips/batch", t_est, per / 1e9, cfg.pipeline_depth, limit / 1e9,
+        n)
     return n
 
 
@@ -450,6 +458,16 @@ def setup_compile_cache(cfg: Config) -> str | None:
     return path
 
 
+def wire_avatar_dtypes() -> tuple:
+    """The avatar dtype tuple warm_start AOT-compiles the wire signature
+    with — ONE definition shared with the test pinning it against
+    ``kernel.wire_args``' staged dtypes, because any drift makes every
+    warm compile a silent cache miss (the AOT writes one key, the real
+    dispatch looks up another)."""
+    return (jnp.int32, jnp.int32, jnp.int16,
+            jnp.dtype(kernel.wire_qa_dtype()))
+
+
 def predict_batch_shape(cfg: Config, acquired: str) -> tuple[int, int, int]:
     """The steady-state padded dispatch shape a run is expected to
     compile: (C, T, wcap).  C mirrors detect_batch's padding (rounded to
@@ -506,8 +524,9 @@ def warm_start(cfg: Config, acquired: str, sensor=None, dtype=None,
     kernel.ensure_x64(dtype)
     C, T, wcap = predict_batch_shape(cfg, acquired)
     B, P = sensor.n_bands, sensor.pixels
-    shapes = ((C, T, 8), (C, T, 5), (C, T), (C, T), (C, B, P, T),
-              (C, P, T))
+    # The all-integer wire signature (kernel.wire_args order): day
+    # ordinals, per-chip counts, int16 spectra, uint8/uint16 QA.
+    shapes = ((C, T), (C,), (C, B, P, T), (C, P, T))
     n_dev = jax.local_device_count()
     use_mesh = cfg.device_sharding != "off" and n_dev > 1
     # Metrics bind to THIS run's registry at start: a long warm compile
@@ -531,8 +550,7 @@ def warm_start(cfg: Config, acquired: str, sensor=None, dtype=None,
                 else:
                     avatars = tuple(
                         jax.ShapeDtypeStruct(s, d) for s, d in zip(
-                            shapes, (dtype, dtype, dtype, jnp.bool_,
-                                     jnp.int16, jnp.uint16)))
+                            shapes, wire_avatar_dtypes()))
                     kernel.aot_compile(avatars, dtype=dtype, wcap=wcap,
                                        sensor=sensor, donate=donate,
                                        compact=cfg.compact)
@@ -675,8 +693,8 @@ def stage_batch(packed, dtype, sharding: str = "auto",
     half of :func:`detect_batch`, run on the prefetch thread so batch
     i+1's transfer overlaps batch i's compute and the main thread only
     dispatches.  Blocks until the transfer lands (the *prefetch* thread
-    eats the wait), records ``pipeline_stage_seconds`` and the
-    ``h2d_bytes`` counter."""
+    eats the wait), records ``pipeline_stage_seconds``, the
+    ``wire_h2d_bytes`` counter, and the h2d ``transfer`` span leg."""
     import jax
 
     from firebird_tpu.ccd import kernel as k
@@ -686,19 +704,25 @@ def stage_batch(packed, dtype, sharding: str = "auto",
     padded, real = _pad_batch(
         packed, _pad_target(packed.n_chips, pad_to, use_mesh, n_dev))
     with tracing.span("stage", chips=real), obs_metrics.timer() as tm:
-        if use_mesh:
-            from firebird_tpu.parallel import make_mesh
-            from firebird_tpu.parallel.mesh import stage_sharded
+        # The `transfer` span leg (leg=h2d; its d2h twin wraps the drain's
+        # bulk fetch) makes transfer-vs-compute overlap directly readable
+        # off the host trace: a healthy pipeline shows h2d transfer spans
+        # riding the prefetch thread UNDER the main thread's dispatch gap.
+        with tracing.span("transfer", leg="h2d", chips=real):
+            if use_mesh:
+                from firebird_tpu.parallel import make_mesh
+                from firebird_tpu.parallel.mesh import stage_sharded
 
-            mesh = make_mesh(devices=jax.local_devices())
-            args, wcap = stage_sharded(padded, mesh, dtype)
-        else:
-            mesh = None
-            args = k.stage_packed(padded, dtype)
-            wcap = k.window_cap(padded)
+                mesh = make_mesh(devices=jax.local_devices())
+                args, wcap = stage_sharded(padded, mesh, dtype)
+            else:
+                mesh = None
+                args = k.stage_packed(padded, dtype)
+                wcap = k.window_cap(padded)
     obs_metrics.histogram("pipeline_stage_seconds").observe(tm.elapsed)
     obs_metrics.counter(
-        "h2d_bytes", help="bytes staged host->device (packed inputs)").inc(
+        "wire_h2d_bytes",
+        help="bytes staged host->device (all-integer packed inputs)").inc(
         int(sum(getattr(a, "nbytes", 0) for a in args)))
     return StagedBatch(packed=padded, args=args, n_real=real, mesh=mesh,
                        wcap=wcap)
@@ -765,23 +789,43 @@ def detect_batch(packed, dtype, sharding: str = "auto",
     return detect_sharded(padded, mesh, dtype=dtype, **kw), real
 
 
-def fetch_results(seg):
+def fetch_results(seg, worst: int | None = None):
     """The ONE bulk device->host fetch per batch: ``jax.device_get`` of
-    the whole batched ChipSegments pytree, collapsing the old per-chip,
-    per-field ``chip_slice(to_host=True)`` pattern (~C x fields D2H round
-    trips per batch) into a single transfer sweep.  Records
-    ``pipeline_d2h_seconds`` and the ``d2h_bytes`` counter; returns a
-    host-array ChipSegments."""
+    the whole batched result, collapsing the old per-chip, per-field
+    ``chip_slice(to_host=True)`` pattern (~C x fields D2H round trips per
+    batch) into a single transfer sweep.
+
+    With ``FIREBIRD_WIRE_EGRESS`` (default on) and a float32 result, the
+    ChipSegments is first packed ON DEVICE into int-coded tables sliced
+    to the batch's observed segment depth (``kernel.pack_egress``) and
+    decoded back host-side (``format.decode_egress``) — identical host
+    arrays, a fraction of the bytes on the wire (docs/ROOFLINE.md "Wire
+    budget").  ``worst`` is the caller's capacity probe (max segments
+    any pixel closed) when it already paid that sync; None probes here.
+    Records ``pipeline_d2h_seconds``, the ``wire_d2h_bytes`` counter,
+    and the d2h ``transfer`` span leg; returns a host-array
+    ChipSegments."""
     import jax
 
+    payload, decode_T = seg, None
+    if kernel.wire_egress_enabled() and seg.seg_meta.dtype == jnp.float32:
+        if worst is None:
+            worst = int(np.asarray(seg.n_segments).max())
+        s_eff = kernel.egress_bucket(worst, seg.seg_meta.shape[-2])
+        payload = kernel.pack_egress(seg, s_eff)
+        decode_T = seg.mask.shape[-1]
     nbytes = int(sum(getattr(v, "nbytes", 0)
-                     for v in jax.tree_util.tree_leaves(seg)))
+                     for v in jax.tree_util.tree_leaves(payload)))
     with tracing.span("d2h", bytes=nbytes), obs_metrics.timer() as tm:
-        host = jax.device_get(seg)
+        with tracing.span("transfer", leg="d2h", bytes=nbytes):
+            host = jax.device_get(payload)
     obs_metrics.histogram("pipeline_d2h_seconds").observe(tm.elapsed)
     obs_metrics.counter(
-        "d2h_bytes", help="bytes fetched device->host (batch results)").inc(
-        nbytes)
+        "wire_d2h_bytes",
+        help="bytes fetched device->host (batch results, int-coded and "
+             "depth-sliced when the egress diet is on)").inc(nbytes)
+    if decode_T is not None:
+        host = ccdformat.decode_egress(host, decode_T)
     return host
 
 
@@ -841,7 +885,7 @@ def drain_batch(seg, packed, n_real, *, writer, counters, dtype=None,
                                       max_segments=min(
                                           2 * cap,
                                           kernel.capacity_bound(packed)))
-            host = fetch_results(seg)
+            host = fetch_results(seg, worst=worst)
             # Occupancy telemetry: the event loop's per-round active/paid
             # lane capture feeds kernel_round_active_fraction and the
             # compaction counters (results are on the host anyway).

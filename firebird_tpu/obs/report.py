@@ -31,7 +31,8 @@ DRIVER_STAGE_HISTOGRAMS = (
     "store_flush_seconds",
     "kernel_first_call_seconds",
 )
-DRIVER_SPAN_NAMES = ("fetch", "pack", "stage", "dispatch", "drain", "d2h")
+DRIVER_SPAN_NAMES = ("fetch", "pack", "stage", "dispatch", "drain", "d2h",
+                     "transfer")
 
 # THE span-name catalog: every tracing.span(...) call site in the
 # codebase must use a name declared here, and every declared name must
@@ -54,6 +55,7 @@ SPAN_NAMES = (
     "stage",
     "store_flush",
     "store_write",
+    "transfer",
     "warm_compile",
 )
 

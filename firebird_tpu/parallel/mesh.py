@@ -101,10 +101,18 @@ def shard_packed(packed, mesh: Mesh, dtype, prepped=None):
     global chip batch and jax.make_array_from_process_local_data assembles
     the global sharded arrays — device_put cannot target non-addressable
     devices.
+
+    Every shipped plane is integer (kernel.wire_args: int32 days + n_obs,
+    int16 spectra, uint8/uint16 QA) — the float designs, date grid, and
+    validity mask are built per shard on device by the jitted program
+    (kernel.device_designs).  ``dtype`` and ``prepped`` are retained for
+    signature stability but no longer shape the wire (nothing float
+    ships); ``prepped`` is ignored.
     """
     import jax.numpy as jnp
-    from firebird_tpu.ccd.kernel import prep_batch
+    from firebird_tpu.ccd.kernel import wire_args
 
+    del dtype, prepped                     # wire is dtype-free (all int)
     C = packed.spectra.shape[0]
     # Cross-host assembly only when the mesh actually spans processes —
     # a multi-process run may still shard a host-local batch over a mesh
@@ -116,17 +124,11 @@ def shard_packed(packed, mesh: Mesh, dtype, prepped=None):
             f"chip batch ({C}) must divide evenly over {n_local} "
             "local devices — pad the batch (static even sharding, no shuffle)")
     sh = chip_sharding(mesh)
-    Xs, Xts, valid = prepped if prepped is not None else prep_batch(packed)
     if multiproc:
-        put = lambda a, d: jax.make_array_from_process_local_data(
-            sh, np.asarray(a, dtype=d))
+        put = lambda a: jax.make_array_from_process_local_data(sh, a)
     else:
-        put = lambda a, d: jax.device_put(jnp.asarray(a, d), sh)
-    # Spectra/QA ship in wire dtypes (int16/uint16) and widen on device.
-    return (put(Xs, dtype), put(Xts, dtype),
-            put(packed.dates, dtype), put(valid, jnp.bool_),
-            put(packed.spectra, jnp.int16),
-            put(packed.qas, jnp.uint16))
+        put = lambda a: jax.device_put(jnp.asarray(a), sh)
+    return tuple(put(a) for a in wire_args(packed))
 
 
 def _wcap_global_max(mesh: Mesh, v: int) -> int:
@@ -249,12 +251,18 @@ def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor,
                              max_segments=max_segments or MAX_SEGMENTS,
                              dtype=dtype, compact=compact)
 
-    def local_batch(Xs, Xts, t, valid, Y_i16, qa_u16):
-        # Wire-dtype spectra pass through: the core widens them itself and
-        # keeps an int16 resident copy for the Pallas fit path's HBM reads.
-        # The batched core (not vmap of the per-chip core): its phase-gated
-        # lax.conds must stay scalar per shard to skip work.
-        return core(Xs, Xts, t, valid, Y_i16, qa_u16.astype(jnp.int32))
+    def local_batch(days, n_obs, Y_i16, qa_wire):
+        # All-integer wire: each shard builds its own chips' float
+        # designs/date grid/validity mask on device (kernel.device_designs
+        # is per-chip math — no cross-shard dependence), and the core
+        # widens the spectra itself, keeping an int16 resident copy for
+        # the Pallas fit path's HBM reads.  The batched core (not vmap of
+        # the per-chip core): its phase-gated lax.conds must stay scalar
+        # per shard to skip work.
+        from firebird_tpu.ccd.kernel import device_designs
+
+        Xs, Xts, t, valid = device_designs(days, n_obs, dtype)
+        return core(Xs, Xts, t, valid, Y_i16, qa_wire.astype(jnp.int32))
 
     spec = PartitionSpec("data")
     # check_vma=False (check_rep=False pre-0.5 jax): the kernel's
@@ -264,18 +272,18 @@ def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor,
     # mentions the mesh axis at all).
     sm = getattr(jax, "shard_map", None)
     if sm is not None:
-        wrapped = sm(local_batch, mesh=mesh, in_specs=(spec,) * 6,
+        wrapped = sm(local_batch, mesh=mesh, in_specs=(spec,) * 4,
                      out_specs=spec, check_vma=False)
     else:  # jax < 0.5: experimental module, check_rep spelling
         from jax.experimental.shard_map import shard_map as sm_exp
 
-        wrapped = sm_exp(local_batch, mesh=mesh, in_specs=(spec,) * 6,
+        wrapped = sm_exp(local_batch, mesh=mesh, in_specs=(spec,) * 4,
                          out_specs=spec, check_rep=False)
     # Donation frees the sharded wire inputs (spectra + QA) at dispatch —
     # the driver's staged single-dispatch path only; capacity-retry
     # callers take the non-donating cache entry (kernel.detect_packed's
     # same rule).
-    return jax.jit(wrapped, donate_argnums=(4, 5) if donate else ())
+    return jax.jit(wrapped, donate_argnums=(2, 3) if donate else ())
 
 
 def aot_compile_sharded(mesh: Mesh, dtype, wcap: int, sensor, shapes,
@@ -283,18 +291,21 @@ def aot_compile_sharded(mesh: Mesh, dtype, wcap: int, sensor, shapes,
                         donate: bool = False,
                         compact: bool | None = None):
     """AOT lower+compile the sharded batch program for a shape without
-    running it (``shapes``: the 6 global array shapes in shard_packed's
-    argument order; wire dtypes applied here).  The sharded half of
+    running it (``shapes``: the 4 global array shapes in shard_packed's
+    argument order — days [C,T], n_obs [C], spectra [C,B,P,T], QA
+    [C,P,T]; wire dtypes applied here, QA following the
+    FIREBIRD_WIRE_QA8 knob like the real stage).  The sharded half of
     kernel.aot_compile, for driver.core.warm_start on multi-device
     topologies.  ``compact`` must match the real dispatch's value (see
     kernel.aot_compile)."""
     import jax.numpy as jnp
+    from firebird_tpu.ccd.kernel import wire_qa_dtype
 
     fn = sharded_detect_fn(mesh, jnp.dtype(dtype), wcap, sensor,
                            max_segments=max_segments, donate=donate,
                            compact=compact)
     sh = chip_sharding(mesh)
-    dts = (dtype, dtype, dtype, jnp.bool_, jnp.int16, jnp.uint16)
+    dts = (jnp.int32, jnp.int32, jnp.int16, wire_qa_dtype())
     avatars = tuple(jax.ShapeDtypeStruct(s, jnp.dtype(d), sharding=sh)
                     for s, d in zip(shapes, dts))
     return fn.lower(*avatars).compile()

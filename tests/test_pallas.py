@@ -91,9 +91,8 @@ def test_pallas_flag_routes_full_detect(monkeypatch):
 
 
 def _wire_args(p):
-    Xs, Xts, valid = kernel.prep_batch(p)
-    return (Xs.astype(np.float64), Xts.astype(np.float64),
-            p.dates.astype(np.float64), valid, p.spectra, p.qas)
+    # The all-integer wire tuple (designs build on device).
+    return kernel.wire_args(p)
 
 
 @pytest.mark.slow  # ~27s interpret-mode run; tier-1 (-m 'not slow') keeps the lax sharded parity (test_parallel) + single-device Pallas rungs

@@ -116,7 +116,7 @@ def test_drain_records_egress_metrics(ragged_batch):
         writer.close()
     snap = obs_metrics.get_registry().snapshot()
     assert snap["histograms"]["pipeline_d2h_seconds"]["count"] == 1
-    assert snap["counters"]["d2h_bytes"] > 0
+    assert snap["counters"]["wire_d2h_bytes"] > 0
     assert snap["counters"]["store_rows_written"] >= n_real * (1 + 64 + 64)
     obs_metrics.reset_registry()
 
@@ -138,7 +138,7 @@ def test_stage_batch_then_staged_dispatch_matches(ragged_batch):
                                       np.asarray(getattr(seg, f))[:3])
     snap = obs_metrics.get_registry().snapshot()
     assert snap["histograms"]["pipeline_stage_seconds"]["count"] == 1
-    assert snap["counters"]["h2d_bytes"] > 0
+    assert snap["counters"]["wire_h2d_bytes"] > 0
     obs_metrics.reset_registry()
 
 
@@ -229,7 +229,9 @@ def test_predict_batch_shape_is_padded_and_bucketed():
 
 
 def test_pipeline_depth_config():
-    assert Config().pipeline_depth == 2
+    # default 3 since the wire diet: int-coded depth-sliced egress freed
+    # the HBM one more in-flight batch pins (config.py rationale)
+    assert Config().pipeline_depth == 3
     with pytest.raises(ValueError):
         Config(pipeline_depth=0)
     cfg = Config.from_env({"FIREBIRD_PIPELINE_DEPTH": "4",

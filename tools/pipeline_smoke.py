@@ -71,7 +71,7 @@ def main() -> int:
                   f"{missing}", file=sys.stderr)
             return 1
         counters = rep1["metrics"]["counters"]
-        for c in ("h2d_bytes", "d2h_bytes", "store_rows_written"):
+        for c in ("wire_h2d_bytes", "wire_d2h_bytes", "store_rows_written"):
             if counters.get(c, 0) <= 0:
                 print(f"pipeline-smoke: run-1 counter {c!r} did not move "
                       f"(counters: {counters})", file=sys.stderr)
@@ -96,8 +96,8 @@ def main() -> int:
         occ = rep2["metrics"]["gauges"].get("pipeline_inflight")
         print("pipeline-smoke OK: "
               f"{len(hists)} histograms, "
-              f"h2d {counters['h2d_bytes']} B, "
-              f"d2h {counters['d2h_bytes']} B, "
+              f"h2d {counters['wire_h2d_bytes']} B, "
+              f"d2h {counters['wire_d2h_bytes']} B, "
               f"run-2 compile-cache hits {hits}, "
               f"final in-flight gauge {occ}")
     return 0

@@ -58,11 +58,9 @@ def main() -> int:
                           cloud_frac=0.15)
     packed = pack([src.chip(100 + 3000 * i, 200) for i in range(n_chips)],
                   bucket=64)
-    Xs, Xts, valid = kernel.prep_batch(packed)
     fd = jnp.float32
-    args = (jnp.asarray(Xs, fd), jnp.asarray(Xts, fd),
-            jnp.asarray(packed.dates, fd), jnp.asarray(valid),
-            jnp.asarray(packed.spectra), jnp.asarray(packed.qas))
+    # All-integer wire (kernel.wire_args): designs build on device.
+    args = tuple(jnp.asarray(a) for a in kernel.wire_args(packed))
     f = functools.partial(kernel._detect_batch_wire, dtype=fd,
                           wcap=kernel.window_cap(packed),
                           sensor=packed.sensor)
