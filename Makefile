@@ -11,8 +11,8 @@ ACQUIRED ?= 1982-01-01/2017-12-31
 
 .PHONY: install lint test bench obs-smoke pipeline-smoke chaos-smoke \
         fleet-smoke serve-smoke compact-smoke postmortem-smoke \
-        alert-smoke wire-smoke image db-up db-schema db-test db-down \
-        changedetection classification clean
+        alert-smoke wire-smoke fuse-smoke fuse-repro image db-up \
+        db-schema db-test db-down changedetection classification clean
 
 install:
 	pip install -e . --no-build-isolation
@@ -32,6 +32,7 @@ lint:
 # it exercises stream + serve + fleet queue together under chaos).
 test: lint
 	python -m pytest tests/ -x -q
+	$(MAKE) fuse-smoke
 	$(MAKE) alert-smoke
 
 bench:
@@ -100,6 +101,21 @@ compact-smoke:
 # artifact folded by bench.py.
 wire-smoke:
 	python tools/wire_probe.py
+
+# Fused-fit / rebalancing-ring check (docs/ROOFLINE.md "Fused fit"):
+# fused on/off dispatches byte-identical, occupancy counters still
+# moving, and the straggler ring migrating lanes row-identically on a
+# forced-ragged 2-device simulated mesh; artifact folded by bench.py.
+fuse-smoke:
+	python tools/fuse_smoke.py
+
+# Mosaic SIGABRT bisection (the r05 mega/fused-combo compiler crash):
+# compiles each multi-phase pairing in subprocesses across a lane-block
+# ladder and records the smallest failing shape as a classified,
+# bench-foldable artifact.  CPU hosts record the honest
+# interpret-only caveat.
+fuse-repro:
+	python tools/fuse_repro.py
 
 # Alerting end-to-end drill (docs/ALERTS.md): a streaming run over a
 # step-change archive with injected ingest faults and a SIGKILL

@@ -289,6 +289,18 @@ def _wire_fold() -> dict:
                           "wire_smoke.json")
 
 
+def _fuse_fold() -> dict:
+    """`make fuse-smoke` + tools/fuse_repro.py evidence: fused-on/off
+    store identity, occupancy counters moving, the forced-ragged
+    rebalance leg, and the classified SIGABRT-repro probe outcomes
+    (bisectable compiler-crash records, docs/ROOFLINE.md "Fused fit")."""
+    out = _artifact_fold("fuse_smoke", "FIREBIRD_FUSE_DIR",
+                         "fuse_smoke.json")
+    out.update(_artifact_fold("fuse_repro", "FIREBIRD_FUSE_DIR",
+                              "fuse_repro.json"))
+    return out
+
+
 def previous_round_e2e(here: str) -> dict | None:
     """The newest committed TPU evidence artifact's end-to-end figure —
     the denominator of the headline regression gate.  Scans
@@ -379,8 +391,22 @@ def measure(cpu_only: bool) -> None:
 
         probe_outs = {}
 
+        def _apply_tune_flag(flag: str) -> None:
+            """One autotune rung -> the env it means: 'fused' /
+            'fused+<components>' arms FIREBIRD_FUSED_FIT with
+            FIREBIRD_PALLAS set to the (possibly empty) component list;
+            anything else is a plain FIREBIRD_PALLAS value with the
+            fused knob off.  Shared by the probes and the final pick so
+            the timed run executes exactly the raced configuration."""
+            if flag == "fused" or flag.startswith("fused+"):
+                _os.environ["FIREBIRD_FUSED_FIT"] = "1"
+                _os.environ["FIREBIRD_PALLAS"] = flag[len("fused+"):] or "0"
+            else:
+                _os.environ["FIREBIRD_FUSED_FIT"] = "0"
+                _os.environ["FIREBIRD_PALLAS"] = flag
+
         def probe_rate(flag: str) -> float:
-            _os.environ["FIREBIRD_PALLAS"] = flag
+            _apply_tune_flag(flag)
             jax.clear_caches()
             f = _ft.partial(kernel._detect_batch_wire, dtype=jnp.float32,
                             wcap=kernel.window_cap(probe),
@@ -476,6 +502,18 @@ def measure(cpu_only: bool) -> None:
         if not any(set(k.split(",")) == {"fit", "score", "init"}
                    for k in rates):
             safe_rate("fit,init,score")
+        # Fused gram→CD→close rungs (FIREBIRD_FUSED_FIT): the fit-path
+        # ladder is lax fallback ('0'), gram+Pallas-CD ('lasso'),
+        # fully-fused fit kernel ('fit') — all raced above — plus the
+        # round-fusing kernel alone and composed with the monitor/init
+        # winners (the fused kernel replaces the close+fit pair, so it
+        # composes with score/init, and '+fit' keeps the prologue's
+        # one-shot alt fits on the Pallas fit kernel too).
+        safe_rate("fused")
+        safe_rate("fused+fit")
+        fw = ",".join(sorted(set(winners) | {"fit"}))
+        if f"fused+{fw}" not in rates:
+            safe_rate(f"fused+{fw}")
         parity, decision_exact = autotune_parity(probe_outs)
         pick, demoted, parity_unavailable = autotune_pick(
             rates, errors, decision_exact)
@@ -486,7 +524,7 @@ def measure(cpu_only: bool) -> None:
             **({"parity_unavailable": True} if parity_unavailable else {}),
             **({"probe_parity_vs_xla": parity} if parity else {}),
             **({"errors": errors} if errors else {})}}
-        _os.environ["FIREBIRD_PALLAS"] = pick
+        _apply_tune_flag(pick)
         jax.clear_caches()
 
     def _mega_fits_shape(pk, wcap_, seg_) -> bool:
@@ -684,10 +722,23 @@ def measure(cpu_only: bool) -> None:
         # VMEM guard — a refused mega runs the XLA loop, and modeling
         # one-pass traffic for it would overstate the ceiling ~100x.
         pallas=frozenset(
-            c for c in ("score", "init", "fit", "mega")
-            if kernel.use_pallas(c)
-            and (c != "mega" or _mega_fits_shape(packed, wcap, seg))),
+            [c for c in ("score", "init", "fit", "mega")
+             if kernel.use_pallas(c)
+             and (c != "mega" or _mega_fits_shape(packed, wcap, seg))]
+            + (["fused"] if kernel.use_fused_fit() else [])),
         wire_bytes=2)
+
+    # ---- rebalance: straggler-idle model + what the ring moved ----
+    # Per-device round counts bound the idle a perfect balancer could
+    # reclaim (each shard's chips all report their loop's count); the
+    # lanes_migrated field is present exactly when FIREBIRD_REBALANCE
+    # armed the ring for this dispatch.
+    lm = getattr(seg, "lanes_migrated", None)
+    rebalance_block = {"rebalance": {
+        "enabled": lm is not None,
+        **flopsmod.rebalance_detail(
+            np.asarray(seg.rounds).reshape(-1), n_pixels / dev_rate,
+            int(np.asarray(lm).sum()) if lm is not None else 0)}}
 
     # ---- CPU per-pixel rate (the pyccd stand-in), extrapolated ----
     sample = 12
@@ -868,6 +919,7 @@ def measure(cpu_only: bool) -> None:
             "baseline_2000_core_pixels_per_sec": round(baseline_2000_cores, 1),
             "mean_segments": float(np.asarray(seg.n_segments).mean()),
             **occupancy_detail,
+            **rebalance_block,
             **pipeline_detail,
             **pallas_detail,
             # Per-run telemetry fold (obs_report schema's metrics half):
@@ -892,6 +944,10 @@ def measure(cpu_only: bool) -> None:
             # Last compact-smoke evidence (stores identical on vs off,
             # wasted lane-rounds reduced) when one ran on this host.
             **_compact_fold(),
+            # Last fuse-smoke / fuse-repro evidence (fused on/off store
+            # identity, forced-ragged rebalance leg, classified
+            # compiler-crash probe records) when one ran on this host.
+            **_fuse_fold(),
             # Last `make lint` summary (contract-checker clean flag +
             # per-rule counts) when the linter ran on this host.
             **_lint_fold(),

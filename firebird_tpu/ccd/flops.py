@@ -180,6 +180,14 @@ def round_bytes(P: int, T: int, W: int, S: int, dtype_bytes: int,
     - 'fit': the refit streams the [B,T,P] wire spectra + the [T,P]
       window plane; Gram/corr/CD/RMSE stay in VMEM
       (pallas_ops._fit_block).
+    - 'fused': the FIREBIRD_FUSED_FIT gram→CD→close kernel
+      (pallas_ops._fused_fit_close_block) — the close + shared-fit pair
+      runs on ONE wire-spectra residency per fit round, and the close's
+      buffer rewrite crosses the kernel boundary once instead of
+      round-tripping the [P,S*k] planes plus the PEEK-run one-hot
+      tensors.  Close-only rounds (the shared tail close) still pay one
+      buffer boundary; the rare break round adds kernel._close_mags'
+      spectra read, modeled under the close term.
     """
     B = sensor.n_bands
     D = len(sensor.detection_bands)
@@ -215,12 +223,24 @@ def round_bytes(P: int, T: int, W: int, S: int, dtype_bytes: int,
                 + 3.0 * P * W * T * dtype_bytes
                 + 2.0 * P * W * (NT + B + NT * NT) * dtype_bytes
                 + P * B * T * dtype_bytes)
-    if "fit" in pallas:
+    if "fused" in pallas:
+        # The fused kernel reads the wire spectra once per firing round
+        # and serves BOTH the fit and the close row write from it; the
+        # buffer planes stream through the kernel boundary (in + out)
+        # instead of the XLA path's oh_run tensors + cond round-trips.
+        # The pre-fusion model charged the spectra twice (fit + close
+        # oh_run) — the satellite bugfix this branch exists for: an
+        # unfused byte model here overstated the fused path's traffic
+        # and understated its intensity/MFU.
         fit = P * B * T * wire_bytes + 5.0 * P * T
+        close = 2.0 * P * S * (6 + 2 * B + B * K) * dtype_bytes + 8.0 * P
     else:
-        fit = P * B * T * dtype_bytes             # cfull Gram corr Y read
-    close = (2.0 * P * params.PEEK_SIZE * T * dtype_bytes    # oh_run
-             + 2.0 * P * S * (6 + 2 * B + B * K) * dtype_bytes)  # bufs
+        if "fit" in pallas:
+            fit = P * B * T * wire_bytes + 5.0 * P * T
+        else:
+            fit = P * B * T * dtype_bytes         # cfull Gram corr Y read
+        close = (2.0 * P * params.PEEK_SIZE * T * dtype_bytes    # oh_run
+                 + 2.0 * P * S * (6 + 2 * B + B * K) * dtype_bytes)  # bufs
     return every * rounds + init * ir + fit * fr + close * cr
 
 
@@ -316,6 +336,38 @@ def expected_compaction_speedup(mean_active_fraction: float,
     a = min(max(mean_active_fraction, 0.0), 1.0)
     paid = -lane_block * (-max(a * lanes, 1.0) // lane_block)
     return lanes / max(paid, 1.0)
+
+
+def rebalance_detail(rounds_by_shard, wall_seconds: float,
+                     lanes_migrated: int = 0) -> dict:
+    """Straggler-idle model for the cross-device rebalancing ring
+    (parallel.mesh; docs/ROOFLINE.md "Fused fit").
+
+    In SPMD each device runs its own event loop and the dispatch ends at
+    the SLOWEST device, so per-device round counts bound the idle:
+    a device executing r_d rounds of a max-R dispatch idles
+    ~(R - r_d)/R of the wall.  ``rounds_by_shard`` is the per-device
+    executed round count (one value per shard — under sharding every
+    chip of a shard reports its loop's count, so callers pass one per
+    device); the model reports the idle seconds a perfect balancer
+    could reclaim and the balance ratio (mean/max rounds, 1.0 = no
+    straggler).  ``lanes_migrated`` (the kernel counter) rides along so
+    the bench artifact pairs the model with what the ring actually
+    moved."""
+    import numpy as np
+
+    r = np.asarray(rounds_by_shard, np.float64).reshape(-1)
+    r = r[r > 0] if (r > 0).any() else r
+    if r.size == 0:
+        return {"straggler_idle_seconds_saved_model": 0.0,
+                "balance_ratio": 1.0, "lanes_migrated": int(lanes_migrated)}
+    mx = float(r.max())
+    ratio = float(r.mean()) / max(mx, 1.0)
+    idle = (1.0 - ratio) * float(wall_seconds)
+    return {"straggler_idle_seconds_saved_model": round(idle, 4),
+            "balance_ratio": round(ratio, 4),
+            "rounds_by_shard": [int(x) for x in r[:64]],
+            "lanes_migrated": int(lanes_migrated)}
 
 
 # ---------------------------------------------------------------------------

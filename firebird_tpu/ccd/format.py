@@ -88,7 +88,8 @@ def decode_egress(tables: dict, T: int):
     mask = np.unpackbits(np.asarray(tables["mask"], np.uint8),
                          axis=-1, count=T).astype(bool)
     opt = {f: (np.asarray(tables[f]) if f in tables else None)
-           for f in ("rounds", "round_counts", "occupancy", "compactions")}
+           for f in ("rounds", "round_counts", "occupancy", "compactions",
+                     "lanes_migrated")}
     vario = f32(tables["vario"]) if "vario" in tables else None
     return _kernel.ChipSegments(
         n_segments=np.asarray(tables["n_segments"]),
@@ -97,7 +98,8 @@ def decode_egress(tables: dict, T: int):
         mask=mask, procedure=np.asarray(tables["procedure"]),
         rounds=opt["rounds"], vario=vario,
         round_counts=opt["round_counts"], occupancy=opt["occupancy"],
-        compactions=opt["compactions"])
+        compactions=opt["compactions"],
+        lanes_migrated=opt["lanes_migrated"])
 
 
 # ---------------------------------------------------------------------------

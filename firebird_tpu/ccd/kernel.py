@@ -96,6 +96,19 @@ def _wire_resident_only() -> bool:
             and use_pallas("fit"))
 
 
+def use_fused_fit() -> bool:
+    """Whether the event loop's segment-close + shared-Lasso-fit pair
+    runs as the fused Pallas gram→CD→close kernel
+    (pallas_ops.fused_fit_close): one VMEM residency of the wire spectra
+    serves both phases instead of two HBM streams plus the [P,*]
+    intermediates between the cond-gated fusions.  FIREBIRD_FUSED_FIT,
+    default off; read at trace time like use_pallas (f32-on-TPU only
+    when compiled; interpret elsewhere); the mega route supersedes it."""
+    from firebird_tpu.config import env_knob
+
+    return env_knob("FIREBIRD_FUSED_FIT") not in ("", "0")
+
+
 # ---------------------------------------------------------------------------
 # Results container
 # ---------------------------------------------------------------------------
@@ -141,13 +154,21 @@ class ChipSegments:
     #   recorded at each loop's first chip row and zero elsewhere — sum
     #   over the chip axis for the batch total (correct under sharding,
     #   where each shard runs its own loop; see _detect_batch_impl).
+    lanes_migrated: jnp.ndarray | None = None
+    # ^ [..] int32 per chip: straggler lanes this chip DONATED to the
+    #   right-neighbor device through the rebalancing ring at the
+    #   bucketed-tail boundary (parallel.mesh.rebalance_tail_out).  The
+    #   donated lanes' results are computed on the neighbor and merged
+    #   back positionally, so stores stay row-identical; the chip-sum
+    #   feeds the kernel_lanes_migrated counter (record_occupancy).
+    #   None on every non-rebalancing dispatch.
 
 
 jax.tree_util.register_pytree_node(
     ChipSegments,
     lambda s: ((s.n_segments, s.seg_meta, s.seg_rmse, s.seg_mag, s.seg_coef,
                 s.mask, s.procedure, s.rounds, s.vario, s.round_counts,
-                s.occupancy, s.compactions),
+                s.occupancy, s.compactions, s.lanes_migrated),
                None),
     lambda _, c: ChipSegments(*c),
 )
@@ -986,22 +1007,19 @@ def _mon_zeros(st):
                 included_mon=st["included"], alive_mon=st["alive"])
 
 
-def _close_block(res, st, mon, *, S, fdtype):
-    """One chip's segment-close work: break magnitudes and the segment
-    row write.  Runs under a scalar lax.cond on any(close) — segment
-    closes land on a handful of rounds (the shared tail round plus break
-    rounds), so most rounds skip both the PEEK-run one-hot einsums and
-    the full result-buffer rewrite."""
-    t, X = res["t"], res["X"]
+def _close_mags(res, st, mon, *, fdtype):
+    """Break magnitudes: median full-band residual over the PEEK run at
+    the break — the spectra-reading half of the close, split out so the
+    fused-fit route (FIREBIRD_FUSED_FIT) can run EXACTLY this code under
+    its own any(is_brk) cond: break rounds are rare, and sharing the
+    very same program keeps the fused-on/off stores byte-identical
+    (tests/test_fuse.py golden) where a re-derived in-kernel median
+    would differ by backend-fusion ulps."""
+    X = res["X"]
     alive = st["alive"]
-    # Shapes from the always-present carries, not res["Yt"]: compaction
-    # mode carries only the residents the traced paths actually read, so
-    # the wire view may be absent here when the float view serves.
     P, B, _K = st["coefs"].shape
     T = X.shape[0]
-    is_tail, is_brk = mon["is_tail"], mon["is_brk"]
-    ev_rank, pos_ev, m = mon["ev_rank"], mon["pos_ev"], mon["m"]
-    included_mon = mon["included_mon"]
+    ev_rank, m = mon["ev_rank"], mon["m"]
     rank = jnp.cumsum(alive, -1) - 1
 
     # Magnitudes: median full-band residual over the PEEK run at the
@@ -1030,8 +1048,26 @@ def _close_block(res, st, mon, *, S, fdtype):
         Y_run = jnp.einsum("btp,pkt->pbk", res["Yt"].astype(fdtype),
                            oh_run, precision=lax.Precision.HIGHEST)
     resid_run = Y_run - pred_run                              # [P,7,PEEK]
-    mags = _masked_median(
+    return _masked_median(
         resid_run, jnp.broadcast_to(run_ok[:, None, :], resid_run.shape))
+
+
+def _close_block(res, st, mon, *, S, fdtype):
+    """One chip's segment-close work: break magnitudes and the segment
+    row write.  Runs under a scalar lax.cond on any(close) — segment
+    closes land on a handful of rounds (the shared tail round plus break
+    rounds), so most rounds skip both the PEEK-run one-hot einsums and
+    the full result-buffer rewrite."""
+    t = res["t"]
+    # Shapes from the always-present carries, not res["Yt"]: compaction
+    # mode carries only the residents the traced paths actually read, so
+    # the wire view may be absent here when the float view serves.
+    P, B, _K = st["coefs"].shape
+    T = res["X"].shape[0]
+    is_tail, is_brk = mon["is_tail"], mon["is_brk"]
+    pos_ev = mon["pos_ev"]
+    included_mon = mon["included_mon"]
+    mags = _close_mags(res, st, mon, fdtype=fdtype)
 
     last_inc = T - 1 - jnp.argmax(included_mon[:, ::-1], -1)
     first_inc = jnp.argmax(included_mon, -1)
@@ -1155,7 +1191,8 @@ def _block_widths(P: int) -> np.ndarray:
 def _detect_batch_core(Xs, Xts, ts, valids, Ys, qas, *,
                        wcap: int | None = None, sensor=LANDSAT_ARD,
                        max_segments: int = MAX_SEGMENTS, dtype=None,
-                       compact: bool | None = None):
+                       compact: bool | None = None,
+                       fused: bool | None = None, rebalance=None):
     """A chip batch: Xs [C,T,8], Xts [C,T,5], ts [C,T], valids [C,T],
     Ys [C,B,P,T] (wire int16 or float), qas [C,P,T] int32 → ChipSegments
     with [C, ...] leading axes.
@@ -1190,15 +1227,29 @@ def _detect_batch_core(Xs, Xts, ts, valids, Ys, qas, *,
     per-block skip guards into the Pallas kernels, and re-enters a
     power-of-two bucket once the alive fraction falls below
     FIREBIRD_COMPACT_FLOOR — row-identical results, cost tracking the
-    active set instead of the padded batch."""
+    active set instead of the padded batch.
+
+    ``fused`` (static) routes each round's segment-close + shared-fit
+    pair through the fused gram→CD→close Pallas kernel (None defers to
+    FIREBIRD_FUSED_FIT at trace time, like ``compact``); results are
+    byte-identical against the unfused Pallas-fit configuration
+    (tests/test_fuse.py golden).
+
+    ``rebalance`` (static; a parallel.mesh.RebalanceSpec, sharded
+    dispatches only) arms the cross-device straggler rebalancing ring at
+    the bucketed-tail boundary — lanes migrate to the right-neighbor
+    device when the alive-count imbalance crosses the threshold, results
+    migrate back, stores stay row-identical."""
     with jax.default_matmul_precision("highest"):
         return _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, wcap=wcap,
                                   sensor=sensor, max_segments=max_segments,
-                                  dtype=dtype, compact=compact)
+                                  dtype=dtype, compact=compact,
+                                  fused=fused, rebalance=rebalance)
 
 
 def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
-                       max_segments, dtype, compact=None):
+                       max_segments, dtype, compact=None, fused=None,
+                       rebalance=None):
     C, B, P, T = Ys.shape
     S = max_segments
     W = T if wcap is None else min(wcap, T)
@@ -1227,6 +1278,13 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
     # compaction applies to the XLA/per-component loop only.
     compact_on = (params.compact_default() if compact is None
                   else bool(compact)) and not mega
+    # Fused gram→CD→close round kernel (FIREBIRD_FUSED_FIT / explicit
+    # fused=): each round's segment-close + shared-Lasso-fit pair runs
+    # as ONE pallas_call on a single VMEM residency of the wire spectra.
+    # The mega route supersedes it (the whole loop is already one
+    # kernel); the f64-on-TPU bit-parity path keeps the XLA pair.
+    fused_on = (use_fused_fit() if fused is None else bool(fused)) \
+        and f32_ok and not mega
 
     res, state = jax.vmap(functools.partial(
         _prologue, sensor=sensor, S=S, fdtype=fdtype, fit=fit,
@@ -1269,6 +1327,21 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
                                                active=a))
     else:
         fitf = jax.vmap(lambda r, w, n: fit(r, w, _coefmask_for(n)))
+    if fused_on:
+        from firebird_tpu.ccd import pallas_ops
+
+        def _fused_chip(r, w, df, nf, mg, st_c, mn_c, act=None):
+            return pallas_ops.fused_fit_close(
+                r["Yt"], r["X"], r["t"], w, df, nf,
+                mn_c["included_mon"], st_c["coefs"], st_c["rmse"], mg,
+                mn_c["is_tail"], mn_c["is_brk"],
+                mn_c["pos_ev"], mn_c["n_exceed"],
+                st_c["first_seg"], st_c["nseg"], st_c["bufs"], S=S,
+                active=act, interpret=not on_tpu)
+
+        fusedf = jax.vmap(_fused_chip) if compact_on \
+            else jax.vmap(functools.partial(_fused_chip, act=None))
+        magsf = jax.vmap(functools.partial(_close_mags, fdtype=fdtype))
 
     max_rounds = 2 * T + 8
 
@@ -1294,7 +1367,7 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
     resp_keys = ["vario"]
     if "Y" in res:
         resp_keys.append("Y")
-    if fit_pallas or init_pallas or "Y" not in res:
+    if fit_pallas or init_pallas or fused_on or "Y" not in res:
         resp_keys.append("Yt")
     if score_pallas:
         resp_keys.append("Yd")
@@ -1311,18 +1384,29 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
                      # the first periodic compaction.
                      base_alive=jnp.full((C,), P, jnp.int32))
 
-    def _loop_res(st):
-        return dict(res_shared, **st["resp"]) if compact_on else res
+    def _loop_res(st, shared=None):
+        if not compact_on:
+            return res
+        return dict(res_shared if shared is None else shared,
+                    **st["resp"])
 
     def cond(carry):
         st, rounds, _, _, _, tail = carry
         return ((rounds < max_rounds)
                 & jnp.any(st["phase"] != PHASE_DONE) & ~tail)
 
-    def _make_body(allow_cascade_exit):
+    def _make_body(allow_cascade_exit, shared=None, allow_compact=True,
+                   occ_fold=None):
+        # ``shared``: chip-shared designs override for the rebalanced
+        # tail (own + guest chips concatenated).  ``allow_compact=False``
+        # pins lane positions through the loop — the rebalancing ring's
+        # un-migration merge is positional, so the rebalanced tail must
+        # not permute.  ``occ_fold=C`` folds guest chip rows C..2C into
+        # their host rows for the occupancy capture, so migrated lanes
+        # stay accounted on the device that computes them.
         def body(carry):
             st, rounds, counts, occ, ncomp, tail = carry
-            res_l = _loop_res(st)
+            res_l = _loop_res(st, shared)
             phase = st["phase"]
             in_init = phase == PHASE_INIT
             in_mon = phase == PHASE_MONITOR
@@ -1334,6 +1418,9 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
             active_c = jnp.sum(phase != PHASE_DONE, -1).astype(jnp.int32)
             paid_c = _paid_lanes(phase, _block_widths(Pc)) if compact_on \
                 else jnp.full_like(active_c, Pc)
+            if occ_fold is not None:
+                active_c = active_c[:occ_fold] + active_c[occ_fold:]
+                paid_c = paid_c[:occ_fold] + paid_c[occ_fold:]
             occ = lax.dynamic_update_slice(
                 occ, jnp.stack([active_c, paid_c], -1)[None],
                 (rounds, jnp.zeros((), rounds.dtype),
@@ -1348,29 +1435,54 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
 
             close = mon["is_tail"] | mon["is_brk"]
             any_close = jnp.any(close)
-            bufs, nseg = lax.cond(any_close,
-                                  lambda: closef(res_l, st, mon),
-                                  lambda: (st["bufs"], st["nseg"]))
-
             # Refit / init-ok shared fit (skipped when no pixel needs one).
             init_ok, is_refit = init["init_ok"], mon["is_refit"]
             do_fit = init_ok | is_refit
             any_fit = jnp.any(do_fit)
             n_full = jnp.where(init_ok, init["n_ok"], mon["n_rf"])
 
-            def _run_fit():
-                # The [C,P,T] fit-window build lives inside the branch so
-                # a no-fit round materializes nothing.
-                w_full = jnp.where(init_ok[..., None], init["w_stab"],
-                                   mon["included_mon"]
-                                   & is_refit[..., None])
-                if compact_on:
-                    return fitf(res_l, w_full.astype(fdtype), n_full,
-                                do_fit)
-                return fitf(res_l, w_full.astype(fdtype), n_full)
+            def _w_full():
+                # The [C,P,T] fit-window build lives inside the branches
+                # so a no-fit round materializes nothing.
+                return jnp.where(init_ok[..., None], init["w_stab"],
+                                 mon["included_mon"] & is_refit[..., None])
 
-            cfull, rfull = lax.cond(any_fit, _run_fit,
-                                    lambda: (st["coefs"], st["rmse"]))
+            if fused_on:
+                # One fused pallas_call serves the close AND the shared
+                # fit on a single VMEM residency of the wire spectra;
+                # the do_fit coefs/rmse merge happens in-kernel, so the
+                # branch returns the MERGED model directly.  The break
+                # magnitudes stay on the shared _close_mags program
+                # under their own (rare) any-break cond — the identical
+                # code on fused and unfused paths, which is what keeps
+                # the golden byte-identical instead of envelope-bound.
+                def _run_fused():
+                    w = _w_full().astype(fdtype)
+                    mg = lax.cond(jnp.any(mon["is_brk"]),
+                                  lambda: magsf(res_l, st, mon),
+                                  lambda: jnp.zeros_like(st["rmse"]))
+                    if compact_on:
+                        return fusedf(res_l, w, do_fit, n_full, mg, st,
+                                      mon, do_fit | close)
+                    return fusedf(res_l, w, do_fit, n_full, mg, st, mon)
+
+                bufs, nseg, cfull, rfull = lax.cond(
+                    any_close | any_fit, _run_fused,
+                    lambda: (st["bufs"], st["nseg"], st["coefs"],
+                             st["rmse"]))
+            else:
+                bufs, nseg = lax.cond(any_close,
+                                      lambda: closef(res_l, st, mon),
+                                      lambda: (st["bufs"], st["nseg"]))
+
+                def _run_fit():
+                    w = _w_full().astype(fdtype)
+                    if compact_on:
+                        return fitf(res_l, w, n_full, do_fit)
+                    return fitf(res_l, w, n_full)
+
+                cfull, rfull = lax.cond(any_fit, _run_fit,
+                                        lambda: (st["coefs"], st["rmse"]))
 
             # ============== next state (batched elementwise) ============
             is_tail, is_brk = mon["is_tail"], mon["is_brk"]
@@ -1396,9 +1508,12 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
                 jnp.where(is_brk[..., None], False,
                           jnp.where(in_mon[..., None], mon["included_mon"],
                                     st["included"])))
-            coefs_n = jnp.where(do_fit[..., None, None], cfull,
-                                st["coefs"])
-            rmse_n = jnp.where(do_fit[..., None], rfull, st["rmse"])
+            if fused_on:
+                coefs_n, rmse_n = cfull, rfull    # merged in-kernel
+            else:
+                coefs_n = jnp.where(do_fit[..., None, None], cfull,
+                                    st["coefs"])
+                rmse_n = jnp.where(do_fit[..., None], rfull, st["rmse"])
             nlast_n = jnp.where(do_fit, n_full.astype(jnp.int32),
                                 st["n_last_fit"])
             first_n = st["first_seg"] & ~is_brk
@@ -1412,7 +1527,7 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
             counts_n = counts + jnp.stack(
                 [any_init, any_fit, any_close]).astype(jnp.int32)
 
-            if compact_on:
+            if compact_on and allow_compact:
                 # ---- dense-prefix compaction ----
                 n_alive = jnp.sum(st_n["phase"] != PHASE_DONE,
                                   -1).astype(jnp.int32)          # [C]
@@ -1445,6 +1560,7 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
     state, rounds, counts, occ, ncomp, tail = lax.while_loop(
         cond, _make_body(cascade_on), carry0)
 
+    lanes_migrated = None
     if cascade_on:
         # ---- stage 2: bucketed re-entry for the long tail ----
         # The exit compaction put every still-working lane in the dense
@@ -1467,9 +1583,38 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
         st2["perm"] = _slice_p(state["perm"])
         st2["base_alive"] = jnp.sum(st2["phase"] != PHASE_DONE,
                                     -1).astype(jnp.int32)
-        carry2 = (st2, rounds, counts, occ, ncomp, jnp.zeros((), bool))
-        st2, rounds, counts, occ, ncomp, _ = lax.while_loop(
-            cond, _make_body(False), carry2)
+        if rebalance is not None:
+            # ---- cross-device straggler rebalancing ring ----
+            # Compaction's per-device alive residue diverges, so without
+            # migration every chip waits on the slowest device's tail.
+            # At this boundary the survivors sit in a dense prefix per
+            # chip: ship the whole stage-2 carry one ring hop rightward
+            # (lax.ppermute on simulated meshes, the Pallas
+            # async-remote-copy kernel on TPU), activate only the DONATED
+            # lanes on the host device, run the tail loop over own+guest
+            # chips with lane positions pinned (allow_compact=False —
+            # the un-migration merge is positional), then ship the guest
+            # results back and merge them into the donor's rows.  Stores
+            # stay row-identical by construction; tests/test_fuse.py
+            # proves it on the simulated mesh.
+            from firebird_tpu.parallel import mesh as _pmesh
+
+            st2cat, shcat, donated, lanes_migrated = \
+                _pmesh.rebalance_tail_out(st2, res_shared, rebalance,
+                                          bucket)
+            carry2 = (st2cat, rounds, counts, occ, ncomp,
+                      jnp.zeros((), bool))
+            st2cat, rounds, counts, occ, ncomp, _ = lax.while_loop(
+                cond, _make_body(False, shared=shcat,
+                                 allow_compact=False, occ_fold=C),
+                carry2)
+            st2 = _pmesh.rebalance_tail_back(st2cat, donated, rebalance,
+                                             C)
+        else:
+            carry2 = (st2, rounds, counts, occ, ncomp,
+                      jnp.zeros((), bool))
+            st2, rounds, counts, occ, ncomp, _ = lax.while_loop(
+                cond, _make_body(False), carry2)
         merge = lambda full, part: full.at[:, :bucket].set(part)
         state = dict(state,
                      nseg=merge(state["nseg"], st2["nseg"]),
@@ -1507,7 +1652,14 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
         # aggregation wrong (sum overcounts by chips-per-shard, max
         # drops all but the busiest shard) — one nonzero per loop makes
         # the chip-sum THE batch total (record_occupancy).
-        compactions=jnp.where(jnp.arange(C) == 0, ncomp, 0))
+        compactions=jnp.where(jnp.arange(C) == 0, ncomp, 0),
+        # Zeros (not None) whenever a rebalance spec was armed, even on
+        # shapes whose cascade never built — so the sharded program's
+        # output structure is one trace and the counter reads 0, not
+        # "absent", when the ring had nothing to move.
+        lanes_migrated=(lanes_migrated if lanes_migrated is not None
+                        else (jnp.zeros((C,), jnp.int32)
+                              if rebalance is not None else None)))
 
 
 # ---------------------------------------------------------------------------
@@ -1557,7 +1709,8 @@ def device_designs(days, n_obs, dtype):
 
 def _detect_batch_wire(days_i32, n_obs_i32, Y_i16, qa_wire, *, dtype,
                        wcap=None, sensor=LANDSAT_ARD,
-                       max_segments=MAX_SEGMENTS, compact=None):
+                       max_segments=MAX_SEGMENTS, compact=None,
+                       fused=None):
     """Batch detect from the all-integer wire: spectra ride int16, QA
     uint8/uint16, and the day ordinals ride int32 — the harmonic design
     matrices, the float date grid, and the validity mask are built on
@@ -1571,10 +1724,11 @@ def _detect_batch_wire(days_i32, n_obs_i32, Y_i16, qa_wire, *, dtype,
     return _detect_batch_core(Xs, Xts, ts, valids, Y_i16,
                               qa_wire.astype(jnp.int32), wcap=wcap,
                               sensor=sensor, max_segments=max_segments,
-                              dtype=dtype, compact=compact)
+                              dtype=dtype, compact=compact, fused=fused)
 
 
-_WIRE_STATICS = ("dtype", "wcap", "sensor", "max_segments", "compact")
+_WIRE_STATICS = ("dtype", "wcap", "sensor", "max_segments", "compact",
+                 "fused")
 # Donating twin for the driver's staged steady-state dispatch: the packed
 # wire buffers (spectra + QA, the dominant HBM input term) are consumed by
 # the dispatch, so a deeper pipeline (Config.pipeline_depth) doesn't pin
@@ -1785,6 +1939,19 @@ def record_occupancy(seg) -> dict | None:
             "kernel_compactions",
             help="dense-prefix lane compactions").inc(
             int(np.asarray(comp).sum()))
+    lm = getattr(seg, "lanes_migrated", None)
+    if lm is not None:
+        moved = int(np.asarray(lm).sum())
+        obs_metrics.counter(
+            "kernel_lanes_migrated",
+            help="straggler lanes migrated to a neighbor device by the "
+                 "rebalancing ring").inc(moved)
+        if moved:
+            obs_metrics.counter(
+                "rebalance_migrations",
+                help="dispatches in which the rebalancing ring moved "
+                     "lanes").inc()
+        det["lanes_migrated"] = moved
     return det
 
 
@@ -1865,7 +2032,7 @@ def stage_packed(packed, dtype) -> tuple:
 
 def aot_compile(avatars, *, dtype, wcap, sensor=LANDSAT_ARD,
                 max_segments: int = MAX_SEGMENTS, donate: bool = False,
-                compact: bool | None = None):
+                compact: bool | None = None, fused: bool | None = None):
     """AOT lower+compile the wire-dtype batch program for a shape WITHOUT
     running it (``avatars`` are jax.ShapeDtypeStructs in the
     ``_detect_batch_wire`` argument order: days int32 [C,T], n_obs int32
@@ -1880,14 +2047,15 @@ def aot_compile(avatars, *, dtype, wcap, sensor=LANDSAT_ARD,
     fn = _detect_batch_wire_donated if donate else _detect_batch_wire
     return fn.lower(*avatars, dtype=jnp.dtype(dtype), wcap=wcap,
                     sensor=sensor, max_segments=max_segments,
-                    compact=compact).compile()
+                    compact=compact, fused=fused).compile()
 
 
 def detect_packed(packed, dtype=jnp.float32,
                   max_segments: int = MAX_SEGMENTS,
                   check_capacity: bool = True, staged: tuple | None = None,
                   donate: bool = False,
-                  compact: bool | None = None) -> ChipSegments:
+                  compact: bool | None = None,
+                  fused: bool | None = None) -> ChipSegments:
     """Run the kernel over a PackedChips batch -> ChipSegments with leading
     chip axis [C, P, ...].  The batch's sensor spec selects the band
     layout the kernel compiles for.
@@ -1912,12 +2080,12 @@ def detect_packed(packed, dtype=jnp.float32,
     args = staged if staged is not None else stage_packed(packed, dtype)
     kw = dict(dtype=jnp.dtype(dtype), wcap=window_cap(packed),
               sensor=getattr(packed, "sensor", LANDSAT_ARD),
-              compact=compact)
+              compact=compact, fused=fused)
     fn = _detect_batch_wire_donated if donate and not check_capacity \
         else _detect_batch_wire
     dispatch = lambda S: record_first_call(
         ("single", packed.spectra.shape, str(kw["dtype"]), kw["wcap"],
-         kw["sensor"].name, S, compact),
+         kw["sensor"].name, S, compact, fused),
         lambda: fn(*args, max_segments=S, **kw))
     if not check_capacity:
         return dispatch(max(max_segments, 1))
@@ -1988,7 +2156,8 @@ def pack_egress(seg: ChipSegments, s_eff: int) -> dict:
                meta=meta_i, rmse=bc(sl(seg.seg_rmse)),
                mag=bc(sl(seg.seg_mag)), coef=bc(sl(seg.seg_coef)),
                mask=jnp.packbits(seg.mask, axis=-1))
-    for f in ("rounds", "round_counts", "occupancy", "compactions"):
+    for f in ("rounds", "round_counts", "occupancy", "compactions",
+              "lanes_migrated"):
         v = getattr(seg, f)
         if v is not None:
             out[f] = v

@@ -1197,6 +1197,314 @@ def tmask_bad(Xtw, Y2, w, vario2, *, active=None, interpret=False):
 
 
 # ---------------------------------------------------------------------------
+# Fused fit+close round kernel (FIREBIRD_FUSED_FIT): the gram→CD→close
+# boundary of one event-loop round in a single pallas_call.
+# ---------------------------------------------------------------------------
+
+def fused_block_p(T: int, B: int, S: int, y_bytes: int) -> int:
+    """Lane-block width for the fused fit+close kernel: the [B,T,BP]
+    wire spectra, ~8 live [T,BP] f32 planes (fit window, alive/included,
+    prediction temporaries), the [S,*,BP] result buffers twice (in+out
+    live across the block), and the PEEK-run selection planes."""
+    budget = 10 * 2 ** 20
+    per_lane = (max(T, 1) * (B * y_bytes + 8 * 4)
+                + 2 * max(S, 1) * (6 + 2 * B + B * params.MAX_COEFS) * 4
+                + params.PEEK_SIZE * (params.MAX_COEFS + B + 4) * 4)
+    return max(128, min(512, (budget // per_lane) // 128 * 128))
+
+
+def _fused_fit_close_block(x_ref, xtk_ref, xxt_ref, t_ref, y_ref,
+                           wfit_ref, dofit_ref, nfull_ref,
+                           incm_ref, coefs_ref, rmse_ref, magsin_ref,
+                           tail_ref, brk_ref, pos_ref, nexc_ref,
+                           first_ref, nseg_ref, meta0_ref, rmses0_ref,
+                           mags0_ref, coefs0_ref, *refs, T, B, K, S, peek,
+                           qa_start, qa_inside, qa_end,
+                           cd_iters, alpha, num_obs_factor, mid_coefs,
+                           guarded=False):
+    """One pixel block's fit round ACROSS the gram→CD→close boundary:
+    the segment-close row write against the closing model and the shared
+    Lasso refit (_gram_cd_core + RMSE) run back to back on one VMEM
+    residency of the wire spectra — the XLA loop streams the [B,T,P]
+    spectra for the fit's Gram/corr/RMSE and round-trips the [P,S*k]
+    result buffers plus the [P,*] intermediates between its two
+    cond-gated fusions.  Every close value here is an exact select, an
+    integer in f32, or a carried input: the break magnitudes (the one
+    genuinely float close term) arrive PRE-COMPUTED in ``magsin_ref`` —
+    kernel._close_mags runs the identical program on fused and unfused
+    paths under a rare any(is_brk) cond — and the fit half is the same
+    _gram_cd_core the per-component fit kernel wraps.  That is what
+    makes the fused-on/off stores byte-identical (tests/test_fuse.py
+    golden) instead of decision-exact-with-envelope like the mega route.
+    """
+    cnt_ref, (meta_ref, rmses_ref, mags_ref, coefsb_ref, nsego_ref,
+              co_ref, ro_ref) = ((refs[0], refs[1:]) if guarded
+                                 else (None, refs))
+
+    def compute():
+        X = x_ref[...]
+        t_col = t_ref[...]
+        f32 = X.dtype
+        y_of = lambda b: y_ref[b].astype(f32)
+        i32 = jnp.int32
+        one = i32(1)
+        coefs = coefs_ref[...]
+        rmse = rmse_ref[...]
+
+        # ---- close row write (kernel._close_block, minus the
+        #      pre-computed magnitudes; the OLD model closes) ----
+        incm = incm_ref[...] > 0                              # [T, BP]
+        is_tail = tail_ref[...] > 0
+        is_brk = brk_ref[...] > 0
+        first_seg = first_ref[...] > 0
+        nseg0 = nseg_ref[...]
+        close = is_tail | is_brk                              # [1, BP]
+        ti = lax.broadcasted_iota(i32, incm.shape, 0)
+        t_plane = jnp.broadcast_to(t_col, incm.shape)
+
+        def at_t(plane, idx):
+            return jnp.sum(jnp.where(ti == idx, plane, 0), 0,
+                           keepdims=True)
+
+        any_inc = jnp.any(incm, 0, keepdims=True)
+        INF = i32(T + 1)
+        first_inc = jnp.where(
+            any_inc,
+            jnp.min(jnp.where(incm, ti, INF), 0, keepdims=True), 0)
+        last_inc = jnp.where(
+            any_inc,
+            jnp.max(jnp.where(incm, ti, -1), 0, keepdims=True), T - 1)
+        start_day = at_t(t_plane, first_inc)
+        end_day = at_t(t_plane, last_inc)
+        break_day = jnp.where(is_brk, at_t(t_plane, pos_ref[...]),
+                              end_day)
+        chprob = jnp.where(is_brk, 1.0,
+                           nexc_ref[...].astype(f32) / float(peek))
+        qa_tail = qa_end + jnp.where(first_seg, qa_start, 0)
+        qa_brk = jnp.where(first_seg, qa_start, qa_inside)
+        qa = jnp.where(is_brk, qa_brk, qa_tail).astype(f32)
+        n_obs = jnp.sum(jnp.where(incm, one, 0), 0,
+                        keepdims=True).astype(f32)
+        meta_new = jnp.concatenate(
+            [start_day, end_day, break_day, chprob, qa, n_obs], 0)
+        mag_new = jnp.where(is_brk, magsin_ref[...], 0.0)     # [B, BP]
+        coef_new = jnp.concatenate([coefs[b] for b in range(B)], 0)
+
+        # One-hot append at nseg (kernel._write_seg): rows past capacity
+        # are never selected, but nseg still counts — the overflow
+        # contract detect_packed's capacity_retry relies on.
+        si = lax.broadcasted_iota(i32, (S, 1) + incm.shape[1:], 0)
+        sel = (si == nseg0[None]) & close[None]               # [S,1,BP]
+        meta_b = jnp.where(sel, meta_new[None], meta0_ref[...])
+        rmses_b = jnp.where(sel, rmse[None], rmses0_ref[...])
+        mags_b = jnp.where(sel, mag_new[None], mags0_ref[...])
+        coefs_b = jnp.where(sel, coef_new[None], coefs0_ref[...])
+        nseg = nseg0 + jnp.where(close, one, 0)
+
+        # ---- shared Lasso fit (init-ok + refit; mega's run_fit math) ----
+        wf = wfit_ref[...]                                    # [T, BP]
+        n_full = nfull_ref[...]                               # [1, BP]
+        nc = jnp.where(
+            n_full >= K * num_obs_factor, K,
+            jnp.where(n_full >= mid_coefs * num_obs_factor,
+                      mid_coefs, 4))
+        cm = jnp.where(
+            lax.broadcasted_iota(i32, (K,) + n_full.shape[1:], 0) < nc,
+            1.0, 0.0).astype(f32)
+        beta, n = _gram_cd_core(xtk_ref[...], xxt_ref[...], y_of, wf, cm,
+                                B=B, K=K, iters=cd_iters, alpha=alpha)
+        rs = []
+        for b in range(B):
+            pred = jnp.dot(X, beta[b], preferred_element_type=f32)
+            r = y_of(b) - pred
+            rs.append(jnp.sqrt(jnp.maximum(
+                jnp.sum(r * r * wf, 0, keepdims=True) / n, 0.0)))
+        rmse_new = jnp.concatenate(rs, 0)                     # [B, BP]
+
+        do_fit = dofit_ref[...] > 0                           # [1, BP]
+        meta_ref[...] = meta_b
+        rmses_ref[...] = rmses_b
+        mags_ref[...] = mags_b
+        coefsb_ref[...] = coefs_b
+        nsego_ref[...] = nseg.astype(nsego_ref.dtype)
+        co_ref[...] = jnp.where(do_fit[None], beta, coefs)
+        ro_ref[...] = jnp.where(do_fit, rmse_new, rmse)
+
+    def skip():
+        # A block with no closing and no fitting lane is a pure
+        # pass-through: the close write-mask selects nothing and the
+        # do_fit merge keeps the old model — so copying the inputs IS
+        # the computed value, exactly (the skip-guard contract).
+        meta_ref[...] = meta0_ref[...]
+        rmses_ref[...] = rmses0_ref[...]
+        mags_ref[...] = mags0_ref[...]
+        coefsb_ref[...] = coefs0_ref[...]
+        nsego_ref[...] = nseg_ref[...].astype(nsego_ref.dtype)
+        co_ref[...] = coefs_ref[...]
+        ro_ref[...] = rmse_ref[...]
+
+    _when_active(cnt_ref, compute, skip)
+
+
+@functools.partial(jax.jit, static_argnames=("S", "block_p", "interpret"))
+def fused_fit_close(Yt, X, t, w_fit, do_fit, n_full, included_mon,
+                    coefs, rmse, mags, is_tail, is_brk, pos_ev,
+                    n_exceed, first_seg, nseg, bufs, *, S, active=None,
+                    block_p=None, interpret=False):
+    """Fused Pallas twin of one round's close + shared-fit pair
+    (kernel._close_block + the refit's fit), reading the wire-dtype
+    resident spectra ONCE per pixel block.
+
+    Args:
+        Yt: [B, T, P] resident spectra (wire int16 or float32).
+        X: [T, K] design (chip-shared); t: [T] float ordinal days.
+        w_fit: [P, T] 0/1 fit window (init w_stab or included&refit).
+        do_fit: [P] bool; n_full: [P] int (the fit's obs count).
+        included_mon: [P, T] bool round plane.
+        coefs: [P, B, K]; rmse: [P, B] — the CURRENT model (closes the
+            segment; replaced where do_fit).
+        mags: [P, B] break magnitudes, pre-computed by
+            kernel._close_mags under an any(is_brk) cond (identical
+            program fused and unfused — the byte-identity anchor).
+        pos_ev, n_exceed: [P] int; is_tail/is_brk/first_seg: [P] bool
+            (the monitor chain's event outputs).
+        nseg: [P] int32; bufs: the four FLAT result buffers
+            (meta [P,S*6], rmse [P,S*B], mag [P,S*B], coef [P,S*B*K]).
+        active: optional [P] bool per-block skip guard — normally
+            do_fit | is_tail | is_brk; skipped blocks pass everything
+            through unchanged (exact, see the block's skip note).
+        block_p: static lane-width override (tools/fuse_repro.py's
+            block-shape reduction); None sizes from the VMEM budget.
+    Returns:
+        (bufs', nseg', coefs', rmse') in the caller's layouts.
+    """
+    B, T, P = Yt.shape
+    K = X.shape[-1]
+    f32 = X.dtype
+    i32 = jnp.int32
+    peek = int(params.PEEK_SIZE)
+    BP = block_p or fused_block_p(T, B, S, Yt.dtype.itemsize)
+    Pp = -BP * (-P // BP)
+    pad = Pp - P
+    plane, vec = _pad_helpers(pad)
+
+    meta0, rmse0, mag0, coef0 = bufs
+    XT = X.T                                                  # [K, T]
+    XXT = (X[:, :, None] * X[:, None, :]).reshape(T, K * K).T  # [K*K, T]
+    padb = lambda a: jnp.pad(a, ((0, 0), (0, 0), (0, pad)))
+    padr = lambda a, cv=0.0: jnp.pad(a.T, ((0, 0), (0, pad)),
+                                     constant_values=cv)
+    args = [X, XT.astype(f32), XXT.astype(f32), t.astype(f32)[:, None],
+            padb(Yt), plane(w_fit.astype(f32)), vec(do_fit.astype(i32)),
+            vec(n_full.astype(i32)),
+            plane(included_mon.astype(i32)),
+            padb(coefs.transpose(1, 2, 0)),
+            padr(rmse, 1.0), padr(mags),
+            vec(is_tail.astype(i32)), vec(is_brk.astype(i32)),
+            vec(pos_ev.astype(i32)), vec(n_exceed.astype(i32)),
+            vec(first_seg.astype(i32)), vec(nseg.astype(i32)),
+            padb(meta0.reshape(P, S, 6).transpose(1, 2, 0)),
+            padb(rmse0.reshape(P, S, B).transpose(1, 2, 0)),
+            padb(mag0.reshape(P, S, B).transpose(1, 2, 0)),
+            padb(coef0.reshape(P, S, B * K).transpose(1, 2, 0))]
+
+    full = lambda shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+    pspec = pl.BlockSpec((T, BP), lambda i: (0, i))
+    vspec = pl.BlockSpec((1, BP), lambda i: (0, i))
+    bspec = pl.BlockSpec((B, BP), lambda i: (0, i))
+    b3 = lambda lead: pl.BlockSpec((lead[0], lead[1], BP),
+                                   lambda i: (0, 0, i))
+    in_specs = [full((T, K)), full((K, T)), full((K * K, T)), full((T, 1)),
+                b3((B, T)), pspec, vspec, vspec, pspec,
+                b3((B, K)), bspec, bspec,
+                vspec, vspec, vspec, vspec, vspec, vspec,
+                b3((S, 6)), b3((S, B)), b3((S, B)), b3((S, B * K))]
+    if active is not None:
+        args.append(_block_counts(active, BP, Pp))
+        in_specs.append(_CNT_SPEC)
+
+    kern = functools.partial(
+        _fused_fit_close_block, T=T, B=B, K=K, S=S, peek=peek,
+        qa_start=int(params.CURVE_QA_START),
+        qa_inside=int(params.CURVE_QA_INSIDE),
+        qa_end=int(params.CURVE_QA_END),
+        cd_iters=int(params.LASSO_ITERS), alpha=float(params.LASSO_ALPHA),
+        num_obs_factor=int(params.NUM_OBS_FACTOR),
+        mid_coefs=int(params.MID_COEFS), guarded=active is not None)
+    outs = pl.pallas_call(
+        kern,
+        grid=(Pp // BP,),
+        in_specs=in_specs,
+        out_specs=[b3((S, 6)), b3((S, B)), b3((S, B)), b3((S, B * K)),
+                   vspec, b3((B, K)), pl.BlockSpec((B, BP),
+                                                   lambda i: (0, i))],
+        out_shape=[jax.ShapeDtypeStruct((S, 6, Pp), f32),
+                   jax.ShapeDtypeStruct((S, B, Pp), f32),
+                   jax.ShapeDtypeStruct((S, B, Pp), f32),
+                   jax.ShapeDtypeStruct((S, B * K, Pp), f32),
+                   jax.ShapeDtypeStruct((1, Pp), i32),
+                   jax.ShapeDtypeStruct((B, K, Pp), f32),
+                   jax.ShapeDtypeStruct((B, Pp), f32)],
+        interpret=interpret,
+    )(*args)
+    meta_n, rmses_n, mags_n, coefsb_n, nseg_n, co, ro = outs
+    unflat = lambda a, k: a[..., :P].transpose(2, 0, 1).reshape(P, S * k)
+    bufs_n = (unflat(meta_n, 6), unflat(rmses_n, B), unflat(mags_n, B),
+              unflat(coefsb_n, B * K))
+    return (bufs_n, nseg_n[0, :P], co[..., :P].transpose(2, 0, 1),
+            ro[:, :P].T)
+
+
+# ---------------------------------------------------------------------------
+# Ring remote-copy kernel (cross-device straggler rebalancing).  One ring
+# hop of the rebalancing exchange (parallel.mesh): ship a shard-local
+# array to the logical neighbor over ICI via an async remote DMA —
+# SNIPPETS.md [1]/[2]'s shard_map + make_async_remote_copy template.
+# TPU-compiled only: the CPU/simulated-mesh path uses lax.ppermute
+# (mesh._ring_shift), which is semantically identical (a fixed
+# source→dest permutation along the ring axis).
+# ---------------------------------------------------------------------------
+
+def _ring_copy_kernel(dst_ref, x_ref, out_ref, send_sem, recv_sem):
+    from jax.experimental.pallas import tpu as pltpu  # TPU-only lowering
+
+    copy = pltpu.make_async_remote_copy(
+        src_ref=x_ref, dst_ref=out_ref, send_sem=send_sem,
+        recv_sem=recv_sem, device_id=(dst_ref[0],),
+        device_id_type=pltpu.DeviceIdType.LOGICAL)
+    copy.start()
+    copy.wait()
+
+
+def ring_remote_copy(x, dst_index):
+    """Ship ``x`` (shard-local, any shape) to logical device
+    ``dst_index`` on the ring; returns the buffer received from whichever
+    neighbor targeted THIS device (every device along the axis calls
+    with its own neighbor, so the exchange is a pure ring rotation).
+
+    The payload stays in HBM (``TPUMemorySpace.ANY``) — the rebalancing
+    slabs are MB-scale state trees, not VMEM blocks — and the DMA
+    completes before return (start+wait; the overlap the rebalancer
+    needs is across payload FIELDS, which jax schedules as independent
+    kernels).  ``dst_index`` is a traced scalar (axis_index ± 1), fed
+    through scalar prefetch.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * 2,
+    )
+    return pl.pallas_call(
+        _ring_copy_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid_spec=grid_spec,
+    )(jnp.asarray(dst_index, jnp.int32).reshape(1), x)
+
+
+# ---------------------------------------------------------------------------
 # Whole-loop mega kernel: the entire event-horizon loop in one pallas_call
 # ---------------------------------------------------------------------------
 
@@ -1571,10 +1879,10 @@ def _detect_mega_block(phase0_ref, curi0_ref, nseg0_ref, alive0_ref,
 
 @functools.partial(jax.jit, static_argnames=(
     "W", "S", "sensor", "phases", "change_thr", "outlier_thr",
-    "interpret"))
+    "block_p", "interpret"))
 def detect_mega(Yt, phase0, cur_i0, alive0, nseg0, bufs0, t, X, Xt, vario,
                 *, W, S, sensor, phases, change_thr, outlier_thr,
-                interpret=False):
+                block_p=None, interpret=False):
     """The whole event-horizon loop as ONE pallas_call (the 'mega'
     component): grid over (chip, pixel-block), each block running its own
     while_loop with the wire spectra VMEM-resident — HBM traffic for the
@@ -1602,7 +1910,9 @@ def detect_mega(Yt, phase0, cur_i0, alive0, nseg0, bufs0, t, X, Xt, vario,
     det = tuple(sensor.detection_bands)
     tmb = tuple(sensor.tmask_bands)
     ph_init, ph_mon, ph_done = phases
-    BP = mega_block_p(T, W, B, S, Yt.dtype.itemsize)
+    # ``block_p`` (static) overrides the budget-derived width — the
+    # SIGABRT repro's block-shape reduction (tools/fuse_repro.py).
+    BP = block_p or mega_block_p(T, W, B, S, Yt.dtype.itemsize)
     Pp = -BP * (-P // BP)
     pad = Pp - P
     nblk = Pp // BP

@@ -186,6 +186,15 @@ KNOBS = (
          help="bucket fraction that triggers loop re-entry"),
     Knob(name="FIREBIRD_PALLAS", default="0",
          help="Pallas kernel component selection (0/1/comma list)"),
+    Knob(name="FIREBIRD_FUSED_FIT", default="0",
+         help="fused gram→CD→close Pallas round kernel (one VMEM "
+              "residency serves the close + shared-fit pair)"),
+    Knob(name="FIREBIRD_REBALANCE", default="0",
+         help="cross-device straggler rebalancing ring at the "
+              "bucketed-tail boundary (sharded dispatches)"),
+    Knob(name="FIREBIRD_REBALANCE_THRESHOLD", default="0.25",
+         help="alive-count gap (fraction of a device's stage-2 lanes) "
+              "that triggers a migration hop"),
     Knob(name="FIREBIRD_WIRE_QA8", default="1",
          help="ship the staged QA plane as uint8 (0: full uint16)"),
     Knob(name="FIREBIRD_WIRE_EGRESS", default="1",
@@ -237,6 +246,8 @@ KNOBS = (
          help="alert-soak artifact directory"),
     Knob(name="FIREBIRD_WIRE_DIR", default="/tmp/fb_wire",
          help="wire-smoke artifact directory"),
+    Knob(name="FIREBIRD_FUSE_DIR", default="/tmp/fb_fuse",
+         help="fuse-smoke / fuse-repro artifact directory"),
     Knob(name="FIREBIRD_LINT_DIR", default="/tmp/fb_lint",
          readers=("Makefile",), internal=True,
          help="lint-report artifact directory (make lint)"),

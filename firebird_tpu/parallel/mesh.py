@@ -18,6 +18,7 @@ and jax.distributed handles DCN bring-up (parallel.dist).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import itertools
 
@@ -131,6 +132,159 @@ def shard_packed(packed, mesh: Mesh, dtype, prepped=None):
     return tuple(put(a) for a in wire_args(packed))
 
 
+# ---------------------------------------------------------------------------
+# Cross-device straggler rebalancing ring (FIREBIRD_REBALANCE).
+#
+# Active-lane compaction (kernel._detect_batch_impl) leaves a ragged
+# per-device residue: each shard's event loop runs until ITS slowest lane
+# finishes, so after most lanes die, whole chips idle while one device
+# grinds its tail.  At the bucketed-tail boundary the survivors sit in a
+# dense prefix per chip — the cheapest possible migration point: ship the
+# stage-2 carry one ring hop rightward, activate only the lanes the donor
+# chose to shed, run the tail over own+guest chips, ship the guest
+# results back, and merge them positionally into the donor's rows.  The
+# exchange is a fixed ring rotation (every device sends exactly once and
+# receives exactly once per hop), realized as lax.ppermute on simulated/
+# CPU meshes and as the Pallas async-remote-copy kernel
+# (pallas_ops.ring_remote_copy — SNIPPETS.md [1]/[2]'s template) on TPU.
+# Row identity holds by construction: the donated lanes' state is
+# bit-identical on the host device, the tail loop never permutes lanes
+# (kernel passes allow_compact=False), and the merge is positional.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class RebalanceSpec:
+    """Static configuration of the rebalancing ring for one dispatch.
+
+    Hashable on purpose: it rides the ``sharded_detect_fn`` lru_cache
+    key and the jit closure, so a knob change mid-process traces a fresh
+    program instead of reusing a stale one.  ``threshold`` is the
+    alive-count gap (as a fraction of a device's stage-2 lanes,
+    chips x bucket) beyond which the donor sheds half the gap;
+    ``rdma=True`` routes each hop through the Pallas remote-copy kernel
+    (TPU), False through lax.ppermute (CPU / simulated meshes).
+    """
+
+    axis: str = "data"
+    n: int = 1
+    threshold: float = 0.25
+    rdma: bool = False
+
+    def _hop(self, shift: int):
+        from jax import lax
+
+        if self.rdma:
+            from firebird_tpu.ccd import pallas_ops
+
+            def f(x):
+                me = lax.axis_index(self.axis)
+                return pallas_ops.ring_remote_copy(
+                    x, (me + shift) % self.n)
+
+            return f
+        pairs = [(i, (i + shift) % self.n) for i in range(self.n)]
+        return lambda x: lax.ppermute(x, self.axis, pairs)
+
+    def to_right(self, tree):
+        """One hop rightward: device d's payload lands on d+1; returns
+        what arrived from the left neighbor."""
+        return jax.tree_util.tree_map(self._hop(+1), tree)
+
+    def to_left(self, tree):
+        """One hop leftward (the return path, and the count probe: what
+        comes back is the RIGHT neighbor's payload)."""
+        return jax.tree_util.tree_map(self._hop(-1), tree)
+
+
+def rebalance_spec(mesh: Mesh):
+    """The dispatch's rebalancing configuration, or None when the ring
+    is off (FIREBIRD_REBALANCE, default off) or the mesh has a single
+    device.  Resolved at program-construction time — the spec is part of
+    the sharded program's cache key, like the other trace-time knobs."""
+    from firebird_tpu.config import env_knob
+
+    if env_knob("FIREBIRD_REBALANCE") in ("", "0", None):
+        return None
+    n = int(mesh.devices.size)
+    if n < 2:
+        return None
+    return RebalanceSpec(
+        axis=mesh.axis_names[0], n=n,
+        threshold=float(env_knob("FIREBIRD_REBALANCE_THRESHOLD")),
+        rdma=jax.default_backend() == "tpu")
+
+
+def rebalance_tail_out(st2, shared, spec: RebalanceSpec, bucket: int):
+    """The migration half of the ring, at the stage-2 (bucketed-tail)
+    boundary inside the traced per-shard program.
+
+    ``st2`` is the shard's stage-2 carry (state dict incl. residents and
+    result buffers, every leaf chip-leading); ``shared`` the chip-shared
+    designs dict.  Decides the donation (gap to the RIGHT neighbor over
+    the threshold → shed half the gap, taken from the global tail of the
+    shard's dense alive prefixes), ships the full carry one hop
+    rightward, and returns ``(st2cat, sharedcat, donated,
+    lanes_migrated)`` where st2cat/sharedcat hold own + guest chips
+    concatenated on the chip axis, guest lanes active only where the
+    donor shed them, and the donor's own copies of those lanes parked
+    DONE.  ``donated`` [C, bucket] is kept by the donor for the
+    positional merge in :func:`rebalance_tail_back`."""
+    import jax.numpy as jnp
+    from firebird_tpu.ccd.kernel import PHASE_DONE
+
+    phase = st2["phase"]                                   # [C, bucket]
+    C = phase.shape[0]
+    alive = phase != PHASE_DONE
+    n_alive_c = jnp.sum(alive, -1).astype(jnp.int32)       # [C]
+    na = jnp.sum(n_alive_c)
+    na_right = spec.to_left(na.reshape(1))[0]
+    thresh = max(int(spec.threshold * C * bucket), 1)
+    gap = na - na_right
+    give = jnp.where(gap > thresh, gap // 2, 0)
+    # Global lane index over the shard's dense alive prefixes: the
+    # donated set is exactly the global tail of size ``give`` (greedy
+    # from the last chips), so the count is exact and deterministic.
+    off = jnp.cumsum(n_alive_c) - n_alive_c                # exclusive
+    lane = jnp.arange(bucket, dtype=jnp.int32)[None, :]
+    g_idx = off[:, None] + lane
+    donated = (lane < n_alive_c[:, None]) & (g_idx >= na - give)
+
+    guest_st2, guest_sh, guest_don = spec.to_right(
+        (st2, shared, donated))
+    own = dict(st2, phase=jnp.where(donated, PHASE_DONE, phase))
+    guest = dict(guest_st2, phase=jnp.where(
+        guest_don, guest_st2["phase"], PHASE_DONE))
+    cat = jax.tree_util.tree_map(
+        lambda a, b: jnp.concatenate([a, b], 0), own, guest)
+    shcat = jax.tree_util.tree_map(
+        lambda a, b: jnp.concatenate([a, b], 0), dict(shared), guest_sh)
+    return cat, shcat, donated, jnp.sum(donated, -1).astype(jnp.int32)
+
+
+def rebalance_tail_back(stcat, donated, spec: RebalanceSpec, C: int):
+    """The un-migration half: split own/guest, ship the guest results
+    back to their owner (one hop leftward), and merge them into the
+    donor's rows — positional, because the tail loop pinned lane order
+    (allow_compact=False), so ``donated`` still addresses the same rows.
+    Only the per-lane OUTPUTS move back (nseg, alive, result buffers);
+    the owner's carried permutation was never touched and stays valid
+    for the dispatch-exit unpermute."""
+    import jax.numpy as jnp
+
+    tm = jax.tree_util.tree_map
+    own = tm(lambda a: a[:C], stcat)
+    guest = tm(lambda a: a[C:], stcat)
+    ret = spec.to_left({"nseg": guest["nseg"], "alive": guest["alive"],
+                        "bufs": guest["bufs"]})
+    pick = lambda o, r, nd: jnp.where(
+        donated.reshape(donated.shape + (1,) * nd), r, o)
+    return dict(own,
+                nseg=jnp.where(donated, ret["nseg"], own["nseg"]),
+                alive=pick(own["alive"], ret["alive"], 1),
+                bufs=tuple(pick(o, r, 1) for o, r in
+                           zip(own["bufs"], ret["bufs"])))
+
+
 def _wcap_global_max(mesh: Mesh, v: int) -> int:
     """Cross-process agreement on a host scalar (the static wcap trace
     constant): every process of a cross-host SPMD dispatch must trace the
@@ -176,7 +330,8 @@ def detect_sharded(packed, mesh: Mesh, dtype=None,
                    check_capacity: bool = True,
                    max_segments: int | None = None,
                    staged: tuple | None = None, donate: bool = False,
-                   compact: bool | None = None):
+                   compact: bool | None = None,
+                   fused: bool | None = None):
     """Run the CCD kernel with the chip batch sharded over the mesh.
 
     This is the multi-device production path: same math as
@@ -194,7 +349,14 @@ def detect_sharded(packed, mesh: Mesh, dtype=None,
     overrides FIREBIRD_COMPACT per call (kernel._detect_batch_core;
     compaction is per-shard — each shard permutes its own chips' lanes,
     so no cross-shard dependence is introduced and the zero-collective
-    property holds).
+    property holds).  ``fused`` overrides FIREBIRD_FUSED_FIT likewise.
+
+    The one deliberate exception to zero-collectives is the straggler
+    rebalancing ring (FIREBIRD_REBALANCE, default off): three
+    straight-line ring exchanges at the bucketed-tail boundary (count
+    probe, migrate out, migrate back — rebalance_tail_out/_back), never
+    a collective inside the event loop, stores row-identical
+    (tests/test_fuse.py proves it on the simulated mesh).
     """
     import jax.numpy as jnp
     from firebird_tpu.ccd.kernel import (MAX_SEGMENTS, capacity_bound,
@@ -209,12 +371,15 @@ def detect_sharded(packed, mesh: Mesh, dtype=None,
     def dispatch(S):
         from firebird_tpu.ccd.kernel import record_first_call
 
+        rb = rebalance_spec(mesh)
         fn = sharded_detect_fn(mesh, jnp.dtype(dtype), wcap,
                                packed.sensor, max_segments=S,
-                               donate=do_donate, compact=compact)
+                               donate=do_donate, compact=compact,
+                               fused=fused, rebalance=rb)
         return record_first_call(
             ("sharded", packed.spectra.shape, str(jnp.dtype(dtype)), wcap,
-             packed.sensor.name, S, len(mesh.devices.flat), compact),
+             packed.sensor.name, S, len(mesh.devices.flat), compact,
+             fused, rb),
             lambda: fn(*args))
 
     def read_worst(seg):
@@ -235,7 +400,9 @@ def detect_sharded(packed, mesh: Mesh, dtype=None,
 def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor,
                       max_segments: int | None = None,
                       donate: bool = False,
-                      compact: bool | None = None):
+                      compact: bool | None = None,
+                      fused: bool | None = None,
+                      rebalance: RebalanceSpec | None = None):
     """The jitted shard_map program, cached per (mesh, dtype, wcap, sensor,
     capacity) — rebuilding the jit wrapper per batch would retrace every
     dispatch.
@@ -249,7 +416,8 @@ def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor,
 
     core = functools.partial(_detect_batch_core, wcap=wcap, sensor=sensor,
                              max_segments=max_segments or MAX_SEGMENTS,
-                             dtype=dtype, compact=compact)
+                             dtype=dtype, compact=compact, fused=fused,
+                             rebalance=rebalance)
 
     def local_batch(days, n_obs, Y_i16, qa_wire):
         # All-integer wire: each shard builds its own chips' float
@@ -289,7 +457,8 @@ def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor,
 def aot_compile_sharded(mesh: Mesh, dtype, wcap: int, sensor, shapes,
                         max_segments: int | None = None,
                         donate: bool = False,
-                        compact: bool | None = None):
+                        compact: bool | None = None,
+                        fused: bool | None = None):
     """AOT lower+compile the sharded batch program for a shape without
     running it (``shapes``: the 4 global array shapes in shard_packed's
     argument order — days [C,T], n_obs [C], spectra [C,B,P,T], QA
@@ -303,7 +472,8 @@ def aot_compile_sharded(mesh: Mesh, dtype, wcap: int, sensor, shapes,
 
     fn = sharded_detect_fn(mesh, jnp.dtype(dtype), wcap, sensor,
                            max_segments=max_segments, donate=donate,
-                           compact=compact)
+                           compact=compact, fused=fused,
+                           rebalance=rebalance_spec(mesh))
     sh = chip_sharding(mesh)
     dts = (jnp.int32, jnp.int32, jnp.int16, wire_qa_dtype())
     avatars = tuple(jax.ShapeDtypeStruct(s, jnp.dtype(d), sharding=sh)

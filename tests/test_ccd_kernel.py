@@ -37,8 +37,12 @@ def packed():
 
 
 def fetch(seg: kernel.ChipSegments, chip: int = 0) -> kernel.ChipSegments:
-    return kernel.ChipSegments(*[np.asarray(getattr(seg, f.name)[chip])
-                                 for f in dataclasses.fields(seg)])
+    # None-valued optionals (e.g. lanes_migrated on non-rebalancing
+    # dispatches) pass through, matching kernel.chip_slice's contract.
+    return kernel.ChipSegments(*[
+        None if getattr(seg, f.name) is None
+        else np.asarray(getattr(seg, f.name)[chip])
+        for f in dataclasses.fields(seg)])
 
 
 def run_kernel(p: PackedChips) -> kernel.ChipSegments:

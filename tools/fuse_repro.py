@@ -1,0 +1,175 @@
+"""Minimized repro for the r05 mega/fused-combo Mosaic SIGABRT
+(``python tools/fuse_repro.py``).
+
+BENCH_r05's autotune recorded the multi-phase Pallas combos dying with a
+compiler SIGABRT on the real-v5e remote toolchain (now classified
+compiler-crash records, bench.classify_tune_error).  This tool makes
+that crash BISECTABLE instead of anecdotal: each multi-phase pairing —
+the fused gram→CD→close kernel and the whole-loop mega kernel — is
+compiled in an isolated SUBPROCESS (a Mosaic abort kills the process;
+the parent survives and classifies) at a ladder of explicit lane-block
+widths (the ``block_p`` override on pallas_ops.fused_fit_close /
+detect_mega), smallest first.  The artifact records, per pairing, every
+probe's classified outcome and the SMALLEST failing block shape — the
+minimized repro a compiler bug report or a scratch-budget split needs.
+
+On a CPU-only host the probes run the interpret path (no Mosaic), which
+cannot reproduce a Mosaic crash — the artifact says so honestly
+(``platform: cpu``) instead of reporting a hollow all-ok.
+
+Writes ``fuse_repro.json`` (FIREBIRD_FUSE_DIR, default /tmp/fb_fuse;
+folded into bench artifacts by bench._fuse_fold).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+os.environ["FIREBIRD_PALLAS"] = "0"
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, HERE)
+
+from firebird_tpu.config import env_knob  # noqa: E402
+
+# Ladder of explicit lane-block widths, smallest first: the first
+# failure IS the minimized repro (everything below it compiles).
+BLOCKS = (128, 256, 512)
+PAIRINGS = ("fused", "mega")
+PROBE_TIMEOUT = float(env_knob("FIREBIRD_BENCH_BUDGET")) / 6
+
+
+def _probe(pairing: str, block_p: int) -> None:
+    """Child body: compile + run ONE kernel at one block shape, then
+    exit 0.  Any Mosaic abort kills this process — by design."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from firebird_tpu.ccd import pallas_ops
+    from firebird_tpu.ccd.sensor import LANDSAT_ARD
+
+    on_tpu = jax.default_backend() == "tpu"
+    rng = np.random.default_rng(0)
+    B, T, K, S, P = 7, 64, 8, 4, block_p
+    Yt = jnp.asarray(rng.integers(100, 3000, (B, T, P)), jnp.int16)
+    X = jnp.asarray(rng.standard_normal((T, K)), jnp.float32)
+    t = jnp.asarray(np.sort(rng.integers(724000, 727000, T)), jnp.float32)
+    if pairing == "fused":
+        out = pallas_ops.fused_fit_close(
+            Yt, X, t,
+            jnp.asarray(rng.integers(0, 2, (P, T)), jnp.float32),
+            jnp.ones(P, bool), jnp.full(P, 24, jnp.int32),
+            jnp.asarray(rng.integers(0, 2, (P, T)).astype(bool)),
+            jnp.asarray(rng.standard_normal((P, B, K)), jnp.float32),
+            jnp.ones((P, B), jnp.float32),
+            jnp.zeros((P, B), jnp.float32),
+            jnp.zeros(P, bool), jnp.ones(P, bool),
+            jnp.full(P, T // 2, jnp.int32), jnp.zeros(P, jnp.int32),
+            jnp.ones(P, bool), jnp.zeros(P, jnp.int32),
+            (jnp.zeros((P, S * 6), jnp.float32),
+             jnp.zeros((P, S * B), jnp.float32),
+             jnp.zeros((P, S * B), jnp.float32),
+             jnp.zeros((P, S * B * K), jnp.float32)),
+            S=S, block_p=block_p, interpret=not on_tpu)
+        jax.block_until_ready(out)
+    else:  # mega
+        C, W = 1, 16
+        Xt = jnp.asarray(rng.standard_normal((C, T, 5)), jnp.float32)
+        out = pallas_ops.detect_mega(
+            Yt[None], jnp.zeros((C, P), jnp.int32),
+            jnp.zeros((C, P), jnp.int32),
+            jnp.asarray(rng.integers(0, 2, (C, P, T)).astype(bool)),
+            jnp.zeros((C, P), jnp.int32),
+            (jnp.zeros((C, P, S * 6), jnp.float32),
+             jnp.zeros((C, P, S * B), jnp.float32),
+             jnp.zeros((C, P, S * B), jnp.float32),
+             jnp.zeros((C, P, S * B * K), jnp.float32)),
+            t[None], X[None], Xt, jnp.ones((C, P, B), jnp.float32),
+            W=W, S=S, sensor=LANDSAT_ARD, phases=(0, 1, 2),
+            change_thr=35.9, outlier_thr=31.7,
+            block_p=block_p, interpret=not on_tpu)
+        jax.block_until_ready(out)
+
+
+def _classify(rc: int, err_tail: str) -> dict:
+    """Subprocess outcome -> the same classified-record shape
+    bench.classify_tune_error emits for in-process probe failures."""
+    from bench import clean_text
+
+    if rc == 0:
+        return {"class": "ok", "kind": "ok", "detail": ""}
+    if rc in (-6, 134):
+        return {"class": "SIGABRT", "kind": "compiler-crash",
+                "detail": clean_text(err_tail, limit=300)}
+    if rc in (-9, 124):
+        return {"class": "Timeout", "kind": "deadline",
+                "detail": f"probe exceeded {PROBE_TIMEOUT:.0f}s"}
+    return {"class": f"exit{rc}", "kind": "other",
+            "detail": clean_text(err_tail, limit=300)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe", choices=PAIRINGS)
+    ap.add_argument("--block", type=int)
+    args = ap.parse_args()
+    if args.probe:
+        _probe(args.probe, args.block)
+        return 0
+
+    import jax
+
+    platform = jax.default_backend()
+    results = {}
+    for pairing in PAIRINGS:
+        ladder = []
+        smallest_failing = None
+        for bp in BLOCKS:
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--probe", pairing, "--block", str(bp)],
+                    capture_output=True, text=True,
+                    timeout=PROBE_TIMEOUT * 1.2, cwd=HERE)
+                rec = _classify(proc.returncode, proc.stderr[-2000:])
+            except subprocess.TimeoutExpired as e:
+                # A hanging Mosaic compile is one of the pathologies this
+                # tool bisects — it must become a classified deadline
+                # record, never a parent traceback with no artifact.
+                err = e.stderr or ""
+                if isinstance(err, bytes):
+                    err = err.decode(errors="replace")
+                rec = _classify(124, err[-2000:])
+            ladder.append({"block_p": bp, **rec})
+            print(f"[fuse-repro] {pairing} block_p={bp}: {rec['kind']}",
+                  file=sys.stderr, flush=True)
+            if rec["kind"] != "ok" and smallest_failing is None:
+                smallest_failing = bp
+        results[pairing] = {"ladder": ladder,
+                            "smallest_failing_block": smallest_failing}
+
+    report = {
+        "schema": "firebird-fuse-repro/1",
+        "platform": platform,
+        # A CPU run exercises the interpret path only — it proves the
+        # probe harness, not the Mosaic toolchain; the crash this tool
+        # minimizes is only reachable where Mosaic compiles for real.
+        "mosaic_reachable": platform == "tpu",
+        "probes": results,
+    }
+    art_dir = env_knob("FIREBIRD_FUSE_DIR")
+    os.makedirs(art_dir, exist_ok=True)
+    art = os.path.join(art_dir, "fuse_repro.json")
+    with open(art, "w") as f:
+        json.dump(report, f, indent=1)
+    worst = {k: v["smallest_failing_block"] for k, v in results.items()}
+    print(f"fuse-repro: {platform}; smallest failing blocks {worst}; "
+          f"artifact {art}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

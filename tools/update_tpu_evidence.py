@@ -69,6 +69,24 @@ def main() -> int:
                            if k in best["e2e"]}
         if e2e:
             best["wire"] = e2e
+        # Promote the fused-fit/rebalance round's verdicts likewise: the
+        # autotune's fused-vs-unfused fit rung and the rebalance model
+        # (lanes migrated, straggler-idle seconds the ring can reclaim).
+        kperf = {}
+        pa = det.get("pallas_autotune")
+        if isinstance(pa, dict):
+            fused = {k: v for k, v in
+                     (pa.get("runs_per_sec") or {}).items()
+                     if k == "fused" or k.startswith("fused+")}
+            if fused or "fused" in str(pa.get("picked", "")):
+                kperf["fused_runs_per_sec"] = fused
+                kperf["picked"] = pa.get("picked")
+                if pa.get("errors"):
+                    kperf["errors"] = pa["errors"]
+        if isinstance(det.get("rebalance"), dict):
+            kperf["rebalance"] = det["rebalance"]
+        if kperf:
+            best["fused_fit"] = kperf
     best["evidence"] = {
         "source_log": src,
         "generated_by": "tools/update_tpu_evidence.py",
