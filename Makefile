@@ -11,8 +11,9 @@ ACQUIRED ?= 1982-01-01/2017-12-31
 
 .PHONY: install lint test bench obs-smoke pipeline-smoke chaos-smoke \
         fleet-smoke serve-smoke compact-smoke postmortem-smoke \
-        alert-smoke wire-smoke fuse-smoke fuse-repro image db-up \
-        db-schema db-test db-down changedetection classification clean
+        alert-smoke streamfleet-smoke wire-smoke fuse-smoke fuse-repro \
+        image db-up db-schema db-test db-down changedetection \
+        classification clean
 
 install:
 	pip install -e . --no-build-isolation
@@ -34,6 +35,7 @@ test: lint
 	python -m pytest tests/ -x -q
 	$(MAKE) fuse-smoke
 	$(MAKE) alert-smoke
+	$(MAKE) streamfleet-smoke
 
 bench:
 	python bench.py
@@ -126,6 +128,17 @@ fuse-repro:
 # (folded by bench.py).
 alert-smoke:
 	python tools/alert_soak.py
+
+# Streaming-first end-to-end drill (docs/STREAMING.md): a standing
+# fleet — `firebird watch` + N `fleet work --forever` workers — drains
+# synthetic scenes as they land on the manifest, with the watcher AND
+# one worker SIGKILLed mid-drain; asserts every scene processed exactly
+# once across watcher incarnations, every alert delivered exactly once,
+# the packed tile statestore byte-identical to a clean serial leg, and
+# the acquisition→alert freshness SLO evaluated over real observations
+# (artifact folded by bench.py next to the e2e block).
+streamfleet-smoke:
+	python tools/stream_fleet_soak.py
 
 image:
 	docker build -f deploy/Dockerfile -t firebird .

@@ -281,6 +281,34 @@ def _alert_fold() -> dict:
                           "alert_soak.json")
 
 
+def _streamfleet_fold() -> dict:
+    """`make streamfleet-smoke` evidence (tools/stream_fleet_soak.py):
+    the standing watcher+worker fleet drill — scenes drained through
+    watcher/worker SIGKILLs, alerts exactly-once, the packed statestore
+    byte-identical to a clean serial leg, and the evaluated end-to-end
+    acquisition -> alert freshness SLO."""
+    return _artifact_fold("stream_fleet_soak", "FIREBIRD_STREAMFLEET_DIR",
+                          "stream_fleet_soak.json")
+
+
+def _acquisition_freshness_block() -> dict:
+    """``acquisition_to_alert_p95`` promoted NEXT TO the e2e block: the
+    read-side headline is pixels/sec including transfer; the streaming
+    product's headline is how many seconds after a scene publishes its
+    alerts are durable (docs/STREAMING.md)."""
+    sf = _streamfleet_fold().get("stream_fleet_soak") or {}
+    if sf.get("acquisition_to_alert_p95") is None:
+        return {}
+    return {"acquisition_to_alert_p95": {
+        "metric": "acquisition_to_alert_seconds",
+        "stat": "p95",
+        "value_sec": sf["acquisition_to_alert_p95"],
+        "observations": sf.get("acquisition_to_alert_count"),
+        "slo": sf.get("slo"),
+        "source": "stream_fleet_soak",
+    }}
+
+
 def _wire_fold() -> dict:
     """`make wire-smoke` evidence (tools/wire_probe.py): the staged
     ingress planes proven all-integer and the egress tables int-coded,
@@ -893,6 +921,11 @@ def measure(cpu_only: bool) -> None:
         "unit": "pixels/sec",
         "vs_baseline": round(dev_rate / baseline_2000_cores, 3),
         "e2e": e2e_block,
+        # The streaming product's headline metric, side by side with
+        # the batch read-side one: scene publish -> durable alert p95
+        # from the last stream-fleet soak on this host (empty when the
+        # soak never ran).
+        **_acquisition_freshness_block(),
         "detail": {
             "platform": jax.devices()[0].platform,
             "devices": n_devices,
@@ -957,6 +990,10 @@ def measure(cpu_only: bool) -> None:
             # Last alert-smoke evidence (exactly-once alerting through
             # SIGKILL, webhook catch-up, repair drain, freshness SLO).
             **_alert_fold(),
+            # Last streamfleet-smoke evidence (standing watcher+worker
+            # fleet through SIGKILLs: scenes drained exactly-once,
+            # packed statestore byte-identity, acquisition->alert SLO).
+            **_streamfleet_fold(),
             "streaming_pixels_per_sec": round(stream_rate, 1),
             **s2_detail,
             **hard_detail,

@@ -77,6 +77,7 @@ def repair_chip(cfg, cid, acquired: str, *, source=None, store=None,
     from firebird_tpu.driver import stream as sdrv
     from firebird_tpu.ingest import pack
     from firebird_tpu.store import AsyncWriter, open_store
+    from firebird_tpu.streamops import statestore as sstore_mod
 
     cx, cy = int(cid[0]), int(cid[1])
     source = source or dcore.make_source(cfg)
@@ -110,8 +111,14 @@ def repair_chip(cfg, cid, acquired: str, *, source=None, store=None,
                     horizon=np.float64(packed.dates[0][T - 1]))
         if fence_guard is not None:
             fence_guard()
-        sdrv.save_state(
-            sdrv._state_path(sdrv.state_dir(cfg), (cx, cy)), st, side)
+        # Same checkpoint store as the stream driver (packed by default
+        # — streamops/statestore.py): the check-then-write window is
+        # one atomic slot publish wide, the FencedStore discipline.
+        sstore = sstore_mod.open_statestore(cfg)
+        try:
+            sstore.save((cx, cy), st, side)
+        finally:
+            sstore.close()
         writer.flush()
         summary = {"chip": [cx, cy],
                    "obs": T,

@@ -204,6 +204,72 @@ def stream(x, y, acquired, number, trace, ops_port, compile_cache, faults,
 
 
 @entrypoint.command()
+@click.option("--x", "-x", required=True, type=float)
+@click.option("--y", "-y", required=True, type=float)
+@click.option("--number", "-n", required=False, default=2500, type=int,
+              help="chips of the tile the watcher covers (testing)")
+@click.option("--acquired-start", default="1982-01-01",
+              help="archive start date for the jobs' acquired ranges "
+                   "(ends derive from each scene's date, half-open)")
+@click.option("--interval", "-i", default=None, type=float,
+              help="manifest poll interval seconds; overrides "
+                   "FIREBIRD_WATCH_INTERVAL")
+@click.option("--once", is_flag=True, default=False,
+              help="one poll, print its summary JSON, exit (the "
+                   "cron/test mode; the default is a standing loop)")
+@click.option("--ops-port", default=None, type=int,
+              help="live ops endpoints for the watcher (adds a "
+                   "`streamops` block to /progress); overrides "
+                   "FIREBIRD_OPS_PORT")
+def watch(x, y, number, acquired_start, interval, once, ops_port):
+    """Watch the configured source's acquisition manifest and keep the
+    fleet queue fed: each new scene becomes idempotent per-chip
+    ``stream`` jobs (at most one open per chip), with ``detect``
+    bootstrap jobs dep'd ahead for chips that have no stream checkpoint
+    yet.  Scene ids dedupe against a durable sqlite cursor, so a killed
+    watcher's replacement resumes without double-enqueueing — see
+    docs/STREAMING.md."""
+    import json as _json
+    import signal
+    import threading
+
+    from firebird_tpu.config import Config
+    from firebird_tpu.driver import core
+    from firebird_tpu.obs import jsonlog
+    from firebird_tpu.streamops import AcquisitionWatcher
+
+    overrides = {"ops_port": ops_port} if ops_port is not None else {}
+    cfg = Config.from_env(**overrides)
+    watcher = AcquisitionWatcher(cfg, x, y, number=number,
+                                 acquired_start=acquired_start)
+    if once:
+        try:
+            summary = watcher.poll_once()
+        finally:
+            watcher.close()
+        click.echo(_json.dumps(summary, indent=1))
+        return
+    run_id = jsonlog.new_run_id()
+    run_block = {"kind": "watcher", "run_id": run_id,
+                 "host": jsonlog.HOST, "tile_h": watcher.tile["h"],
+                 "tile_v": watcher.tile["v"]}
+    from firebird_tpu.obs import Counters
+
+    _, srv, wd = core.start_ops(cfg, run_id, "watcher", chips_total=0,
+                                counters=Counters(), run_block=run_block,
+                                streamops=watcher.status)
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    try:
+        summary = watcher.run(interval=interval, stop=stop)
+    finally:
+        core.stop_ops(srv, wd)
+        watcher.close()
+    click.echo(_json.dumps(summary, indent=1))
+
+
+@entrypoint.command()
 @click.option("--bounds", "-b", multiple=True, required=True,
               help="x,y projection point; repeat to extend the area")
 @click.option("--shard", "-s", required=False, default=None,
@@ -504,6 +570,32 @@ def status(x, y):
         except Exception as e:
             out["alerts"] = {"path": apath,
                              "error": f"{type(e).__name__}: {e}"}
+    # Streamops view (docs/STREAMING.md): the packed checkpoint store's
+    # per-tile slot occupancy + disk bytes, and the acquisition
+    # watcher's durable cursor — guarded like the fleet/alerts views.
+    try:
+        from firebird_tpu.streamops import open_statestore, watch_db_path
+        from firebird_tpu.streamops.watcher import SceneCursor
+
+        sstore = open_statestore(cfg)
+        try:
+            scan = sstore.scan() if hasattr(sstore, "scan") \
+                else sstore.status()
+        finally:
+            sstore.close()
+        out["streamops"] = {"statestore": scan}
+        try:
+            wpath = watch_db_path(cfg)
+        except ValueError:
+            wpath = None
+        if wpath is not None and _os.path.exists(wpath):
+            cur = SceneCursor(wpath)
+            try:
+                out["streamops"]["watcher"] = cur.status()
+            finally:
+                cur.close()
+    except Exception as e:
+        out["streamops"] = {"error": f"{type(e).__name__}: {e}"}
     if x is not None:
         tile = grid.tile(x, y)
         cids = [tuple(int(v) for v in c) for c in grid.chips(tile)]
@@ -573,29 +665,43 @@ def fleet_enqueue(tiles, acquired, number, chunk_size, msday, meday,
 @click.option("--until-drained", is_flag=True, default=False,
               help="poll until every job is done or dead (default: exit "
                    "when nothing is claimable)")
+@click.option("--forever", is_flag=True, default=False,
+              help="standing worker: keep polling through an empty "
+                   "queue until signalled — the steady-state streaming "
+                   "fleet mode behind `firebird watch`")
 @click.option("--poll", required=False, default=1.0, type=float,
               help="idle claim-poll interval, seconds")
 @click.option("--ops-port", default=None, type=int,
               help="live ops endpoints for this worker (adds a `fleet` "
                    "block to /progress); overrides FIREBIRD_OPS_PORT")
-def fleet_work(max_jobs, until_drained, poll, ops_port):
+def fleet_work(max_jobs, until_drained, forever, poll, ops_port):
     """Run one fleet worker against the shared queue until it drains."""
     import json as _json
+    import signal
+    import threading
 
     from firebird_tpu.config import Config
     from firebird_tpu.driver import core
     from firebird_tpu.fleet import FleetWorker, make_queue
 
+    if forever and until_drained:
+        raise click.BadParameter("--forever and --until-drained are "
+                                 "exclusive")
     apply_platform()
     overrides = {"ops_port": ops_port} if ops_port is not None else {}
     cfg = Config.from_env(**overrides)
     core.setup_compile_cache(cfg)
     queue = make_queue(cfg)
     worker = FleetWorker(cfg, queue, poll_sec=poll)
+    stop = threading.Event()
+    if forever:
+        signal.signal(signal.SIGINT, lambda *_: stop.set())
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
     _, srv, wd = worker.start_ops()
     try:
         summary = worker.run(max_jobs=max_jobs,
-                             until_drained=until_drained)
+                             until_drained=until_drained,
+                             forever=forever, stop=stop)
     finally:
         core.stop_ops(srv, wd)
         queue.close()

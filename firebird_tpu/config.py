@@ -107,6 +107,15 @@ KNOBS = (
          help="persistent XLA compile cache directory"),
     Knob(name="FIREBIRD_STREAM_DIR", field="stream_dir",
          help="streaming-state checkpoint directory"),
+    Knob(name="FIREBIRD_STREAM_STATESTORE", field="stream_statestore",
+         help="stream checkpoint layout: packed (tile-packed slot "
+              "files) | npz (legacy per-chip, the f64/compat escape "
+              "hatch)"),
+    Knob(name="FIREBIRD_WATCH_INTERVAL", field="watch_interval",
+         help="acquisition-watcher manifest poll interval (seconds)"),
+    Knob(name="FIREBIRD_WATCH_DB", field="watch_db",
+         help="acquisition-watcher durable scene-cursor sqlite path "
+              "(default: watcher.db next to the store)"),
     # ---- observability (Config-backed) ----
     Knob(name="FIREBIRD_PROFILE_DIR", field="profile_dir",
          help="jax.profiler trace output directory (device-side)"),
@@ -244,6 +253,8 @@ KNOBS = (
          help="fleet-chaos artifact directory"),
     Knob(name="FIREBIRD_ALERT_DIR", default="/tmp/fb_alerts",
          help="alert-soak artifact directory"),
+    Knob(name="FIREBIRD_STREAMFLEET_DIR", default="/tmp/fb_streamfleet",
+         help="stream-fleet-soak artifact directory"),
     Knob(name="FIREBIRD_WIRE_DIR", default="/tmp/fb_wire",
          help="wire-smoke artifact directory"),
     Knob(name="FIREBIRD_FUSE_DIR", default="/tmp/fb_fuse",
@@ -368,6 +379,22 @@ class Config:
     # Streaming-state checkpoint directory (driver/stream.py); empty means
     # '<store_path>.stream' next to the store.
     stream_dir: str = ""
+
+    # Stream checkpoint layout (FIREBIRD_STREAM_STATESTORE;
+    # streamops/statestore.py): 'packed' (default) stores a whole
+    # tile's 2500 chip checkpoints in ONE crash-safe slot file with
+    # O(1) access and transparent read-through migration from the
+    # legacy layout; 'npz' keeps the one-.npz-per-chip layout — the
+    # escape hatch for float64 state, which the packed float32 layout
+    # refuses to round (docs/STREAMING.md).
+    stream_statestore: str = "packed"
+
+    # Acquisition watcher (FIREBIRD_WATCH_*; streamops/watcher.py):
+    # manifest poll cadence, and the durable scene-cursor sqlite path
+    # ("" derives watcher.db next to the store — the fleet.db
+    # placement rule; the memory backend needs an explicit path).
+    watch_interval: float = 30.0
+    watch_db: str = ""
 
     # Embedded HTTP ops endpoint (obs/server.py): /healthz /readyz
     # /metrics /progress /report.  0 (the default) binds NO port — the
@@ -566,6 +593,13 @@ class Config:
             from firebird_tpu.obs import slo as _slo
 
             _slo.parse_spec(self.slo)
+        if self.stream_statestore not in ("packed", "npz"):
+            raise ValueError(
+                "FIREBIRD_STREAM_STATESTORE must be 'packed' or 'npz', "
+                f"got {self.stream_statestore!r}")
+        if self.watch_interval <= 0:
+            raise ValueError("FIREBIRD_WATCH_INTERVAL must be > 0 "
+                             f"seconds, got {self.watch_interval}")
         if self.fleet_lease_sec <= 0:
             raise ValueError("FIREBIRD_FLEET_LEASE_SEC must be > 0 "
                              f"seconds, got {self.fleet_lease_sec}")
@@ -643,6 +677,11 @@ class Config:
             trace=e.get("FIREBIRD_TRACE", cls.trace),
             obs_report=e.get("FIREBIRD_OBS_REPORT", cls.obs_report),
             stream_dir=e.get("FIREBIRD_STREAM_DIR", cls.stream_dir),
+            stream_statestore=e.get("FIREBIRD_STREAM_STATESTORE",
+                                    cls.stream_statestore),
+            watch_interval=float(e.get("FIREBIRD_WATCH_INTERVAL",
+                                       cls.watch_interval)),
+            watch_db=e.get("FIREBIRD_WATCH_DB", cls.watch_db),
             ops_port=int(e.get("FIREBIRD_OPS_PORT", cls.ops_port)),
             ops_host=e.get("FIREBIRD_OPS_HOST", cls.ops_host),
             stall_sec=float(e.get("FIREBIRD_STALL_SEC", cls.stall_sec)),

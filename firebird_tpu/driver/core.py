@@ -138,7 +138,7 @@ def record_topology_metrics() -> None:
 
 def start_ops(cfg: Config, run_id: str, kind: str, *, chips_total: int,
               counters, run_block: dict, quarantine=None, breaker=None,
-              fleet=None, alerts=None):
+              fleet=None, alerts=None, streamops=None):
     """Bring up the run's live ops surface (shared by both drivers).
 
     Registers the run context for JSON logs, clears stale report shards
@@ -184,7 +184,7 @@ def start_ops(cfg: Config, run_id: str, kind: str, *, chips_total: int,
             watchdog=watchdog, run=run_block, mesh_up=_mesh_ready(),
             pipeline_depth=cfg.pipeline_depth, quarantine=quarantine,
             breaker=breaker, profiler=profiler, slo_spec=cfg.slo,
-            fleet=fleet, alerts=alerts))
+            fleet=fleet, alerts=alerts, streamops=streamops))
         if cfg.ops_port > 0:
             server = obs_server.start_ops_server(cfg.ops_port, status,
                                                  host=cfg.ops_host)

@@ -8,8 +8,9 @@ operational:
 
 - **bootstrap**: first run per chip does batch detection over ``acquired``,
   persists the normal chip/pixel/segment frames, and seeds a per-chip
-  :class:`~firebird_tpu.ccd.incremental.StreamState` checkpoint (atomic
-  npz next to the store).
+  :class:`~firebird_tpu.ccd.incremental.StreamState` checkpoint in the
+  stream statestore (tile-packed crash-safe slot files by default —
+  streamops/statestore.py, docs/STREAMING.md).
 - **update**: later runs fetch the chip, apply only observations past the
   checkpoint's horizon through ``incremental.step`` (one jitted [P]-wide
   step each), and re-publish the open tail segments' rows — same sday key,
@@ -33,7 +34,6 @@ ordinal day).
 from __future__ import annotations
 
 import concurrent.futures as cf
-import os
 import time
 
 import jax.numpy as jnp
@@ -53,44 +53,20 @@ from firebird_tpu.obs import metrics as obs_metrics
 from firebird_tpu.obs import report as obs_report
 from firebird_tpu.obs import server as obs_server
 from firebird_tpu.obs import tracing
+from firebird_tpu.streamops import statestore as sstore_mod
 from firebird_tpu.utils import dates as dt
 from firebird_tpu.utils.fn import partition_all, take
 
-_STATE_FIELDS = ("coefs", "rmse", "vario", "nobs", "n_exceed", "end_day",
-                 "exceed_day0", "break_day", "active")
-_SIDE_FIELDS = ("sday", "curqa", "anchor", "horizon")
-
-
-def state_dir(cfg: Config) -> str:
-    """Checkpoint directory: FIREBIRD_STREAM_DIR, else '<store_path>.stream'."""
-    return cfg.stream_dir or (cfg.store_path + ".stream")
-
-
-def _state_path(sdir: str, cid) -> str:
-    return os.path.join(sdir, f"state_{int(cid[0])}_{int(cid[1])}.npz")
-
-
-def save_state(path: str, st: incremental.StreamState, side: dict) -> None:
-    """Atomic checkpoint write (tmp + rename, the crash-safe idiom).
-    The temp name carries the pid: a fleet zombie and its successor can
-    both be writing the same chip's checkpoint (fleet/worker.py designs
-    for exactly that overlap), and a SHARED temp would interleave two
-    writers into one corrupt .npz before the rename publishes it."""
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    arrs = {f: np.asarray(getattr(st, f)) for f in _STATE_FIELDS}
-    arrs.update({k: np.asarray(side[k]) for k in _SIDE_FIELDS})
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
-        np.savez_compressed(f, **arrs)
-    os.replace(tmp, path)
-
-
-def load_state(path: str) -> tuple[incremental.StreamState, dict]:
-    with np.load(path, allow_pickle=False) as d:
-        st = incremental.StreamState(
-            *(jnp.asarray(d[f]) for f in _STATE_FIELDS))
-        side = {k: d[k] for k in _SIDE_FIELDS}
-    return st, side
+# Checkpoint plumbing lives in streamops/statestore.py now — ONE
+# serialization/path/crash-safety implementation shared by this driver,
+# the repair path, and the fleet (PR 13 deleted the duplicated copies).
+# The names below stay as aliases for the legacy (.npz) layout's
+# direct users (tests, tools).
+_STATE_FIELDS = sstore_mod.STATE_FIELDS
+_SIDE_FIELDS = sstore_mod.SIDE_FIELDS
+state_dir = sstore_mod.state_dir
+save_state = sstore_mod.save_state
+load_state = sstore_mod.load_state
 
 
 def _tail_identity(one: kernel.ChipSegments) -> tuple[np.ndarray, np.ndarray]:
@@ -222,7 +198,8 @@ def _new_break_records(packed, st: incremental.StreamState,
 
 def stream(x, y, acquired: str | None = None, number: int = 2500,
            cfg: Config | None = None, source=None, store=None,
-           reset_metrics: bool = True) -> dict:
+           reset_metrics: bool = True, cids=None,
+           published: float | None = None) -> dict:
     """Streaming incremental change detection over one tile.
 
     First run per chip bootstraps (batch detect + checkpoint); later runs
@@ -234,6 +211,14 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
     worker (fleet/worker.py) hosts MANY jobs in one process, and a
     stream job must not wipe the worker's fleet counters the way a
     standalone run wipes the previous run's telemetry.
+
+    ``cids`` scopes the pass to specific chips instead of the tile
+    enumeration — the acquisition watcher's per-chip stream jobs
+    (streamops/watcher.py).  ``published`` is the driving scene's
+    publish timestamp (unix seconds): alerts this pass commits observe
+    publish -> durable-append latency into the
+    ``acquisition_to_alert_seconds`` histogram, the feed of the
+    ``alert_freshness`` SLO's end-to-end leg (docs/STREAMING.md).
     """
     cfg = cfg or Config.from_env()
     acquired = acquired or dt.default_acquired()
@@ -256,7 +241,10 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
     # ingest breaker, store-write retries, per-chip quarantine.
     source, store, writer, policy, breaker, quarantine = \
         dcore.robustness_setup(cfg, run_id, source=source, store=store)
-    sdir = state_dir(cfg)
+    # The stream checkpoint store (streamops/statestore.py): tile-packed
+    # slot files by default, with read-through migration from legacy
+    # per-chip .npz; FIREBIRD_STREAM_STATESTORE=npz keeps the old layout.
+    sstore = sstore_mod.open_statestore(cfg)
     # The durable alert log (firebird_tpu.alerts): None when alerting is
     # off or the store has no file-backed "next to".  An unopenable log
     # degrades alerting, never detection — breaks still publish to the
@@ -273,13 +261,20 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                           type(e).__name__, e)
 
     tile = grid.tile(x=x, y=y)
-    cids = dcore.host_shard(list(take(number, grid.chips(tile))))
-    log.info("streaming tile h=%s v=%s: %d chips (acquired %s, state %s, "
-             "alerts %s)", tile["h"], tile["v"], len(cids), acquired,
-             sdir, alog.path if alog is not None else "off")
+    if cids is None:
+        cids = dcore.host_shard(list(take(number, grid.chips(tile))))
+    else:
+        # Watcher-scoped pass: exactly the scene's affected chips, no
+        # host sharding (the fleet queue already spread the work).
+        cids = [tuple(int(v) for v in c) for c in cids]
+    log.info("streaming tile h=%s v=%s: %d chips (acquired %s, state "
+             "%s:%s, alerts %s)", tile["h"], tile["v"], len(cids),
+             acquired, sstore.backend, sstore_mod.state_dir(cfg),
+             alog.path if alog is not None else "off")
     summary = dict(bootstrapped=0, updated=0, obs_applied=0,
                    pixels_need_batch=0, alerts_emitted=0,
-                   alerts_deduped=0, repair_jobs_enqueued=0)
+                   alerts_deduped=0, repair_jobs_enqueued=0,
+                   state_voided=0)
     # Per-chip needs_batch rollup: the update loop fills it (serial), the
     # repair scheduler turns it into fleet jobs at end of run.
     needs_by_chip: dict = {}
@@ -323,8 +318,8 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
             [chip], bucket=cfg.obs_bucket, max_obs=cfg.max_obs)
 
     hi_iso = acquired.split("/")[1]
-    boot = [c for c in cids if not os.path.exists(_state_path(sdir, c))]
-    upd = [c for c in cids if os.path.exists(_state_path(sdir, c))]
+    boot = [c for c in cids if not sstore.exists(c)]
+    upd = [c for c in cids if sstore.exists(c)]
     run_block = dict(kind="stream", run_id=run_id, host=jsonlog.HOST,
                      process_id=dcore._process_index(), tile_h=tile["h"],
                      tile_v=tile["v"], acquired=acquired, chips=len(cids))
@@ -340,7 +335,8 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
             run={k: summary[k] for k in ("alerts_emitted",
                                          "alerts_deduped",
                                          "pixels_need_batch",
-                                         "repair_jobs_enqueued")})))
+                                         "repair_jobs_enqueued")})),
+        streamops=sstore.status)
     tracer = tracing.start(run_id=run_id) \
         if tracing.wants_trace(cfg.trace) else None
     counters.start()   # rate clock from first productive work, not setup
@@ -436,7 +432,7 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                                     staged.packed.dates[c][T - 1]))
                             summary["bootstrapped"] += 1
                             counters.add("chips")
-                            save_state(_state_path(sdir, cid), st, side)
+                            sstore.save(cid, st, side)
                             quarantine.discard(cid)
                             summary["pixels_need_batch"] += int(
                                 np.asarray(st.needs_batch).sum())
@@ -449,8 +445,22 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
 
         def update_one(cid) -> None:
             t_seen = time.monotonic()   # the freshness-SLO clock start
-            path = _state_path(sdir, cid)
-            st, side = load_state(path)
+            try:
+                st, side = sstore.load(cid)
+            except sstore_mod.StateStoreError as e:
+                # Unrecoverable checkpoint (every bank failed its
+                # checksum — e.g. power loss persisted a commit header
+                # before its payload).  Void the slot so `exists` turns
+                # False and the NEXT stream run re-bootstraps the chip;
+                # erroring here forever would leave the heal path
+                # (bootstrap) permanently gated off by exists().
+                log.error("chip (%s,%s): checkpoint unrecoverable (%s); "
+                          "voided — the next stream run re-bootstraps",
+                          cid[0], cid[1], e)
+                sstore.void(cid)
+                summary["state_voided"] += 1
+                counters.add("chips")
+                return
             horizon = float(side["horizon"])
             # fetch only the delta past the horizon — the whole point
             # of the hot path is not re-ingesting the archive (span only
@@ -497,13 +507,27 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                                      "durable alert commit (the "
                                      "alert_freshness SLO feed)").observe(
                                 time.monotonic() - t_seen)
+                            if published is not None:
+                                # The END-TO-END freshness leg: scene
+                                # publish (the watcher job carries the
+                                # manifest timestamp) to durable alert
+                                # append — queue wait, bootstrap deps,
+                                # fetch and step all included.
+                                obs_metrics.histogram(
+                                    "acquisition_to_alert_seconds",
+                                    help="scene publish time to durable "
+                                         "alert-log append (the "
+                                         "end-to-end alert_freshness "
+                                         "SLO feed; docs/STREAMING.md)"
+                                ).observe(
+                                    max(time.time() - published, 0.0))
                             summary["alerts_emitted"] += ins
                             summary["alerts_deduped"] += dup
                     with tracing.span("publish", chip=tuple(cid)), \
                             obs_metrics.timer() as tm:
                         writer.write("segment", publish_frame(p, st, side),
                                      key=tuple(cid))
-                        save_state(path, st, side)
+                        sstore.save(cid, st, side)
                     obs_metrics.histogram(
                         "stream_publish_seconds").observe(tm.elapsed)
                     summary["updated"] += 1
@@ -551,6 +575,7 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
     finally:
         obs_server.set_stage("finalize")
         writer.close()
+        sstore.close()
         if alog is not None:
             alog.close()
         if warm is not None:       # collect warm-compile counters if done

@@ -551,14 +551,17 @@ class FleetQueue:
                 and int(rows.get(PENDING, 0)) > 0)
 
     def enqueue_unique_chip(self, job_type: str, payload: dict, *,
+                            depends_on=(),
                             max_attempts: int = 3) -> int | None:
         """Enqueue a chip-keyed job ONLY if no open (pending/leased) job
         of ``job_type`` already names the same (cx, cy) — the check and
         the insert in ONE transaction, so two schedulers racing (a
         zombie stream worker and its successor both reaching end-of-run
         repair scheduling) cannot both slip past a read-then-insert
-        window.  Returns the new job id, or None when an open job
-        already covers the chip."""
+        window.  ``depends_on`` works as in :meth:`enqueue` — the
+        acquisition watcher deps a chip's first stream job behind its
+        bootstrap detect job this way.  Returns the new job id, or None
+        when an open job already covers the chip."""
         if job_type not in JOB_TYPES:
             raise ValueError(
                 f"job_type must be one of {JOB_TYPES}, got {job_type!r}")
@@ -566,12 +569,20 @@ class FleetQueue:
             raise ValueError(
                 f"max_attempts must be >= 1, got {max_attempts}")
         chip = (int(payload["cx"]), int(payload["cy"]))
+        deps = [int(d) for d in depends_on]
         now = self._clock()
         jid = None
         with self._lock:
             con = self._con
             con.execute("BEGIN IMMEDIATE")
             try:
+                known = {r[0] for r in con.execute(
+                    "SELECT id FROM jobs WHERE id IN (%s)"
+                    % ",".join("?" * len(deps)), deps)} if deps else set()
+                missing = [d for d in deps if d not in known]
+                if missing:
+                    raise ValueError(
+                        f"depends_on names unknown job ids {missing}")
                 rows = con.execute(
                     "SELECT payload FROM jobs WHERE job_type = ? AND "
                     "state IN ('pending', 'leased')",
@@ -589,6 +600,10 @@ class FleetQueue:
                          json.dumps([{"event": "enqueued",
                                       "at": _now_iso()}]), now, now))
                     jid = int(cur.lastrowid)
+                    for d in deps:
+                        con.execute(
+                            "INSERT OR IGNORE INTO deps (job_id, needs) "
+                            "VALUES (?, ?)", (jid, d))
                 con.execute("COMMIT")
             except BaseException:
                 con.execute("ROLLBACK")

@@ -116,16 +116,25 @@ class FleetWorker:
     # -- the loop ----------------------------------------------------------
 
     def run(self, *, max_jobs: int | None = None,
-            until_drained: bool = False) -> dict:
+            until_drained: bool = False, forever: bool = False,
+            stop=None) -> dict:
         """Drain the queue.  Default: exit when nothing is claimable.
         ``until_drained``: poll until every job is done or dead (exits
         early — wedged — when the only remaining jobs are blocked behind
-        dead dependencies, which polling can never fix)."""
+        dead dependencies, which polling can never fix).  ``forever``:
+        a STANDING worker — keep polling through an empty queue (the
+        steady-state streaming fleet: the acquisition watcher feeds
+        jobs as scenes land) until ``stop`` (a threading.Event) is set
+        or the process is signalled."""
         executed = 0
         wedged = False
-        while max_jobs is None or executed < max_jobs:
+        while (max_jobs is None or executed < max_jobs) \
+                and not (stop is not None and stop.is_set()):
             lease = self.queue.claim(self.worker_id)
             if lease is None:
+                if forever:
+                    self._sleep(self.poll_sec)
+                    continue
                 if not until_drained or self.queue.drained():
                     break
                 if self.queue.wedged():
@@ -285,9 +294,19 @@ class FleetWorker:
         """One changedetection chunk: the promoted driver loop
         (core.run_chunk) against a fenced store, with the re-delivery
         fast path (already-stored chips skip, quarantine entries for
-        landed chips drain)."""
+        landed chips drain).
+
+        A ``bootstrap: true`` payload is the acquisition watcher's
+        stream-bootstrap flavor (streamops/watcher.py): ONE chip that
+        needs batch detection AND a seeded stream checkpoint before its
+        dep'd stream job can run — exactly what the repair path does
+        (alerts/repair.repair_chip: fenced batch re-detection + fresh
+        checkpoint), so it routes there instead of run_chunk."""
         from firebird_tpu.driver import core as dcore
         from firebird_tpu.driver import quarantine as qlib
+
+        if payload.get("bootstrap"):
+            return self._run_repair(payload, lease)
 
         # Stamp the lease's fencing token into run_manifest.json: the
         # store-adjacent record of which lease last owned this output
@@ -338,6 +357,12 @@ class FleetWorker:
             sdrv.stream(x=payload["x"], y=payload["y"],
                         acquired=payload.get("acquired"),
                         number=int(payload.get("number", 2500)),
+                        # Watcher-shaped jobs scope the pass to the
+                        # scene's affected chips and carry its publish
+                        # timestamp for the acquisition_to_alert_seconds
+                        # freshness histogram.
+                        cids=payload.get("cids"),
+                        published=payload.get("published"),
                         cfg=dataclasses.replace(self.cfg, ops_port=0),
                         store=fenced, reset_metrics=False)
         finally:

@@ -14,6 +14,7 @@ ccdc/timeseries.py:46-56).
 from __future__ import annotations
 
 import json
+import os
 import urllib.parse
 import urllib.request
 
@@ -32,11 +33,20 @@ AUX_NAMES = ("dem", "trends", "aspect", "posidex", "slope", "mpw")
 
 
 def _slice_acquired(t, spectra, qas, acquired):
-    """Restrict a chip archive to an ISO8601 acquired range (inclusive)."""
+    """Restrict a chip archive to an ISO8601 acquired range.
+
+    The window is consistently HALF-OPEN: ``[start, end)`` — an
+    observation dated exactly ``end`` belongs to the NEXT window, never
+    to both or neither.  The acquisition watcher's ``since`` cursor
+    (streamops/watcher.py) and the stream driver's horizon both slice
+    the archive into adjacent windows; inclusive ends would
+    double-deliver a boundary scene to two windows, and an exclusive
+    start would skip it entirely (tests/test_ingest.py pins the
+    partition property)."""
     if not acquired:
         return t, spectra, qas
     lo, hi = dt.acquired_range(acquired)
-    keep = (t >= lo) & (t <= hi)
+    keep = (t >= lo) & (t < hi)
     return t[keep], spectra[:, keep], qas[keep]
 
 
@@ -148,6 +158,27 @@ class SyntheticSource:
         return ChipData(cx=int(cx), cy=int(cy), dates=t, spectra=spectra,
                         qas=qas, sensor=sn)
 
+    def list_acquisitions(self, since: float = 0.0) -> list[dict]:
+        """The acquisition manifest (streamops/watcher.py contract):
+        ``[{scene_id, published, date, bbox}, ...]`` with ``published >
+        since``.  One deterministic scene per cadence date covering the
+        whole grid (bbox None); ``published`` is the fabricated
+        timestamp ``ordinal * 86400`` — monotone in acquisition date,
+        so cursor tests are reproducible (the dir-backed FileSource
+        manifest carries real wall-clock publish times)."""
+        t = synthetic.acquisition_dates(self.start, self.end,
+                                        self.cadence_days)
+        out = []
+        for d in t:
+            published = float(d) * 86400.0
+            if published <= since:
+                continue
+            iso = dt.to_iso(int(d))
+            out.append({"scene_id": f"synthetic-{self.seed}-{iso}",
+                        "published": published, "date": iso,
+                        "bbox": None})
+        return out
+
     def aux(self, cx: int, cy: int, acquired: str | None = None) -> dict:
         """AUX layers: one [100,100] array per AUX_NAMES entry."""
         rng = self._rng(cx, cy, salt=1)
@@ -172,7 +203,16 @@ class SyntheticSource:
 
 class FileSource:
     """Chips stored as .npz files in a directory: chip_{cx}_{cy}.npz with
-    arrays dates/spectra/qas, aux_{cx}_{cy}.npz with the AUX names."""
+    arrays dates/spectra/qas, aux_{cx}_{cy}.npz with the AUX names.
+
+    The directory doubles as a landing zone for the acquisition
+    watcher: a ``scenes.jsonl`` manifest next to the chips records each
+    delivered scene (one JSON line: scene_id, published, date, bbox),
+    appended by :meth:`append_scene` after the chip archives are
+    updated — so a watcher listing the manifest never sees a scene
+    whose pixels have not landed yet."""
+
+    SCENES_FILE = "scenes.jsonl"
 
     def __init__(self, root: str):
         self.root = root
@@ -191,11 +231,56 @@ class FileSource:
         return {k: z[k] for k in AUX_NAMES}
 
     def save_chip(self, c: ChipData) -> None:
-        np.savez_compressed(self._path("chip", c.cx, c.cy),
-                            dates=c.dates, spectra=c.spectra, qas=c.qas)
+        """Atomic archive write (tmp + rename): a reader fetching the
+        chip mid-landing sees the previous archive, never a torn one."""
+        path = self._path("chip", c.cx, c.cy)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, dates=c.dates, spectra=c.spectra,
+                                qas=c.qas)
+        os.replace(tmp, path)
 
     def save_aux(self, cx: int, cy: int, aux: dict) -> None:
         np.savez_compressed(self._path("aux", cx, cy), **aux)
+
+    def append_scene(self, scene_id: str, *, date: str,
+                     published: float | None = None, bbox=None) -> dict:
+        """Publish one scene on the manifest (AFTER its chip archives
+        landed — see class docstring).  Returns the manifest record."""
+        import time as _time
+
+        rec = {"scene_id": str(scene_id),
+               "published": float(published if published is not None
+                                  else _time.time()),
+               "date": str(date),
+               "bbox": None if bbox is None else [float(v) for v in bbox]}
+        with open(os.path.join(self.root, self.SCENES_FILE), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        return rec
+
+    def list_acquisitions(self, since: float = 0.0) -> list[dict]:
+        """The acquisition manifest (streamops/watcher.py contract):
+        scenes with ``published > since`` from ``scenes.jsonl``.  A
+        truncated trailing line (a writer mid-append) is skipped — it
+        re-lists complete on the next poll."""
+        path = os.path.join(self.root, self.SCENES_FILE)
+        out = []
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            return out
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue            # torn tail append; next poll has it
+            if float(rec.get("published", 0.0)) > since:
+                out.append(rec)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -496,6 +581,11 @@ class ChipmunkSource:
         for s in per_band.values():
             common &= set(s)
         t = np.array(sorted(common), dtype=np.int64)
+        # The service's own acquired filter is inclusive; re-apply the
+        # half-open [start, end) window here so every source agrees on
+        # boundary ownership (_slice_acquired docstring).
+        lo, hi = dt.acquired_range(acquired)
+        t = t[(t >= lo) & (t < hi)]
         T = t.shape[0]
         spectra = np.empty((sensor.n_bands, T, side, side), np.int16)
         for b, name in enumerate(bands):

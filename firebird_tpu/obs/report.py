@@ -57,6 +57,7 @@ SPAN_NAMES = (
     "store_write",
     "transfer",
     "warm_compile",
+    "watch_poll",
 )
 
 

@@ -56,7 +56,8 @@ class RunStatus:
                  counters=None, watchdog=None, run: dict | None = None,
                  mesh_up: bool = True, pipeline_depth: int = 2,
                  quarantine=None, breaker=None, profiler=None,
-                 slo_spec: str | None = None, fleet=None, alerts=None):
+                 slo_spec: str | None = None, fleet=None, alerts=None,
+                 streamops=None):
         self.run_id = run_id
         self.kind = kind
         self.chips_total = int(chips_total)
@@ -79,6 +80,10 @@ class RunStatus:
         # callable over its AlertLog.status): /progress's "alerts"
         # block; None for runs without an alert log.
         self.alerts = alerts
+        # Streamops view provider (the stream driver passes its
+        # checkpoint store's status; `firebird watch` passes the
+        # watcher's): /progress's "streamops" block; None elsewhere.
+        self.streamops = streamops
         self.run = dict(run or {})
         self.pipeline_depth = max(int(pipeline_depth), 1)
         self._lock = threading.Lock()
@@ -230,6 +235,7 @@ class RunStatus:
             "degraded": self.degraded_block(),
             "fleet": self._fleet_block(),
             "alerts": self._alerts_block(),
+            "streamops": self._streamops_block(),
             "watchdog": (self.watchdog.snapshot()
                          if self.watchdog is not None else None),
         }
@@ -243,6 +249,18 @@ class RunStatus:
             return None
         try:
             return self.alerts()
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def _streamops_block(self) -> dict | None:
+        """The /progress 'streamops' sub-document: the packed
+        checkpoint store's activity (or the watcher's cursor view, for
+        ``firebird watch``; docs/STREAMING.md).  None for runs without
+        streamops; a snapshot failure degrades this block only."""
+        if self.streamops is None:
+            return None
+        try:
+            return self.streamops()
         except Exception as e:
             return {"error": f"{type(e).__name__}: {e}"}
 

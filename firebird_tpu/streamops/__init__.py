@@ -1,0 +1,30 @@
+"""streamops: the subsystem that makes streaming the primary mode.
+
+Three pillars (ROADMAP item 3 — "streaming-first CONUS"):
+
+- :mod:`firebird_tpu.streamops.statestore` — the tile-packed stream
+  checkpoint store: one file per tile holding 2500 fixed-size chip
+  slots with per-slot generation counters and checksums, replacing the
+  one-``.npz``-per-chip layout that would mean ~1.8M small files at
+  CONUS scale.
+- :mod:`firebird_tpu.streamops.watcher` — the acquisition watcher:
+  polls a source's ``list_acquisitions`` manifest, dedupes scene ids
+  against a durable sqlite cursor, maps scene footprints to affected
+  chips, and enqueues idempotent ``stream`` jobs (bootstrap ``detect``
+  jobs dep'd ahead of them) on the fleet queue.
+- the freshness loop: scene publish time -> alert-log append measured
+  as the ``acquisition_to_alert_seconds`` histogram, judged by the
+  ``alert_freshness`` SLO leg (obs/slo.py) and proven end-to-end by
+  ``tools/stream_fleet_soak.py`` (``make streamfleet-smoke``).
+
+docs/STREAMING.md is the architecture document.
+"""
+
+from firebird_tpu.streamops.statestore import (LegacyNpzStore,
+                                               TileStateStore,
+                                               open_statestore)
+from firebird_tpu.streamops.watcher import (AcquisitionWatcher,
+                                            watch_db_path)
+
+__all__ = ["AcquisitionWatcher", "LegacyNpzStore", "TileStateStore",
+           "open_statestore", "watch_db_path"]

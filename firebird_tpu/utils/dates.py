@@ -29,8 +29,15 @@ def acquired_range(acquired: str) -> tuple[int, int]:
 
 
 def default_acquired() -> str:
-    """Full-archive default range (ccdc/core.py:41-50)."""
-    return "0001-01-01/{}".format(datetime.datetime.now().date().isoformat())
+    """Full-archive default range (ccdc/core.py:41-50).
+
+    Ends TOMORROW: acquired windows are half-open ``[start, end)``
+    (ingest/sources._slice_acquired), so covering everything up to and
+    including today — the freshest acquisitions are exactly what a
+    default streaming run exists to process — needs today + 1 as the
+    exclusive end."""
+    tomorrow = datetime.datetime.now().date() + datetime.timedelta(days=1)
+    return "0001-01-01/{}".format(tomorrow.isoformat())
 
 
 def ordinal_to_fractional_year(ordinal) -> np.ndarray:

@@ -43,7 +43,35 @@ def test_acquired_range_filters(source):
     import datetime
     lo = datetime.date(1996, 1, 1).toordinal()
     hi = datetime.date(1998, 1, 1).toordinal()
-    assert c.dates.min() >= lo and c.dates.max() <= hi
+    assert c.dates.min() >= lo and c.dates.max() < hi
+
+
+def test_acquired_window_half_open_partition(tmp_path):
+    """The _slice_acquired boundary contract (streamops regression):
+    ``[start, end)`` across every source, so adjacent windows PARTITION
+    an archive — an observation dated exactly on the boundary lands in
+    the later window, never in both (double-delivery) or neither
+    (skip).  The acquisition watcher's cursor depends on this."""
+    from firebird_tpu.ingest.packer import ChipData
+
+    t = np.array([datetime.date(1999, 6, d).toordinal()
+                  for d in (1, 9, 17, 25)], np.int64)
+    rng = np.random.default_rng(5)
+    spectra = rng.integers(0, 4000, (7, 4, CHIP_SIDE, CHIP_SIDE),
+                           dtype=np.int16)
+    qas = np.zeros((4, CHIP_SIDE, CHIP_SIDE), np.uint16)
+    fs = FileSource(str(tmp_path))
+    fs.save_chip(ChipData(cx=0, cy=0, dates=t, spectra=spectra, qas=qas))
+    # 1999-06-17 is EXACTLY the boundary of these adjacent windows
+    first = fs.chip(0, 0, acquired="1999-06-01/1999-06-17")
+    second = fs.chip(0, 0, acquired="1999-06-17/1999-07-01")
+    assert list(first.dates) == list(t[:2])
+    assert list(second.dates) == list(t[2:])
+    # partition: no overlap, no gap — together they are the archive
+    both = np.concatenate([first.dates, second.dates])
+    assert np.array_equal(both, t)
+    assert np.array_equal(
+        np.concatenate([first.spectra, second.spectra], axis=1), spectra)
 
 
 def test_pack_shapes_and_padding(chipdata, source):

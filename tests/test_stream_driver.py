@@ -8,7 +8,6 @@ the break (chprob 1.0 published, pixels flagged for the cold-path batch
 rerun); a third run with the same range is a no-op.
 """
 
-import glob
 import json
 
 import numpy as np
@@ -20,6 +19,18 @@ from firebird_tpu.driver import stream as sdrv
 from firebird_tpu.ingest.packer import ChipData
 from firebird_tpu.store import open_store
 from firebird_tpu.utils import dates as dt
+
+
+def _state_chips(cfg):
+    """Chip ids with a stream checkpoint, whatever the configured
+    statestore layout (packed tile files by default)."""
+    from firebird_tpu.streamops import open_statestore
+
+    st = open_statestore(cfg)
+    try:
+        return st.chips()
+    finally:
+        st.close()
 
 
 class StepSource:
@@ -73,7 +84,7 @@ def test_bootstrap_then_update_then_noop(runs):
     # same range again: nothing new, flags persist in the checkpoint
     assert s3["updated"] == 0 and s3["obs_applied"] == 0
     assert s3["pixels_need_batch"] == s2["pixels_need_batch"]
-    assert glob.glob(f"{cfg.stream_dir}/state_*.npz")
+    assert _state_chips(cfg)
 
 
 def test_published_rows_reflect_stream(runs):
@@ -181,7 +192,7 @@ def test_sharded_bootstrap_multi_chip(tmp_path):
     s1 = sdrv.stream(100, 200, acquired="1995-01-01/1998-12-31", number=2,
                      cfg=cfg, source=src, store=mk())
     assert s1["bootstrapped"] == 2 and s1["updated"] == 0
-    assert len(glob.glob(f"{cfg.stream_dir}/state_*.npz")) == 2
+    assert len(_state_chips(cfg)) == 2
     # both chips' batch rows landed under their own chip keys
     seg = mk().read("segment")
     assert len({(x, y) for x, y in zip(seg["cx"], seg["cy"])}) == 2
@@ -218,7 +229,7 @@ def test_stream_quarantine_branch_and_drain(tmp_path):
     qpath = qlib.quarantine_path(cfg)
     doc = json.load(open(qpath))
     assert doc["chips"][f"{poisoned[0]},{poisoned[1]}"]["stage"] == "stream"
-    assert len(glob.glob(f"{cfg.stream_dir}/state_*.npz")) == 1
+    assert len(_state_chips(cfg)) == 1
 
     # poison cleared: the missing chip bootstraps, the landed one
     # updates, and the dead letter drains
@@ -227,4 +238,4 @@ def test_stream_quarantine_branch_and_drain(tmp_path):
                      cfg=healed, source=src, store=mk())
     assert s2["bootstrapped"] == 1 and s2["quarantined"] == 0
     assert len(qlib.Quarantine.load(qpath)) == 0
-    assert len(glob.glob(f"{cfg.stream_dir}/state_*.npz")) == 2
+    assert len(_state_chips(cfg)) == 2

@@ -170,16 +170,17 @@ def alert_rows(path: str):
 
 
 def flagged_pixels(state_dir: str, cids) -> int:
-    """needs_batch pixels summed straight from the checkpoint files (no
-    jax in the parent — break_day > 0 IS the flag)."""
-    import numpy as np
+    """needs_batch pixels summed straight from the packed checkpoint
+    slots (no jax in the parent — statestore.peek_arrays is the
+    JAX-free read path; break_day > 0 IS the flag)."""
+    from firebird_tpu.streamops.statestore import TileStateStore
 
-    total = 0
-    for cx, cy in cids:
-        path = os.path.join(state_dir, f"state_{int(cx)}_{int(cy)}.npz")
-        with np.load(path, allow_pickle=False) as d:
-            total += int((d["break_day"] > 0).sum())
-    return total
+    store = TileStateStore(state_dir)
+    try:
+        return sum(int((store.peek_arrays((cx, cy))["break_day"] > 0)
+                       .sum()) for cx, cy in cids)
+    finally:
+        store.close()
 
 
 def tail(path: str, n: int = 3000) -> str:
