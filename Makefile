@@ -10,10 +10,10 @@ Y ?= 1650000
 ACQUIRED ?= 1982-01-01/2017-12-31
 
 .PHONY: install lint test bench obs-smoke pipeline-smoke chaos-smoke \
-        fleet-smoke serve-smoke compact-smoke postmortem-smoke \
-        alert-smoke streamfleet-smoke wire-smoke fuse-smoke fuse-repro \
-        image db-up db-schema db-test db-down changedetection \
-        classification clean
+        fleet-smoke serve-smoke pyramid-smoke serve-fleet compact-smoke \
+        postmortem-smoke alert-smoke streamfleet-smoke wire-smoke \
+        fuse-smoke fuse-repro image db-up db-schema db-test db-down \
+        changedetection classification clean
 
 install:
 	pip install -e . --no-build-isolation
@@ -33,6 +33,7 @@ lint:
 # it exercises stream + serve + fleet queue together under chaos).
 test: lint
 	python -m pytest tests/ -x -q
+	$(MAKE) pyramid-smoke
 	$(MAKE) fuse-smoke
 	$(MAKE) alert-smoke
 	$(MAKE) streamfleet-smoke
@@ -80,6 +81,28 @@ fleet-smoke:
 # (RPS, p50/p95/p99, hit rate) written + folded by bench.py.
 serve-smoke:
 	python tools/serve_smoke.py
+
+# Pyramid + changefeed coherence check (docs/SERVING.md): seed a store,
+# build a 2-level quadkey pyramid — base tiles byte-compared against
+# products.save rasters — then mutate one chip through the
+# product_writes feed and assert EXACTLY the ancestor tiles go stale
+# and the old ETag's 304 flips to a fresh 200; artifact folded by
+# bench.py alongside the serve loadtest.
+pyramid-smoke:
+	python tools/pyramid_smoke.py
+
+# Multi-replica read-path bench (docs/SERVING.md): seed + pyramid, then
+# N `firebird serve` replicas (read-only mode=ro store connections)
+# behind a round-robin front door under a mixed hot/cold/304/SSE
+# workload from multi-process client shards, with a live writer
+# mutating mid-test — the artifact carries aggregate RPS, p50/p95/p99,
+# hit/304 rates, and max observed staleness vs the changefeed bound.
+# Heavier than the smoke tier (spawns a process fleet), so not part of
+# `make test`; bench.py folds the artifact when it exists.
+serve-fleet:
+	python tools/serve_loadtest.py --fleet 10 --requests 400000 \
+	  --client-procs 12 --concurrency 5 --mutations 6 --sse 4 \
+	  --feed-poll 0.5
 
 # Crash flight-recorder check (docs/OBSERVABILITY.md "Flight recorder"):
 # a subprocess run SIGTERM'd mid-batch must die with real SIGTERM

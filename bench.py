@@ -244,9 +244,23 @@ def _compact_fold() -> dict:
 
 def _serve_fold() -> dict:
     """Serving-layer loadtest evidence (tools/serve_loadtest.py, run by
-    `make serve-smoke`): RPS, p50/p95/p99, cache hit rate."""
-    return _artifact_fold("serve_loadtest", "FIREBIRD_SERVE_DIR",
-                          "serve_loadtest.json")
+    `make serve-smoke`): RPS, p50/p95/p99, cache hit rate.  The
+    multi-replica fleet artifact (`make serve-fleet`: aggregate RPS,
+    304/hit rates, max observed staleness vs the changefeed bound)
+    folds next to it when one ran."""
+    out = _artifact_fold("serve_loadtest", "FIREBIRD_SERVE_DIR",
+                         "serve_loadtest.json")
+    out.update(_artifact_fold("serve_fleet_loadtest", "FIREBIRD_SERVE_DIR",
+                              "serve_fleet_loadtest.json"))
+    return out
+
+
+def _pyramid_fold() -> dict:
+    """`make pyramid-smoke` evidence (tools/pyramid_smoke.py): base
+    tiles byte-identical to products.save rasters, surgical ancestor
+    invalidation through the changefeed, and the ETag 304->200 flip."""
+    return _artifact_fold("pyramid_smoke", "FIREBIRD_PYRAMID_DIR",
+                          "pyramid_smoke.json")
 
 
 def _lint_fold() -> dict:
@@ -969,8 +983,12 @@ def measure(cpu_only: bool) -> None:
             # drained, zero stale-fence writes accepted) when one ran.
             **_fleet_fold(),
             # Last serve-loadtest evidence (read-path RPS/latency/hit
-            # rate) when the serving layer was exercised on this host.
+            # rate) when the serving layer was exercised on this host,
+            # plus the multi-replica fleet artifact when one ran.
             **_serve_fold(),
+            # Last pyramid-smoke evidence (base-tile byte identity,
+            # surgical changefeed invalidation, ETag flip).
+            **_pyramid_fold(),
             # Last wire-smoke evidence (all-integer ingress, int-coded
             # egress, measured bytes-on-wire cut) when the probe ran.
             **_wire_fold(),

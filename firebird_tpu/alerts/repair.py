@@ -120,6 +120,15 @@ def repair_chip(cfg, cid, acquired: str, *, source=None, store=None,
         finally:
             sstore.close()
         writer.flush()
+        # Cross-process coherence (serve/changefeed.py): a repair
+        # republishes the chip's segment rows but clears the break, so
+        # no alert record announces it — the product_writes feed is how
+        # serve replicas learn to drop their cached frames/rasters/
+        # pyramid tiles for this chip.  Appended AFTER the flush: a
+        # replica applying the record reads the repaired rows.
+        from firebird_tpu.serve.changefeed import append_product_writes
+
+        append_product_writes(cfg, "segment", [(cx, cy)])
         summary = {"chip": [cx, cy],
                    "obs": T,
                    "active": int(np.asarray(st.active).sum()),

@@ -401,15 +401,34 @@ def validate(x, y, acquired, n_pixels, dtype, seed):
               help="disable compute-on-miss: absent product rows answer "
                    "404 instead of running the products.save-path "
                    "computation (strictly read-only serving)")
-def serve(port, host, cache_entries, cache_dir, no_compute):
+@click.option("--read-only", is_flag=True, default=False,
+              help="open the store as a mode=ro replica connection "
+                   "(sqlite): this replica can never take the writer's "
+                   "lock; implies --no-compute")
+@click.option("--replica-id", default=None,
+              help="stable changefeed replica id (cursor resume across "
+                   "restarts); overrides FIREBIRD_SERVE_REPLICA — "
+                   "default host:pid, which replays the feed on start")
+@click.option("--pyramid-dir", default=None,
+              help="quadkey tile-pyramid root for /v1/pyramid; "
+                   "overrides FIREBIRD_SERVE_PYRAMID_DIR (default: "
+                   "pyramid/ under the cache dir, else next to the "
+                   "store)")
+def serve(port, host, cache_entries, cache_dir, no_compute, read_only,
+          replica_id, pyramid_dir):
     """Serve the query API over the configured results store.
 
     Endpoints: /v1/segments?cx=&cy=, /v1/pixel?x=&y=&date=,
     /v1/product/<name>?cx=&cy=&date=, /v1/tile/<name>?bounds=&date=,
-    plus /healthz and /metrics.  Cold product requests compute through
-    the products.save path (once per key, coalesced) and persist, so the
-    store warms as it serves.  When the store has an alert log next to
-    it, the change-alert feed mounts too: /v1/alerts (cursor pull),
+    /v1/pyramid/<name>/<z>/<x>/<y>?date= (quadkey map tiles), plus
+    /healthz and /metrics.  Cold product requests compute through the
+    products.save path (once per key, coalesced) and persist, so the
+    store warms as it serves; /v1/product, /v1/tile and /v1/pyramid
+    carry strong ETags + Cache-Control so edge caches revalidate with
+    304s.  A changefeed consumer tails the alert log + product_writes
+    cursors so N replicas and a live writer stay coherent
+    (docs/SERVING.md).  When the store has an alert log next to it, the
+    change-alert feed mounts too: /v1/alerts (cursor pull),
     /v1/alerts/stream (SSE push), /v1/alerts/webhooks (POST registers a
     subscriber; delivery runs in the background from each subscriber's
     durable cursor).  See docs/SERVING.md and docs/ALERTS.md."""
@@ -419,38 +438,68 @@ def serve(port, host, cache_entries, cache_dir, no_compute):
     from firebird_tpu.alerts import AlertFeed, AlertLog, alert_db_path
     from firebird_tpu.config import Config
     from firebird_tpu.serve import api as serve_api
+    from firebird_tpu.serve import changefeed as cflib
+    from firebird_tpu.serve import pyramid as pyrlib
     from firebird_tpu.store import open_store
 
     overrides = {k: v for k, v in
                  (("serve_port", port), ("serve_host", host),
                   ("serve_cache_entries", cache_entries),
-                  ("serve_cache_dir", cache_dir)) if v is not None}
+                  ("serve_cache_dir", cache_dir),
+                  ("serve_replica", replica_id),
+                  ("serve_pyramid_dir", pyramid_dir)) if v is not None}
     # --port 0 means "ephemeral bind", which Config rejects as a
     # deploy-time port; thread it past validation separately.
     bind_port = overrides.pop("serve_port", None)
     cfg = Config.from_env(**overrides)
     if bind_port is None:
         bind_port = cfg.serve_port
-    store = open_store(cfg.store_backend, cfg.store_path, cfg.keyspace())
+    store = open_store(cfg.store_backend, cfg.store_path, cfg.keyspace(),
+                       read_only=read_only)
     # Mount the alert feed when this store has an alert log behind it
     # (docs/ALERTS.md): /v1/alerts endpoints + background webhook
     # delivery.  Unavailable/corrupt log degrades to a serve layer
     # without alerts, not a dead server.
     feed = None
+    alog = None
     if cfg.alerts_enabled:
         apath = alert_db_path(cfg)
         if apath is not None:
             try:
-                feed = AlertFeed(AlertLog(apath), cfg)
+                alog = AlertLog(apath)
+                feed = AlertFeed(alog, cfg)
                 feed.deliverer.start()
             except Exception as e:
                 click.echo(f"WARNING: alert log {apath} unavailable "
                            f"({type(e).__name__}: {e}); serving without "
                            "/v1/alerts", err=True)
-                feed = None
-    service = serve_api.ServeService(store, cfg,
-                                     compute_on_miss=not no_compute,
-                                     alerts=feed)
+                feed = alog = None
+    # Quadkey tile pyramid (docs/SERVING.md): static versioned tiles
+    # under the pyramid root; absent root -> /v1/pyramid answers 404.
+    proot = pyrlib.pyramid_root(cfg)
+    pyr = pyrlib.TilePyramid(proot) if proot else None
+    # Changefeed consumer: this replica's cache-coherence loop — tail
+    # the alert log + product_writes cursors, bump the touched chip
+    # generations, stale-stamp pyramid ancestors, checkpoint into the
+    # replica registry.  A corrupt feed db degrades to in-process-only
+    # invalidation (the PR 5 behavior), not a dead server.
+    consumer = None
+    service = serve_api.ServeService(
+        store, cfg, compute_on_miss=not no_compute and not read_only,
+        alerts=feed, pyramid=pyr)
+    try:
+        fpath = cflib.changefeed_db_path(cfg)
+        wfeed = cflib.ProductWrites(fpath) if fpath else None
+        if wfeed is not None or alog is not None:
+            consumer = cflib.ChangefeedConsumer(
+                service.gens, feed=wfeed, alerts=alog,
+                replica=cflib.default_replica_id(cfg),
+                poll_sec=cfg.serve_feed_poll_sec).start()
+            service.changefeed = consumer
+    except Exception as e:
+        click.echo(f"WARNING: changefeed unavailable "
+                   f"({type(e).__name__}: {e}); serving with in-process "
+                   "invalidation only", err=True)
     srv = serve_api.start_serve_server(bind_port, service,
                                        host=cfg.serve_host)
     click.echo(f"serving {cfg.store_backend}:{cfg.store_path} "
@@ -462,9 +511,89 @@ def serve(port, host, cache_entries, cache_dir, no_compute):
         stop.wait()
     finally:
         srv.close()
+        if consumer is not None:
+            consumer.stop()
         if feed is not None:
             feed.close()
         store.close()
+
+
+@entrypoint.group()
+def pyramid():
+    """Quadkey tile-pyramid precompute (docs/SERVING.md): materialize
+    the standard products as versioned static map tiles under the
+    pyramid root, so /v1/pyramid hot traffic is a file read."""
+
+
+@pyramid.command("build")
+@click.option("--bounds", "-b", multiple=True, required=True,
+              help="x,y projection point; repeat to extend the area")
+@click.option("--products", "-p", "product_names", multiple=True,
+              required=True, help="product name; repeat for several")
+@click.option("--product_dates", "-d", multiple=True, required=True,
+              help="ISO query date; repeat for several")
+@click.option("--levels", "-l", default=2, type=int,
+              help="pyramid levels to materialize, base upward "
+                   "(1 = base tiles only)")
+@click.option("--refresh", is_flag=True, default=False,
+              help="rebuild fresh tiles too (default: skip them)")
+@click.option("--no-compute", is_flag=True, default=False,
+              help="render only stored product rows; chips without one "
+                   "render as fill instead of computing")
+@click.option("--enqueue", is_flag=True, default=False,
+              help="enqueue a fleet `pyramid` job instead of building "
+                   "inline (any `firebird fleet work` worker executes "
+                   "it)")
+def pyramid_build(bounds, product_names, product_dates, levels, refresh,
+                  no_compute, enqueue):
+    """Materialize pyramid tiles over an area, bottom-up: base tiles
+    render chips (byte-identical to `firebird save` rasters), each
+    parent level downsamples its children 2x.  Run it over hot regions
+    so map traffic never waits on a cold build — tiles farther than the
+    compute-on-miss floor from the base ONLY serve precomputed."""
+    import json as _json
+
+    from firebird_tpu import products as prodlib
+    from firebird_tpu.config import Config
+    from firebird_tpu.serve import pyramid as pyrlib
+    from firebird_tpu.store import open_store
+
+    for p in product_names:
+        if p not in prodlib.PRODUCTS:
+            raise click.BadParameter(
+                f"unknown product {p!r}; available: {prodlib.PRODUCTS}")
+    cfg = Config.from_env()
+    if enqueue:
+        from firebird_tpu.fleet import make_queue
+
+        queue = make_queue(cfg)
+        try:
+            jid = queue.enqueue("pyramid", {
+                "bounds": [list(b) for b in _parse_bounds(bounds)],
+                "products": list(product_names),
+                "product_dates": list(product_dates),
+                "levels": int(levels), "refresh": bool(refresh),
+                "compute": not no_compute,
+            }, max_attempts=cfg.fleet_max_attempts)
+            click.echo(_json.dumps({"queue": queue.path, "job": jid}))
+        finally:
+            queue.close()
+        return
+    root = pyrlib.pyramid_root(cfg)
+    if root is None:
+        raise click.ClickException(
+            "no pyramid root: set FIREBIRD_SERVE_PYRAMID_DIR (or use a "
+            "file-backed store for the next-to-store default)")
+    store = open_store(cfg.store_backend, cfg.store_path, cfg.keyspace())
+    try:
+        pyr = pyrlib.TilePyramid(
+            root, pyrlib.store_read_chip(store, compute=not no_compute))
+        summary = pyr.build_area(list(product_names), list(product_dates),
+                                 _parse_bounds(bounds), levels=levels,
+                                 refresh=refresh)
+    finally:
+        store.close()
+    click.echo(_json.dumps({"root": root, **summary}, indent=1))
 
 
 @entrypoint.command()
@@ -474,9 +603,10 @@ def serve(port, host, cache_entries, cache_dir, no_compute):
 def status(x, y):
     """Inspect the configured results store: per-table row counts, chips
     with stored segments, quarantine state, the fleet queue, the alert
-    log (depth, cursor, subscriber lag, open repair jobs), and (with
-    -x/-y) one tile's completion — the operational view behind
-    `changedetection --resume`."""
+    log (depth, cursor, subscriber lag, open repair jobs), the serving
+    fleet (changefeed replicas with cursor lag, pyramid tile census by
+    level), and (with -x/-y) one tile's completion — the operational
+    view behind `changedetection --resume`."""
     import collections
     import json as _json
     import os as _os
@@ -596,6 +726,36 @@ def status(x, y):
                 cur.close()
     except Exception as e:
         out["streamops"] = {"error": f"{type(e).__name__}: {e}"}
+    # Serving view (docs/SERVING.md): the replica fleet as the shared
+    # changefeed db sees it (replica count, per-replica cursor lag) and
+    # the pyramid's tile census by level — guarded like the fleet/
+    # alerts views: a corrupt feed db or unreadable pyramid root
+    # degrades THIS section, never the store output above.
+    try:
+        from firebird_tpu.serve import changefeed as _cflib
+        from firebird_tpu.serve import pyramid as _pyrlib
+
+        serving: dict = {}
+        fpath = _cflib.changefeed_db_path(cfg)
+        if fpath is not None and _os.path.exists(fpath):
+            pw = _cflib.ProductWrites(fpath)
+            try:
+                reps = pw.replicas()
+                serving["changefeed"] = {
+                    "path": fpath,
+                    "latest_cursor": pw.latest_cursor(),
+                    "replicas_seen": len(reps),
+                    "replicas": reps,
+                }
+            finally:
+                pw.close()
+        proot = _pyrlib.pyramid_root(cfg)
+        if proot is not None and _os.path.isdir(proot):
+            serving["pyramid"] = _pyrlib.TilePyramid(proot).status()
+        if serving:
+            out["serving"] = serving
+    except Exception as e:
+        out["serving"] = {"error": f"{type(e).__name__}: {e}"}
     if x is not None:
         tile = grid.tile(x, y)
         cids = [tuple(int(v) for v in c) for c in grid.chips(tile)]

@@ -180,6 +180,21 @@ KNOBS = (
          help="admission waiting-line bound (past it: 429)"),
     Knob(name="FIREBIRD_SERVE_DEADLINE", field="serve_deadline_sec",
          help="per-request deadline (seconds; past it: 504)"),
+    Knob(name="FIREBIRD_SERVE_PYRAMID_DIR", field="serve_pyramid_dir",
+         help="quadkey tile-pyramid root (default: pyramid/ under the "
+              "serve cache dir, else next to the store)"),
+    Knob(name="FIREBIRD_SERVE_EDGE_TTL", field="serve_edge_ttl",
+         help="Cache-Control max-age seconds on /v1/product, /v1/tile, "
+              "/v1/pyramid (0 = no Cache-Control header)"),
+    Knob(name="FIREBIRD_SERVE_FEED_POLL", field="serve_feed_poll_sec",
+         help="replica changefeed poll interval (seconds) — the "
+              "serving staleness bound is one poll + one apply"),
+    Knob(name="FIREBIRD_SERVE_REPLICA", field="serve_replica",
+         help="stable serve replica id for changefeed cursor resume "
+              "(default host:pid — an unseen id replays the feed)"),
+    Knob(name="FIREBIRD_CHANGEFEED_DB", field="changefeed_db",
+         help="product_writes changefeed + replica-registry sqlite "
+              "path (default: changefeed.db next to the store)"),
     # ---- trace-time kernel knobs (read per trace, not per run — a
     # Config field would freeze them at construction; declared readers
     # route through env_knob) ----
@@ -257,6 +272,8 @@ KNOBS = (
          help="stream-fleet-soak artifact directory"),
     Knob(name="FIREBIRD_WIRE_DIR", default="/tmp/fb_wire",
          help="wire-smoke artifact directory"),
+    Knob(name="FIREBIRD_PYRAMID_DIR", default="/tmp/fb_pyramid",
+         help="pyramid-smoke artifact directory"),
     Knob(name="FIREBIRD_FUSE_DIR", default="/tmp/fb_fuse",
          help="fuse-smoke / fuse-repro artifact directory"),
     Knob(name="FIREBIRD_LINT_DIR", default="/tmp/fb_lint",
@@ -534,6 +551,31 @@ class Config:
     serve_queue: int = 64
     serve_deadline_sec: float = 30.0
 
+    # Quadkey tile-pyramid root (FIREBIRD_SERVE_PYRAMID_DIR;
+    # serve/pyramid.py): "" derives pyramid/ under serve_cache_dir when
+    # set, else next to the results store; the memory backend with
+    # neither disables the /v1/pyramid endpoint.
+    serve_pyramid_dir: str = ""
+
+    # Edge caching (FIREBIRD_SERVE_EDGE_TTL): Cache-Control max-age in
+    # seconds stamped (with a strong ETag) on /v1/product, /v1/tile and
+    # /v1/pyramid responses so CDN/browser caches revalidate with
+    # If-None-Match -> 304 instead of refetching bodies.  0 sends no
+    # Cache-Control (ETag/304 still work).
+    serve_edge_ttl: int = 30
+
+    # Replica changefeed (FIREBIRD_SERVE_FEED_POLL / _SERVE_REPLICA /
+    # _CHANGEFEED_DB; serve/changefeed.py): each serve replica tails
+    # the alert log + product_writes cursors every poll and bumps
+    # exactly the touched chip generations — the serving staleness
+    # bound is one poll interval + one apply.  The replica id keys the
+    # durable cursor row; "" derives host:pid (an id never seen before
+    # replays the whole feed — the safe default for an unknown cache
+    # dir).  changefeed_db "" derives changefeed.db next to the store.
+    serve_feed_poll_sec: float = 2.0
+    serve_replica: str = ""
+    changefeed_db: str = ""
+
     # Framework version (reference: version.txt read in keyspace()).
     version: str = _VERSION
 
@@ -634,6 +676,13 @@ class Config:
         if self.serve_deadline_sec <= 0:
             raise ValueError("FIREBIRD_SERVE_DEADLINE must be > 0 seconds, "
                              f"got {self.serve_deadline_sec}")
+        if self.serve_edge_ttl < 0:
+            raise ValueError("FIREBIRD_SERVE_EDGE_TTL must be >= 0 "
+                             "seconds (0 = no Cache-Control), got "
+                             f"{self.serve_edge_ttl}")
+        if self.serve_feed_poll_sec <= 0:
+            raise ValueError("FIREBIRD_SERVE_FEED_POLL must be > 0 "
+                             f"seconds, got {self.serve_feed_poll_sec}")
 
     @classmethod
     def from_env(cls, env: dict | None = None, **overrides) -> "Config":
@@ -719,6 +768,16 @@ class Config:
             serve_queue=int(e.get("FIREBIRD_SERVE_QUEUE", cls.serve_queue)),
             serve_deadline_sec=float(e.get("FIREBIRD_SERVE_DEADLINE",
                                            cls.serve_deadline_sec)),
+            serve_pyramid_dir=e.get("FIREBIRD_SERVE_PYRAMID_DIR",
+                                    cls.serve_pyramid_dir),
+            serve_edge_ttl=int(e.get("FIREBIRD_SERVE_EDGE_TTL",
+                                     cls.serve_edge_ttl)),
+            serve_feed_poll_sec=float(e.get("FIREBIRD_SERVE_FEED_POLL",
+                                            cls.serve_feed_poll_sec)),
+            serve_replica=e.get("FIREBIRD_SERVE_REPLICA",
+                                cls.serve_replica),
+            changefeed_db=e.get("FIREBIRD_CHANGEFEED_DB",
+                                cls.changefeed_db),
         )
         kw.update(overrides)
         return cls(**kw)

@@ -57,7 +57,8 @@ QUEUE_SCHEMA = "firebird-fleet-queue/1"
 PENDING, LEASED, DONE, DEAD = "pending", "leased", "done", "dead"
 STATES = (PENDING, LEASED, DONE, DEAD)
 
-JOB_TYPES = ("detect", "stream", "classify", "product", "repair")
+JOB_TYPES = ("detect", "stream", "classify", "product", "repair",
+             "pyramid")
 
 # Exception text kept in job history is for diagnosis, not a log archive
 # (the quarantine.py discipline).

@@ -93,6 +93,7 @@ class FleetWorker:
             "classify": self._run_classify,
             "product": self._run_product,
             "repair": self._run_repair,
+            "pyramid": self._run_pyramid,
         }
         self.counters = Counters()
         # Worker-local tallies: the obs registry resets when a job runs
@@ -430,6 +431,36 @@ class FleetWorker:
                 product_dates=list(payload["product_dates"]),
                 acquired=payload.get("acquired"), cfg=self.cfg,
                 store=fenced)
+        finally:
+            raw.close()
+
+    def _run_pyramid(self, payload: dict, lease: Lease) -> None:
+        """Precompute pyramid tiles over the job's bounds
+        (serve/pyramid.py build_area) — the hot-region materializer the
+        serving fleet's cold-miss depth floor points at.  Product rows
+        computed along the way persist through the FENCED store (a
+        zombie's store writes reject); the tile files themselves are
+        idempotent atomic replaces, safe under re-delivery."""
+        from firebird_tpu.serve import pyramid as pyrlib
+
+        root = payload.get("root") or pyrlib.pyramid_root(self.cfg)
+        if root is None:
+            raise ValueError(
+                "pyramid job has no root: set FIREBIRD_SERVE_PYRAMID_DIR "
+                "(or a file-backed store) or put 'root' in the payload")
+        raw, fenced = self._fenced_store(lease)
+        try:
+            pyr = pyrlib.TilePyramid(
+                root, pyrlib.store_read_chip(
+                    fenced, compute=bool(payload.get("compute", True))))
+            summary = pyr.build_area(
+                list(payload["products"]),
+                list(payload["product_dates"]),
+                [tuple(b) for b in payload["bounds"]],
+                levels=int(payload.get("levels", 2)),
+                refresh=bool(payload.get("refresh", False)))
+            self.log.info("pyramid job %d built: %s", lease.job_id,
+                          summary)
         finally:
             raw.close()
 

@@ -169,6 +169,12 @@ class Httpd(http.server.ThreadingHTTPServer):
 
     daemon_threads = True
     allow_reuse_address = True
+    # socketserver's default listen backlog is 5: a replica fleet's
+    # load generator opening ~100 keep-alive connections in one burst
+    # overflows it and the excess see connection resets — a transport
+    # error the client books against the SERVER.  128 absorbs any sane
+    # connection storm; steady state is unaffected (keep-alive reuses).
+    request_queue_size = 128
     thread_name = "firebird-httpd"
 
     def __init__(self, addr, handler_cls):

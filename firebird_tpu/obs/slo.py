@@ -23,6 +23,10 @@ are seconds):
     Seconds since the last drained batch (the watchdog's
     ``last_beat_age_sec``) — the liveness half of an alerting-grade
     freshness promise: results are at most this stale.
+``changefeed_lag``
+    The ``serve_changefeed_lag_seconds`` gauge — how far behind the
+    write feed a serve replica's cache-coherence loop ran at its last
+    poll (docs/SERVING.md's staleness bound, measured).
 
 An objective whose metric has no data reports ``ok: null`` ("no_data")
 rather than passing or failing — a serve SLO must not fail a batch run
@@ -32,7 +36,7 @@ that never served a request.  ``FIREBIRD_SLO=0`` disables evaluation.
 from __future__ import annotations
 
 DEFAULT_SPEC = ("batch_p95=30;serve_p99=2;freshness=600;"
-                "alert_freshness=60")
+                "alert_freshness=60;changefeed_lag=10")
 
 # name -> (kind, metric/field, stat, description)
 OBJECTIVES = {
@@ -55,6 +59,15 @@ OBJECTIVES = {
                          "alert_visible_seconds"), "p95",
                         "scene publish (or stream ingest start) -> "
                         "alert-visible seconds (p95)"),
+    # The replica-coherence promise (docs/SERVING.md): a serve replica
+    # applies a changefeed record — and so stops serving stale cached
+    # answers for the touched chips — within the target.  The gauge is
+    # the age of the newest record the last poll applied (0 = caught
+    # up), so the objective judges the serving staleness bound the
+    # replica fleet actually ran at.
+    "changefeed_lag": ("gauge", "serve_changefeed_lag_seconds", None,
+                       "replica changefeed apply lag seconds "
+                       "(newest-applied record age at last poll)"),
 }
 
 
@@ -121,6 +134,10 @@ def evaluate_snapshot(metrics: dict, watchdog: dict | None = None,
                 if h.get("count", 0) > 0:
                     value = h.get(stat)
                     break
+        elif kind == "gauge":
+            # An absent gauge is no_data (a batch run with no serve
+            # replica must not pass or fail the coherence objective).
+            value = ((metrics or {}).get("gauges") or {}).get(key)
         else:                            # watchdog field
             if watchdog is not None:
                 value = watchdog.get(key)

@@ -293,6 +293,7 @@ def save(bounds, products, product_dates, acquired: str | None = None,
     log.info("products %s at %s over %d chips (clip=%s)",
              list(products), list(product_dates), len(cids), clip)
 
+    detected: list[tuple[int, int]] = []
     if acquired:
         have = store.chip_ids("segment")
         missing = [c for c in cids if c not in have]
@@ -320,6 +321,7 @@ def save(bounds, products, product_dates, acquired: str | None = None,
                 raise RuntimeError(
                     f"products: {len(lost)} chips failed detection "
                     f"(first: {lost[0]}); rerun once ingest recovers")
+            detected = list(processed)
 
     # The cover product maps stored rfrawp votes through the trained
     # model's class order; tile_classes keeps one tile-table lookup per
@@ -345,4 +347,17 @@ def save(bounds, products, product_dates, acquired: str | None = None,
                                  arrays, classes=classes, keep=keep)
                 written.append((name, d, cx, cy))
     log.info("products complete: %d rasters written", len(written))
+    # Cross-process coherence (serve/changefeed.py): a batch save is
+    # exactly the "non-alert mutation" the serve replicas cannot see
+    # through the alert log — append one product_writes record per
+    # touched chip (and per chip the self-contained acquired path
+    # re-detected) AFTER the rows land, so a replica that applies the
+    # record is guaranteed to read the new rows.
+    from firebird_tpu.serve.changefeed import append_product_writes
+
+    if written:
+        append_product_writes(cfg, "product",
+                              {(cx, cy) for _, _, cx, cy in written})
+    if detected:
+        append_product_writes(cfg, "segment", detected)
     return written

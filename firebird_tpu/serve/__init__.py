@@ -13,21 +13,34 @@ inference server:
 - :mod:`firebird_tpu.serve.flight` — single-flight request coalescing,
   admission control (429/504), and breaker-backed degraded mode
   (cache-only serving while the store is down).
+- :mod:`firebird_tpu.serve.pyramid` — the quadkey tile pyramid:
+  versioned static product tiles (base renders chips, parents
+  downsample children 2x) behind ``/v1/pyramid``, precomputed by
+  ``firebird pyramid build`` / fleet ``pyramid`` jobs.
+- :mod:`firebird_tpu.serve.changefeed` — replica-fleet cache coherence:
+  each replica tails the alert log + product_writes cursors, bumps
+  exactly the touched chip generations, stale-stamps ancestor pyramid
+  tiles, and checkpoints into the shared replica registry.
 
-Entry points: ``firebird serve`` (cli.py), ``make serve-smoke``
-(tools/serve_smoke.py), ``tools/serve_loadtest.py``.  See
-docs/SERVING.md.
+Entry points: ``firebird serve`` (cli.py), ``make serve-smoke`` /
+``make pyramid-smoke``, ``tools/serve_loadtest.py`` (incl. the
+multi-replica ``--fleet`` mode).  See docs/SERVING.md.
 """
 
 from firebird_tpu.serve.api import (ServeServer, ServeService,
                                     start_serve_server)
 from firebird_tpu.serve.cache import LRUCache, StoreGenerations, watch_store
+from firebird_tpu.serve.changefeed import (ChangefeedConsumer, ProductWrites,
+                                           changefeed_db_path)
 from firebird_tpu.serve.flight import (AdmissionControl, DeadlineExceeded,
                                        Overload, SingleFlight, StoreDegraded)
+from firebird_tpu.serve.pyramid import TilePyramid, pyramid_root
 
 __all__ = [
     "ServeServer", "ServeService", "start_serve_server",
     "LRUCache", "StoreGenerations", "watch_store",
+    "ChangefeedConsumer", "ProductWrites", "changefeed_db_path",
+    "TilePyramid", "pyramid_root",
     "AdmissionControl", "DeadlineExceeded", "Overload", "SingleFlight",
     "StoreDegraded",
 ]

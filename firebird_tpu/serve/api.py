@@ -23,6 +23,11 @@ arXiv:1703.10979):
 ``/v1/tile/<name>?bounds=x,y&bounds=x,y&date=[&format=json|npy]``
     A mosaic over the bounds area via the export helpers, reading each
     chip through the same cache/compute path as ``/v1/product``.
+``/v1/pyramid/<name>/<z>/<x>/<y>?date=[&format=npy|json]``
+    One quadkey pyramid tile (serve/pyramid.py): a hit is a static-file
+    read of the persisted versioned ``.npy`` — no store, no decode —
+    and cold tiles near the base compute through the same single-flight
+    path.  THE map-traffic endpoint.
 ``/v1/alerts?since=&bbox=&t0=&t1=``, ``/v1/alerts/stream``,
 ``/v1/alerts/webhooks``
     The near-real-time change-alert feed over the durable alert log
@@ -32,6 +37,16 @@ arXiv:1703.10979):
     Discovery, liveness (``degraded`` while the store breaker is open),
     and the Prometheus exposition of the shared obs registry — the
     ``serve_*`` family lands next to the pipeline metrics.
+
+Edge offload: ``/v1/product``, ``/v1/tile``, and ``/v1/pyramid``
+responses carry strong ``ETag`` + ``Cache-Control`` headers, and a
+request presenting a matching ``If-None-Match`` answers **304** without
+touching the body path — so CDN/browser caches do the heavy lifting
+and revalidations cost a generation lookup, not a raster.  ETags derive
+from the replica's store-write generations (serve/cache.py) and the
+pyramid tile version; the changefeed consumer (serve/changefeed.py)
+bumps both on every cross-process write, which is what flips a cached
+ETag to a fresh 200.
 
 Every ``/v1`` request runs under admission control (429 + Retry-After
 past the waiting line, 504 past the deadline) and the store sits behind
@@ -104,7 +119,8 @@ class ServeService:
     def __init__(self, store, cfg=None, *, cache: LRUCache | None = None,
                  gens: StoreGenerations | None = None,
                  admission: AdmissionControl | None = None,
-                 breaker=None, compute_on_miss: bool = True, alerts=None):
+                 breaker=None, compute_on_miss: bool = True, alerts=None,
+                 pyramid=None, changefeed=None):
         from firebird_tpu.config import Config
         from firebird_tpu.retry import CircuitBreaker
 
@@ -126,6 +142,27 @@ class ServeService:
         # Alert feed (alerts/feed.AlertFeed) — None when the store has
         # no alert log; the /v1/alerts endpoints then answer 404.
         self.alerts = alerts
+        # Quadkey tile pyramid (serve/pyramid.TilePyramid) — None when
+        # no pyramid root is configured; /v1/pyramid then answers 404.
+        # The pyramid shares this service's SingleFlight, so concurrent
+        # cold tile misses coalesce like product misses do.
+        self.pyramid = pyramid
+        if pyramid is not None:
+            if pyramid.flight is None:
+                pyramid.flight = self.flight
+            if pyramid.read_chip is None:
+                # Base tiles render through this service's cached,
+                # compute-on-miss raster path — byte-identical to the
+                # products.save output, warming the same cache.
+                pyramid.read_chip = self.pyramid_read_chip()
+            # In-process writes through the watched store dirty the
+            # pyramid too (the changefeed covers other processes).
+            self.gens.on_bump = \
+                lambda table, cx, cy: pyramid.invalidate_chip(cx, cy)
+        # Changefeed consumer (serve/changefeed.ChangefeedConsumer) —
+        # owned by the caller (start/stop lifecycles belong to the serve
+        # command); mounted here for /healthz context and status.
+        self.changefeed = changefeed
         # One tile-model class-order lookup per tile, shared across
         # requests; invalidated wholesale when the tile table changes.
         self._classes: dict = {}
@@ -332,6 +369,89 @@ class ServeService:
         return export.mosaic(name, date, bounds, self.store,
                              read_chip=read_chip)
 
+    # -- pyramid ------------------------------------------------------------
+
+    def pyramid_read_chip(self, deadline=None):
+        """The pyramid's base-tile renderer: this service's cached,
+        compute-on-miss raster path — a base tile is byte-identical to
+        the ``products.save`` raster, and building one warms the same
+        cache the point endpoints use."""
+        def read_chip(name, date, cx, cy):
+            try:
+                return self.product_raster(name, date, int(cx), int(cy),
+                                           deadline=deadline)
+            except NotFound:
+                return None   # absent chips render as FILL
+        return read_chip
+
+    def pyramid_tile(self, name: str, date: str, z: int, x: int, y: int,
+                     deadline=None):
+        """One pyramid tile ``(cells [side, side] int32, meta)``; 404
+        when no pyramid is mounted, the tile address is off-domain, or
+        a cold tile sits past the compute-on-miss depth floor."""
+        from firebird_tpu import products
+        from firebird_tpu.utils import dates as dt
+
+        if self.pyramid is None:
+            raise NotFound(
+                "no pyramid root configured — set "
+                "FIREBIRD_SERVE_PYRAMID_DIR (or FIREBIRD_SERVE_CACHE_DIR; "
+                "docs/SERVING.md) and precompute with "
+                "`firebird pyramid build`")
+        if name not in products.PRODUCTS:
+            raise BadRequest(f"unknown product {name!r}; available: "
+                             f"{products.PRODUCTS}")
+        try:
+            dt.to_ordinal(date)
+        except (ValueError, TypeError) as e:
+            raise BadRequest(f"bad date {date!r}: {e}") from e
+        try:
+            return self.pyramid.tile(name, date, z, x, y,
+                                     deadline=deadline)
+        except ValueError as e:
+            raise BadRequest(str(e)) from e
+        except LookupError as e:
+            raise NotFound(str(e)) from e
+
+    # -- ETags (edge offload) ----------------------------------------------
+
+    def product_etag(self, name: str, date: str, cx: int, cy: int) -> str:
+        """Strong ETag for one product raster: the (segment, product,
+        tile-model) generations the cache key embeds — cheap to derive
+        (no body computation) and bumped by exactly the writes that
+        change the answer, in-process (watched store) and cross-process
+        (changefeed) alike.  Replica-local: a peer restarted since may
+        mint a different tag for the same bytes, which costs one full
+        revalidation, never a stale hit."""
+        return (f'"p-{name}-{date}-{cx}-{cy}-'
+                f'g{self.gens.gen("segment", cx, cy)}.'
+                f'{self.gens.gen("product", cx, cy)}.'
+                f'{self.gens.table_gen("tile")}"')
+
+    def tile_etag(self, name: str, date: str, bounds) -> str:
+        """Strong ETag for a mosaic: a digest over every covering
+        chip's generation triple — any chip changing changes the tag."""
+        import hashlib
+
+        from firebird_tpu import products
+
+        h = hashlib.sha256(f"{name}@{date}".encode())
+        for cx, cy in products.covering_chips(bounds):
+            h.update(b"%d,%d:%d.%d;" % (
+                cx, cy, self.gens.gen("segment", cx, cy),
+                self.gens.gen("product", cx, cy)))
+        h.update(str(self.gens.table_gen("tile")).encode())
+        return f'"t-{h.hexdigest()[:24]}"'
+
+    @staticmethod
+    def pyramid_etag(meta: dict) -> str:
+        """Strong ETag for a pyramid tile: the persisted version
+        counter, which survives invalidation (stale-stamping never
+        resets it) — stable across replica restarts sharing one
+        pyramid dir."""
+        return (f'"py-{meta["name"]}-{meta["date"]}-{meta["z"]}-'
+                f'{meta["x"]}-{meta["y"]}-v{meta["version"]}"')
+
     # -- alert feed ---------------------------------------------------------
 
     def alert_feed(self):
@@ -416,7 +536,8 @@ class _ServeHandler(httpd.JsonHandler):
             "error": f"unknown path {path!r}",
             "paths": ["/healthz", "/metrics", "/v1/products",
                       "/v1/segments", "/v1/pixel", "/v1/product/<name>",
-                      "/v1/tile/<name>", "/v1/alerts",
+                      "/v1/tile/<name>",
+                      "/v1/pyramid/<name>/<z>/<x>/<y>", "/v1/alerts",
                       "/v1/alerts/stream", "/v1/alerts/webhooks"]})
 
     def _route_post(self, path: str, query: dict) -> None:
@@ -512,6 +633,37 @@ class _ServeHandler(httpd.JsonHandler):
                 "serve_errors_total",
                 help="/v1 requests answered with a non-200 status").inc()
 
+    # -- edge caching (ETag / If-None-Match / Cache-Control) ----------------
+
+    def _edge_headers(self, svc: ServeService, etag: str) -> dict:
+        h = {"ETag": etag}
+        ttl = int(getattr(svc.cfg, "serve_edge_ttl", 0))
+        if ttl > 0:
+            h["Cache-Control"] = f"public, max-age={ttl}"
+        return h
+
+    def _not_modified(self, svc: ServeService, etag: str) -> bool:
+        """304 the request when its If-None-Match covers ``etag`` —
+        BEFORE the body path runs: a revalidation costs a generation
+        lookup, not a raster.  True when the 304 went out."""
+        inm = self.headers.get("If-None-Match")
+        if not inm:
+            return False
+        # Exact-tag matches only.  `*` is deliberately NOT honored: it
+        # matches "any current representation", and this check runs
+        # BEFORE the body path decides whether one exists — a 304 here
+        # would validate a cached copy of a 404.
+        if etag not in (t.strip() for t in inm.split(",")):
+            return False
+        obs_metrics.counter(
+            "serve_304_total",
+            help="conditional requests answered 304 Not Modified (the "
+                 "edge-offload proof: revalidations that never touched "
+                 "the body path)").inc()
+        self._send(304, b"", "application/octet-stream",
+                   self._edge_headers(svc, etag))
+        return True
+
     def _dispatch(self, svc: ServeService, path: str, query: dict,
                   deadline) -> None:
         if path == "/v1/segments":
@@ -535,7 +687,11 @@ class _ServeHandler(httpd.JsonHandler):
             date = _one(query, "date", str)
             fmt = _one(query, "format", str, required=False) or "json"
             obs_metrics.counter("serve_requests_product").inc()
+            etag = svc.product_etag(name, date, cx, cy)
+            if self._not_modified(svc, etag):
+                return
             cells = svc.product_raster(name, date, cx, cy, deadline=deadline)
+            edge = self._edge_headers(svc, etag)
             if fmt == "npy":
                 from firebird_tpu.ingest.packer import CHIP_SIDE
                 self._send(200,
@@ -543,11 +699,11 @@ class _ServeHandler(httpd.JsonHandler):
                            "application/octet-stream",
                            {"X-Firebird-Product": name,
                             "X-Firebird-Date": date,
-                            "X-Firebird-Chip": f"{cx},{cy}"})
+                            "X-Firebird-Chip": f"{cx},{cy}", **edge})
             elif fmt == "json":
                 self._send_json(200, {"name": name, "date": date,
                                       "cx": cx, "cy": cy,
-                                      "cells": cells.tolist()})
+                                      "cells": cells.tolist()}, edge)
             else:
                 raise BadRequest(f"unknown format {fmt!r} (json|npy)")
         elif path.startswith("/v1/tile/"):
@@ -556,8 +712,12 @@ class _ServeHandler(httpd.JsonHandler):
             bounds = _bounds_param(query)
             fmt = _one(query, "format", str, required=False) or "npy"
             obs_metrics.counter("serve_requests_tile").inc()
+            etag = svc.tile_etag(name, date, bounds)
+            if self._not_modified(svc, etag):
+                return
             cells, ulx, uly = svc.tile_mosaic(name, date, bounds,
                                               deadline=deadline)
+            edge = self._edge_headers(svc, etag)
             from firebird_tpu.ccd.params import FILL_VALUE
             from firebird_tpu.ingest.packer import PIXEL_SIZE_M
             if fmt == "npy":
@@ -568,14 +728,17 @@ class _ServeHandler(httpd.JsonHandler):
                             "X-Firebird-Ulx": f"{ulx:.1f}",
                             "X-Firebird-Uly": f"{uly:.1f}",
                             "X-Firebird-Pixel-Size-M": PIXEL_SIZE_M,
-                            "X-Firebird-Fill": FILL_VALUE})
+                            "X-Firebird-Fill": FILL_VALUE, **edge})
             elif fmt == "json":
                 self._send_json(200, {
                     "name": name, "date": date, "ulx": ulx, "uly": uly,
                     "pixel_size_m": PIXEL_SIZE_M, "fill": FILL_VALUE,
-                    "shape": list(cells.shape), "cells": cells.tolist()})
+                    "shape": list(cells.shape), "cells": cells.tolist()},
+                    edge)
             else:
                 raise BadRequest(f"unknown format {fmt!r} (json|npy)")
+        elif path.startswith("/v1/pyramid/"):
+            self._pyramid(svc, path, query, deadline)
         elif path == "/v1/alerts":
             obs_metrics.counter(
                 "serve_requests_alerts",
@@ -591,6 +754,62 @@ class _ServeHandler(httpd.JsonHandler):
                 200, {"subscribers": svc.alert_feed().log.subscribers()})
         else:
             raise NotFound(f"unknown path {path!r}")
+
+    def _pyramid(self, svc: ServeService, path: str, query: dict,
+                 deadline) -> None:
+        """``/v1/pyramid/<name>/<z>/<x>/<y>?date=`` — the map-serving
+        endpoint: a fresh tile is a static-file read; a conditional hit
+        is a meta peek + 304."""
+        parts = path[len("/v1/pyramid/"):].split("/")
+        if len(parts) != 4:
+            raise BadRequest(
+                "pyramid path is /v1/pyramid/<name>/<z>/<x>/<y> "
+                "(?date=YYYY-MM-DD[&format=npy|json])")
+        name = parts[0]
+        try:
+            z, x, y = (int(v) for v in parts[1:])
+        except ValueError as e:
+            raise BadRequest(f"bad pyramid address {parts[1:]}: {e}") from e
+        date = _one(query, "date", str)
+        fmt = _one(query, "format", str, required=False) or "npy"
+        if fmt not in ("npy", "json"):
+            raise BadRequest(f"unknown format {fmt!r} (npy|json)")
+        obs_metrics.counter(
+            "serve_requests_pyramid",
+            help="/v1/pyramid tile requests (304s included)").inc()
+        # Conditional fast path: a FRESH persisted meta answers 304
+        # without loading cells; a stale/missing tile falls through to
+        # the (rebuilding) body path, whose new version can never match
+        # the client's old tag.
+        if svc.pyramid is not None:
+            meta = svc.pyramid.peek_meta(name, date, z, x, y)
+            if meta is not None and not meta.get("stale") and \
+                    self._not_modified(svc, svc.pyramid_etag(meta)):
+                return
+        cells, meta = svc.pyramid_tile(name, date, z, x, y,
+                                       deadline=deadline)
+        etag = svc.pyramid_etag(meta)
+        if self._not_modified(svc, etag):
+            return                        # rebuilt to the same version
+        edge = self._edge_headers(svc, etag)
+        ext = meta.get("extent") or {}
+        if fmt == "npy":
+            self._send(200, _npy_bytes(cells), "application/octet-stream",
+                       {"X-Firebird-Product": name,
+                        "X-Firebird-Date": date,
+                        "X-Firebird-Quadkey": meta.get("quadkey", ""),
+                        "X-Firebird-Ulx": f"{ext.get('ulx', 0):.1f}",
+                        "X-Firebird-Uly": f"{ext.get('uly', 0):.1f}",
+                        "X-Firebird-Tile-Version": meta["version"],
+                        **edge})
+        else:
+            self._send_json(200, {
+                "name": name, "date": date, "z": z, "x": x, "y": y,
+                "quadkey": meta.get("quadkey", ""),
+                "version": meta["version"], "extent": ext,
+                "empty": meta.get("empty"),
+                "shape": list(cells.shape),
+                "cells": cells.tolist()}, edge)
 
     # -- alert feed transport ------------------------------------------------
 
@@ -762,7 +981,9 @@ def start_serve_server(port: int, service: ServeService,
     srv = ServeServer((host, int(port)), service).start()
     log.info("serve endpoint up on %s:%d (/healthz /metrics /v1/products "
              "/v1/segments /v1/pixel /v1/product/<name> /v1/tile/<name>"
-             "%s)", host, srv.port,
+             "%s%s)", host, srv.port,
+             " /v1/pyramid/<name>/<z>/<x>/<y>"
+             if service.pyramid is not None else "",
              " /v1/alerts /v1/alerts/stream /v1/alerts/webhooks"
              if service.alerts is not None else "")
     return srv

@@ -14,7 +14,9 @@ perfectly reusable — *until the store underneath changes*.  So:
 - **Tier 2** (optional, ``FIREBIRD_SERVE_CACHE_DIR``): evicted entries
   spill to disk (``.npy`` for arrays, ``.json`` for frames) and promote
   back on a memory miss — a restart-warm cache for rasters that took a
-  products.save-path computation to build.
+  products.save-path computation to build.  The bound trims
+  LRU-by-access (promotions touch the file), so hot entries survive
+  cold churn.
 - **Invalidation** (:class:`StoreGenerations` + :func:`watch_store`): a
   per-``(table, cx, cy)`` generation counter bumped by every store write
   that touches the chip.  Cache keys embed the generation at build time,
@@ -173,15 +175,28 @@ class LRUCache:
             pass
 
     def _trim_spill_dir(self) -> None:
-        """Drop the oldest spill files past the bound (best-effort).
-        Only called when the in-memory count crosses the bound, so the
-        directory scan is amortized — not per spill."""
+        """Drop the least-recently-ACCESSED spill files past the bound
+        (best-effort).  Only called when the in-memory count crosses the
+        bound, so the directory scan is amortized — not per spill.
+
+        Trim order is LRU-by-access, not insert order: ``_disk_get``
+        touches a file's mtime on every hit, so a hot entry (a pyramid
+        tile the whole map fleet revalidates against) keeps floating to
+        the young end while cold generation churn ages out — without
+        the touch, steady cold-spill traffic would evict the hottest
+        file as surely as the coldest (it was merely written first)."""
         names = [n for n in os.listdir(self.spill_dir)
                  if n.endswith((".npy", ".json"))]
         excess = len(names) - self.spill_max_files
         if excess > 0:
             paths = [os.path.join(self.spill_dir, n) for n in names]
-            paths.sort(key=lambda p: os.path.getmtime(p))
+
+            def mtime(p):
+                try:
+                    return os.path.getmtime(p)
+                except OSError:
+                    return 0.0          # already gone: oldest, harmless
+            paths.sort(key=mtime)
             for p in paths[:excess]:
                 try:
                     os.remove(p)
@@ -197,13 +212,25 @@ class LRUCache:
         npy, js = paths
         try:
             if os.path.exists(npy):
-                return np.load(npy)
+                v = np.load(npy)
+                self._touch(npy)
+                return v
             if os.path.exists(js):
                 with open(js) as f:
-                    return json.load(f)
+                    v = json.load(f)
+                self._touch(js)
+                return v
         except (OSError, ValueError):
             return None
         return None
+
+    @staticmethod
+    def _touch(path: str) -> None:
+        """Record the access: trim is LRU-by-access over mtimes."""
+        try:
+            os.utime(path, None)
+        except OSError:
+            pass
 
 
 # ---------------------------------------------------------------------------
@@ -225,24 +252,42 @@ class StoreGenerations:
     every chip's ``cover`` answer.
     """
 
-    def __init__(self):
+    def __init__(self, on_bump=None):
         self._lock = threading.Lock()
         self._gens: dict[tuple, int] = {}  # guarded-by: _lock
         self._table_gens: dict[str, int] = {}  # guarded-by: _lock
+        # Optional (table, cx, cy) hook fired AFTER a chip bump, outside
+        # the lock (it may do file I/O — the serving layer wires it to
+        # pyramid.invalidate_chip so in-process writes dirty the tile
+        # pyramid exactly like changefeed-applied ones).
+        self.on_bump = on_bump
+        # Folded into EVERY generation.  The changefeed consumer sets it
+        # to its resumed durable cursor sum at construction: in-memory
+        # counters reset to 0 on restart, but a PERSISTENT disk-spill
+        # cache keeps files keyed by the previous incarnation's
+        # generations — without the epoch, a resumed replica (which
+        # skips the replay) would recompute the pre-restart keys and
+        # serve pre-mutation spill entries forever.  Any feed movement
+        # across a restart therefore re-keys everything (coarse, but
+        # strictly over-invalidating); an unmoved feed keeps the warm
+        # spill cache valid.
+        self.epoch = 0
 
     def gen(self, table: str, cx, cy) -> int:
         with self._lock:
             return (self._gens.get((table, int(cx), int(cy)), 0)
-                    + self._table_gens.get(table, 0))
+                    + self._table_gens.get(table, 0) + self.epoch)
 
     def table_gen(self, table: str) -> int:
         with self._lock:
-            return self._table_gens.get(table, 0)
+            return self._table_gens.get(table, 0) + self.epoch
 
     def bump(self, table: str, cx, cy) -> None:
         with self._lock:
             k = (table, int(cx), int(cy))
             self._gens[k] = self._gens.get(k, 0) + 1
+        if self.on_bump is not None:
+            self.on_bump(table, int(cx), int(cy))
 
     def bump_table(self, table: str) -> None:
         with self._lock:

@@ -150,29 +150,58 @@ class SqliteStore:
     One database file per keyspace (the reference namespaces by Cassandra
     keyspace derived from inputs+version, ccdc/__init__.py:29-44; here the
     keyspace is part of the filename).
+
+    ``read_only=True`` opens a **replica connection**: a ``mode=ro`` URI
+    open plus ``PRAGMA query_only=ON``, so the handle can never take the
+    write lock — N serve replicas tailing one WAL database read
+    concurrently with the writer's AsyncWriter and never contend on its
+    lock (WAL readers see the last committed transaction; they block
+    nothing and nothing blocks them).  Schema DDL is skipped (the writer
+    owns it) and ``write`` refuses loudly before sqlite would.
     """
 
-    def __init__(self, path: str, keyspace: str = "default"):
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    def __init__(self, path: str, keyspace: str = "default",
+                 read_only: bool = False):
+        self.read_only = bool(read_only)
+        if not self.read_only:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         root, ext = os.path.splitext(path)
         self.path = f"{root}.{keyspace}{ext or '.db'}"
         self.keyspace = keyspace
+        if self.read_only and not os.path.exists(self.path):
+            raise FileNotFoundError(
+                f"read-only replica open of {self.path}: the database "
+                "does not exist (the writer creates it; replicas only "
+                "ever attach)")
         self._local = threading.local()
         self._all_conns: list[sqlite3.Connection] = []
         self._conns_lock = threading.Lock()
-        self._create()
+        if not self.read_only:
+            self._create()
 
     def _conn(self) -> sqlite3.Connection:
         if not hasattr(self._local, "conn"):
             # check_same_thread=False so close() can shut every thread's
             # connection down; each thread still only *uses* its own.
-            conn = sqlite3.connect(self.path, timeout=60,
-                                   check_same_thread=False)
-            _retry_locked(lambda: conn.execute("PRAGMA journal_mode=WAL"))
-            # WAL + NORMAL is durable to application crash (not OS crash);
-            # the durability model is rerun-idempotence (keyed upserts),
-            # so trading fsync-per-commit for write throughput is right.
-            conn.execute("PRAGMA synchronous=NORMAL")
+            if self.read_only:
+                # mode=ro refuses the write lock at the VFS layer;
+                # query_only refuses at the SQL layer — defense in
+                # depth, and neither converts journal modes (a replica
+                # must never run the WAL-conversion DDL the writer owns).
+                conn = sqlite3.connect(
+                    f"file:{self.path}?mode=ro", uri=True, timeout=60,
+                    check_same_thread=False)
+                conn.execute("PRAGMA query_only=ON")
+            else:
+                conn = sqlite3.connect(self.path, timeout=60,
+                                       check_same_thread=False)
+                _retry_locked(
+                    lambda: conn.execute("PRAGMA journal_mode=WAL"))
+                # WAL + NORMAL is durable to application crash (not OS
+                # crash); the durability model is rerun-idempotence
+                # (keyed upserts), so trading fsync-per-commit for write
+                # throughput is right.
+                conn.execute("PRAGMA synchronous=NORMAL")
             self._local.conn = conn
             with self._conns_lock:
                 self._all_conns.append(conn)
@@ -203,6 +232,11 @@ class SqliteStore:
         con.commit()
 
     def write(self, table: str, frame: dict) -> int:
+        if self.read_only:
+            raise RuntimeError(
+                f"write to {table!r} on a read-only replica connection "
+                f"({self.path}): writes belong to the writer process "
+                "(open_store(..., read_only=False))")
         types = _col_types(table)
         cols = list(types)
         n = len(next(iter(frame.values())))
@@ -505,8 +539,15 @@ class CassandraStore:
             self._cluster.shutdown()
 
 
-def open_store(backend: str, path: str, keyspace: str):
+def open_store(backend: str, path: str, keyspace: str,
+               read_only: bool = False):
     """Factory used by the driver (cfg.store_backend).
+
+    ``read_only=True`` opens a replica connection where the backend
+    supports one (sqlite: ``mode=ro`` + ``PRAGMA query_only`` — the N
+    serve replicas never touch the writer's lock); backends without a
+    lock to contend on (memory, parquet, cassandra) reject it loudly
+    rather than silently serving a writable handle as "read-only".
 
     For the 'cassandra' backend, connection settings come from the
     reference's env contract (ccdc/__init__.py:17-22): CASSANDRA
@@ -514,6 +555,12 @@ def open_store(backend: str, path: str, keyspace: str):
     CASSANDRA_PASS, CASSANDRA_OUTPUT_CONCURRENT_WRITES — credentials stay
     in the environment, not in Config.
     """
+    if read_only and backend != "sqlite":
+        raise ValueError(
+            f"read_only is a sqlite replica mode; backend {backend!r} "
+            "has no writer lock for replicas to avoid")
+    if backend == "sqlite":
+        return SqliteStore(path, keyspace, read_only=read_only)
     if backend == "cassandra":
         hosts = os.environ.get("CASSANDRA", "127.0.0.1").split(",")
         return CassandraStore(
@@ -526,8 +573,6 @@ def open_store(backend: str, path: str, keyspace: str):
                 os.environ.get("CASSANDRA_OUTPUT_CONCURRENT_WRITES", "2")))
     if backend == "memory":
         return MemoryStore(keyspace)
-    if backend == "sqlite":
-        return SqliteStore(path, keyspace)
     if backend == "parquet":
         return ParquetStore(path, keyspace)
     raise ValueError(f"unknown store backend: {backend!r}")

@@ -115,3 +115,67 @@ def test_mosaic_rejects_cell_count_sensor_mismatch():
     with pytest.raises(ValueError, match="sentinel2"):
         export.mosaic("seglength", "2014-01-01", bounds, store,
                       sensor=SENTINEL2)
+
+
+# ---------------------------------------------------------------------------
+# Bounds edge cases feeding the pyramid (docs/SERVING.md)
+# ---------------------------------------------------------------------------
+
+def test_mosaic_single_chip_bounds():
+    """One interior point -> exactly the containing chip, ulx/uly at
+    the chip's (grid-aligned) UL corner even for a non-aligned point."""
+    store = MemoryStore()
+    put_product(store, "curveqa", "2014-01-01", CX, CY, 5)
+    cells, ulx, uly = export.mosaic(
+        "curveqa", "2014-01-01", [(CX + 1234.5, CY - 987.6)], store)
+    assert cells.shape == (CHIP_SIDE, CHIP_SIDE)
+    assert (ulx, uly) == (CX, CY)
+    assert np.all(cells == 5)
+
+
+def test_mosaic_non_aligned_bounds_snap_outward():
+    """Non-chip-aligned bounds SNAP to the covering chips (they never
+    shift the raster off-grid): a 1 m sliver across a chip edge covers
+    both chips, and the mosaic's UL is the UL chip's corner."""
+    store = MemoryStore()
+    put_product(store, "curveqa", "2014-01-01", CX, CY, 1)
+    put_product(store, "curveqa", "2014-01-01", CX + CHIP_M, CY, 2)
+    bounds = [(CX + CHIP_M - 0.5, CY - 10.0),
+              (CX + CHIP_M + 0.5, CY - 20.0)]
+    cells, ulx, uly = export.mosaic("curveqa", "2014-01-01", bounds, store)
+    assert (ulx, uly) == (CX, CY)
+    assert cells.shape == (CHIP_SIDE, 2 * CHIP_SIDE)
+    assert np.all(cells[:, :CHIP_SIDE] == 1)
+    assert np.all(cells[:, CHIP_SIDE:] == 2)
+
+
+def test_mosaic_stored_row_size_mismatch_message():
+    """A stored row whose cell count disagrees with the sensor chip
+    geometry must reject with the pass-the-campaign's-sensor message,
+    not mis-georeference (the pyramid's base renderer leans on this)."""
+    import pytest
+
+    store = MemoryStore()
+    cells = np.empty(1, object)
+    cells[0] = [7] * 64          # not 100x100
+    store.write("product", {"name": ["curveqa"], "date": ["2014-01-01"],
+                            "cx": [CX], "cy": [CY], "cells": cells})
+    with pytest.raises(ValueError,
+                       match="pass the campaign's sensor"):
+        export.mosaic("curveqa", "2014-01-01", [(CX + 1, CY - 1)], store)
+
+
+def test_pyramid_bounds_reject_off_domain(tmp_path):
+    """Bounds feeding the pyramid must reject chips outside the quadkey
+    domain with the domain message (a map tile cannot address them) —
+    the mosaic itself happily snaps any bounds, so the rejection
+    belongs to (and happens at) the pyramid layer."""
+    import pytest
+
+    from firebird_tpu.serve import pyramid as pyr
+
+    store = MemoryStore()
+    p = pyr.TilePyramid(str(tmp_path), pyr.store_read_chip(store))
+    with pytest.raises(ValueError, match="quadkey domain"):
+        p.build_area(["curveqa"], ["2014-01-01"],
+                     [(-9_000_000.0, CY)], levels=1)

@@ -1,0 +1,452 @@
+"""Quadkey tile pyramid + changefeed coherence + edge caching
+(firebird_tpu.serve.pyramid / serve.changefeed; docs/SERVING.md)."""
+
+import json
+import os
+import random
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from firebird_tpu import grid, products
+from firebird_tpu.ccd.params import FILL_VALUE
+from firebird_tpu.config import Config
+from firebird_tpu.obs import metrics as obs_metrics
+from firebird_tpu.serve import api as serve_api
+from firebird_tpu.serve import pyramid as pyr
+from firebird_tpu.serve.cache import StoreGenerations
+from firebird_tpu.serve.changefeed import (ChangefeedConsumer,
+                                           ProductWrites)
+from firebird_tpu.store import open_store
+from firebird_tpu.utils import dates as dt
+
+CX, CY = (int(v) for v in grid.snap(100, 200)["chip"]["proj-pt"])
+DATE = "1996-01-01"
+CHIP_M = 3000
+
+
+@pytest.fixture
+def fresh_metrics():
+    obs_metrics.reset_registry()
+    yield
+    obs_metrics.reset_registry()
+
+
+def seg_frame(cx=CX, cy=CY, curqa=(4, 8, 4), n=3):
+    return {
+        "cx": [cx] * n, "cy": [cy] * n,
+        "px": [cx + 30 * i for i in range(n)],
+        "py": [cy - 30] * n,
+        "sday": ["1995-01-01"] * n, "eday": ["1999-01-01"] * n,
+        "bday": ["1997-06-01"] * n, "chprob": [1.0] * n,
+        "curqa": list(curqa)[:n],
+        "rfrawp": [None] * n,
+    }
+
+
+def seeded_store(chips=((CX, CY),)):
+    store = open_store("memory", "", "t")
+    for cx, cy in chips:
+        store.write("segment", seg_frame(cx, cy))
+    return store
+
+
+# ---------------------------------------------------------------------------
+# Quadkey / Albers math
+# ---------------------------------------------------------------------------
+
+def test_quadkey_round_trip_every_zoom():
+    rng = random.Random(7)
+    for z in range(pyr.Z_BASE + 1):
+        for _ in range(8):
+            x = rng.randrange(1 << z)
+            y = rng.randrange(1 << z)
+            qk = pyr.quadkey(z, x, y)
+            assert len(qk) == z
+            assert pyr.tile_from_quadkey(qk) == (z, x, y)
+    assert pyr.tile_from_quadkey("") == (0, 0, 0)
+    with pytest.raises(ValueError):
+        pyr.tile_from_quadkey("4")
+    with pytest.raises(ValueError):
+        pyr.quadkey(2, 4, 0)               # x outside the level domain
+
+
+def test_albers_round_trip_every_zoom():
+    """quadkey<->Albers: a tile's UL projection corner must map back to
+    the same tile at every zoom level (the satellite property test)."""
+    rng = random.Random(13)
+    for z in range(pyr.Z_BASE + 1):
+        for _ in range(8):
+            x = rng.randrange(1 << z)
+            y = rng.randrange(1 << z)
+            ext = pyr.tile_extent(z, x, y)
+            # UL corner and an interior point both land in the tile.
+            assert pyr.tile_for_point(ext["ulx"], ext["uly"], z) == (x, y)
+            assert pyr.tile_for_point(
+                ext["ulx"] + 1.0, ext["uly"] - 1.0, z) == (x, y)
+            # extent is chip-grid aligned and the right size
+            span = 1 << (pyr.Z_BASE - z)
+            assert ext["lrx"] - ext["ulx"] == span * CHIP_M
+            assert ext["uly"] - ext["lry"] == span * CHIP_M
+
+
+def test_tile_chip_mapping_and_tree():
+    bx, by = pyr.tile_of_chip(CX, CY)
+    assert pyr.chips_of_tile(pyr.Z_BASE, bx, by) == [(CX, CY)]
+    z, x, y = pyr.parent(pyr.Z_BASE, bx, by)
+    assert (bx >> 1, by >> 1) == (x, y)
+    kids = pyr.children(z, x, y)
+    assert (pyr.Z_BASE, bx, by) in kids and len(kids) == 4
+    anc = pyr.ancestors(pyr.Z_BASE, bx, by)
+    assert len(anc) == pyr.Z_BASE + 1 and anc[-1][0] == 0
+    # every chip of the parent tile maps back to it
+    for cx, cy in pyr.chips_of_tile(z, x, y):
+        assert pyr.tile_of_chip(cx, cy, z) == (x, y)
+    # off-domain chips reject with the quadkey-domain message
+    with pytest.raises(ValueError, match="quadkey domain"):
+        pyr.tile_of_chip(-3_000_000.0, CY)
+
+
+def test_downsample2x_is_selection():
+    cells = np.arange(16, dtype=np.int32).reshape(4, 4)
+    got = pyr.downsample2x(cells)
+    assert got.tolist() == [[0, 2], [8, 10]]
+
+
+# ---------------------------------------------------------------------------
+# TilePyramid: build, versioning, invalidation
+# ---------------------------------------------------------------------------
+
+def test_base_tile_byte_identical_to_products(tmp_path, fresh_metrics):
+    store = seeded_store()
+    p = pyr.TilePyramid(str(tmp_path), pyr.store_read_chip(store))
+    bx, by = pyr.tile_of_chip(CX, CY)
+    cells, meta = p.tile("curveqa", DATE, pyr.Z_BASE, bx, by)
+    want = products.chip_product("curveqa", dt.to_ordinal(DATE), CX, CY,
+                                 store.read("segment",
+                                            {"cx": CX, "cy": CY}))
+    assert np.array_equal(cells.ravel(), want)
+    assert cells.dtype == np.int32
+    assert meta["version"] == 1 and not meta["stale"]
+    assert meta["quadkey"] == pyr.quadkey(pyr.Z_BASE, bx, by)
+    # compute-on-miss persisted the product row (store_read_chip shares
+    # the products.save path)
+    rows = store.read("product", {"name": "curveqa", "date": DATE,
+                                  "cx": CX, "cy": CY})
+    assert rows["cells"]
+    # the persisted file serves the repeat without a rebuild
+    built = obs_metrics.counter("pyramid_tiles_built").value
+    cells2, meta2 = p.tile("curveqa", DATE, pyr.Z_BASE, bx, by)
+    assert meta2["version"] == 1
+    assert obs_metrics.counter("pyramid_tiles_built").value == built
+    assert obs_metrics.counter("pyramid_tile_hits").value >= 1
+
+
+def test_parent_downsamples_children(tmp_path, fresh_metrics):
+    store = seeded_store()
+    p = pyr.TilePyramid(str(tmp_path), pyr.store_read_chip(store))
+    bx, by = pyr.tile_of_chip(CX, CY)
+    base, _ = p.tile("curveqa", DATE, pyr.Z_BASE, bx, by)
+    z, x, y = pyr.parent(pyr.Z_BASE, bx, by)
+    cells, meta = p.tile("curveqa", DATE, z, x, y)
+    assert cells.shape == (pyr.TILE_SIDE, pyr.TILE_SIDE)
+    half = pyr.TILE_SIDE // 2
+    dx, dy = bx - 2 * x, by - 2 * y
+    quadrant = cells[dy * half:(dy + 1) * half, dx * half:(dx + 1) * half]
+    assert np.array_equal(quadrant, pyr.downsample2x(base))
+    # sibling quadrants cover chips with no data: FILL, and the empty
+    # base tiles persisted as negative cache
+    other = cells[(1 - dy) * half:(2 - dy) * half,
+                  dx * half:(dx + 1) * half]
+    assert (other == FILL_VALUE).all()
+
+
+def test_invalidation_is_surgical_and_versions_rise(tmp_path,
+                                                    fresh_metrics):
+    chips = [(CX, CY), (CX + CHIP_M, CY)]
+    store = seeded_store(chips)
+    p = pyr.TilePyramid(str(tmp_path), pyr.store_read_chip(store))
+    t0 = pyr.tile_of_chip(*chips[0])
+    t1 = pyr.tile_of_chip(*chips[1])
+    assert t0 != t1
+    for bx, by in (t0, t1):
+        p.tile("curveqa", DATE, pyr.Z_BASE, bx, by)
+    n = p.invalidate_chip(*chips[0])
+    assert n >= 1
+    assert p.peek_meta("curveqa", DATE, pyr.Z_BASE, *t0)["stale"]
+    assert not p.peek_meta("curveqa", DATE, pyr.Z_BASE, *t1)["stale"]
+    # rebuild bumps the version (ETags can never collide with the
+    # stale tile's), and a second invalidation of an already-stale
+    # tile is a no-op
+    _, meta = p.tile("curveqa", DATE, pyr.Z_BASE, *t0)
+    assert meta["version"] == 2 and not meta["stale"]
+    assert obs_metrics.counter("pyramid_tiles_dirtied").value == n
+    # off-domain chips dirty nothing (and do not raise)
+    assert p.invalidate_chip(-3_000_000.0, CY) == 0
+
+
+def test_compute_on_miss_depth_floor(tmp_path):
+    store = seeded_store()
+    p = pyr.TilePyramid(str(tmp_path), pyr.store_read_chip(store))
+    with pytest.raises(LookupError, match="not\\s+precomputed"):
+        p.tile("curveqa", DATE, 0, 0, 0)
+    # within the floor, misses build
+    bx, by = pyr.tile_of_chip(CX, CY, pyr.Z_BASE - pyr.MAX_MISS_DEPTH)
+    cells, _ = p.tile("curveqa", DATE,
+                      pyr.Z_BASE - pyr.MAX_MISS_DEPTH, bx, by)
+    assert (cells != FILL_VALUE).any()
+
+
+def test_build_area_two_levels(tmp_path):
+    chips = [(CX + CHIP_M * i, CY - CHIP_M * j)
+             for i in range(2) for j in range(2)]
+    store = seeded_store(chips)
+    p = pyr.TilePyramid(str(tmp_path), pyr.store_read_chip(store))
+    bounds = [(CX + 1.0, CY - 1.0),
+              (CX + 2 * CHIP_M - 1.0, CY - 2 * CHIP_M + 1.0)]
+    summary = p.build_area(["curveqa"], [DATE], bounds, levels=2)
+    assert summary["chips"] == 4
+    assert summary["levels"][str(pyr.Z_BASE)]["built"] == 4
+    assert summary["levels"][str(pyr.Z_BASE - 1)]["built"] >= 1
+    # second build skips everything (fresh)
+    again = p.build_area(["curveqa"], [DATE], bounds, levels=2)
+    assert again["levels"][str(pyr.Z_BASE)]["built"] == 0
+    st = p.status()
+    assert st["tiles_by_level"][str(pyr.Z_BASE)]["tiles"] >= 4
+    # bounds off the quadkey domain reject with the domain message
+    with pytest.raises(ValueError, match="quadkey domain"):
+        p.build_area(["curveqa"], [DATE],
+                     [(-3_000_000.0, CY)], levels=1)
+
+
+# ---------------------------------------------------------------------------
+# Changefeed: product_writes feed, consumer, replica registry
+# ---------------------------------------------------------------------------
+
+def test_product_writes_feed_cursors(tmp_path):
+    feed = ProductWrites(str(tmp_path / "cf.db"))
+    try:
+        assert feed.latest_cursor() == 0
+        assert feed.append("product", [(CX, CY), (CX + CHIP_M, CY)]) == 2
+        recs = feed.since(0)
+        assert [r["id"] for r in recs] == [1, 2]
+        assert recs[0]["table"] == "product"
+        assert feed.since(2) == []
+        # checkpoint is monotonic forward: stale state cannot rewind
+        feed.checkpoint("r1", alert_cursor=5, writes_cursor=2)
+        feed.checkpoint("r1", alert_cursor=3, writes_cursor=1)
+        assert feed.replica_cursors("r1") == (5, 2)
+        assert feed.replica_cursors("unknown") == (0, 0)
+        reps = feed.replicas()
+        assert len(reps) == 1 and reps[0]["writes_behind"] == 0
+    finally:
+        feed.close()
+
+
+def test_consumer_applies_and_resumes(tmp_path, fresh_metrics):
+    feed = ProductWrites(str(tmp_path / "cf.db"))
+    gens = StoreGenerations()
+    store = seeded_store()
+    p = pyr.TilePyramid(str(tmp_path / "pyr"),
+                        pyr.store_read_chip(store))
+    bx, by = pyr.tile_of_chip(CX, CY)
+    p.tile("curveqa", DATE, pyr.Z_BASE, bx, by)
+    try:
+        cons = ChangefeedConsumer(gens, feed=feed, pyramid=p,
+                                  replica="r1", poll_sec=60)
+        feed.append("product", [(CX, CY)])
+        out = cons.poll_once()
+        assert out["applied"] == 1 and out["writes_cursor"] == 1
+        assert gens.gen("product", CX, CY) == 1
+        assert p.peek_meta("curveqa", DATE, pyr.Z_BASE, bx, by)["stale"]
+        assert obs_metrics.counter(
+            "changefeed_records_applied").value == 1
+        # lag gauge exists (0 <= lag, caught-up polls read 0)
+        assert obs_metrics.gauge(
+            "serve_changefeed_lag_seconds").value >= 0
+        # a NEW consumer with the same replica id resumes from the
+        # durable cursor: nothing re-applies
+        cons2 = ChangefeedConsumer(gens, feed=feed, pyramid=p,
+                                   replica="r1", poll_sec=60)
+        assert cons2.poll_once()["applied"] == 0
+        # an UNSEEN replica id replays the whole feed (the safe
+        # default for an unknown cache dir)
+        cons3 = ChangefeedConsumer(StoreGenerations(), feed=feed,
+                                   replica="r2", poll_sec=60)
+        assert cons3.poll_once()["applied"] == 1
+        assert len(feed.replicas()) == 2
+    finally:
+        feed.close()
+
+
+def test_consumer_tails_alert_log(tmp_path, fresh_metrics):
+    from firebird_tpu.alerts.log import AlertLog
+
+    alog = AlertLog(str(tmp_path / "alerts.db"))
+    gens = StoreGenerations()
+    try:
+        alog.append([{"cx": CX, "cy": CY, "px": CX, "py": CY - 30,
+                      "break_day": 728000}])
+        cons = ChangefeedConsumer(gens, alerts=alog, replica="r1",
+                                  poll_sec=60)
+        out = cons.poll_once()
+        assert out["applied"] == 1 and out["alert_cursor"] == 1
+        # an alert is a segment-rows republish: the segment generation
+        # (which every cached frame/raster key embeds) bumps
+        assert gens.gen("segment", CX, CY) == 1
+        assert cons.poll_once()["applied"] == 0
+    finally:
+        alog.close()
+
+
+def test_gens_on_bump_hook_fires_outside_lock():
+    seen = []
+    gens = StoreGenerations(on_bump=lambda t, cx, cy:
+                            seen.append((t, cx, cy)))
+    gens.bump("segment", CX, CY)
+    gens.bump_frame("product", {"cx": [CX], "cy": [CY]})
+    assert seen == [("segment", CX, CY), ("product", CX, CY)]
+
+
+# ---------------------------------------------------------------------------
+# HTTP: /v1/pyramid + ETag/304 edge contract
+# ---------------------------------------------------------------------------
+
+def _get(base, path, headers=None):
+    req = urllib.request.Request(base + path, headers=headers or {})
+    try:
+        r = urllib.request.urlopen(req, timeout=10)
+        return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+@pytest.fixture
+def served_pyramid(tmp_path, fresh_metrics):
+    store = seeded_store()
+    p = pyr.TilePyramid(str(tmp_path / "pyr"))
+    svc = serve_api.ServeService(store, Config(store_backend="memory"),
+                                 pyramid=p)
+    srv = serve_api.start_serve_server(0, svc, host="127.0.0.1")
+    yield svc, store, f"http://127.0.0.1:{srv.port}"
+    srv.close()
+
+
+def test_http_pyramid_tile_and_304(served_pyramid):
+    svc, store, base = served_pyramid
+    bx, by = pyr.tile_of_chip(CX, CY)
+    path = f"/v1/pyramid/curveqa/{pyr.Z_BASE}/{bx}/{by}?date={DATE}"
+    code, body, h = _get(base, path)
+    assert code == 200
+    import io
+    arr = np.load(io.BytesIO(body))
+    want = products.chip_product("curveqa", dt.to_ordinal(DATE), CX, CY,
+                                 store.read("segment",
+                                            {"cx": CX, "cy": CY}))
+    assert np.array_equal(arr.ravel(), want)
+    assert h["X-Firebird-Quadkey"] == pyr.quadkey(pyr.Z_BASE, bx, by)
+    etag = h["ETag"]
+    assert etag.startswith('"') and "max-age=" in h["Cache-Control"]
+    # revalidation: 304, empty body, counted
+    code, body, h2 = _get(base, path, {"If-None-Match": etag})
+    assert (code, body) == (304, b"")
+    assert h2["ETag"] == etag
+    assert obs_metrics.counter("serve_304_total").value == 1
+    # json format carries the addressing + extent
+    code, body, _ = _get(base, path + "&format=json")
+    doc = json.loads(body)
+    assert (doc["z"], doc["x"], doc["y"]) == (pyr.Z_BASE, bx, by)
+    assert doc["version"] == 1 and doc["extent"]["chip_span"] == 1
+
+
+def test_http_pyramid_errors(served_pyramid):
+    svc, _, base = served_pyramid
+    code, body, _ = _get(base, f"/v1/pyramid/curveqa/3/1?date={DATE}")
+    assert code == 400 and b"/v1/pyramid/<name>/<z>/<x>/<y>" in body
+    code, body, _ = _get(base, f"/v1/pyramid/nope/3/1/1?date={DATE}")
+    assert code == 400
+    code, body, _ = _get(base,
+                         f"/v1/pyramid/curveqa/3/999/0?date={DATE}")
+    assert code == 400 and b"domain" in body
+    code, body, _ = _get(base, f"/v1/pyramid/curveqa/0/0/0?date={DATE}")
+    assert code == 404 and b"precomputed" in body
+    # no pyramid mounted -> 404 with guidance
+    svc.pyramid = None
+    code, body, _ = _get(base,
+                         f"/v1/pyramid/curveqa/11/1/1?date={DATE}")
+    assert code == 404 and b"pyramid root" in body
+
+
+def test_http_product_etag_flips_on_write(served_pyramid):
+    """The edge contract on /v1/product: ETag + 304, and a write
+    through the watched store flips the revalidation to a fresh 200
+    with a new tag (in-process coherence; the changefeed provides the
+    same flip cross-process)."""
+    svc, store, base = served_pyramid
+    path = f"/v1/product/curveqa?cx={CX}&cy={CY}&date={DATE}"
+    code, _, h = _get(base, path)
+    assert code == 200
+    etag = h["ETag"]
+    code, body, _ = _get(base, path, {"If-None-Match": etag})
+    assert (code, body) == (304, b"")
+    svc.watched_store().write("segment", seg_frame(curqa=(9, 9, 9)))
+    code, _, h2 = _get(base, path, {"If-None-Match": etag})
+    assert code == 200 and h2["ETag"] != etag
+    # the in-process bump also dirtied the pyramid (gens.on_bump hook)
+    bx, by = pyr.tile_of_chip(CX, CY)
+    svc.pyramid.tile("curveqa", DATE, pyr.Z_BASE, bx, by)
+    svc.watched_store().write("segment", seg_frame(curqa=(5, 5, 5)))
+    assert svc.pyramid.peek_meta("curveqa", DATE, pyr.Z_BASE,
+                                 bx, by)["stale"]
+
+
+def test_http_tile_etag_covers_all_chips(served_pyramid):
+    svc, store, base = served_pyramid
+    path = (f"/v1/tile/curveqa?bounds={CX + 1},{CY - 1}"
+            f"&bounds={CX + CHIP_M + 1},{CY - 1}&date={DATE}")
+    code, _, h = _get(base, path)
+    assert code == 200
+    etag = h["ETag"]
+    code, body, _ = _get(base, path, {"If-None-Match": etag})
+    assert (code, body) == (304, b"")
+    # writing the SECOND chip (not the first) still flips the mosaic
+    svc.watched_store().write("segment", seg_frame(cx=CX + CHIP_M))
+    code, _, h2 = _get(base, path, {"If-None-Match": etag})
+    assert code == 200 and h2["ETag"] != etag
+
+
+# ---------------------------------------------------------------------------
+# Fleet pyramid job
+# ---------------------------------------------------------------------------
+
+def test_fleet_pyramid_job_builds_area(tmp_path, fresh_metrics):
+    """A `pyramid` job on the fleet queue materializes the payload's
+    area through the real worker handler (fenced store; idempotent
+    atomic tile writes)."""
+    from firebird_tpu.fleet.queue import FleetQueue
+    from firebird_tpu.fleet.worker import FleetWorker
+
+    cfg = Config(store_backend="sqlite",
+                 store_path=str(tmp_path / "fb.db"),
+                 serve_pyramid_dir=str(tmp_path / "pyr"))
+    store = open_store("sqlite", cfg.store_path, cfg.keyspace())
+    store.write("segment", seg_frame())
+    store.close()
+    q = FleetQueue(str(tmp_path / "fleet.db"))
+    try:
+        q.enqueue("pyramid", {
+            "bounds": [[CX + 1.0, CY - 1.0]],
+            "products": ["curveqa"], "product_dates": [DATE],
+            "levels": 2})
+        summary = FleetWorker(cfg, q).run()
+        assert summary["acked"] == 1 and summary["dead"] == 0
+    finally:
+        q.close()
+    p = pyr.TilePyramid(str(tmp_path / "pyr"))
+    bx, by = pyr.tile_of_chip(CX, CY)
+    meta = p.peek_meta("curveqa", DATE, pyr.Z_BASE, bx, by)
+    assert meta is not None and meta["version"] == 1
+    assert p.peek_meta("curveqa", DATE,
+                       *pyr.parent(pyr.Z_BASE, bx, by)) is not None

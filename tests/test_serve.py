@@ -277,6 +277,35 @@ def test_spill_dir_is_bounded(tmp_path, fresh_metrics):
     assert len(files) <= 3
 
 
+def test_spill_trim_is_lru_by_access(tmp_path, fresh_metrics):
+    """The trim must evict by ACCESS recency, not insert order: a hot
+    spilled entry (a pyramid tile the whole fleet revalidates against)
+    was *written* first, so insert-order trim would drop it first —
+    but every disk hit touches its mtime, so cold churn ages out
+    instead."""
+    import time
+
+    c = serve_cache.LRUCache(max_entries=1, spill_dir=str(tmp_path),
+                             spill_max_files=2)
+    hot = ("hot-tile",)
+    c.put(hot, np.arange(8, dtype=np.int32))
+    c.put(("cold", 0), np.zeros(1, np.int32))   # hot -> disk (oldest write)
+    time.sleep(0.05)
+    c.put(("cold", 1), np.zeros(1, np.int32))   # cold0 -> disk
+    time.sleep(0.05)
+    # Disk hit on hot: the promotion TOUCHES its file (newest access)
+    # and re-inserting it evicts cold1 -> disk, crossing the bound ->
+    # trim fires.  LRU-by-access drops cold0; insert-order would have
+    # dropped hot (its write is the oldest on disk).
+    got = c.get(hot)
+    assert isinstance(got, np.ndarray) and got[3] == 3
+    c.clear()
+    assert isinstance(c.get(hot), np.ndarray), \
+        "hot spill file was evicted by cold churn (insert-order trim)"
+    files = [n for n in os.listdir(tmp_path) if n.endswith(".npy")]
+    assert len(files) <= 2
+
+
 # ---------------------------------------------------------------------------
 # Service: queries, compute-on-miss, degraded mode
 # ---------------------------------------------------------------------------

@@ -3,6 +3,7 @@ upserts — the reference's pattern minus the live Cassandra container
 (test/test_cassandra.py, test_chip/pixel/segment/tile.py)."""
 
 import re
+import sqlite3
 
 import numpy as np
 import pytest
@@ -424,4 +425,96 @@ def test_sqlite_chip_reads_use_secondary_index(tmp_path):
                 "WHERE cx = ? AND cy = ?", (1, 2)))
         assert "idx_product_chip" in plan
     finally:
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# Read-only replica connections (serve fleet; docs/SERVING.md)
+# ---------------------------------------------------------------------------
+
+def test_sqlite_read_only_replica_cannot_write(tmp_path):
+    """A mode=ro replica open can read everything and write NOTHING —
+    neither through the refusing facade nor past it at the SQL layer
+    (PRAGMA query_only)."""
+    import pytest
+
+    path = str(tmp_path / "repl.db")
+    writer = SqliteStore(path, "t")
+    writer.write("segment", {
+        "cx": [1], "cy": [2], "px": [1], "py": [2],
+        "sday": ["1995-01-01"], "eday": ["1999-01-01"],
+        "bday": ["0001-01-01"], "chprob": [0.0], "curqa": [4],
+    })
+    replica = open_store("sqlite", path, "t", read_only=True)
+    try:
+        assert replica.read("segment", {"cx": 1, "cy": 2})["px"] == [1]
+        assert replica.count("segment") == 1
+        with pytest.raises(RuntimeError, match="read-only replica"):
+            replica.write("segment", {"cx": [9], "cy": [9], "px": [9],
+                                      "py": [9]})
+        # defense in depth: even a raw statement on the connection is
+        # refused by PRAGMA query_only / the ro VFS open
+        with pytest.raises(sqlite3.OperationalError):
+            replica._conn().execute(
+                'INSERT INTO "segment" (cx, cy, px, py) '
+                "VALUES (9, 9, 9, 9)")
+    finally:
+        replica.close()
+        writer.close()
+
+
+def test_sqlite_read_only_requires_existing_db(tmp_path):
+    import pytest
+
+    with pytest.raises(FileNotFoundError, match="read-only replica"):
+        open_store("sqlite", str(tmp_path / "nope.db"), "t",
+                   read_only=True)
+    with pytest.raises(ValueError, match="replica mode"):
+        open_store("memory", "", "t", read_only=True)
+
+
+def test_read_only_replica_does_not_block_live_writer(tmp_path):
+    """The satellite regression: N replicas reading a WAL store must
+    never contend on the writer's lock — a replica holding a long read
+    cannot stall a live AsyncWriter flush."""
+    import threading
+    import time
+
+    path = str(tmp_path / "live.db")
+    store = SqliteStore(path, "t")
+    frame = {
+        "cx": [5], "cy": [6], "px": [5], "py": [6],
+        "sday": ["1995-01-01"], "eday": ["1999-01-01"],
+        "bday": ["0001-01-01"], "chprob": [0.0], "curqa": [4],
+    }
+    store.write("segment", frame)
+    replica = open_store("sqlite", path, "t", read_only=True)
+    stop = threading.Event()
+
+    def read_loop():
+        while not stop.is_set():
+            replica.read("segment")
+
+    readers = [threading.Thread(target=read_loop, daemon=True)
+               for _ in range(3)]
+    for t in readers:
+        t.start()
+    w = AsyncWriter(store)
+    try:
+        t0 = time.monotonic()
+        for i in range(30):
+            w.write("segment", dict(frame, px=[5 + i]), key=(5, 6))
+            if i % 10 == 9:
+                w.flush()
+        elapsed = time.monotonic() - t0
+        # WAL: writer never waits on readers.  The generous bound only
+        # fails if the replica actually BLOCKED the writer (the
+        # pre-mode=ro failure was 'database is locked' stalls).
+        assert elapsed < 20.0
+    finally:
+        w.close()
+        stop.set()
+        for t in readers:
+            t.join(5)
+        replica.close()
         store.close()

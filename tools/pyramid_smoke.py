@@ -1,0 +1,225 @@
+"""Pyramid + changefeed smoke: the read-path coherence contract, end
+to end (docs/SERVING.md; `make pyramid-smoke`, wired into `make test`).
+
+1. Seed a sqlite store with synthetic chips (numpy only — no JAX),
+   persist product rows, and build a 2-level pyramid.
+2. **Byte-identity**: every base tile must equal the `products.save`
+   raster for its chip, bit for bit — a map tile served from the
+   pyramid is the same answer the batch CLI writes.
+3. Serve it (ephemeral port) and prove the edge contract: a pyramid GET
+   carries a strong ETag; repeating it with If-None-Match answers 304.
+4. **Mutate one chip** through the store + product_writes feed, drive
+   the replica's changefeed consumer one poll, and assert EXACTLY the
+   mutated chip's base tile and its ancestors went stale — every other
+   tile must still be fresh (invalidation is surgical, not a flush).
+5. The old ETag must now revalidate to a full 200 with a NEW ETag (the
+   304 flip), and the rebuilt base tile must carry the mutated bytes.
+
+The JSON artifact lands in FIREBIRD_PYRAMID_DIR (default
+/tmp/fb_pyramid) and is folded into bench rounds by bench.py
+(_pyramid_fold), alongside the serve loadtest evidence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from firebird_tpu.config import env_knob  # noqa: E402
+
+ARTIFACT_SCHEMA = "firebird-pyramid-smoke/1"
+
+
+def _get(base: str, path: str, headers: dict | None = None):
+    req = urllib.request.Request(base + path, headers=headers or {})
+    try:
+        r = urllib.request.urlopen(req, timeout=10)
+        return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def main() -> int:
+    import numpy as np
+
+    from firebird_tpu import products
+    from firebird_tpu.config import Config
+    from firebird_tpu.obs import metrics as obs_metrics
+    from firebird_tpu.serve import api as serve_api
+    from firebird_tpu.serve import pyramid as pyrlib
+    from firebird_tpu.serve.changefeed import (ChangefeedConsumer,
+                                               ProductWrites)
+    from firebird_tpu.store import open_store
+    from firebird_tpu.utils import dates as dt
+    from serve_loadtest import seed_fleet_store
+
+    out_dir = env_knob("FIREBIRD_PYRAMID_DIR")
+    os.makedirs(out_dir, exist_ok=True)
+    artifact: dict = {"schema": ARTIFACT_SCHEMA, "ok": False}
+
+    def fail(msg: str) -> int:
+        artifact["error"] = msg
+        _write(artifact, out_dir)
+        print(f"FAIL: {msg}", file=sys.stderr)
+        return 1
+
+    obs_metrics.reset_registry()
+    with tempfile.TemporaryDirectory(prefix="fb_pyramid_smoke_") as work:
+        seed = seed_fleet_store(work, chips_side=2, pyramid_levels=2)
+        date = seed["date"]
+        store = open_store("sqlite", seed["store_path"], seed["keyspace"])
+        pyr = pyrlib.TilePyramid(seed["pyramid_dir"])
+
+        # -- act 1: base tiles byte-identical to products.save rasters --
+        compared = 0
+        for cx, cy in seed["chips"]:
+            seg = store.read("segment", {"cx": cx, "cy": cy})
+            arrays = products.ChipSegmentArrays(cx, cy, seg)
+            for name in seed["products"]:
+                want = products.chip_product(
+                    name, dt.to_ordinal(date), cx, cy, arrays)
+                bx, by = pyrlib.tile_of_chip(cx, cy)
+                npy, _ = pyr.tile_paths(name, date, pyrlib.Z_BASE, bx, by)
+                got = np.load(npy)
+                if got.dtype != np.int32 or \
+                        not np.array_equal(got.ravel(), want):
+                    return fail(f"base tile {name} z{pyrlib.Z_BASE}/"
+                                f"{bx}/{by} != products raster for chip "
+                                f"({cx},{cy})")
+                compared += 1
+        artifact["base_tiles_byte_identical"] = compared
+
+        # -- act 2: serve it; ETag + 304 --
+        feed = ProductWrites(os.path.join(work, "changefeed.db"))
+        svc = serve_api.ServeService(
+            store, Config.from_env(env=dict(
+                os.environ, FIREBIRD_STORE_BACKEND="sqlite",
+                FIREBIRD_STORE_PATH=seed["store_path"])),
+            pyramid=pyr)
+        consumer = ChangefeedConsumer(svc.gens, feed=feed,
+                                      replica="smoke", poll_sec=30)
+        srv = serve_api.start_serve_server(0, svc, host="127.0.0.1")
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            mcx, mcy = seed["chips"][0]
+            bx, by = pyrlib.tile_of_chip(mcx, mcy)
+            paths = {
+                "base": f"/v1/pyramid/curveqa/{pyrlib.Z_BASE}/{bx}/{by}"
+                        f"?date={date}",
+                "parent": f"/v1/pyramid/curveqa/{pyrlib.Z_BASE - 1}/"
+                          f"{bx >> 1}/{by >> 1}?date={date}",
+            }
+            etags = {}
+            for k, p in paths.items():
+                code, _, h = _get(base, p)
+                if code != 200 or not h.get("ETag"):
+                    return fail(f"GET {p} -> {code}, ETag "
+                                f"{h.get('ETag')!r}")
+                if "max-age" not in h.get("Cache-Control", ""):
+                    return fail(f"GET {p} carries no Cache-Control")
+                etags[k] = h["ETag"]
+                code, body, _ = _get(base, p,
+                                     {"If-None-Match": h["ETag"]})
+                if code != 304 or body:
+                    return fail(f"conditional GET {p} -> {code} "
+                                f"(want empty 304)")
+            if obs_metrics.counter("serve_304_total").value < 2:
+                return fail("serve_304_total never moved")
+            artifact["etag_304"] = True
+
+            # -- act 3: mutate one chip; exactly the ancestors dirty --
+            sentinel = 4242
+            store.write("product", {
+                "name": ["curveqa"], "date": [date],
+                "cx": [mcx], "cy": [mcy],
+                "cells": [[sentinel] * 10000]})
+            feed.append("product", [(mcx, mcy)])
+            applied = consumer.poll_once()
+            if applied["applied"] != 1:
+                return fail(f"consumer applied {applied['applied']} "
+                            "records (want 1)")
+            dirty_set = {(z, xx, yy) for z, xx, yy in
+                         pyrlib.ancestors(pyrlib.Z_BASE, bx, by)}
+            wrong_fresh, wrong_stale = [], []
+            for name in seed["products"]:
+                for cx, cy in seed["chips"]:
+                    tz = pyrlib.Z_BASE
+                    tx, ty = pyrlib.tile_of_chip(cx, cy)
+                    m = pyr.peek_meta(name, date, tz, tx, ty)
+                    stale = bool(m and m.get("stale"))
+                    expect = (tz, tx, ty) in dirty_set
+                    if expect and not stale:
+                        wrong_fresh.append((name, tz, tx, ty))
+                    if not expect and stale:
+                        wrong_stale.append((name, tz, tx, ty))
+                # parent level: each distinct parent of the seeded chips
+                for cx, cy in seed["chips"]:
+                    tx, ty = pyrlib.tile_of_chip(cx, cy)
+                    pz, px, py = pyrlib.parent(pyrlib.Z_BASE, tx, ty)
+                    m = pyr.peek_meta(name, date, pz, px, py)
+                    if m is None:
+                        continue
+                    stale = bool(m.get("stale"))
+                    expect = (pz, px, py) in dirty_set
+                    if expect and not stale:
+                        wrong_fresh.append((name, pz, px, py))
+                    if not expect and stale:
+                        wrong_stale.append((name, pz, px, py))
+            if wrong_fresh or wrong_stale:
+                return fail(f"invalidation not surgical: should-be-"
+                            f"stale-but-fresh {wrong_fresh}, should-be-"
+                            f"fresh-but-stale {wrong_stale}")
+            artifact["ancestors_exactly_dirty"] = True
+
+            # -- act 4: the 304 flips to a fresh 200 with new bytes --
+            flips = {}
+            for k, p in paths.items():
+                code, body, h = _get(base, p + "&format=npy",
+                                     {"If-None-Match": etags[k]})
+                if code != 200:
+                    return fail(f"post-mutation conditional GET {p} -> "
+                                f"{code} (want 200: tile changed)")
+                if h.get("ETag") == etags[k]:
+                    return fail(f"post-mutation ETag did not change "
+                                f"on {p}")
+                flips[k] = {"old": etags[k], "new": h["ETag"]}
+            import io
+            arr = np.load(io.BytesIO(body))  # parent tile, last in loop
+            code, body, h = _get(base, paths["base"] + "&format=npy")
+            arr = np.load(io.BytesIO(body))
+            if int(arr.ravel()[0]) != sentinel:
+                return fail("rebuilt base tile does not carry the "
+                            "mutated product row")
+            artifact["etag_flip"] = flips
+            artifact["pyramid_status"] = pyr.status()
+            artifact["ok"] = True
+        finally:
+            srv.close()
+            feed.close()
+            store.close()
+
+    _write(artifact, out_dir)
+    print(json.dumps({k: v for k, v in artifact.items()
+                      if k != "pyramid_status"}, indent=1))
+    print(f"pyramid-smoke OK -> {os.path.join(out_dir, 'pyramid_smoke.json')}")
+    return 0
+
+
+def _write(artifact: dict, out_dir: str) -> None:
+    path = os.path.join(out_dir, "pyramid_smoke.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(artifact, f, indent=1, default=str)
+    os.replace(tmp, path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

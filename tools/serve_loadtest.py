@@ -31,6 +31,29 @@ SSE subscription open for the duration of the run and count the events
 and keep-alive comments they receive — so the artifact carries the
 alert feed's RPS/percentiles next to the other endpoints plus an
 ``sse`` block proving the push path delivered under load.
+
+The multi-replica fleet mode (--fleet N): the planet-scale read-path
+proof (docs/SERVING.md).  The tool seeds a sqlite store with synthetic
+chips (numpy only — no JAX), saves product rows, precomputes a pyramid,
+then spawns N ``firebird serve`` replica subprocesses (read-only
+mode=ro store connections, each with its own changefeed replica id)
+behind a tiny round-robin front door and drives a mixed workload:
+
+- hot pyramid/product paths revalidated with ``If-None-Match`` (the
+  304 mix an edge cache generates),
+- a cold long tail of chip reads,
+- SSE alert subscribers fanned out across replicas on one feed,
+- a LIVE writer mutating product rows + appending alerts mid-test,
+  with per-mutation staleness probes: the wall time until EVERY
+  replica's answer reflects the write, asserted against the changefeed
+  lag bound (poll interval + apply).
+
+Closed-loop client shards run as separate *processes* (the GIL caps a
+single generator process well under the fleet's capacity), and the
+artifact (``serve_fleet_loadtest.json``) carries aggregate RPS,
+p50/p95/p99, hit/304 rates, per-replica counters, and max observed
+staleness vs the bound — folded by bench.py next to the single-replica
+loadtest.
 """
 
 from __future__ import annotations
@@ -252,9 +275,581 @@ def run_loadtest(base_url: str, paths: list[str], *, concurrency: int = 8,
     return artifact
 
 
+# ---------------------------------------------------------------------------
+# Multi-replica fleet mode
+# ---------------------------------------------------------------------------
+
+FLEET_SCHEMA = "firebird-serve-fleet-loadtest/1"
+
+
+class FrontDoor:
+    """The tiny round-robin front door: hands each request the next
+    replica base URL.  (A real deployment puts nginx/envoy here; the
+    scheduling decision — uniform round robin over interchangeable
+    replicas — is the same.)"""
+
+    def __init__(self, urls: list[str]):
+        self.urls = list(urls)
+        self._i = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> str:
+        with self._lock:
+            u = self.urls[self._i % len(self.urls)]
+            self._i += 1
+            return u
+
+
+class _KeepAliveClient:
+    """One persistent HTTP/1.1 connection per replica, raw sockets
+    with a minimal response parser.  urllib re-handshakes per request
+    and http.client routes headers through email.parser (~0.5 ms of
+    client CPU per response) — at fleet scale the GENERATOR becomes the
+    bottleneck and the measured "latency" is client-side parsing.  The
+    replicas always answer with a status line, plain headers, and an
+    exact Content-Length (no chunked encoding on these endpoints), so
+    a readline parser is sufficient and ~5x cheaper.  Not thread-safe —
+    one per worker thread."""
+
+    def __init__(self, timeout: float):
+        import socket as _socket
+
+        self._socket = _socket
+        self.timeout = timeout
+        self._conns: dict = {}
+
+    def _open(self, hostport: str):
+        host, _, port = hostport.partition(":")
+        s = self._socket.create_connection((host, int(port or 80)),
+                                           timeout=self.timeout)
+        s.setsockopt(self._socket.IPPROTO_TCP,
+                     self._socket.TCP_NODELAY, 1)
+        ent = (s, s.makefile("rb"))
+        self._conns[hostport] = ent
+        return ent
+
+    def _close_one(self, hostport: str) -> None:
+        ent = self._conns.pop(hostport, None)
+        if ent is not None:
+            for h in ent[::-1]:
+                try:
+                    h.close()
+                except OSError:
+                    pass
+
+    def _get(self, hostport: str, path: str,
+             headers: dict | None) -> tuple[int, bytes, dict]:
+        ent = self._conns.get(hostport) or self._open(hostport)
+        sock, rf = ent
+        req = [f"GET {path} HTTP/1.1\r\nHost: {hostport}\r\n"]
+        for k, v in (headers or {}).items():
+            req.append(f"{k}: {v}\r\n")
+        req.append("\r\n")
+        sock.sendall("".join(req).encode())
+        line = rf.readline()
+        if not line:
+            raise OSError("server closed the connection")
+        status = int(line.split(None, 2)[1])
+        hdrs: dict = {}
+        while True:
+            ln = rf.readline()
+            if ln in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = ln.decode("latin-1").partition(":")
+            hdrs[k.strip()] = v.strip()
+        n = int(hdrs.get("Content-Length") or 0)
+        body = rf.read(n) if n else b""
+        if hdrs.get("Connection", "").lower() == "close":
+            self._close_one(hostport)
+        return status, body, hdrs
+
+    def get(self, base_url: str, path: str,
+            headers: dict | None = None) -> tuple[int, bytes, dict]:
+        hostport = base_url.split("://", 1)[1]
+        try:
+            return self._get(hostport, path, headers)
+        except (OSError, ValueError, IndexError):
+            # One reconnect: the server may have closed an idle
+            # keep-alive; a second failure is the request's outcome.
+            self._close_one(hostport)
+            return self._get(hostport, path, headers)
+
+    def close(self) -> None:
+        for hostport in list(self._conns):
+            self._close_one(hostport)
+
+
+def _shard_worker(urls, paths, hot, hot_frac, conditional, n_requests,
+                  concurrency, seed, timeout, out_q) -> None:
+    """One client-shard process: closed-loop worker threads over the
+    front door, remembering ETags per path for the If-None-Match mix."""
+    door = FrontDoor(urls)
+    hot_paths, cold_paths = paths[:hot], paths[hot:]
+    latencies: list[float] = []
+    status_counts: dict[str, int] = {}
+    lock = threading.Lock()
+    remaining = [int(n_requests)]
+
+    def worker(wid: int) -> None:
+        rng = random.Random(seed * 7919 + wid)
+        client = _KeepAliveClient(timeout)
+        etags: dict[str, str] = {}
+        try:
+            while True:
+                with lock:
+                    if remaining[0] <= 0:
+                        return
+                    remaining[0] -= 1
+                pool = hot_paths if (rng.random() < hot_frac and hot_paths) \
+                    else (cold_paths or hot_paths)
+                path = rng.choice(pool)
+                headers = {}
+                if conditional and path in etags:
+                    headers["If-None-Match"] = etags[path]
+                t0 = time.monotonic()
+                try:
+                    code, _, rh = client.get(door.next(), path, headers)
+                    etag = rh.get("ETag")
+                    if code == 200 and etag:
+                        etags[path] = etag
+                except OSError:
+                    code = 0
+                dt = time.monotonic() - t0
+                with lock:
+                    latencies.append(dt)
+                    status_counts[str(code)] = \
+                        status_counts.get(str(code), 0) + 1
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(max(int(concurrency), 1))]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out_q.put((latencies, status_counts, time.monotonic() - t0))
+
+
+def _scrape_counters(base_url: str, names, timeout: float) -> dict:
+    try:
+        text = urllib.request.urlopen(
+            base_url + "/metrics", timeout=timeout).read().decode()
+    except (OSError, urllib.error.URLError):
+        return {n: 0 for n in names}
+    out = {}
+    for name in names:
+        m = re.search(rf"^firebird_{name}(?:_total)? (\d+)$", text, re.M)
+        out[name] = int(m.group(1)) if m else 0
+    return out
+
+
+def run_fleet_workload(urls: list[str], paths: list[str], *,
+                       hot: int, hot_frac: float = 0.8,
+                       requests: int = 20000, concurrency: int = 8,
+                       client_procs: int = 4, conditional: bool = True,
+                       seed: int = 0, timeout: float = 30.0) -> dict:
+    """Drive the mixed workload from ``client_procs`` shard processes
+    and return merged latency/status tallies."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    per = max(int(requests) // max(client_procs, 1), 1)
+    procs = [ctx.Process(target=_shard_worker,
+                         args=(urls, paths, hot, hot_frac, conditional,
+                               per, concurrency, seed + i, timeout, q),
+                         daemon=True)
+             for i in range(max(int(client_procs), 1))]
+    t_start = time.monotonic()
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=600) for _ in procs]
+    for p in procs:
+        p.join(timeout=30)
+    elapsed = max(time.monotonic() - t_start, 1e-9)
+    latencies: list[float] = []
+    status_counts: dict[str, int] = {}
+    for lat, sc, _ in results:
+        latencies.extend(lat)
+        for k, v in sc.items():
+            status_counts[k] = status_counts.get(k, 0) + v
+    lat = sorted(latencies)
+    n304 = status_counts.get("304", 0)
+    ok = status_counts.get("200", 0) + n304
+    return {
+        "requests": len(lat),
+        "ok": ok,
+        "errors": len(lat) - ok,
+        "elapsed_sec": round(elapsed, 3),
+        "rps": round(len(lat) / elapsed, 1),
+        "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3) if lat else None,
+        "p95_ms": round(_percentile(lat, 0.95) * 1e3, 3) if lat else None,
+        "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3) if lat else None,
+        "rate_304": round(n304 / len(lat), 4) if lat else None,
+        "status_counts": dict(sorted(status_counts.items())),
+        "client_procs": len(procs),
+        "concurrency_per_proc": int(concurrency),
+    }
+
+
+def seed_fleet_store(workdir: str, *, chips_side: int = 4,
+                     date: str = "1996-01-01",
+                     products_list=("curveqa", "seglength"),
+                     pyramid_levels: int = 3) -> dict:
+    """Seed ``workdir`` with a sqlite store of synthetic chips (numpy
+    only — no JAX), persisted product rows, a precomputed pyramid, and
+    an (empty) alert log.  Returns the seed description: chip ids, the
+    store path, pyramid root, hot/cold request paths."""
+    import numpy as np
+
+    from firebird_tpu import grid, products
+    from firebird_tpu.alerts.log import AlertLog
+    from firebird_tpu.serve import pyramid as pyrlib
+    from firebird_tpu.store import open_store
+    from firebird_tpu.utils import dates as dt
+
+    from firebird_tpu.config import Config
+
+    store_path = os.path.join(workdir, "fb.db")
+    # The SAME keyspace derivation the replica subprocesses will run
+    # (Config.keyspace() from an identical env) — a literal here would
+    # seed a database file the replicas never open.
+    keyspace = Config.from_env().keyspace()
+    store = open_store("sqlite", store_path, keyspace)
+    base_cx, base_cy = (int(v) for v in
+                        grid.snap(100, 200)["chip"]["proj-pt"])
+    cids = [(base_cx + 3000 * i, base_cy - 3000 * j)
+            for j in range(chips_side) for i in range(chips_side)]
+    rng = random.Random(7)
+    for cx, cy in cids:
+        n = 40
+        store.write("segment", {
+            "cx": [cx] * n, "cy": [cy] * n,
+            "px": [cx + 30 * (k % 20) for k in range(n)],
+            "py": [cy - 30 * (k // 20 + 1) for k in range(n)],
+            "sday": ["1995-01-01"] * n, "eday": ["1999-01-01"] * n,
+            "bday": ["1997-06-01"] * n,
+            "chprob": [1.0] * n,
+            "curqa": [rng.choice((4, 8)) for _ in range(n)],
+            "rfrawp": [None] * n,
+        })
+        seg = store.read("segment", {"cx": cx, "cy": cy})
+        arrays = products.ChipSegmentArrays(cx, cy, seg)
+        for name in products_list:
+            products.save_chip_raster(store, name, date,
+                                      dt.to_ordinal(date), cx, cy, arrays)
+    pyramid_dir = os.path.join(workdir, "pyramid")
+    pyr = pyrlib.TilePyramid(pyramid_dir,
+                             pyrlib.store_read_chip(store, compute=False))
+    bounds = [(float(base_cx) + 1, float(base_cy) - 1),
+              (float(base_cx + 3000 * chips_side) - 1,
+               float(base_cy - 3000 * chips_side) + 1)]
+    built = pyr.build_area(list(products_list), [date], bounds,
+                           levels=pyramid_levels)
+    AlertLog(os.path.join(workdir, "alerts.db")).close()
+    store.close()
+    del pyr
+    # Hot set: the parent pyramid tiles + one base tile + one product —
+    # the few-popular-areas shape edge caches revalidate against.
+    bz = pyrlib.Z_BASE
+    bx, by = pyrlib.tile_of_chip(*cids[0])
+    hot_paths = [
+        f"/v1/pyramid/curveqa/{bz - 1}/{bx >> 1}/{by >> 1}?date={date}",
+        f"/v1/pyramid/curveqa/{bz - 2}/{bx >> 2}/{by >> 2}?date={date}",
+        f"/v1/pyramid/curveqa/{bz}/{bx}/{by}?date={date}",
+        f"/v1/product/curveqa?cx={cids[0][0]}&cy={cids[0][1]}"
+        f"&date={date}&format=npy",
+    ]
+    cold_paths = [f"/v1/segments?cx={cx}&cy={cy}" for cx, cy in cids] + [
+        f"/v1/product/seglength?cx={cx}&cy={cy}&date={date}&format=npy"
+        for cx, cy in cids]
+    return {"store_path": store_path, "keyspace": keyspace,
+            "pyramid_dir": pyramid_dir,
+            "chips": cids, "date": date,
+            "products": list(products_list), "pyramid_built": built,
+            "hot_paths": hot_paths, "cold_paths": cold_paths}
+
+
+def _free_ports(n: int) -> list[int]:
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def spawn_replicas(n: int, seed: dict, *, feed_poll: float,
+                   workdir: str, inflight: int = 32) -> list[dict]:
+    """N `firebird serve` replica subprocesses over the seeded store:
+    read-only mode=ro store connections, a SHARED pyramid dir (the
+    static files a CDN would front), per-replica changefeed ids."""
+    import subprocess
+
+    ports = _free_ports(n)
+    replicas = []
+    for i, port in enumerate(ports):
+        env = dict(os.environ,
+                   FIREBIRD_STORE_BACKEND="sqlite",
+                   FIREBIRD_STORE_PATH=seed["store_path"],
+                   FIREBIRD_SERVE_PYRAMID_DIR=seed["pyramid_dir"],
+                   FIREBIRD_ALERT_DB=os.path.join(workdir, "alerts.db"),
+                   FIREBIRD_CHANGEFEED_DB=os.path.join(
+                       workdir, "changefeed.db"),
+                   FIREBIRD_SERVE_FEED_POLL=str(feed_poll),
+                   FIREBIRD_SERVE_INFLIGHT=str(inflight),
+                   FIREBIRD_SERVE_QUEUE="512",
+                   FIREBIRD_METRICS="1")
+        logf = open(os.path.join(workdir, f"replica{i}.log"), "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "firebird_tpu.cli", "serve",
+             "--port", str(port), "--host", "127.0.0.1",
+             "--read-only", "--replica-id", f"replica-{i}"],
+            env=env, stdout=logf, stderr=subprocess.STDOUT)
+        replicas.append({"proc": proc, "log": logf, "port": port,
+                         "url": f"http://127.0.0.1:{port}",
+                         "replica_id": f"replica-{i}"})
+    return replicas
+
+
+def _wait_healthy(url: str, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            r = urllib.request.urlopen(url + "/healthz", timeout=2)
+            r.read()
+            if r.status == 200:
+                return
+        except (OSError, urllib.error.URLError):
+            pass
+        time.sleep(0.2)
+    raise RuntimeError(f"replica at {url} never became healthy")
+
+
+def _mutation_rounds(seed: dict, urls: list[str], *, rounds: int,
+                     bound_sec: float, alert_db: str,
+                     timeout: float = 10.0,
+                     interval: float = 0.5) -> dict:
+    """The live-writer leg: mutate a product row + append the
+    changefeed record, then measure how long until EVERY replica's
+    answer reflects it (their changefeed consumers must apply the
+    record and drop the stale cache entry).  Also appends one alert per
+    round, feeding the SSE subscribers and the alert-cursor half of the
+    feed."""
+    import numpy as np
+
+    from firebird_tpu.alerts.log import AlertLog
+    from firebird_tpu.serve.changefeed import ProductWrites
+    from firebird_tpu.store import open_store
+
+    cx, cy = seed["chips"][0]
+    date = seed["date"]
+    path = (f"/v1/product/curveqa?cx={cx}&cy={cy}&date={date}"
+            "&format=npy")
+    store = open_store("sqlite", seed["store_path"], seed["keyspace"])
+    feed = ProductWrites(os.path.join(
+        os.path.dirname(seed["store_path"]), "changefeed.db"))
+    alog = AlertLog(alert_db)
+    client = _KeepAliveClient(timeout)
+    out: list = []
+    try:
+        for k in range(rounds):
+            sentinel = 1000 + k
+            cells_obj = [[sentinel] * 10000]
+            store.write("product", {
+                "name": ["curveqa"], "date": [date],
+                "cx": [cx], "cy": [cy], "cells": cells_obj})
+            feed.append("product", [(cx, cy)])
+            alog.append([{"cx": cx, "cy": cy, "px": cx + 30 * k,
+                          "py": cy - 30, "break_day": 728000 + k}],
+                        run_id=f"loadtest-{k}")
+            t0 = time.monotonic()
+            waiting = set(urls)
+            staleness = None
+            while waiting and time.monotonic() - t0 < bound_sec * 5 + 10:
+                for u in sorted(waiting):
+                    try:
+                        code, body, _ = client.get(u, path)
+                    except OSError:
+                        continue
+                    if code == 200:
+                        import io as _io
+                        arr = np.load(_io.BytesIO(body))
+                        if int(arr.ravel()[0]) == sentinel:
+                            waiting.discard(u)
+                if waiting:
+                    time.sleep(0.02)
+            if not waiting:
+                staleness = time.monotonic() - t0
+            out.append({"round": k, "staleness_sec":
+                        None if staleness is None else round(staleness, 3),
+                        "converged": not waiting,
+                        "laggards": sorted(waiting)})
+            time.sleep(interval)
+    finally:
+        client.close()
+        alog.close()
+        feed.close()
+        store.close()
+    vals = [r["staleness_sec"] for r in out if r["staleness_sec"]
+            is not None]
+    return {"rounds": out,
+            "max_staleness_sec": max(vals) if vals else None,
+            "bound_sec": bound_sec,
+            "within_bound": bool(vals) and all(r["converged"] for r in out)
+            and max(vals) <= bound_sec}
+
+
+def run_replica_fleet(*, replicas: int = 4, requests: int = 40000,
+                      concurrency: int = 8, client_procs: int = 4,
+                      feed_poll: float = 0.5, mutations: int = 5,
+                      sse: int = 4, hot_frac: float = 0.9,
+                      seed_val: int = 0, workdir: str | None = None,
+                      out_dir: str | None = None,
+                      timeout: float = 30.0) -> dict:
+    """The whole fleet drill: seed -> spawn N replicas -> mixed
+    hot/cold/304/SSE workload from multi-process client shards with a
+    live writer mutating mid-test -> artifact."""
+    import shutil
+    import tempfile
+
+    own_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="fb_fleet_serve_")
+    fleet = []
+    writer_stats = sse_block = None
+    try:
+        seed = seed_fleet_store(workdir)
+        fleet = spawn_replicas(replicas, seed, feed_poll=feed_poll,
+                               workdir=workdir)
+        urls = [r["url"] for r in fleet]
+        for r in fleet:
+            _wait_healthy(r["url"])
+        # Warm each replica's caches (and prove every path serves).
+        warm_client = _KeepAliveClient(timeout)
+        try:
+            for u in urls:
+                for p in seed["hot_paths"] + seed["cold_paths"]:
+                    code, _, _ = warm_client.get(u, p)
+                    if code != 200:
+                        raise RuntimeError(
+                            f"warmup GET {u}{p} answered {code}")
+        finally:
+            warm_client.close()
+        subscribers = []
+        for i in range(max(int(sse), 0)):
+            s = _SseSubscriber(urls[i % len(urls)],
+                               "/v1/alerts/stream?since=0", timeout)
+            s.start()
+            subscribers.append(s)
+        c0 = {u: _scrape_counters(
+            u, ("serve_cache_hits", "serve_cache_misses", "serve_304",
+                "pyramid_tile_hits", "serve_requests"), timeout)
+            for u in urls}
+        # The writer runs CONCURRENTLY with the workload: mutations land
+        # mid-test and the staleness probe races the closed-loop load.
+        writer_result: dict = {}
+        bound = feed_poll * 2 + 1.0
+
+        def writer():
+            writer_result.update(_mutation_rounds(
+                seed, urls, rounds=mutations, bound_sec=bound,
+                alert_db=os.path.join(workdir, "alerts.db")))
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        workload = run_fleet_workload(
+            urls, seed["hot_paths"] + seed["cold_paths"],
+            hot=len(seed["hot_paths"]), hot_frac=hot_frac,
+            requests=requests, concurrency=concurrency,
+            client_procs=client_procs, seed=seed_val, timeout=timeout)
+        wt.join(timeout=bound * 5 * mutations + 60)
+        writer_stats = writer_result or None
+        for s in subscribers:
+            s.join(timeout=3)
+        for s in subscribers:
+            s.close()
+            s.join(timeout=5)
+        if subscribers:
+            sse_block = {
+                "subscribers": len(subscribers),
+                "events": sum(s.events for s in subscribers),
+                "comments": sum(s.comments for s in subscribers),
+                "errors": [s.error for s in subscribers if s.error],
+            }
+        c1 = {u: _scrape_counters(
+            u, ("serve_cache_hits", "serve_cache_misses", "serve_304",
+                "pyramid_tile_hits", "serve_requests"), timeout)
+            for u in urls}
+        per_replica = {}
+        th = tm = t304 = 0
+        for u in urls:
+            d = {k: c1[u][k] - c0[u][k] for k in c1[u]}
+            per_replica[u] = d
+            th += d["serve_cache_hits"]
+            tm += d["serve_cache_misses"]
+            t304 += d["serve_304"]
+        from firebird_tpu.serve.changefeed import ProductWrites
+
+        pw = ProductWrites(os.path.join(workdir, "changefeed.db"))
+        try:
+            feed_status = pw.status()
+        finally:
+            pw.close()
+        artifact = {
+            "schema": FLEET_SCHEMA,
+            "replicas": len(fleet),
+            "urls": urls,
+            "feed_poll_sec": feed_poll,
+            "seed": {"chips": len(seed["chips"]),
+                     "products": seed["products"],
+                     "pyramid_built": seed["pyramid_built"]},
+            "workload": workload,
+            "rps": workload["rps"],
+            "p50_ms": workload["p50_ms"],
+            "p95_ms": workload["p95_ms"],
+            "p99_ms": workload["p99_ms"],
+            "rate_304": workload["rate_304"],
+            "hit_rate": round(th / (th + tm), 4) if th + tm else None,
+            "per_replica": per_replica,
+            "sse": sse_block,
+            "staleness": writer_stats,
+            "changefeed": {
+                "latest_cursor": feed_status["latest_cursor"],
+                "replicas_seen": len(feed_status["replicas"]),
+            },
+        }
+        out_dir = out_dir or env_knob("FIREBIRD_SERVE_DIR")
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, "serve_fleet_loadtest.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(artifact, f, indent=1)
+        os.replace(tmp, path)
+        artifact["artifact_path"] = path
+        return artifact
+    finally:
+        for r in fleet:
+            r["proc"].terminate()
+        for r in fleet:
+            try:
+                r["proc"].wait(timeout=10)
+            except Exception:
+                r["proc"].kill()
+            r["log"].close()
+        if own_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--url", required=True,
+    ap.add_argument("--url", required=False, default=None,
                     help="base URL of a running firebird serve endpoint")
     ap.add_argument("--path", action="append", default=[],
                     help="relative request path (repeatable); the first "
@@ -267,15 +862,47 @@ def main() -> int:
                     help="probability a request draws from the hot set")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--timeout", type=float, default=30.0)
-    ap.add_argument("--sse", type=int, default=0,
+    ap.add_argument("--sse", type=int, default=None,
                     help="hold this many live /v1/alerts/stream SSE "
-                         "subscriptions open for the run")
+                         "subscriptions open for the run (fleet mode "
+                         "defaults to 4; pass 0 to disable)")
     ap.add_argument("--sse-path", default="/v1/alerts/stream?since=0")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="multi-replica mode: seed a store, spawn this "
+                         "many serve replica subprocesses behind a "
+                         "round-robin front door, and run the mixed "
+                         "hot/cold/304/SSE workload with a live writer "
+                         "(--url/--path ignored)")
+    ap.add_argument("--client-procs", type=int, default=4,
+                    help="fleet mode: closed-loop client shard "
+                         "processes (one GIL cannot saturate a fleet)")
+    ap.add_argument("--feed-poll", type=float, default=0.5,
+                    help="fleet mode: replica changefeed poll seconds "
+                         "(the staleness bound is ~2x this)")
+    ap.add_argument("--mutations", type=int, default=5,
+                    help="fleet mode: live-writer mutation rounds")
     args = ap.parse_args()
+    if args.fleet > 0:
+        artifact = run_replica_fleet(
+            replicas=args.fleet, requests=args.requests,
+            concurrency=args.concurrency,
+            client_procs=args.client_procs, feed_poll=args.feed_poll,
+            mutations=args.mutations,
+            sse=4 if args.sse is None else args.sse,
+            hot_frac=args.hot_frac, seed_val=args.seed,
+            timeout=args.timeout)
+        print(json.dumps(artifact, indent=1))
+        stale = artifact.get("staleness") or {}
+        ok = (artifact["workload"]["errors"] == 0
+              and stale.get("within_bound") is True
+              and not (artifact.get("sse") or {}).get("errors"))
+        return 0 if ok else 1
+    if not args.url:
+        ap.error("--url is required (or use --fleet N)")
     artifact = run_loadtest(
         args.url.rstrip("/"), args.path, concurrency=args.concurrency,
         requests=args.requests, hot=args.hot, hot_frac=args.hot_frac,
-        seed=args.seed, timeout=args.timeout, sse=args.sse,
+        seed=args.seed, timeout=args.timeout, sse=args.sse or 0,
         sse_path=args.sse_path)
     print(json.dumps(artifact, indent=1))
     sse_errors = (artifact.get("sse") or {}).get("errors", [])
