@@ -10,10 +10,10 @@ Y ?= 1650000
 ACQUIRED ?= 1982-01-01/2017-12-31
 
 .PHONY: install lint test bench obs-smoke pipeline-smoke chaos-smoke \
-        fleet-smoke serve-smoke pyramid-smoke serve-fleet compact-smoke \
-        postmortem-smoke alert-smoke streamfleet-smoke wire-smoke \
-        fuse-smoke fuse-repro image db-up db-schema db-test db-down \
-        changedetection classification clean
+        fleet-smoke elastic-smoke serve-smoke pyramid-smoke serve-fleet \
+        compact-smoke postmortem-smoke alert-smoke streamfleet-smoke \
+        wire-smoke fuse-smoke fuse-repro image db-up db-schema db-test \
+        db-down changedetection classification clean
 
 install:
 	pip install -e . --no-build-isolation
@@ -37,6 +37,7 @@ test: lint
 	$(MAKE) fuse-smoke
 	$(MAKE) alert-smoke
 	$(MAKE) streamfleet-smoke
+	$(MAKE) elastic-smoke
 
 bench:
 	python bench.py
@@ -72,6 +73,18 @@ chaos-smoke:
 # row-identical to a clean single-worker run.
 fleet-smoke:
 	python tools/fleet_chaos.py
+
+# Elastic-fleet chaos check (docs/ROBUSTNESS.md "Elastic operation"): a
+# full 726-tile CONUS plan (tiny synthetic chips) drained by the
+# autoscaling supervisor at 10x any prior soak's worker count, with
+# random worker SIGKILLs, a heartbeat-partitioned zombie, and the
+# supervisor itself killed + restarted mid-drain — asserts the restart
+# ADOPTS orphaned workers (no double-spawn), every job drains, zero
+# stale-fence writes are accepted (store row-identical to a clean
+# serial leg), and the fleet scales back to zero afterwards.  The
+# scale-decision log lands in the artifact (folded by bench.py).
+elastic-smoke:
+	python tools/elastic_soak.py
 
 # Serving-layer check (docs/SERVING.md): tiny synthetic run into a
 # sqlite store, then the query API on an ephemeral port — every endpoint

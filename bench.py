@@ -279,6 +279,16 @@ def _fleet_fold() -> dict:
                           "fleet_chaos.json")
 
 
+def _elastic_fold() -> dict:
+    """`make elastic-smoke` evidence (tools/elastic_soak.py): the
+    726-tile elastic drill — peak/ceiling worker counts, kills +
+    partition + supervisor-restart chaos tallies, orphan adoptions,
+    store row-identity, the scale-to-zero verdict, and the supervisor's
+    scale-decision log."""
+    return _artifact_fold("elastic_soak", "FIREBIRD_ELASTIC_DIR",
+                          "elastic_soak.json")
+
+
 def _postmortem_fold() -> dict:
     """`make postmortem-smoke` evidence (tools/postmortem_smoke.py): the
     flight recorder's SIGTERM'd-run bundle validity + row-identical
@@ -982,6 +992,10 @@ def measure(cpu_only: bool) -> None:
             # Last fleet-smoke evidence (SIGKILL/partition drill: queue
             # drained, zero stale-fence writes accepted) when one ran.
             **_fleet_fold(),
+            # Last elastic-smoke evidence (726-tile autoscaled drain
+            # with supervisor kill/adopt chaos + the scale-decision
+            # log) when one ran on this host.
+            **_elastic_fold(),
             # Last serve-loadtest evidence (read-path RPS/latency/hit
             # rate) when the serving layer was exercised on this host,
             # plus the multi-replica fleet artifact when one ran.

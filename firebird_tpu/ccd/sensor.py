@@ -95,4 +95,13 @@ SENTINEL2 = Sensor(
     pixel_size_m=10,
 )
 
-SENSORS = {s.name: s for s in (LANDSAT_ARD, SENTINEL2)}
+# Landsat ARD band semantics on a 10x10 chip: the fleet-scale test
+# geometry.  A full-CONUS plan is 726 tiles; at 100 px/chip the elastic
+# soak (tools/elastic_soak.py) drains all 726 through real detection in
+# smoke time while every queue/fencing/store code path stays the
+# production one.  Only the synthetic source honors it
+# (FIREBIRD_SYNTH_SENSOR) — real archives are fixed-geometry.
+LANDSAT_ARD_TINY = dataclasses.replace(
+    LANDSAT_ARD, name="landsat-ard-tiny", chip_side=10)
+
+SENSORS = {s.name: s for s in (LANDSAT_ARD, SENTINEL2, LANDSAT_ARD_TINY)}

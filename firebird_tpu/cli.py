@@ -660,12 +660,23 @@ def status(x, y):
                 s = fq.status()
             finally:
                 fq.close()
+            sup = s.get("supervisor")
             out["fleet"] = {
                 "path": fpath,
                 "jobs": s["jobs"],
                 "by_type": s["by_type"],
                 "blocked": s["blocked"],
                 "leases": s["leases"],
+                "workers": s.get("workers", []),
+                # Elastic control plane (docs/ROBUSTNESS.md "Elastic
+                # operation"): target vs live, last scale decision +
+                # reason, crash-loop parks — from the supervisor's
+                # heartbeat in the queue db.
+                "supervisor": None if sup is None else {
+                    k: sup.get(k) for k in
+                    ("pid", "host", "target", "live", "retiring", "min",
+                     "max", "adopted_total", "parks", "drain_eta_sec",
+                     "last_decision", "beat_age_sec")},
                 "dead": len(s["dead"]),
                 "dead_errors": s["dead_errors"],
                 "fence_rejects": s["fence_rejects"],
@@ -829,12 +840,22 @@ def fleet_enqueue(tiles, acquired, number, chunk_size, msday, meday,
               help="standing worker: keep polling through an empty "
                    "queue until signalled — the steady-state streaming "
                    "fleet mode behind `firebird watch`")
+@click.option("--hold-idle", is_flag=True, default=False,
+              help="batch worker that polls through an empty queue "
+                   "instead of exiting — how `fleet supervise` holds a "
+                   "min-workers floor (retired by SIGTERM); unlike "
+                   "--forever it still counts as batch drain capacity")
+@click.option("--drain-on-term", is_flag=True, default=False,
+              help="graceful drain: SIGTERM finishes the current lease "
+                   "then exits cleanly instead of dying mid-job — how "
+                   "`fleet supervise` retires workers")
 @click.option("--poll", required=False, default=1.0, type=float,
               help="idle claim-poll interval, seconds")
 @click.option("--ops-port", default=None, type=int,
               help="live ops endpoints for this worker (adds a `fleet` "
                    "block to /progress); overrides FIREBIRD_OPS_PORT")
-def fleet_work(max_jobs, until_drained, forever, poll, ops_port):
+def fleet_work(max_jobs, until_drained, forever, hold_idle, drain_on_term,
+               poll, ops_port):
     """Run one fleet worker against the shared queue until it drains."""
     import json as _json
     import signal
@@ -844,47 +865,147 @@ def fleet_work(max_jobs, until_drained, forever, poll, ops_port):
     from firebird_tpu.driver import core
     from firebird_tpu.fleet import FleetWorker, make_queue
 
-    if forever and until_drained:
-        raise click.BadParameter("--forever and --until-drained are "
-                                 "exclusive")
+    if sum((forever, until_drained, hold_idle)) > 1:
+        raise click.BadParameter("--forever, --until-drained and "
+                                 "--hold-idle are exclusive")
     apply_platform()
     overrides = {"ops_port": ops_port} if ops_port is not None else {}
     cfg = Config.from_env(**overrides)
     core.setup_compile_cache(cfg)
     queue = make_queue(cfg)
-    worker = FleetWorker(cfg, queue, poll_sec=poll)
+    worker = FleetWorker(cfg, queue, poll_sec=poll,
+                         kind="stream" if forever else "batch")
     stop = threading.Event()
-    if forever:
+    if forever or hold_idle or drain_on_term:
         signal.signal(signal.SIGINT, lambda *_: stop.set())
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
     _, srv, wd = worker.start_ops()
     try:
         summary = worker.run(max_jobs=max_jobs,
                              until_drained=until_drained,
-                             forever=forever, stop=stop)
+                             forever=forever or hold_idle, stop=stop)
     finally:
         core.stop_ops(srv, wd)
         queue.close()
     click.echo(_json.dumps(summary, indent=1))
     if summary.get("wedged"):
-        raise SystemExit(4)
+        from firebird_tpu.fleet import WEDGED_EXIT
+        raise SystemExit(WEDGED_EXIT)
+
+
+@fleet.command("supervise")
+@click.option("--min", "min_workers", default=None, type=int,
+              help="worker floor (0 = scale-to-zero); overrides "
+                   "FIREBIRD_FLEET_MIN_WORKERS")
+@click.option("--max", "max_workers", default=None, type=int,
+              help="worker ceiling; overrides FIREBIRD_FLEET_MAX_WORKERS")
+@click.option("--until-drained", is_flag=True, default=False,
+              help="exit once every batch job is done or dead AND the "
+                   "fleet has scaled back to zero (stream jobs don't "
+                   "gate the exit; default: supervise until signalled)")
+@click.option("--tick", default=1.0, type=float,
+              help="control-loop interval, seconds")
+@click.option("--grace", default=None, type=float,
+              help="retiring worker SIGTERM->SIGKILL deadline, seconds; "
+                   "overrides FIREBIRD_FLEET_GRACE_SEC")
+@click.option("--log-dir", default=None,
+              help="directory for spawned workers' stdout logs "
+                   "(default: worker_logs/ next to the queue db)")
+@click.option("--ops-port", default=None, type=int,
+              help="live ops endpoints for the supervisor (the `fleet` "
+                   "/progress block gains the supervisor view); "
+                   "overrides FIREBIRD_OPS_PORT")
+def fleet_supervise(min_workers, max_workers, until_drained, tick, grace,
+                    log_dir, ops_port):
+    """Autoscale a local worker fleet from queue pressure
+    (docs/ROBUSTNESS.md "Elastic operation"): spawn `fleet work`
+    subprocesses on sustained backlog, retire them gracefully after an
+    idle window (scale-to-zero by default), park crash-looping slots
+    with backoff, and adopt orphaned workers left by a dead supervisor
+    instead of double-spawning over them."""
+    import json as _json
+    import os as _os
+    import signal
+    import threading
+
+    from firebird_tpu.config import Config
+    from firebird_tpu.driver import core
+    from firebird_tpu.fleet import Supervisor, make_queue
+    from firebird_tpu.obs import Counters, jsonlog
+
+    # The supervisor runs no kernels: pin ITS jax to CPU so start_ops'
+    # topology probe (jax.devices()) cannot acquire the TPU exclusively
+    # — the spawned workers need it, and a supervisor holding it would
+    # crash-loop every child at TPU bring-up.  In-process config only:
+    # children inherit the untouched environment.
+    apply_platform("cpu")
+    overrides = {k: v for k, v in
+                 (("fleet_min_workers", min_workers),
+                  ("fleet_max_workers", max_workers),
+                  ("fleet_grace_sec", grace),
+                  ("ops_port", ops_port)) if v is not None}
+    cfg = Config.from_env(**overrides)
+    queue = make_queue(cfg)
+    sup = Supervisor(
+        cfg, queue,
+        tick_sec=tick, grace_sec=cfg.fleet_grace_sec,
+        log_dir=log_dir or _os.path.join(
+            _os.path.dirname(_os.path.abspath(queue.path)), "worker_logs"))
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    run_block = {"kind": "fleet-supervisor", "run_id": sup.run_id,
+                 "host": jsonlog.HOST, "queue": queue.path}
+    _, srv, wd = core.start_ops(cfg, sup.run_id, "fleet-supervisor",
+                                chips_total=0, counters=Counters(),
+                                run_block=run_block, fleet=sup.fleet_block)
+    try:
+        summary = sup.run(until_drained=until_drained, stop=stop)
+        if stop.is_set() and not sup.drain_out(
+                timeout=cfg.fleet_grace_sec + 10.0):
+            click.echo("warning: workers still draining at supervisor "
+                       "exit (pids %s)" % sorted(sup.workers), err=True)
+    except RuntimeError as e:
+        # The succession guard: a LIVE supervisor already runs here.
+        click.echo(f"error: {e}", err=True)
+        raise SystemExit(3)
+    finally:
+        core.stop_ops(srv, wd)
+        queue.close()
+    click.echo(_json.dumps(summary, indent=1))
+    if summary.get("wedged"):
+        from firebird_tpu.fleet import WEDGED_EXIT
+        raise SystemExit(WEDGED_EXIT)
 
 
 @fleet.command("status")
 def fleet_status():
     """Inspect the shared queue: depth by job type/state, active leases
-    with age and holder, dead letters with error classes, and the
-    stale-fence rejection tally."""
+    with age and holder, per-worker registry rows (pid, current lease,
+    jobs acked), the supervisor's last heartbeat/decision, dead letters
+    with error classes, and the stale-fence rejection tally.  A
+    corrupt/locked queue db degrades to an error report, not a crash —
+    the `firebird status` guard rule."""
     import json as _json
 
     from firebird_tpu.config import Config
-    from firebird_tpu.fleet import make_queue
+    from firebird_tpu.fleet import make_queue, queue_path
 
-    queue = make_queue(Config.from_env())
+    cfg = Config.from_env()
     try:
-        click.echo(_json.dumps(queue.status(), indent=1))
-    finally:
-        queue.close()
+        queue = make_queue(cfg)
+        try:
+            click.echo(_json.dumps(queue.status(), indent=1))
+        finally:
+            queue.close()
+    except Exception as e:
+        try:
+            path = queue_path(cfg)
+        except ValueError:
+            path = None
+        click.echo(_json.dumps(
+            {"path": path, "error": f"{type(e).__name__}: {e}"}, indent=1))
+        raise SystemExit(3)
 
 
 @fleet.command("requeue")

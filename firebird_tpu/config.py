@@ -75,6 +75,10 @@ KNOBS = (
          help="ingest source: chipmunk | synthetic | file"),
     Knob(name="FIREBIRD_SOURCE_PATH", field="source_path",
          help="file-source archive directory (FIREBIRD_SOURCE=file)"),
+    Knob(name="FIREBIRD_SYNTH_SENSOR", field="synth_sensor",
+         help="sensor spec the synthetic source generates "
+              "(ccd.sensor.SENSORS; landsat-ard-tiny = fleet-scale "
+              "test chips)"),
     Knob(name="FIREBIRD_BAND_PARALLELISM", field="band_parallelism",
          help="concurrent per-chip band fetches"),
     Knob(name="FIREBIRD_CHIPS_PER_BATCH", field="chips_per_batch",
@@ -151,6 +155,15 @@ KNOBS = (
     Knob(name="FIREBIRD_FLEET_MAX_ATTEMPTS", field="fleet_max_attempts",
          help="job attempts (failures or expired leases) before "
               "dead-lettering"),
+    Knob(name="FIREBIRD_FLEET_MIN_WORKERS", field="fleet_min_workers",
+         help="supervisor floor: workers kept alive even when the "
+              "queue is idle (0 = scale-to-zero)"),
+    Knob(name="FIREBIRD_FLEET_MAX_WORKERS", field="fleet_max_workers",
+         help="supervisor ceiling: batch workers the supervisor may "
+              "run concurrently"),
+    Knob(name="FIREBIRD_FLEET_GRACE_SEC", field="fleet_grace_sec",
+         help="seconds a retiring worker gets to finish its lease "
+              "after SIGTERM before the supervisor SIGKILLs it"),
     # ---- alerting (Config-backed; docs/ALERTS.md) ----
     Knob(name="FIREBIRD_ALERTS", field="alerts_enabled", default="1",
          help="0 disables alerting: stream emission AND the serve "
@@ -266,6 +279,8 @@ KNOBS = (
          help="postmortem-smoke artifact directory"),
     Knob(name="FIREBIRD_FLEET_DIR", default="/tmp/fb_fleet",
          help="fleet-chaos artifact directory"),
+    Knob(name="FIREBIRD_ELASTIC_DIR", default="/tmp/fb_elastic",
+         help="elastic-soak artifact directory"),
     Knob(name="FIREBIRD_ALERT_DIR", default="/tmp/fb_alerts",
          help="alert-soak artifact directory"),
     Knob(name="FIREBIRD_STREAMFLEET_DIR", default="/tmp/fb_streamfleet",
@@ -318,6 +333,13 @@ class Config:
     # Ingest source: 'chipmunk' (HTTP, ard_url/aux_url) | 'synthetic' | 'file'
     source_backend: str = "chipmunk"
     source_path: str = "."
+
+    # Sensor spec the SYNTHETIC source generates chips for
+    # (ccd.sensor.SENSORS).  The kernel/pack path is data-driven, so a
+    # tiny spec (landsat-ard-tiny, 10x10 px) runs full-CONUS fleet
+    # drills through every production code path at smoke cost
+    # (tools/elastic_soak.py).  Real sources ignore it.
+    synth_sensor: str = "landsat-ard"
 
     # Host-side ingest parallelism (reference: INPUT_PARTITIONS, default 1,
     # "controls parallel requests to chipmunk")
@@ -501,6 +523,20 @@ class Config:
     # dead-letters instead of crash-looping the fleet.
     fleet_max_attempts: int = 3
 
+    # ---- elastic fleet supervisor (fleet/supervisor.py;
+    # docs/ROBUSTNESS.md "Elastic operation") ----
+    # Worker-count bounds for `firebird fleet supervise`: the policy
+    # sizes the batch fleet from queue pressure between these.  min 0
+    # (the default) is scale-to-zero: an idle queue costs nothing.
+    fleet_min_workers: int = 0
+    fleet_max_workers: int = 8
+
+    # Graceful-drain deadline: a retiring worker gets SIGTERM (finish
+    # the current lease, exit) and this many seconds before SIGKILL —
+    # safe either way, PR 9 fencing already rejects a straggler's
+    # writes.
+    fleet_grace_sec: float = 30.0
+
     # ---- alerting (firebird_tpu.alerts; docs/ALERTS.md) ----
     # Alerting (FIREBIRD_ALERTS, default on): a confirmed tail break
     # appends one durable record to the alert log next to the store,
@@ -588,6 +624,16 @@ class Config:
                 f"FIREBIRD_DTYPE must be float32 or float64, got "
                 f"{self.dtype!r} (bfloat16 is rejected: ordinal days have a "
                 "bf16 ulp of 4096 days)")
+        if self.synth_sensor != "landsat-ard":
+            # Lazy import (the faults/slo fail-fast pattern): a typo'd
+            # sensor failing every chunk inside the driver's isolation
+            # would exit "successfully" having detected nothing.
+            from firebird_tpu.ccd.sensor import SENSORS as _SENSORS
+
+            if self.synth_sensor not in _SENSORS:
+                raise ValueError(
+                    f"FIREBIRD_SYNTH_SENSOR must be one of "
+                    f"{sorted(_SENSORS)}, got {self.synth_sensor!r}")
         if self.device_sharding not in ("auto", "off"):
             raise ValueError(
                 "FIREBIRD_DEVICE_SHARDING must be 'auto' or 'off', got "
@@ -658,6 +704,17 @@ class Config:
         if self.fleet_max_attempts < 1:
             raise ValueError("FIREBIRD_FLEET_MAX_ATTEMPTS must be >= 1, "
                              f"got {self.fleet_max_attempts}")
+        if self.fleet_min_workers < 0:
+            raise ValueError("FIREBIRD_FLEET_MIN_WORKERS must be >= 0, "
+                             f"got {self.fleet_min_workers}")
+        if self.fleet_max_workers < max(self.fleet_min_workers, 1):
+            raise ValueError(
+                "FIREBIRD_FLEET_MAX_WORKERS must be >= 1 and >= "
+                f"FIREBIRD_FLEET_MIN_WORKERS ({self.fleet_min_workers}), "
+                f"got {self.fleet_max_workers}")
+        if self.fleet_grace_sec <= 0:
+            raise ValueError("FIREBIRD_FLEET_GRACE_SEC must be > 0 "
+                             f"seconds, got {self.fleet_grace_sec}")
         if self.alert_webhook_timeout <= 0:
             raise ValueError("FIREBIRD_ALERT_WEBHOOK_TIMEOUT must be > 0 "
                              f"seconds, got {self.alert_webhook_timeout}")
@@ -700,6 +757,7 @@ class Config:
             store_path=e.get("FIREBIRD_STORE_PATH", cls.store_path),
             source_backend=e.get("FIREBIRD_SOURCE", cls.source_backend),
             source_path=e.get("FIREBIRD_SOURCE_PATH", cls.source_path),
+            synth_sensor=e.get("FIREBIRD_SYNTH_SENSOR", cls.synth_sensor),
             input_parallelism=int(e.get("INPUT_PARTITIONS", cls.input_parallelism)),
             band_parallelism=int(e.get("FIREBIRD_BAND_PARALLELISM",
                                        cls.band_parallelism)),
@@ -750,6 +808,12 @@ class Config:
                                             cls.fleet_heartbeat_sec)),
             fleet_max_attempts=int(e.get("FIREBIRD_FLEET_MAX_ATTEMPTS",
                                          cls.fleet_max_attempts)),
+            fleet_min_workers=int(e.get("FIREBIRD_FLEET_MIN_WORKERS",
+                                        cls.fleet_min_workers)),
+            fleet_max_workers=int(e.get("FIREBIRD_FLEET_MAX_WORKERS",
+                                        cls.fleet_max_workers)),
+            fleet_grace_sec=float(e.get("FIREBIRD_FLEET_GRACE_SEC",
+                                        cls.fleet_grace_sec)),
             alerts_enabled=e.get("FIREBIRD_ALERTS", "1") not in ("", "0"),
             alert_db=e.get("FIREBIRD_ALERT_DB", cls.alert_db),
             alert_repair=e.get("FIREBIRD_ALERT_REPAIR", "1")

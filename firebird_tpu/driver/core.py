@@ -227,7 +227,9 @@ def make_source(cfg: Config, kind: str | None = None):
                               band_parallelism=cfg.band_parallelism,
                               timeout=cfg.http_timeout)
     if kind == "synthetic":
-        return SyntheticSource(seed=0)
+        from firebird_tpu.ccd.sensor import SENSORS
+
+        return SyntheticSource(seed=0, sensor=SENSORS[cfg.synth_sensor])
     if kind == "file":
         return FileSource(cfg.source_path)
     raise ValueError(f"unknown source backend: {kind!r}")

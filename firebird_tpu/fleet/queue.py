@@ -174,6 +174,21 @@ class FleetQueue:
                 con.execute(
                     "CREATE TABLE IF NOT EXISTS meta ("
                     " key TEXT PRIMARY KEY, value TEXT)")
+                # Worker registry: every `fleet work` process registers
+                # itself here and beats alongside its lease heartbeats —
+                # the table the supervisor adopts orphans from after its
+                # own death, and the per-worker rows behind
+                # `firebird fleet status`.  Clean exits DELETE the row;
+                # a row whose pid is gone is an abnormal exit (the
+                # supervisor prunes it and feeds the crash-loop circuit).
+                con.execute(
+                    "CREATE TABLE IF NOT EXISTS workers ("
+                    " worker_id TEXT PRIMARY KEY,"
+                    " pid INTEGER NOT NULL,"
+                    " kind TEXT NOT NULL DEFAULT 'batch',"
+                    " host TEXT,"
+                    " started REAL, beat REAL,"
+                    " acked INTEGER NOT NULL DEFAULT 0)")
                 con.execute(
                     "INSERT OR IGNORE INTO meta (key, value) VALUES "
                     "('schema', ?), ('fence_seq', '0'), "
@@ -522,9 +537,21 @@ class FleetQueue:
         out.update({s: int(n) for s, n in rows})
         return out
 
-    def drained(self) -> bool:
+    def drained(self, *, batch_only: bool = False) -> bool:
         """True when no job is pending or leased (everything is either
-        done or dead-lettered — the fleet has nothing left to run)."""
+        done or dead-lettered — the fleet has nothing left to run).
+        ``batch_only`` ignores ``stream`` jobs: the supervisor's
+        drain-exit gate — stream lifecycle belongs to the standing
+        streaming fleet, and a watcher continuously enqueuing stream
+        jobs must not pin ``supervise --until-drained`` open forever
+        after the batch backlog is gone."""
+        if batch_only:
+            with self._lock:
+                n = self._con.execute(
+                    "SELECT COUNT(*) FROM jobs WHERE state IN (?, ?) "
+                    "AND job_type != 'stream'",
+                    (PENDING, LEASED)).fetchone()[0]
+            return int(n) == 0
         c = self.counts()
         return c[PENDING] == 0 and c[LEASED] == 0
 
@@ -700,6 +727,11 @@ class FleetQueue:
             "jobs": totals,
             "by_type": by_type,
             "blocked": int(blocked),
+            # Elastic-fleet view (docs/ROBUSTNESS.md "Elastic
+            # operation"): the registered worker rows and the
+            # supervisor's last persisted heartbeat/decision.
+            "workers": self.workers(),
+            "supervisor": self.supervisor_state(),
             "leases": [{"job": int(j), "type": t, "owner": o,
                         "age_sec": round(max(now - (c or now), 0.0), 3),
                         "expires_in_sec": round((e or now) - now, 3),
@@ -710,6 +742,189 @@ class FleetQueue:
             "fence_rejects": rejects,
             "fence_rejects_by_op": dict(sorted(reject_ops.items())),
         }
+
+    # -- worker registry (the supervisor's adoption/heartbeat table) -------
+
+    def worker_register(self, worker_id: str, pid: int, *,
+                        kind: str = "batch",
+                        host: str | None = None) -> None:
+        """Register a live worker process.  Idempotent upsert: a worker
+        re-registering (ops re-arm after a stream job) refreshes its
+        beat without losing its ack tally.  ``started`` refreshes too —
+        worker_id is host:pid, so after a host reboot a recycled pid
+        collides with a crashed worker's durable row, and a stale stamp
+        would make the supervisor's recycled-pid guard prune the LIVE
+        worker (its process 'started after the row was written')."""
+        now = self._clock()
+        with self._lock:
+            self._con.execute(
+                "INSERT INTO workers (worker_id, pid, kind, host, "
+                "started, beat) VALUES (?, ?, ?, ?, ?, ?) "
+                "ON CONFLICT(worker_id) DO UPDATE SET pid = excluded.pid, "
+                "kind = excluded.kind, host = excluded.host, "
+                "started = excluded.started, beat = excluded.beat",
+                (worker_id, int(pid), kind, host, now, now))
+
+    def worker_beat(self, worker_id: str, *,
+                    acked: int | None = None) -> bool:
+        """Refresh a worker's liveness beat (and its ack tally when
+        given).  Returns False when no row matched — the worker may
+        have been pruned by a supervisor that misread its pid as dead;
+        the caller (FleetWorker._worker_beat) re-registers on False so
+        a pruned-but-live worker does not stay invisible (and
+        double-spawned over) forever."""
+        now = self._clock()
+        with self._lock:
+            if acked is None:
+                cur = self._con.execute(
+                    "UPDATE workers SET beat = ? WHERE worker_id = ?",
+                    (now, worker_id))
+            else:
+                cur = self._con.execute(
+                    "UPDATE workers SET beat = ?, acked = ? "
+                    "WHERE worker_id = ?", (now, int(acked), worker_id))
+        return cur.rowcount > 0
+
+    def worker_deregister(self, worker_id: str) -> None:
+        """Clean-exit removal.  A worker that dies without reaching this
+        leaves its row behind — the supervisor reads that as an
+        abnormal exit (crash-loop circuit food)."""
+        with self._lock:
+            self._con.execute("DELETE FROM workers WHERE worker_id = ?",
+                              (worker_id,))
+
+    def workers(self, kind: str | None = None) -> list[dict]:
+        """Registered worker rows, oldest first, with beat age and each
+        worker's current lease (if any) joined in — the per-worker view
+        `firebird fleet status` renders."""
+        now = self._clock()
+        with self._lock:
+            where = "" if kind is None else " WHERE kind = ?"
+            args = () if kind is None else (kind,)
+            rows = self._con.execute(
+                "SELECT worker_id, pid, kind, host, started, beat, acked "
+                f"FROM workers{where} ORDER BY started, worker_id",
+                args).fetchall()
+            leases = {o: (int(j), t, c) for j, t, o, c in self._con.execute(
+                "SELECT id, job_type, owner, claimed FROM jobs "
+                "WHERE state = 'leased'")}
+        out = []
+        for wid, pid, k, host, started, beat, acked in rows:
+            lease = leases.get(wid)
+            out.append({
+                "worker_id": wid, "pid": int(pid), "kind": k, "host": host,
+                "started": started,
+                "up_sec": round(max(now - (started or now), 0.0), 3),
+                "beat_age_sec": round(max(now - (beat or now), 0.0), 3),
+                "acked": int(acked),
+                "lease": None if lease is None else {
+                    "job": lease[0], "type": lease[1],
+                    "age_sec": round(max(now - (lease[2] or now), 0.0), 3)},
+            })
+        return out
+
+    def supervisor_heartbeat(self, state: dict) -> None:
+        """Persist the supervisor's liveness + last decision into the
+        queue's meta table (key ``supervisor``), so `firebird status`,
+        `fleet status`, and /progress can show the control plane from
+        the one shared file — and so a RESTARTED supervisor can tell it
+        is succeeding a dead one rather than racing a live one."""
+        doc = dict(state)
+        doc["beat"] = self._clock()
+        with self._lock:
+            self._con.execute(
+                "INSERT INTO meta (key, value) VALUES ('supervisor', ?) "
+                "ON CONFLICT(key) DO UPDATE SET value = excluded.value",
+                (json.dumps(doc),))
+
+    def supervisor_state(self) -> dict | None:
+        """The last persisted supervisor heartbeat (with ``beat_age_sec``
+        computed against the queue clock), or None when no supervisor
+        ever ran against this queue."""
+        with self._lock:
+            row = self._con.execute(
+                "SELECT value FROM meta WHERE key = 'supervisor'"
+            ).fetchone()
+        if row is None:
+            return None
+        doc = json.loads(row[0])
+        beat = doc.get("beat")
+        if beat is not None:
+            doc["beat_age_sec"] = round(max(self._clock() - beat, 0.0), 3)
+        return doc
+
+    # -- scale snapshot (the policy's one atomic input) --------------------
+
+    def scale_snapshot(self, *, window_sec: float = 60.0):
+        """One atomic :class:`~firebird_tpu.fleet.policy.QueueSnapshot`
+        of queue pressure: depth by type/state, claimable count, oldest
+        lease age, dead letters, and the trailing-window drain rate
+        (acks/sec derived from done-job ``updated`` stamps) — all read
+        in a single transaction so the policy never mixes readings from
+        different moments.  ``stream`` jobs are split out: standing
+        stream capacity is provisioned separately from batch drain
+        capacity (fleet/policy.py)."""
+        from firebird_tpu.fleet.policy import QueueSnapshot
+
+        now = self._clock()
+        with self._lock:
+            con = self._con
+            con.execute("BEGIN IMMEDIATE")
+            try:
+                by = con.execute(
+                    "SELECT job_type, state, COUNT(*) FROM jobs "
+                    "GROUP BY job_type, state").fetchall()
+                claimable = con.execute(
+                    "SELECT COUNT(*) FROM jobs j WHERE "
+                    "(state = 'pending' OR (state = 'leased' AND "
+                    "lease_expires < ?)) AND job_type != 'stream' "
+                    "AND NOT EXISTS (SELECT 1 FROM deps d JOIN jobs b "
+                    "ON b.id = d.needs WHERE d.job_id = j.id "
+                    "AND b.state != 'done')", (now,)).fetchone()[0]
+                blocked = con.execute(
+                    "SELECT COUNT(*) FROM jobs j WHERE state = 'pending' "
+                    "AND EXISTS (SELECT 1 FROM deps d JOIN jobs b "
+                    "ON b.id = d.needs WHERE d.job_id = j.id "
+                    "AND b.state != 'done')").fetchone()[0]
+                # LIVE leases only: an expired lease is claimable work
+                # (counted above) — counting it here too would double
+                # it in the policy's backlog after a mass worker kill.
+                live_leased = con.execute(
+                    "SELECT COUNT(*) FROM jobs WHERE state = 'leased' "
+                    "AND lease_expires >= ? AND job_type != 'stream'",
+                    (now,)).fetchone()[0]
+                oldest = con.execute(
+                    "SELECT MIN(claimed) FROM jobs WHERE state = 'leased'"
+                ).fetchone()[0]
+                acked = con.execute(
+                    "SELECT COUNT(*) FROM jobs WHERE state = 'done' "
+                    "AND updated >= ?", (now - window_sec,)).fetchone()[0]
+                con.execute("COMMIT")
+            except BaseException:
+                con.execute("ROLLBACK")
+                raise
+        by_type: dict[str, dict] = {}
+        for jtype, state, n in by:
+            by_type.setdefault(jtype, {s: 0 for s in STATES})[state] = int(n)
+        def total(state: str, *, stream: bool) -> int:
+            return sum(int(c.get(state, 0)) for t, c in by_type.items()
+                       if (t == "stream") == stream)
+        return QueueSnapshot(
+            at=now,
+            by_type=by_type,
+            claimable=int(claimable),
+            pending=total(PENDING, stream=False),
+            leased=int(live_leased),
+            dead=total(DEAD, stream=False) + total(DEAD, stream=True),
+            blocked=int(blocked),
+            oldest_lease_age_sec=round(max(now - oldest, 0.0), 3)
+            if oldest is not None else 0.0,
+            drain_rate_per_sec=int(acked) / window_sec
+            if window_sec > 0 else 0.0,
+            drain_window_sec=float(window_sec),
+            stream_open=total(PENDING, stream=True)
+            + total(LEASED, stream=True),
+        )
 
     def close(self) -> None:
         with self._lock:

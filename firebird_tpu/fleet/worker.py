@@ -53,6 +53,13 @@ from firebird_tpu.obs import tracing
 from firebird_tpu.store import AsyncWriter, open_store
 
 
+# `fleet work`/`fleet supervise` exit status for a WEDGED queue
+# (pending jobs all blocked behind dead deps — an operator must
+# requeue).  The supervisor's reaper treats it as a deliberate
+# self-report, never crash-loop-circuit food.
+WEDGED_EXIT = 4
+
+
 def make_queue(cfg: Config, clock=time.time) -> FleetQueue:
     """The config's queue: FIREBIRD_FLEET_DB (or next to the store),
     with the config's lease length."""
@@ -71,9 +78,11 @@ class FleetWorker:
 
     def __init__(self, cfg: Config, queue: FleetQueue, *,
                  worker_id: str | None = None, handlers: dict | None = None,
-                 poll_sec: float = 1.0, clock=time.time, sleep=time.sleep):
+                 poll_sec: float = 1.0, kind: str = "batch",
+                 clock=time.time, sleep=time.sleep):
         self.cfg = cfg
         self.queue = queue
+        self.kind = kind
         self.worker_id = worker_id or \
             f"{socket.gethostname()}:{os.getpid()}"
         self.poll_sec = float(poll_sec)
@@ -129,10 +138,26 @@ class FleetWorker:
         or the process is signalled."""
         executed = 0
         wedged = False
+        # Register in the queue's worker table (docs/ROBUSTNESS.md
+        # "Elastic operation"): the supervisor's adoption source and
+        # `fleet status`'s per-worker rows.  Registration failure must
+        # not stop a worker from draining — it just becomes invisible
+        # to the elastic layer.
+        try:
+            self.queue.worker_register(self.worker_id, os.getpid(),
+                                       kind=self.kind, host=jsonlog.HOST)
+        except Exception as e:
+            self.log.warning("worker registration failed (%s: %s)",
+                             type(e).__name__, e)
         while (max_jobs is None or executed < max_jobs) \
                 and not (stop is not None and stop.is_set()):
             lease = self.queue.claim(self.worker_id)
             if lease is None:
+                # Beat on the idle branches too: an idle --hold-idle /
+                # --forever worker would otherwise read as dead in
+                # `fleet status` (beat_age growing for hours) and could
+                # never run the re-register-on-pruned recovery below.
+                self._worker_beat()
                 if forever:
                     self._sleep(self.poll_sec)
                     continue
@@ -156,12 +181,35 @@ class FleetWorker:
                 continue
             self.execute(lease)
             executed += 1
+            self._worker_beat()
         summary = {"worker": self.worker_id, "executed": executed,
                    "wedged": wedged, **self.tallies,
                    "queue": self.queue.counts(),
                    "fence_rejects": self.queue.fence_rejects()}
+        # Clean exit: the registry row goes away.  A worker that dies
+        # before reaching this leaves its row behind — that is the
+        # supervisor's abnormal-exit signal (crash-loop circuit).
+        try:
+            self.queue.worker_deregister(self.worker_id)
+        except Exception:
+            pass
         self.log.info("fleet worker done: %s", summary)
         return summary
+
+    def _worker_beat(self) -> None:
+        """Refresh this worker's registry row (liveness + ack tally);
+        best-effort — a locked queue just ages the beat.  A beat that
+        matches no row means a supervisor pruned us (a recycled-pid or
+        EPERM misread): re-register, or this live worker stays
+        invisible to adoption and gets double-spawned over."""
+        try:
+            if not self.queue.worker_beat(self.worker_id,
+                                          acked=self.tallies["acked"]):
+                self.queue.worker_register(self.worker_id, os.getpid(),
+                                           kind=self.kind,
+                                           host=jsonlog.HOST)
+        except Exception:
+            pass
 
     def execute(self, lease: Lease) -> None:
         """One leased job end-to-end: heartbeat thread up, handler run
@@ -261,6 +309,9 @@ class FleetWorker:
             if self._lease_inj is not None:
                 self._lease_inj.fire()
             self.queue.heartbeat(lease)
+            # Piggyback the worker-registry beat on the lease beat so a
+            # long job's row stays fresh in `fleet status`.
+            self._worker_beat()
             return True
         except LeaseLost:
             return None

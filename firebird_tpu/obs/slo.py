@@ -36,7 +36,7 @@ that never served a request.  ``FIREBIRD_SLO=0`` disables evaluation.
 from __future__ import annotations
 
 DEFAULT_SPEC = ("batch_p95=30;serve_p99=2;freshness=600;"
-                "alert_freshness=60;changefeed_lag=10")
+                "alert_freshness=60;changefeed_lag=10;drain_eta=3600")
 
 # name -> (kind, metric/field, stat, description)
 OBJECTIVES = {
@@ -68,6 +68,14 @@ OBJECTIVES = {
     "changefeed_lag": ("gauge", "serve_changefeed_lag_seconds", None,
                        "replica changefeed apply lag seconds "
                        "(newest-applied record age at last poll)"),
+    # The elastic-fleet promise (docs/ROBUSTNESS.md "Elastic
+    # operation"): at the capacity the supervisor is running, the open
+    # batch backlog drains within the target.  The gauge is the
+    # supervisor's per-tick open-work / trailing-ack-rate estimate; a
+    # run with no supervisor has no gauge and reports no_data.
+    "drain_eta": ("gauge", "queue_drain_eta_seconds", None,
+                  "estimated seconds to drain the open batch backlog "
+                  "at the observed ack rate"),
 }
 
 

@@ -49,6 +49,18 @@ CLOSED, HALF_OPEN, OPEN = 0, 1, 2
 _STATE_NAMES = {CLOSED: "closed", HALF_OPEN: "half_open", OPEN: "open"}
 
 
+def decorrelated_delay(prev: float, *, base: float, cap: float,
+                       rng: random.Random | None = None) -> float:
+    """One decorrelated-jitter backoff step (the AWS result): uniform
+    over ``[base, 3 * prev]``, capped.  THE repo's backoff primitive —
+    :class:`RetryPolicy` draws every retry delay through it, and the
+    fleet supervisor's crash-loop circuit (fleet/policy.py) draws its
+    park backoff the same way, so concurrent retriers / respawned
+    worker slots decohere instead of thundering in lockstep."""
+    r = rng if rng is not None else random
+    return min(float(cap), r.uniform(float(base), max(prev * 3, base)))
+
+
 class RetryBudget:
     """A run-wide ceiling on total retries, shared across threads and
     retry sites (ingest fetches, store writes).  ``limit <= 0`` means
@@ -253,12 +265,12 @@ class RetryPolicy:
         (self._sleep or time.sleep)(delay)
 
     def _next_delay(self, prev: float) -> float:
-        # Decorrelated jitter: uniform over [base, 3*prev], capped —
-        # concurrent threads' retries decohere instead of synchronizing
-        # into repeated thundering herds against a browned-out service.
+        # Decorrelated jitter so concurrent threads' retries decohere
+        # instead of synchronizing into repeated thundering herds
+        # against a browned-out service.
         with self._rng_lock:
-            return min(self.cap, self._rng.uniform(self.base,
-                                                   max(prev * 3, self.base)))
+            return decorrelated_delay(prev, base=self.base, cap=self.cap,
+                                      rng=self._rng)
 
     def run(self, log, what: str, fn):
         """fn() under the policy; raises the last error when attempts,

@@ -66,13 +66,21 @@ PAGE = 1000
 def changefeed_db_path(cfg) -> str | None:
     """``cfg.changefeed_db`` when set, else ``changefeed.db`` next to
     the results store (the fleet.db placement rule); None — feed
-    disabled — for the memory backend without an explicit path."""
+    disabled — for the memory backend without an explicit path.
+
+    The derived default requires the store to actually EXIST on disk:
+    every legitimate producer/consumer (serve, products.save, repair)
+    opens the store first, while a default-constructed Config in a
+    stray cwd must not scatter ``changefeed.db`` files into
+    directories that have no store at all (the repo-root litter bug)."""
     if getattr(cfg, "changefeed_db", ""):
         return cfg.changefeed_db
     from firebird_tpu.driver import quarantine as qlib
 
     d = qlib._artifact_dir(cfg)
-    return None if d is None else os.path.join(d, "changefeed.db")
+    if d is None or not os.path.exists(cfg.store_path):
+        return None
+    return os.path.join(d, "changefeed.db")
 
 
 def default_replica_id(cfg=None) -> str:

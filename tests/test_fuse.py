@@ -112,6 +112,7 @@ def _assert_identical(on, off):
                                       err_msg=f)
 
 
+@pytest.mark.slow  # ~41s (two extra full kernel shapes); tier-1 keeps the compact-ON golden — the production configuration — and `make test` / fuse-smoke still run this compaction-free pure-kernel leg
 def test_fused_golden_compact_off():
     """The headline contract: fused on/off byte-identical with
     compaction off (no permutation in play — pure kernel equality)."""

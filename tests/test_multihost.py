@@ -73,6 +73,7 @@ def _run_children(tmp_path, tag, cmd_for, env_for, n=2, timeout=1800):
     return outs
 
 
+@pytest.mark.slow  # ~35s (two jax subprocess bring-ups); tier-1 keeps the faster two-proc mesh/report rungs below — `make test` still runs the full end-to-end
 def test_two_process_changedetection(tmp_path):
     store = tmp_path / "mh.db"
     env_base = dict(os.environ)

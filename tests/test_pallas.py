@@ -464,6 +464,7 @@ def test_fit_kernel_in_detect_matches_default(monkeypatch):
     np.testing.assert_array_equal(np.asarray(got.mask), np.asarray(ref.mask))
 
 
+@pytest.mark.slow  # ~67s, the suite's single heaviest test; tier-1 keeps the per-kernel mega rungs (init/fit/tmask parity) and `make test` / fuse-smoke still run the full mega-vs-core equality
 def test_detect_mega_matches_batch_core(monkeypatch):
     """FIREBIRD_PALLAS=mega routes the ENTIRE event loop through the
     whole-loop kernel (one pallas_call, VMEM-resident spectra, per-block

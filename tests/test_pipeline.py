@@ -180,6 +180,12 @@ def test_warm_start_compile_cache_hit_on_second_run(tmp_path):
     acq = "1995-01-01/1995-09-01"
     try:
         assert core.setup_compile_cache(cfg) == str(tmp_path / "cache")
+        # Run 1 must trace from a clean slate: module-level lowering dedup
+        # depends on the in-memory tracing caches, so a run 1 traced with
+        # caches warmed by EARLIER tests (e.g. an x64 driver run) emits a
+        # differently-numbered module — and writes a persistent-cache key
+        # run 2's post-clear_caches canonical trace can never look up.
+        jax.clear_caches()
         obs_metrics.reset_registry()
         t = core.warm_start(cfg, acq)
         assert t is not None
