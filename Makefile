@@ -12,8 +12,8 @@ ACQUIRED ?= 1982-01-01/2017-12-31
 .PHONY: install lint test bench obs-smoke pipeline-smoke chaos-smoke \
         fleet-smoke elastic-smoke serve-smoke pyramid-smoke serve-fleet \
         compact-smoke postmortem-smoke alert-smoke streamfleet-smoke \
-        wire-smoke fuse-smoke fuse-repro image db-up db-schema db-test \
-        db-down changedetection classification clean
+        telemetry-smoke wire-smoke fuse-smoke fuse-repro image db-up \
+        db-schema db-test db-down changedetection classification clean
 
 install:
 	pip install -e . --no-build-isolation
@@ -37,6 +37,7 @@ test: lint
 	$(MAKE) fuse-smoke
 	$(MAKE) alert-smoke
 	$(MAKE) streamfleet-smoke
+	$(MAKE) telemetry-smoke
 	$(MAKE) elastic-smoke
 
 bench:
@@ -175,6 +176,19 @@ alert-smoke:
 # (artifact folded by bench.py next to the e2e block).
 streamfleet-smoke:
 	python tools/stream_fleet_soak.py
+
+# Fleet telemetry-plane drill (docs/OBSERVABILITY.md "Fleet telemetry
+# plane"): a standing watcher + 2-worker fleet over a landing zone, the
+# worker holding the alerting job SIGKILLed mid-lease, a separate
+# deliverer process pushing the webhook backlog — then `firebird trace
+# collect` must merge every process's spool (including the SIGKILLed
+# one's recovered segments) into ONE Perfetto trace where the alerting
+# scene's trace id crosses >=4 OS processes, with a per-alert
+# critical-path breakdown summing to the measured
+# acquisition_to_alert_seconds within 10%; a FIREBIRD_TELEMETRY=0 leg
+# proves disarmed telemetry writes nothing (artifact folded by bench.py).
+telemetry-smoke:
+	python tools/telemetry_smoke.py
 
 image:
 	docker build -f deploy/Dockerfile -t firebird .

@@ -315,6 +315,15 @@ def _streamfleet_fold() -> dict:
                           "stream_fleet_soak.json")
 
 
+def _telemetry_fold() -> dict:
+    """`make telemetry-smoke` evidence (tools/telemetry_smoke.py): one
+    scene's causal chain collected across >=4 OS processes (including a
+    SIGKILLed worker's recovered spool) with the per-alert critical-path
+    breakdown agreeing with the measured acquisition_to_alert_seconds."""
+    return _artifact_fold("telemetry_smoke", "FIREBIRD_TELEMETRY_SMOKE_DIR",
+                          "telemetry_smoke.json")
+
+
 def _acquisition_freshness_block() -> dict:
     """``acquisition_to_alert_p95`` promoted NEXT TO the e2e block: the
     read-side headline is pixels/sec including transfer; the streaming
@@ -1026,6 +1035,11 @@ def measure(cpu_only: bool) -> None:
             # fleet through SIGKILLs: scenes drained exactly-once,
             # packed statestore byte-identity, acquisition->alert SLO).
             **_streamfleet_fold(),
+            # Last telemetry-smoke evidence (one scene's causal chain
+            # collected across >=4 OS processes incl. a SIGKILLed
+            # worker's spool; critical-path breakdown vs measured
+            # acquisition_to_alert agreement).
+            **_telemetry_fold(),
             "streaming_pixels_per_sec": round(stream_rate, 1),
             **s2_detail,
             **hard_detail,

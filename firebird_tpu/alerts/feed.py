@@ -34,6 +34,8 @@ from firebird_tpu import retry as retrylib
 from firebird_tpu.alerts.log import AlertLog
 from firebird_tpu.obs import logger
 from firebird_tpu.obs import metrics as obs_metrics
+from firebird_tpu.obs import spool as obs_spool
+from firebird_tpu.obs import tracing
 
 log = logger("alerts")
 
@@ -150,11 +152,22 @@ class WebhookDeliverer:
                     "cursor": recs[-1]["id"],
                     "alerts": recs,
                 }).encode()
+                # The causal chain's last hop: the batch's distinct trace
+                # ids (stamped at append time) ride the deliver span and
+                # the per-trace delivered marks, closing the scene ->
+                # webhook path in the collected fleet trace.
+                traces = sorted({r["trace"] for r in recs
+                                 if r.get("trace")})
+                dctx = tracing.from_wire(traces[0]) \
+                    if len(traces) == 1 else None
                 try:
-                    status = self.policy.run(
-                        log, f"webhook {sub['url']}",
-                        lambda b=body, u=sub["url"]: self._post(
-                            u, b, self.cfg.alert_webhook_timeout))
+                    with tracing.activate(dctx), tracing.span(
+                            "deliver", subscriber=sub["id"],
+                            records=len(recs)):
+                        status = self.policy.run(
+                            log, f"webhook {sub['url']}",
+                            lambda b=body, u=sub["url"]: self._post(
+                                u, b, self.cfg.alert_webhook_timeout))
                 except Exception as e:
                     self.log.record_failure(sub["id"])
                     obs_metrics.counter(
@@ -179,6 +192,9 @@ class WebhookDeliverer:
                 self.log.advance(sub["id"], cursor)
                 sub = dict(sub, cursor=cursor)
                 delivered += len(recs)
+                for tr in traces:
+                    obs_spool.mark("alert_delivered", trace=tr,
+                                   subscriber=sub["id"], cursor=cursor)
                 obs_metrics.counter(
                     "alert_webhook_delivered_total",
                     help="alert records delivered to webhook "

@@ -99,8 +99,15 @@ class AlertLog:
                     " px INTEGER NOT NULL, py INTEGER NOT NULL,"
                     " break_day REAL NOT NULL,"
                     " score REAL, magnitude REAL,"
-                    " run_id TEXT, detected_at TEXT,"
+                    " run_id TEXT, detected_at TEXT, trace TEXT,"
                     " UNIQUE (px, py, break_day))")
+                # Pre-telemetry logs lack the trace column; adding it is
+                # the only schema migration this log has ever needed, so
+                # a guarded ALTER beats a schema-version dance.
+                cols = {row[1] for row in con.execute(
+                    "PRAGMA table_info(alerts)")}
+                if "trace" not in cols:
+                    con.execute("ALTER TABLE alerts ADD COLUMN trace TEXT")
                 con.execute(
                     "CREATE INDEX IF NOT EXISTS idx_alerts_chip "
                     "ON alerts (cx, cy)")
@@ -124,13 +131,16 @@ class AlertLog:
 
     # -- producer side ------------------------------------------------------
 
-    def append(self, records, *, run_id: str | None = None) -> tuple[int,
-                                                                     int]:
+    def append(self, records, *, run_id: str | None = None,
+               trace: str | None = None) -> tuple[int, int]:
         """Append alert records in ONE transaction; returns (inserted,
         deduped).  Each record: dict with cx, cy, px, py, break_day and
         optional score / magnitude.  Records whose (px, py, break_day)
         key already exists are ignored — stream resume and fleet
-        re-delivery are exactly-once."""
+        re-delivery are exactly-once.  ``trace`` stamps the causal trace
+        id (obs/tracing.py wire format) on every record that doesn't
+        carry its own, so the alert row joins the fleet's cross-process
+        telemetry chain all the way out to webhook delivery."""
         records = list(records)
         if not records:
             return 0, 0
@@ -143,12 +153,13 @@ class AlertLog:
                 for r in records:
                     cur = con.execute(
                         "INSERT OR IGNORE INTO alerts (cx, cy, px, py, "
-                        "break_day, score, magnitude, run_id, detected_at)"
-                        " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                        "break_day, score, magnitude, run_id, detected_at,"
+                        " trace) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
                         (int(r["cx"]), int(r["cy"]), int(r["px"]),
                          int(r["py"]), float(r["break_day"]),
                          float(r.get("score", 1.0)),
-                         float(r.get("magnitude", 0.0)), run_id, now))
+                         float(r.get("magnitude", 0.0)), run_id, now,
+                         r.get("trace", trace)))
                     inserted += cur.rowcount
                 con.execute("COMMIT")
             except BaseException:
@@ -186,7 +197,7 @@ class AlertLog:
 
         limit = max(1, min(int(limit), MAX_PAGE))
         sql = ("SELECT id, cx, cy, px, py, break_day, score, magnitude, "
-               "run_id, detected_at FROM alerts WHERE id > ?")
+               "run_id, detected_at, trace FROM alerts WHERE id > ?")
         args: list = [int(cursor)]
         if bbox is not None:
             minx, miny, maxx, maxy = (float(v) for v in bbox)
@@ -204,14 +215,15 @@ class AlertLog:
             rows = self._con.execute(sql, args).fetchall()
         out = []
         for (rid, cx, cy, px, py, bday, score, mag, run_id,
-             detected_at) in rows:
+             detected_at, trace) in rows:
             out.append({
                 "id": int(rid), "cx": int(cx), "cy": int(cy),
                 "px": int(px), "py": int(py),
                 "break_day": float(bday),
                 "break_date": dt.to_iso(int(bday)),
                 "score": score, "magnitude": mag,
-                "run_id": run_id, "detected_at": detected_at})
+                "run_id": run_id, "detected_at": detected_at,
+                "trace": trace})
         return out
 
     def latest_cursor(self) -> int:

@@ -238,14 +238,18 @@ def watch(x, y, number, acquired_start, interval, once, ops_port):
     from firebird_tpu.obs import jsonlog
     from firebird_tpu.streamops import AcquisitionWatcher
 
+    from firebird_tpu.obs import spool as obs_spool
+
     overrides = {"ops_port": ops_port} if ops_port is not None else {}
     cfg = Config.from_env(**overrides)
     watcher = AcquisitionWatcher(cfg, x, y, number=number,
                                  acquired_start=acquired_start)
     if once:
+        obs_spool.arm(cfg, "watcher")
         try:
             summary = watcher.poll_once()
         finally:
+            obs_spool.disarm()
             watcher.close()
         click.echo(_json.dumps(summary, indent=1))
         return
@@ -258,12 +262,14 @@ def watch(x, y, number, acquired_start, interval, once, ops_port):
     _, srv, wd = core.start_ops(cfg, run_id, "watcher", chips_total=0,
                                 counters=Counters(), run_block=run_block,
                                 streamops=watcher.status)
+    obs_spool.arm(cfg, "watcher", run_id)
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     try:
         summary = watcher.run(interval=interval, stop=stop)
     finally:
+        obs_spool.disarm()
         core.stop_ops(srv, wd)
         watcher.close()
     click.echo(_json.dumps(summary, indent=1))
@@ -500,6 +506,9 @@ def serve(port, host, cache_entries, cache_dir, no_compute, read_only,
         click.echo(f"WARNING: changefeed unavailable "
                    f"({type(e).__name__}: {e}); serving with in-process "
                    "invalidation only", err=True)
+    from firebird_tpu.obs import spool as obs_spool
+
+    obs_spool.arm(cfg, "serve")
     srv = serve_api.start_serve_server(bind_port, service,
                                        host=cfg.serve_host)
     click.echo(f"serving {cfg.store_backend}:{cfg.store_path} "
@@ -510,6 +519,7 @@ def serve(port, host, cache_entries, cache_dir, no_compute, read_only,
     try:
         stop.wait()
     finally:
+        obs_spool.disarm()
         srv.close()
         if consumer is not None:
             consumer.stop()
@@ -875,6 +885,9 @@ def fleet_work(max_jobs, until_drained, forever, hold_idle, drain_on_term,
     queue = make_queue(cfg)
     worker = FleetWorker(cfg, queue, poll_sec=poll,
                          kind="stream" if forever else "batch")
+    from firebird_tpu.obs import spool as obs_spool
+
+    obs_spool.arm(cfg, "worker", worker.run_id)
     stop = threading.Event()
     if forever or hold_idle or drain_on_term:
         signal.signal(signal.SIGINT, lambda *_: stop.set())
@@ -885,6 +898,7 @@ def fleet_work(max_jobs, until_drained, forever, hold_idle, drain_on_term,
                              until_drained=until_drained,
                              forever=forever or hold_idle, stop=stop)
     finally:
+        obs_spool.disarm()
         core.stop_ops(srv, wd)
         queue.close()
     click.echo(_json.dumps(summary, indent=1))
@@ -959,6 +973,9 @@ def fleet_supervise(min_workers, max_workers, until_drained, tick, grace,
     _, srv, wd = core.start_ops(cfg, sup.run_id, "fleet-supervisor",
                                 chips_total=0, counters=Counters(),
                                 run_block=run_block, fleet=sup.fleet_block)
+    from firebird_tpu.obs import spool as obs_spool
+
+    obs_spool.arm(cfg, "supervisor", sup.run_id)
     try:
         summary = sup.run(until_drained=until_drained, stop=stop)
         if stop.is_set() and not sup.drain_out(
@@ -970,6 +987,7 @@ def fleet_supervise(min_workers, max_workers, until_drained, tick, grace,
         click.echo(f"error: {e}", err=True)
         raise SystemExit(3)
     finally:
+        obs_spool.disarm()
         core.stop_ops(srv, wd)
         queue.close()
     click.echo(_json.dumps(summary, indent=1))
@@ -1026,6 +1044,186 @@ def fleet_requeue(job_id, dead):
     finally:
         queue.close()
     click.echo(f"{n} job(s) requeued")
+
+
+@entrypoint.group("trace")
+def trace_group():
+    """Fleet telemetry plane (docs/OBSERVABILITY.md "Fleet telemetry
+    plane"): every fleet-role process spools its spans, causal-chain
+    marks, and metric snapshots to disk; these commands are the read
+    side."""
+
+
+@trace_group.command("collect")
+@click.option("--dir", "-d", "directory", default=None,
+              help="spool directory to collect (default: the configured "
+                   "FIREBIRD_TELEMETRY_DIR, else telemetry/ next to the "
+                   "store)")
+@click.option("--out", "-o", default=None,
+              help="write the full collected artifact (Perfetto trace + "
+                   "critical paths + merged metrics) to this JSON path; "
+                   "default telemetry_collect.json in the spool dir")
+def trace_collect(directory, out):
+    """Merge every process's telemetry spool into ONE artifact: a
+    process/thread-aware Perfetto trace where a scene's causal chain
+    (watcher -> queue -> worker -> alert append -> webhook delivery)
+    shares one filterable trace id across OS processes — including
+    segments a SIGKILLed worker left behind — plus per-alert
+    critical-path breakdowns of acquisition_to_alert_seconds and the
+    fleet-merged metric view."""
+    import json as _json
+    import os as _os
+
+    from firebird_tpu.config import Config
+    from firebird_tpu.obs import collect as obs_collect
+    from firebird_tpu.obs import spool as obs_spool
+
+    cfg = Config.from_env()
+    directory = directory or obs_spool.spool_dir(cfg)
+    if directory is None:
+        raise click.ClickException(
+            "no spool directory: pass --dir or set FIREBIRD_TELEMETRY_DIR "
+            "(the memory store backend has no 'next to the store' default)")
+    doc = obs_collect.collect(directory)
+    path = obs_collect.write(
+        doc, out or _os.path.join(directory, "telemetry_collect.json"))
+    click.echo(_json.dumps({
+        "spool_dir": directory,
+        "out": path,
+        "processes": [f"{p['role']}:{p['pid']}" for p in doc["processes"]],
+        "trace_events": len(doc["trace"]["traceEvents"]),
+        "critical_paths": len(doc["critical_paths"]),
+    }, indent=1))
+
+
+def _top_frame(cfg) -> dict:
+    """One `firebird top` sample: queue + alert + telemetry views, each
+    guarded (a locked db or empty spool degrades its section, never the
+    frame)."""
+    import os as _os
+
+    from firebird_tpu.obs import collect as obs_collect
+    from firebird_tpu.obs import spool as obs_spool
+
+    frame: dict = {}
+    try:
+        from firebird_tpu.fleet import make_queue
+
+        queue = make_queue(cfg)
+        try:
+            frame["fleet"] = queue.status()
+        finally:
+            queue.close()
+    except Exception as e:
+        frame["fleet"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        from firebird_tpu.alerts import AlertLog, alert_db_path
+
+        apath = alert_db_path(cfg)
+        if apath is not None and _os.path.exists(apath):
+            al = AlertLog(apath)
+            try:
+                frame["alerts"] = al.status()
+            finally:
+                al.close()
+    except Exception as e:
+        frame["alerts"] = {"error": f"{type(e).__name__}: {e}"}
+    d = obs_spool.spool_dir(cfg)
+    if d is not None and _os.path.isdir(d):
+        try:
+            events = obs_collect.read_events(d)
+            snaps = obs_collect.latest_snapshots(events)
+            frame["telemetry"] = {
+                "spool_dir": d,
+                "snapshots": snaps,
+                "metrics": obs_collect.merge_snapshots(snaps),
+            }
+        except Exception as e:
+            frame["telemetry"] = {"error": f"{type(e).__name__}: {e}"}
+    return frame
+
+
+def _render_top(frame: dict) -> list[str]:
+    """Render one top frame as terminal lines (pure — tested directly)."""
+    import time as _time
+
+    lines = [f"firebird top — {_time.strftime('%H:%M:%S')}"]
+    fl = frame.get("fleet") or {}
+    if "error" in fl:
+        lines.append(f"fleet: unavailable ({fl['error']})")
+    elif fl:
+        jobs = fl.get("jobs") or {}
+        lines.append(
+            "fleet: " + " ".join(f"{k}={jobs.get(k, 0)}" for k in
+                                 ("pending", "leased", "done", "dead"))
+            + f" workers={len(fl.get('workers') or [])}"
+            + f" leases={len(fl.get('leases') or [])}")
+        sup = fl.get("supervisor")
+        if sup:
+            lines.append(
+                f"supervisor: target={sup.get('target')} "
+                f"live={sup.get('live')} "
+                f"last={sup.get('last_decision')}")
+    al = frame.get("alerts") or {}
+    if "error" in al:
+        lines.append(f"alerts: unavailable ({al['error']})")
+    elif al:
+        subs = al.get("subscribers") or []
+        lag = max((s["lag"] for s in subs), default=0)
+        lines.append(f"alerts: depth={al.get('depth')} "
+                     f"cursor={al.get('latest_cursor')} "
+                     f"subscribers={len(subs)} max_lag={lag}")
+    tel = frame.get("telemetry") or {}
+    if "error" in tel:
+        lines.append(f"telemetry: unavailable ({tel['error']})")
+    elif tel:
+        import time as _t
+
+        now = _t.time()
+        for key in sorted(tel.get("snapshots") or {}):
+            s = tel["snapshots"][key]
+            lines.append(f"  {key:<24} snap {now - s['t']:5.1f}s ago")
+        m = tel.get("metrics") or {}
+        for n, v in sorted((m.get("counters") or {}).items()):
+            lines.append(f"  {n:<40} {v:g}")
+        for n, h in sorted((m.get("histograms") or {}).items()):
+            if h.get("count"):
+                lines.append(
+                    f"  {n:<40} n={h['count']} p50={h['p50']:.3g}s "
+                    f"p95={h['p95']:.3g}s max={h['max']:.3g}s")
+    if len(lines) == 1:
+        lines.append("(no fleet, alert, or telemetry state found)")
+    return lines
+
+
+@entrypoint.command()
+@click.option("--interval", "-i", default=2.0, type=float,
+              help="refresh interval, seconds")
+@click.option("--iterations", "-n", default=0, type=int,
+              help="frames to render before exiting (0 = until ctrl-c) "
+                   "— tests and scripts use -n 1")
+def top(interval, iterations):
+    """Live fleet console: one merged view of the queue (depth, leases,
+    supervisor), the alert log (depth, subscriber lag), and the
+    telemetry plane (per-process spool freshness plus fleet-merged
+    counters and histogram percentiles re-derived from bucket counts).
+    Reads only on-disk state — run it anywhere the store is visible."""
+    import time as _time
+
+    from firebird_tpu.config import Config
+
+    cfg = Config.from_env()
+    n = 0
+    while True:
+        click.echo("\n".join(_render_top(_top_frame(cfg))))
+        n += 1
+        if iterations and n >= iterations:
+            break
+        try:
+            _time.sleep(interval)
+        except KeyboardInterrupt:
+            break
+        click.echo("")
 
 
 @entrypoint.command(context_settings=dict(

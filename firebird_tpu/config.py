@@ -143,6 +143,19 @@ KNOBS = (
          help="SLO spec name=target;... (empty = defaults, 0 disables)"),
     Knob(name="FIREBIRD_FLIGHTREC", field="flightrec", default="128",
          help="crash flight-recorder ring size per thread (0 off)"),
+    Knob(name="FIREBIRD_TELEMETRY", field="telemetry", default="4096",
+         help="telemetry spool ring: span/mark events per segment file "
+              "(0 disarms the fleet telemetry plane)"),
+    Knob(name="FIREBIRD_TELEMETRY_SEGMENTS", field="telemetry_segments",
+         default="4",
+         help="telemetry spool segment files per process (bounded ring)"),
+    Knob(name="FIREBIRD_TELEMETRY_DIR", field="telemetry_dir",
+         help="telemetry spool directory (default: telemetry/ next to "
+              "the store)"),
+    Knob(name="FIREBIRD_TELEMETRY_SNAPSHOT_SEC",
+         field="telemetry_snapshot_sec", default="5",
+         help="seconds between metric-registry snapshots into the "
+              "telemetry spool"),
     # ---- fleet work queue (Config-backed; docs/ROBUSTNESS.md) ----
     Knob(name="FIREBIRD_FLEET_DB", field="fleet_db",
          help="fleet job-queue sqlite path (default: fleet.db next to "
@@ -285,6 +298,8 @@ KNOBS = (
          help="alert-soak artifact directory"),
     Knob(name="FIREBIRD_STREAMFLEET_DIR", default="/tmp/fb_streamfleet",
          help="stream-fleet-soak artifact directory"),
+    Knob(name="FIREBIRD_TELEMETRY_SMOKE_DIR", default="/tmp/fb_telemetry",
+         help="telemetry-smoke artifact directory"),
     Knob(name="FIREBIRD_WIRE_DIR", default="/tmp/fb_wire",
          help="wire-smoke artifact directory"),
     Knob(name="FIREBIRD_PYRAMID_DIR", default="/tmp/fb_pyramid",
@@ -472,6 +487,28 @@ class Config:
     # recent spans/logs/progress marks dumped to postmortem.json on
     # unhandled exception, watchdog stall, or SIGTERM.  0 disarms.
     flightrec: int = 128
+
+    # Fleet telemetry spool (obs/spool.py; docs/OBSERVABILITY.md "Fleet
+    # telemetry plane"): every fleet-role process (watcher, worker,
+    # supervisor, deliverer, serve) appends its span/mark events and
+    # periodic metric snapshots to a bounded per-process segment ring
+    # next to the store, so a SIGKILLed worker's telemetry survives it
+    # and `firebird trace collect` can stitch the fleet into one
+    # Perfetto trace.  FIREBIRD_TELEMETRY is the events-per-segment
+    # bound (0 disarms — zero hot-path cost, the tracing no-op gate);
+    # FIREBIRD_TELEMETRY_SEGMENTS bounds the ring's segment-file count.
+    telemetry: int = 4096
+    telemetry_segments: int = 4
+
+    # Spool directory override (FIREBIRD_TELEMETRY_DIR); "" derives
+    # telemetry/ next to the results store (the quarantine.json
+    # placement rule; the memory backend then disables spooling).
+    telemetry_dir: str = ""
+
+    # Seconds between metric-registry snapshots written into the spool
+    # (the counter/gauge/histogram state `firebird top` and the
+    # collector read for a dead process).
+    telemetry_snapshot_sec: float = 5.0
 
     # Active-lane compaction in the CCD event loop (FIREBIRD_COMPACT,
     # default on): dense-prefix lane permutation + per-block skip guards
@@ -674,6 +711,17 @@ class Config:
         if self.flightrec < 0:
             raise ValueError("FIREBIRD_FLIGHTREC must be >= 0 "
                              f"(0 = disarmed), got {self.flightrec}")
+        if self.telemetry < 0:
+            raise ValueError("FIREBIRD_TELEMETRY must be >= 0 "
+                             f"(0 = disarmed), got {self.telemetry}")
+        if self.telemetry_segments < 2:
+            raise ValueError("FIREBIRD_TELEMETRY_SEGMENTS must be >= 2 "
+                             "(one live + one sealed segment), got "
+                             f"{self.telemetry_segments}")
+        if self.telemetry_snapshot_sec <= 0:
+            raise ValueError("FIREBIRD_TELEMETRY_SNAPSHOT_SEC must be "
+                             "> 0 seconds, got "
+                             f"{self.telemetry_snapshot_sec}")
         # Parse the SLO spec now (the FIREBIRD_FAULTS fail-fast
         # rationale): a typo'd objective silently evaluating nothing is
         # worse than a crash at bring-up.  "" and "0" are both valid.
@@ -797,6 +845,14 @@ class Config:
             profile=float(e.get("FIREBIRD_PROFILE", cls.profile)),
             slo=e.get("FIREBIRD_SLO", cls.slo),
             flightrec=int(e.get("FIREBIRD_FLIGHTREC", cls.flightrec)),
+            telemetry=int(e.get("FIREBIRD_TELEMETRY", cls.telemetry)),
+            telemetry_segments=int(e.get("FIREBIRD_TELEMETRY_SEGMENTS",
+                                         cls.telemetry_segments)),
+            telemetry_dir=e.get("FIREBIRD_TELEMETRY_DIR",
+                                cls.telemetry_dir),
+            telemetry_snapshot_sec=float(
+                e.get("FIREBIRD_TELEMETRY_SNAPSHOT_SEC",
+                      cls.telemetry_snapshot_sec)),
             compact=e.get("FIREBIRD_COMPACT", "1") not in ("", "0"),
             pipeline_depth=int(e.get("FIREBIRD_PIPELINE_DEPTH",
                                      cls.pipeline_depth)),

@@ -52,6 +52,7 @@ from firebird_tpu.obs import jsonlog, logger
 from firebird_tpu.obs import metrics as obs_metrics
 from firebird_tpu.obs import report as obs_report
 from firebird_tpu.obs import server as obs_server
+from firebird_tpu.obs import spool as obs_spool
 from firebird_tpu.obs import tracing
 from firebird_tpu.streamops import statestore as sstore_mod
 from firebird_tpu.utils import dates as dt
@@ -359,9 +360,14 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
         # Per-batch TraceContext, carried across the prefetch hop (the
         # batch driver's contract, driver/core.py detect_chunk): spans,
         # queued writes, and JSON log lines of one bootstrap batch all
-        # parent to one <run_id>/b<seq> id.
-        ctxs = [tracing.TraceContext(tracing.new_batch_id(run_id),
-                                     run_id=run_id) for _ in batches]
+        # parent to one <run_id>/b<seq> id.  A fleet-job pass runs
+        # under the WORKER's adopted context (the watcher's per-scene
+        # id, fleet/worker.py) — inherit it instead of minting, so the
+        # whole pass stays on the scene's cross-process causal chain.
+        inherit = tracing.current_context()
+        ctxs = [inherit
+                or tracing.TraceContext(tracing.new_batch_id(run_id),
+                                        run_id=run_id) for _ in batches]
         with cf.ThreadPoolExecutor(
                 max_workers=max(cfg.input_parallelism, 1)) as ex, \
                 cf.ThreadPoolExecutor(max_workers=1) as prefetch_ex:
@@ -480,14 +486,18 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                 # it is what emits alerts (host copy, immune to whatever
                 # the step loop does to the state's buffers).
                 bday0 = np.array(np.asarray(st.break_day), np.float64)
-                for ti in new_idx:
-                    x_row = jnp.asarray(
-                        incremental.design_row(float(t[ti]), anchor))
-                    y_new = jnp.asarray(
-                        p.spectra[0, :, :, ti].T.astype(np.float32))
-                    qa_new = jnp.asarray(p.qas[0, :, ti].astype(np.int32))
-                    st = incremental.step(st, x_row, y_new, qa_new,
-                                          float(t[ti]), sensor=p.sensor)
+                with tracing.span("step", chip=tuple(cid),
+                                  obs=int(new_idx.size)):
+                    for ti in new_idx:
+                        x_row = jnp.asarray(
+                            incremental.design_row(float(t[ti]), anchor))
+                        y_new = jnp.asarray(
+                            p.spectra[0, :, :, ti].T.astype(np.float32))
+                        qa_new = jnp.asarray(
+                            p.qas[0, :, ti].astype(np.int32))
+                        st = incremental.step(st, x_row, y_new, qa_new,
+                                              float(t[ti]),
+                                              sensor=p.sensor)
                 if new_idx.size:
                     side = dict(side, horizon=np.float64(t[-1]))
                     # Alert BEFORE the checkpoint saves: a crash in the
@@ -498,29 +508,46 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
                     if alog is not None:
                         recs = _new_break_records(p, st, bday0, anchor)
                         if recs:
+                            actx = tracing.current_context()
+                            trace_id = actx.batch_id \
+                                if actx is not None else None
                             with tracing.span("alert", chip=tuple(cid),
                                               alerts=len(recs)):
-                                ins, dup = alog.append(recs, run_id=run_id)
+                                ins, dup = alog.append(recs, run_id=run_id,
+                                                       trace=trace_id)
                             obs_metrics.histogram(
                                 "alert_visible_seconds",
                                 help="stream-update ingest start to "
                                      "durable alert commit (the "
                                      "alert_freshness SLO feed)").observe(
                                 time.monotonic() - t_seen)
+                            acq_to_alert = None
                             if published is not None:
                                 # The END-TO-END freshness leg: scene
                                 # publish (the watcher job carries the
                                 # manifest timestamp) to durable alert
                                 # append — queue wait, bootstrap deps,
                                 # fetch and step all included.
+                                acq_to_alert = max(
+                                    time.time() - published, 0.0)
                                 obs_metrics.histogram(
                                     "acquisition_to_alert_seconds",
                                     help="scene publish time to durable "
                                          "alert-log append (the "
                                          "end-to-end alert_freshness "
                                          "SLO feed; docs/STREAMING.md)"
-                                ).observe(
-                                    max(time.time() - published, 0.0))
+                                ).observe(acq_to_alert)
+                            # The causal chain's durable-append joint:
+                            # carries the SAME measured freshness value
+                            # the histogram observed, so the collector's
+                            # critical-path breakdown decomposes exactly
+                            # what was measured (obs/collect.py).
+                            obs_spool.mark(
+                                "alert_appended", trace=trace_id,
+                                chip=list(int(v) for v in cid),
+                                alerts=ins, deduped=dup,
+                                published=published,
+                                acq_to_alert=acq_to_alert)
                             summary["alerts_emitted"] += ins
                             summary["alerts_deduped"] += dup
                     with tracing.span("publish", chip=tuple(cid)), \
@@ -544,8 +571,10 @@ def stream(x, y, acquired: str | None = None, number: int = 2500,
             # The stream's update unit of work is a chip: one
             # TraceContext each, so the delta fetch, publish write, and
             # any failure log line join on one id (the batch driver's
-            # per-batch contract at chip granularity).
-            with tracing.activate(tracing.TraceContext(
+            # per-batch contract at chip granularity).  Under a fleet
+            # job the worker's adopted per-scene context wins — the
+            # update's spans and alert rows stay on the scene's chain.
+            with tracing.activate(inherit or tracing.TraceContext(
                     tracing.new_batch_id(run_id), run_id=run_id)):
                 update_one(cid)
             # Per-chip progress beat: updates are host-cheap, so the

@@ -49,6 +49,7 @@ from firebird_tpu.obs import Counters, jsonlog, logger
 from firebird_tpu.obs import flightrec
 from firebird_tpu.obs import metrics as obs_metrics
 from firebird_tpu.obs import server as obs_server
+from firebird_tpu.obs import spool as obs_spool
 from firebird_tpu.obs import tracing
 from firebird_tpu.store import AsyncWriter, open_store
 
@@ -229,8 +230,20 @@ class FleetWorker:
                               name=f"fleet-heartbeat-{lease.job_id}",
                               daemon=True)
         hb.start()
-        ctx = tracing.TraceContext(tracing.new_batch_id(self.run_id),
-                                   run_id=self.run_id)
+        # Adopt the ENQUEUER's trace context when the payload carries
+        # one (the watcher stamps a per-scene id; queue re-delivery
+        # preserves the payload verbatim) — the job's spans, alert rows,
+        # and log lines then join the scene's cross-process causal
+        # chain.  Payloads without one (operator enqueues, repair jobs)
+        # keep the minted per-job id.
+        wire = lease.payload.get(tracing.TRACE_KEY) \
+            if isinstance(lease.payload, dict) else None
+        ctx = tracing.from_wire(wire, run_id=self.run_id) \
+            or tracing.TraceContext(tracing.new_batch_id(self.run_id),
+                                    run_id=self.run_id)
+        obs_spool.mark("job_claimed", trace=ctx.batch_id,
+                       job=lease.job_id, type=lease.job_type,
+                       fence=lease.fence, attempt=lease.attempts)
         def stop_heartbeat() -> None:
             # BEFORE ack/fail, not just in the finally: a beat racing
             # the resolution finds the lease already cleared and would
@@ -260,6 +273,8 @@ class FleetWorker:
             self.tallies["acked"] += 1
             flightrec.mark("fleet_ack", job=lease.job_id,
                            fence=lease.fence)
+            obs_spool.mark("job_acked", trace=ctx.batch_id,
+                           job=lease.job_id, type=lease.job_type)
             self.log.info("acked job %d (%.2fs)", lease.job_id, tm.elapsed)
         except (StaleFence, LeaseLost) as e:
             # The job is a successor's now: abandon it quietly — no

@@ -44,6 +44,7 @@ DRIVER_SPAN_NAMES = ("fetch", "pack", "stage", "dispatch", "drain", "d2h",
 SPAN_NAMES = (
     "alert",
     "d2h",
+    "deliver",
     "dispatch",
     "drain",
     "fetch",
@@ -53,6 +54,7 @@ SPAN_NAMES = (
     "profile",
     "publish",
     "stage",
+    "step",
     "store_flush",
     "store_write",
     "transfer",

@@ -86,6 +86,37 @@ def new_batch_id(run_id: str | None) -> str:
     return f"{run_id or 'run'}/b{next(_batch_seq)}"
 
 
+# ---------------------------------------------------------------------------
+# Cross-PROCESS trace propagation (the fleet telemetry plane)
+# ---------------------------------------------------------------------------
+# A trace id travels between processes as a plain string: the watcher
+# stamps it into fleet-queue job payloads (key ``trace``), workers adopt
+# it, alert rows persist it, and serve accepts it as an inbound
+# X-Firebird-Trace header.  Wire ids are validated against WIRE_RE
+# before adoption — a job payload and an HTTP header are both untrusted
+# inputs, and an unbounded id would flow into log lines and sqlite rows.
+
+TRACE_KEY = "trace"
+
+import re as _re  # noqa: E402  (scoped import, stdlib only)
+
+WIRE_RE = _re.compile(r"^[A-Za-z0-9._:/\-]{1,160}$")
+
+
+def to_wire(ctx: TraceContext | None) -> str | None:
+    """The propagable form of a context (its batch id), or None."""
+    return None if ctx is None else ctx.batch_id
+
+
+def from_wire(trace, run_id: str | None = None) -> TraceContext | None:
+    """Adopt a trace id that arrived from another process (queue
+    payload, HTTP header).  None — or None-return on a malformed id —
+    means the caller mints its own context instead."""
+    if not isinstance(trace, str) or WIRE_RE.match(trace) is None:
+        return None
+    return TraceContext(trace, run_id=run_id)
+
+
 def current_context() -> TraceContext | None:
     """The TraceContext active on THIS thread (None outside any unit of
     work)."""
@@ -149,6 +180,10 @@ class _Span:
         if rec is not None:
             rec.span_event(self._name, dur * 1e3,
                            ctx.batch_id if ctx is not None else None)
+        sp = _spool
+        if sp is not None:
+            sp.span_event(self._name, dur,
+                          ctx.batch_id if ctx is not None else None)
         return False
 
 
@@ -256,6 +291,21 @@ def set_recorder(rec) -> None:
     _recorder = rec  # firebird-lint: disable=ownership-global-mutation
 
 
+# The durable telemetry spool's span feed (obs/spool.py installs it
+# while armed): a parallel sink to the flight recorder — the recorder
+# keeps a crash-dump ring in memory, the spool appends to disk so a
+# SIGKILLed process's spans survive for `firebird trace collect`.
+_spool = None
+
+
+def set_spool(sp) -> None:
+    """Install/clear the telemetry-spool span sink (None clears)."""
+    global _spool
+    # Single-reference swap from the process-owning thread (spool
+    # arm/disarm); span exits read the reference once.
+    _spool = sp  # firebird-lint: disable=ownership-global-mutation
+
+
 def active() -> Tracer | None:
     return _active
 
@@ -283,10 +333,10 @@ def stop() -> Tracer | None:
 
 
 def span(name: str, **args):
-    """A span against the active tracer (and the armed flight recorder);
-    a shared no-op when both are off."""
+    """A span against the active tracer (and the armed flight recorder
+    and telemetry spool); a shared no-op when all three are off."""
     t = _active
-    if t is None and _recorder is None:
+    if t is None and _recorder is None and _spool is None:
         return _NULL_SPAN
     return _Span(t, name, args)
 

@@ -507,6 +507,17 @@ class _ServeHandler(httpd.JsonHandler):
     server_version = "firebird-serve/1"
     log_category = "serve"
 
+    def _req_ctx(self) -> tracing.TraceContext:
+        """The request's trace context: adopt a well-formed inbound
+        ``X-Firebird-Trace`` (a fleet caller joining its own causal
+        chain — httpd._send echoes it back, so the id round-trips), else
+        mint a fresh ``req-<hex>`` id.  Adoption is per-request and
+        thread-local: requests coalesced by single-flight each keep
+        their OWN id (only the leader's thread runs the fill)."""
+        inbound = tracing.from_wire(self.headers.get("X-Firebird-Trace"))
+        return inbound or tracing.TraceContext(
+            f"req-{uuid.uuid4().hex[:12]}")
+
     def _route(self, path: str, query: dict) -> None:
         svc: ServeService = self.server.service
         if path == "/healthz":
@@ -549,7 +560,7 @@ class _ServeHandler(httpd.JsonHandler):
         if path != "/v1/alerts/webhooks":
             super()._route_post(path, query)
             return
-        ctx = tracing.TraceContext(f"req-{uuid.uuid4().hex[:12]}")
+        ctx = self._req_ctx()
         status = "ok"
         with tracing.activate(ctx):
             try:
@@ -587,7 +598,7 @@ class _ServeHandler(httpd.JsonHandler):
         # server-side trace on one key.  Requests coalesced by
         # single-flight each keep their OWN id (the context is
         # thread-local; only the leader's thread runs the fill).
-        ctx = tracing.TraceContext(f"req-{uuid.uuid4().hex[:12]}")
+        ctx = self._req_ctx()
         with tracing.activate(ctx):
             with obs_metrics.timer() as tm:
                 try:
@@ -853,7 +864,7 @@ class _ServeHandler(httpd.JsonHandler):
         leaked."""
         from firebird_tpu.serve.flight import Deadline
 
-        ctx = tracing.TraceContext(f"req-{uuid.uuid4().hex[:12]}")
+        ctx = self._req_ctx()
         status = "ok"
         with tracing.activate(ctx):
             obs_metrics.counter(

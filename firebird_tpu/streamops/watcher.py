@@ -43,9 +43,12 @@ import sqlite3
 import threading
 import time
 
+import uuid
+
 from firebird_tpu import grid
 from firebird_tpu.obs import logger
 from firebird_tpu.obs import metrics as obs_metrics
+from firebird_tpu.obs import spool as obs_spool
 from firebird_tpu.obs import tracing
 from firebird_tpu.utils import dates as dt
 from firebird_tpu.utils.fn import take
@@ -293,12 +296,21 @@ class AcquisitionWatcher:
         # scans), kept current with this loop's own enqueues.
         open_boot = self.queue.open_jobs("detect")
         open_stream = self.queue.open_jobs("stream")
+        # One trace id per SCENE, minted here and carried in every job
+        # payload the scene produces: the fleet queue round-trips the
+        # payload through claim/re-delivery, the worker adopts the id
+        # (fleet/worker.py), the stream driver stamps it on the alert
+        # row, and the webhook/SSE egress carries it out — one causal
+        # chain from manifest to delivery (docs/OBSERVABILITY.md
+        # "Fleet telemetry plane").
+        trace_id = f"scene/{scene['scene_id']}/{uuid.uuid4().hex[:8]}"
         jobs = 0
         for cx, cy in chips:
             base = {"cx": cx, "cy": cy, "x": self.x, "y": self.y,
                     "acquired": acquired,
                     "scene_id": str(scene["scene_id"]),
-                    "published": float(scene["published"])}
+                    "published": float(scene["published"]),
+                    tracing.TRACE_KEY: trace_id}
             deps = ()
             if not self.sstore.exists((cx, cy)):
                 if (cx, cy) in open_stream \
@@ -329,6 +341,13 @@ class AcquisitionWatcher:
                 open_stream[(cx, cy)] = jid
                 self.tallies["jobs_stream"] += 1
                 jobs += 1
+        if jobs:
+            # The causal chain's first cross-process joint: the
+            # critical-path breakdown reads watch lag (publish ->
+            # enqueue) and queue wait (enqueue -> claim) off this mark.
+            obs_spool.mark("scene_enqueued", trace=trace_id,
+                           scene=str(scene["scene_id"]), jobs=jobs,
+                           published=float(scene["published"]))
         return jobs
 
     def _coverage_sweep(self) -> int:
@@ -363,15 +382,22 @@ class AcquisitionWatcher:
             if horizon is None or horizon >= target:
                 continue        # bootstrap pending, or already covered
             end = dt.to_iso(target + 1)
+            trace_id = (f"scene/{newest['scene_id']}/"
+                        f"sweep-{uuid.uuid4().hex[:8]}")
             jid = self.queue.enqueue_unique_chip(
                 "stream",
                 {"cx": cid[0], "cy": cid[1], "x": self.x, "y": self.y,
                  "acquired": f"{self.acquired_start}/{end}",
                  "scene_id": str(newest["scene_id"]),
                  "published": float(newest["published"]),
-                 "cids": [[cid[0], cid[1]]], "sweep": True},
+                 "cids": [[cid[0], cid[1]]], "sweep": True,
+                 tracing.TRACE_KEY: trace_id},
                 max_attempts=self.cfg.fleet_max_attempts)
             if jid is not None:
+                obs_spool.mark("scene_enqueued", trace=trace_id,
+                               scene=str(newest["scene_id"]), jobs=1,
+                               sweep=True,
+                               published=float(newest["published"]))
                 # Memo ONLY on a real enqueue: an absorbed sweep (open
                 # job) must keep retrying each poll, because the open
                 # job may cover a shorter window than this target.

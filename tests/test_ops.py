@@ -446,6 +446,7 @@ def test_merge_fleet_report_tolerates_missing_host(tmp_path):
 # Driver integration: live surface during a real (synthetic) run
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # ~24s full driver run under a live ops server; `make obs-smoke` polls the same /healthz /readyz /metrics /progress surface mid-run, and the handler unit rungs above stay in tier-1
 def test_driver_serves_ops_surface_during_run(tmp_path):
     """While batches are in flight the endpoints respond; the /progress
     chip totals agree with the final obs_report.json; and the default

@@ -292,6 +292,7 @@ def test_full_pallas_detect_matches_default(monkeypatch):
                                np.asarray(ref.seg_meta), atol=1e-5)
 
 
+@pytest.mark.slow  # ~61s W-unrolled interpret run; tier-1 (-m 'not slow') keeps test_full_pallas_detect_matches_default (the init block runs inside it) and `make test` / fuse-smoke still run this rung
 def test_init_window_matches_init_block():
     """pallas_ops.init_window (interpret) reproduces kernel._init_block
     on randomized mid-loop round states, reading wire int16 spectra."""
@@ -440,6 +441,7 @@ def test_pallas_fit_matches_fit_lasso():
     assert not np.asarray(nr).any()
 
 
+@pytest.mark.slow  # ~65s interpret-mode run; tier-1 (-m 'not slow') keeps test_pallas_fit_matches_fit_lasso + the guarded-fit rungs and `make test` / fuse-smoke still run the fit-in-detect route
 def test_fit_kernel_in_detect_matches_default(monkeypatch):
     """FIREBIRD_PALLAS=fit routes all three batched Lasso fits through the
     fused Pallas kernel; segment decisions must equal the default path."""

@@ -168,6 +168,7 @@ def test_donated_dispatch_matches_and_consumes_inputs(ragged_batch):
                                   np.asarray(seg.n_segments)[:3])
 
 
+@pytest.mark.slow  # ~46s (two full driver runs back-to-back); tier-1 (-m 'not slow') keeps the staging/egress pipeline rungs and `make pipeline-smoke` still proves the second-run compile-cache hit end-to-end
 def test_warm_start_compile_cache_hit_on_second_run(tmp_path):
     """FIREBIRD_COMPILE_CACHE acceptance: run-1 warm compile populates
     the persistent cache (miss counted), and after dropping the in-memory

@@ -539,6 +539,42 @@ def test_request_trace_ids_survive_coalescing(served):
                for e in snap.get("exemplars", ()))
 
 
+def test_inbound_trace_header_adopted(served):
+    """A client's well-formed X-Firebird-Trace is adopted as the
+    request's identity (echoed back verbatim — the fleet telemetry
+    plane's serve hop); malformed ids are ignored and the handler mints
+    its own, and coalesced single-flight followers each keep the id THEY
+    sent, never the leader's."""
+    svc, store, base = served
+
+    def get(path, trace=None):
+        headers = {"X-Firebird-Trace": trace} if trace else {}
+        r = urllib.request.urlopen(
+            urllib.request.Request(base + path, headers=headers),
+            timeout=10)
+        return r.status, dict(r.headers)
+
+    path = f"/v1/segments?cx={CX}&cy={CY}"
+    code, headers = get(path, trace="scene/LC08_X/aa11")
+    assert code == 200
+    assert headers["X-Firebird-Trace"] == "scene/LC08_X/aa11"
+    # malformed ids (WIRE_RE) must not be adopted: spaces, overlength
+    for bad in ("has spaces", "x" * 161):
+        code, headers = get(path, trace=bad)
+        assert code == 200
+        assert headers["X-Firebird-Trace"].startswith("req-")
+    # 8 coalesced cold misses, each with its own client id: one compute,
+    # every follower's echoed id is the one it sent
+    cold = f"/v1/product/ccd?cx={CX}&cy={CY}&date={DATE}"
+    sent = [f"client/{i:02d}/ffee" for i in range(8)]
+    with concurrent.futures.ThreadPoolExecutor(8) as ex:
+        results = [f.result() for f in
+                   [ex.submit(get, cold, t) for t in sent]]
+    assert [code for code, _ in results] == [200] * 8
+    assert obs_metrics.counter("serve_product_computes").value == 1
+    assert [h["X-Firebird-Trace"] for _, h in results] == sent
+
+
 def test_http_degraded_healthz(fresh_metrics):
     svc, store = make_service(
         breaker=CircuitBreaker(1, cooldown_sec=60.0, name="serve-store"))

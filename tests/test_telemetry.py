@@ -1,0 +1,372 @@
+"""Fleet telemetry plane: spool ring, wire-format trace propagation,
+the collector's merged trace + critical-path attribution, and the
+snapshot-rebuilt Prometheus exposition (docs/OBSERVABILITY.md "Fleet
+telemetry plane").  The cross-PROCESS end-to-end drill — a live fleet
+with a SIGKILLed worker — is `make telemetry-smoke`
+(tools/telemetry_smoke.py); these tests pin the unit contracts the
+smoke builds on.
+"""
+
+import json
+import os
+
+import pytest
+
+from firebird_tpu.alerts.log import AlertLog
+from firebird_tpu.fleet.queue import FleetQueue
+from firebird_tpu.obs import collect as obs_collect
+from firebird_tpu.obs import metrics as obs_metrics
+from firebird_tpu.obs import report as obs_report
+from firebird_tpu.obs import spool as obs_spool
+from firebird_tpu.obs import tracing
+
+
+@pytest.fixture
+def fresh_metrics():
+    obs_metrics.reset_registry()
+    yield
+    obs_metrics.reset_registry()
+
+
+@pytest.fixture
+def sink_guard():
+    """Every test that installs the spool span sink must leave the
+    process clean — a leaked sink would spool every later test's spans."""
+    yield
+    tracing.set_spool(None)
+    obs_spool.disarm()
+
+
+# ---------------------------------------------------------------------------
+# Wire format: the trace id as it crosses processes
+# ---------------------------------------------------------------------------
+
+def test_wire_roundtrip():
+    ctx = tracing.TraceContext("scene/LC08_2020-01-01/ab12cd34",
+                               run_id="r1")
+    wire = tracing.to_wire(ctx)
+    assert wire == "scene/LC08_2020-01-01/ab12cd34"
+    back = tracing.from_wire(wire, run_id="r2")
+    assert back is not None and back.batch_id == wire
+    assert back.run_id == "r2"
+    assert tracing.to_wire(None) is None
+
+
+def test_from_wire_rejects_malformed():
+    # Queue payloads and HTTP headers are untrusted: anything outside
+    # WIRE_RE must be refused (the caller then mints its own context).
+    for bad in (None, "", "has space", "semi;colon", "x" * 161,
+                42, {"trace": "scene/x"}, b"scene/x", "new\nline"):
+        assert tracing.from_wire(bad) is None, bad
+    for ok in ("scene/LC08/1a", "req-0f3c", "run.id:7/b3", "a",
+               "x" * 160):
+        assert tracing.from_wire(ok) is not None, ok
+
+
+# ---------------------------------------------------------------------------
+# The spool: bounded ring, crash recovery, zero-cost disarm
+# ---------------------------------------------------------------------------
+
+def test_spool_ring_is_bounded(tmp_path):
+    sp = obs_spool.TelemetrySpool(str(tmp_path), "worker",
+                                  events_per_segment=5, segments=2,
+                                  snapshot_sec=1e9)
+    for i in range(23):
+        sp.mark("tick", trace=f"t/{i}", i=i)
+    sp.close()   # writes the final snapshot line
+    segs = sorted(p.name for p in tmp_path.iterdir())
+    assert segs == [f"spool.worker.{os.getpid()}.{s}.jsonl"
+                    for s in (0, 1)]
+    events = obs_collect.read_events(str(tmp_path))
+    marks = [e for e in events if e["kind"] == "mark"]
+    # the ring kept only the newest <= 2 * 5 events; the oldest rolled off
+    assert 0 < len(marks) <= 10
+    assert max(e["attrs"]["i"] for e in marks) == 22
+    # every surviving event is attributed from its segment header
+    assert all(e["role"] == "worker" and e["pid"] == os.getpid()
+               for e in events)
+
+
+def test_collector_skips_torn_tail_line(tmp_path):
+    sp = obs_spool.TelemetrySpool(str(tmp_path), "worker",
+                                  events_per_segment=100, segments=2,
+                                  snapshot_sec=1e9)
+    sp.mark("whole", trace="t/1")
+    sp.close()
+    path = sp.segment_path(0)
+    with open(path, "a") as f:
+        f.write('{"kind":"mark","name":"torn","t":12')   # SIGKILL mid-write
+    events = obs_collect.read_events(str(tmp_path))
+    names = [e["name"] for e in events if e["kind"] == "mark"]
+    assert names == ["whole"]           # torn line skipped, not fatal
+
+
+def test_spool_captures_spans_with_trace(tmp_path, sink_guard):
+    sp = obs_spool.TelemetrySpool(str(tmp_path), "worker",
+                                  snapshot_sec=1e9)
+    tracing.set_spool(sp)
+    with tracing.activate(tracing.TraceContext("scene/S1/aa")):
+        with tracing.span("fetch", chip=(1, 2)):
+            pass
+    with tracing.span("fetch"):         # outside any context: no trace
+        pass
+    tracing.set_spool(None)
+    sp.close()
+    spans = [e for e in obs_collect.read_events(str(tmp_path))
+             if e["kind"] == "span"]
+    assert [s["trace"] for s in spans] == ["scene/S1/aa", None]
+    assert all(s["name"] == "fetch" and s["dur"] >= 0 for s in spans)
+
+
+def test_arm_disarmed_by_knob_and_memory_backend(tmp_path, sink_guard):
+    from firebird_tpu.config import Config
+
+    base = {"FIREBIRD_STORE_BACKEND": "sqlite",
+            "FIREBIRD_STORE_PATH": str(tmp_path / "store" / "f.db")}
+    cfg = Config.from_env(env=dict(base, FIREBIRD_TELEMETRY="0"))
+    assert obs_spool.arm(cfg, "worker") is None
+    assert obs_spool.active() is None
+    obs_spool.mark("noop", trace="t/1")          # must not throw
+    assert tracing.span("fetch") is tracing._NULL_SPAN   # no-op gate holds
+    assert not (tmp_path / "store" / "telemetry").exists()
+    # the memory backend has no cross-process "next to": spool disabled
+    mcfg = Config.from_env(env={"FIREBIRD_STORE_BACKEND": "memory"})
+    assert obs_spool.spool_dir(mcfg) is None
+    assert obs_spool.arm(mcfg, "worker") is None
+
+
+def test_arm_derives_dir_next_to_store(tmp_path, sink_guard):
+    from firebird_tpu.config import Config
+
+    cfg = Config.from_env(env={
+        "FIREBIRD_STORE_BACKEND": "sqlite",
+        "FIREBIRD_STORE_PATH": str(tmp_path / "store" / "f.db"),
+        "FIREBIRD_TELEMETRY_SNAPSHOT_SEC": "1e9"})
+    sp = obs_spool.arm(cfg, "watcher", "run-1")
+    assert sp is not None
+    assert obs_spool.arm(cfg, "watcher") is sp   # idempotent
+    obs_spool.mark("scene_enqueued", trace="scene/S/1", jobs=2)
+    obs_spool.disarm()
+    d = tmp_path / "store" / "telemetry"
+    events = obs_collect.read_events(str(d))
+    marks = [e for e in events if e["kind"] == "mark"]
+    assert marks and marks[0]["run_id"] == "run-1"
+
+
+# ---------------------------------------------------------------------------
+# Collector: merged Perfetto trace + critical-path attribution
+# ---------------------------------------------------------------------------
+
+def _write_segment(directory, role, pid, lines):
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"spool.{role}.{pid}.0.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "header", "role": role, "pid": pid,
+                            "run_id": f"run-{role}", "segment": 0,
+                            "t": 0.0}) + "\n")
+        for doc in lines:
+            f.write(json.dumps(doc) + "\n")
+
+
+def _fleet_spool(directory):
+    """A hand-built three-process spool for one scene trace: the joints
+    and spans of publish(t=1000) -> append(t=1002) -> deliver(t=1002.5)."""
+    tr = "scene/LC08_X/aa11"
+    _write_segment(directory, "watcher", 11, [
+        {"kind": "mark", "name": "scene_enqueued", "t": 1000.5,
+         "trace": tr, "tid": 1,
+         "attrs": {"scene": "LC08_X", "jobs": 1, "published": 1000.0}}])
+    _write_segment(directory, "worker", 12, [
+        {"kind": "mark", "name": "job_claimed", "t": 1001.0, "trace": tr,
+         "tid": 2, "attrs": {"job": 7}},
+        {"kind": "span", "name": "fetch", "t0": 1001.1, "dur": 0.2,
+         "trace": tr, "tid": 2, "thread": "MainThread"},
+        {"kind": "span", "name": "step", "t0": 1001.4, "dur": 0.3,
+         "trace": tr, "tid": 2, "thread": "MainThread"},
+        {"kind": "span", "name": "alert", "t0": 1001.8, "dur": 0.1,
+         "trace": tr, "tid": 2, "thread": "MainThread"},
+        {"kind": "mark", "name": "alert_appended", "t": 1002.0,
+         "trace": tr, "tid": 2,
+         "attrs": {"chip": [1, 2], "alerts": 5, "deduped": 0,
+                   "published": 1000.0, "acq_to_alert": 2.0}},
+        {"kind": "mark", "name": "job_acked", "t": 1002.1, "trace": tr,
+         "tid": 2, "attrs": {"job": 7}}])
+    _write_segment(directory, "deliverer", 13, [
+        {"kind": "span", "name": "deliver", "t0": 1002.3, "dur": 0.2,
+         "trace": tr, "tid": 3, "thread": "MainThread"},
+        {"kind": "mark", "name": "alert_delivered", "t": 1002.5,
+         "trace": tr, "tid": 3, "attrs": {"subscriber": 1, "cursor": 5}}])
+    return tr
+
+
+def test_collector_merges_processes_into_valid_trace(tmp_path):
+    tr = _fleet_spool(str(tmp_path))
+    doc = obs_collect.collect(str(tmp_path))
+    obs_report.validate_trace(doc["trace"])      # Perfetto-loadable
+    assert [(p["role"], p["pid"]) for p in doc["processes"]] == \
+        [("deliverer", 13), ("watcher", 11), ("worker", 12)]
+    evs = doc["trace"]["traceEvents"]
+    # one process track per pid, named "<role> <pid>"
+    names = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == {"watcher 11", "worker 12", "deliverer 13"}
+    # every span/instant carries the scene's trace id in args — the one
+    # filterable id across all three OS processes
+    tagged = [e for e in evs if e.get("args", {}).get("trace") == tr]
+    pids = {e["pid"] for e in tagged}
+    assert pids == {11, 12, 13}
+    # instants use the Perfetto-required scope field
+    assert all(e.get("s") == "p" for e in evs if e["ph"] == "i")
+
+
+def test_critical_path_stages_sum_exactly(tmp_path):
+    tr = _fleet_spool(str(tmp_path))
+    paths = obs_collect.critical_paths(
+        obs_collect.read_events(str(tmp_path)))
+    assert len(paths) == 1
+    cp = paths[0]
+    assert cp["trace"] == tr and cp["alerts"] == 5
+    assert set(cp["stages"]) == set(obs_collect.CRITICAL_PATH_STAGES)
+    s = cp["stages"]
+    assert s["watch_lag"] == pytest.approx(0.5)
+    assert s["queue_wait"] == pytest.approx(0.5)
+    assert s["fetch"] == pytest.approx(0.2)
+    assert s["step"] == pytest.approx(0.3)
+    assert s["append"] == pytest.approx(0.1)
+    # `other` is the explicit residual, so the stages sum EXACTLY
+    assert sum(s.values()) == pytest.approx(cp["total"], abs=1e-6)
+    assert cp["total"] == pytest.approx(2.0)
+    # the measured histogram observation rides on the mark
+    assert cp["measured_acq_to_alert"] == pytest.approx(2.0)
+    assert cp["delivery"] == pytest.approx(0.5)
+    assert cp["processes"] == ["deliverer:13", "watcher:11", "worker:12"]
+
+
+def test_critical_path_needs_an_append(tmp_path):
+    # a trace that never reached a durable append yields no breakdown
+    _write_segment(str(tmp_path), "watcher", 11, [
+        {"kind": "mark", "name": "scene_enqueued", "t": 1.0,
+         "trace": "scene/never/1", "tid": 1,
+         "attrs": {"published": 0.5}}])
+    assert obs_collect.critical_paths(
+        obs_collect.read_events(str(tmp_path))) == []
+
+
+# ---------------------------------------------------------------------------
+# Metric snapshots: exposition rebuild + fleet percentile re-derivation
+# ---------------------------------------------------------------------------
+
+def test_prometheus_rebuilt_from_spool_snapshot(tmp_path, fresh_metrics,
+                                                sink_guard):
+    obs_metrics.counter("fetch_retries").inc(3)
+    obs_metrics.gauge("store_queue_depth").set(2)
+    h = obs_metrics.histogram("pipeline_fetch_seconds")
+    for v in (0.01, 0.2, 1.5):
+        h.observe(v)
+    sp = obs_spool.TelemetrySpool(str(tmp_path), "worker",
+                                  snapshot_sec=1e9)
+    sp.close()                                    # close() snapshots
+    snaps = obs_collect.latest_snapshots(
+        obs_collect.read_events(str(tmp_path)))
+    (snap,) = snaps.values()
+    text = obs_metrics.prometheus_from_snapshot(snap["metrics"])
+    for line in text.splitlines():
+        assert obs_metrics.PROM_LINE_RE.match(line), line
+    # catalog help + shared naming rules: the rebuilt exposition IS the
+    # scrape the live process would have served
+    assert text == obs_metrics.get_registry().prometheus()
+    assert 'firebird_pipeline_fetch_seconds_bucket{le="+Inf"} 3' in text
+
+
+def test_fleet_merge_rederives_percentiles(fresh_metrics):
+    # two "processes": disjoint observation sets, same fixed buckets
+    a_obs = [0.01, 0.02, 0.05, 0.1]
+    b_obs = [0.5, 1.0, 2.0, 5.0, 9.0]
+    h = obs_metrics.histogram("pipeline_drain_seconds")
+    for v in a_obs:
+        h.observe(v)
+    obs_metrics.gauge("stream_chips").set(3)
+    obs_metrics.gauge("store_queue_depth").set(1)
+    snap_a = obs_metrics.get_registry().snapshot()
+    obs_metrics.reset_registry()
+    h = obs_metrics.histogram("pipeline_drain_seconds")
+    for v in b_obs:
+        h.observe(v)
+    obs_metrics.gauge("stream_chips").set(4)
+    obs_metrics.gauge("store_queue_depth").set(5)
+    snap_b = obs_metrics.get_registry().snapshot()
+    merged = obs_collect.merge_snapshots({
+        "worker:1": {"t": 1.0, "metrics": snap_a},
+        "worker:2": {"t": 2.0, "metrics": snap_b}})
+    mh = merged["histograms"]["pipeline_drain_seconds"]
+    assert mh["count"] == len(a_obs) + len(b_obs)
+    assert mh["sum"] == pytest.approx(sum(a_obs) + sum(b_obs))
+    # percentiles re-derive from the ADDED bucket counts: identical to a
+    # single registry that observed every value itself
+    obs_metrics.reset_registry()
+    h = obs_metrics.histogram("pipeline_drain_seconds")
+    for v in a_obs + b_obs:
+        h.observe(v)
+    ref = obs_metrics.histogram("pipeline_drain_seconds").snapshot()
+    for q in ("p50", "p95", "p99"):
+        assert mh[q] == pytest.approx(ref[q]), q
+    assert mh["bucket_counts"] == ref["bucket_counts"]
+    # gauges merge per the declared policy: stream_* sums, depths max
+    assert merged["gauges"]["stream_chips"] == 7
+    assert merged["gauges"]["store_queue_depth"] == 5
+
+
+# ---------------------------------------------------------------------------
+# Propagation surfaces: queue payloads and alert rows
+# ---------------------------------------------------------------------------
+
+def test_queue_payload_trace_survives_redelivery(tmp_path):
+    clock = [1000.0]
+    q = FleetQueue(str(tmp_path / "fleet.db"), lease_sec=30.0,
+                   clock=lambda: clock[0])
+    tr = "scene/LC08_X/aa11"
+    q.enqueue("stream", {"cx": 1, "cy": 2, tracing.TRACE_KEY: tr})
+    lease = q.claim("w1")
+    assert lease.payload[tracing.TRACE_KEY] == tr
+    clock[0] += 31.0                 # the SIGKILLed worker's lease lapses
+    lease2 = q.claim("w2")           # re-delivery, fresh fence
+    assert lease2.job_id == lease.job_id and lease2.fence != lease.fence
+    assert lease2.payload[tracing.TRACE_KEY] == tr   # verbatim round-trip
+    q.ack(lease2)
+    q.close()
+
+
+def test_alert_rows_carry_trace_and_migrate(tmp_path):
+    import sqlite3
+
+    path = str(tmp_path / "alerts.db")
+    log = AlertLog(path)
+    tr = "scene/LC08_X/aa11"
+    rec = {"cx": 1, "cy": 2, "px": 10, "py": 20, "break_day": 730000.0}
+    log.append([rec], run_id="r1", trace=tr)
+    # a record carrying its OWN trace wins over the batch default
+    log.append([dict(rec, px=11, trace="scene/other/bb22")], trace=tr)
+    rows = log.since(0)
+    assert [r["trace"] for r in rows] == [tr, "scene/other/bb22"]
+    log.close()
+    # pre-telemetry schema (no trace column) migrates on open
+    old = str(tmp_path / "old.db")
+    con = sqlite3.connect(old)
+    con.execute("CREATE TABLE alerts ("
+                " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+                " cx INTEGER NOT NULL, cy INTEGER NOT NULL,"
+                " px INTEGER NOT NULL, py INTEGER NOT NULL,"
+                " break_day REAL NOT NULL,"
+                " score REAL, magnitude REAL,"
+                " run_id TEXT, detected_at TEXT,"
+                " UNIQUE (px, py, break_day))")
+    con.execute("INSERT INTO alerts (cx, cy, px, py, break_day) "
+                "VALUES (1, 2, 3, 4, 729000.0)")
+    con.commit()
+    con.close()
+    mig = AlertLog(old)
+    rows = mig.since(0)
+    assert [r["trace"] for r in rows] == [None]      # legacy row readable
+    mig.append([dict(rec, px=12)], trace=tr)
+    assert mig.since(0)[-1]["trace"] == tr           # new rows stamped
+    mig.close()

@@ -25,7 +25,10 @@ fleet (the drill)
 
 Every JAX leg is a SUBPROCESS and the parent stays JAX-free (forking
 workers from a parent with live XLA threads is how you get glibc heap
-corruption instead of a chaos drill).
+corruption instead of a chaos drill).  A stream subprocess that logs
+"stream complete" and THEN dies of jax 0.4.37's CPU PJRT teardown
+SIGABRT is success-with-a-warning (its outputs are already durable);
+the rc + log evidence lands in the artifact's ``teardown_races``.
 
 Asserts:
 
@@ -88,6 +91,41 @@ def tail(path: str, n: int = 4000) -> str:
             return f.read()[-n:]
     except OSError:
         return "<no log>"
+
+
+# jax 0.4.37's CPU PJRT client can SIGABRT during interpreter teardown
+# (a C++ "terminate called" out of the XLA thread-pool destructor) AFTER
+# the run finished: the driver has already logged "stream complete" and
+# flushed the store/statestore/alert log, so the work product is whole —
+# only the exit status is corrupted.  Classify exactly that signature
+# (nonzero rc + completion marker in the log + an abort fingerprint) as
+# success-with-a-warning, preserving the rc and log evidence in the
+# artifact; ANY other nonzero rc stays fatal.
+TEARDOWN_SIGNATURES = ("terminate called", "SIGABRT",
+                       "Fatal Python error: Aborted")
+
+
+def stream_rc_ok(rc: int, log_path: str, step: str, warnings: list) -> bool:
+    """True if the stream subprocess's work completed: rc 0, or the
+    post-completion PJRT teardown race (recorded into ``warnings``)."""
+    if rc == 0:
+        return True
+    logtxt = tail(log_path, 8000)
+    aborted = rc in (-6, 134) or any(s in logtxt
+                                     for s in TEARDOWN_SIGNATURES)
+    if "stream complete" in logtxt and aborted:
+        warnings.append({
+            "step": step,
+            "rc": rc,
+            "log": os.path.basename(log_path),
+            "log_excerpt": logtxt[-600:],
+        })
+        print(f"streamfleet-smoke: WARNING {step}: stream exited rc={rc} "
+              "AFTER logging 'stream complete' with a PJRT teardown-abort "
+              "signature — outputs are durable; continuing",
+              file=sys.stderr)
+        return True
+    return False
 
 
 def dump_failure(failures, logs) -> int:
@@ -269,16 +307,21 @@ def main() -> int:  # noqa: C901 (one linear drill, read top to bottom)
         os.makedirs(os.path.join(tmp, "serial"), exist_ok=True)
         scfg = Config.from_env(env=env)
         serial_log = os.path.join(tmp, "serial.log")
-        if run_cli(["stream", *stream_base, "-a", boot_acq], env,
-                   serial_log):
+        teardown_races = []
+        if not stream_rc_ok(
+                run_cli(["stream", *stream_base, "-a", boot_acq], env,
+                        serial_log),
+                serial_log, "serial bootstrap", teardown_races):
             print(tail(serial_log), file=sys.stderr)
             return fail("serial bootstrap failed")
         for sid, date in scenes:
             land(archive, cids, full_t, chips, dt.to_ordinal(date),
                  scene=(sid, date))
             end = dt.to_iso(dt.to_ordinal(date) + 1)
-            if run_cli(["stream", *stream_base,
-                        "-a", f"{ACQ_START}/{end}"], env, serial_log):
+            if not stream_rc_ok(
+                    run_cli(["stream", *stream_base,
+                             "-a", f"{ACQ_START}/{end}"], env, serial_log),
+                    serial_log, f"serial update {sid}", teardown_races):
                 print(tail(serial_log), file=sys.stderr)
                 return fail(f"serial update for {sid} failed")
         serial_rows, serial_n = alert_rows(alert_db_path(scfg))
@@ -298,8 +341,10 @@ def main() -> int:  # noqa: C901 (one linear drill, read top to bottom)
         os.makedirs(os.path.join(tmp, "fleet"), exist_ok=True)
         fcfg = Config.from_env(env=env)
         fleet_log = os.path.join(tmp, "fleet_boot.log")
-        if run_cli(["stream", *stream_base, "-a", boot_acq], env,
-                   fleet_log):
+        if not stream_rc_ok(
+                run_cli(["stream", *stream_base, "-a", boot_acq], env,
+                        fleet_log),
+                fleet_log, "fleet bootstrap", teardown_races):
             print(tail(fleet_log), file=sys.stderr)
             return fail("fleet bootstrap failed")
 
@@ -504,6 +549,9 @@ def main() -> int:  # noqa: C901 (one linear drill, read top to bottom)
             "acquisition_to_alert_count": hist.get("count"),
             "slo": {"spec": slo.get("spec"), "ok": slo.get("ok"),
                     "alert_freshness": fresh},
+            # post-completion PJRT teardown aborts tolerated (rc + log
+            # evidence) — empty on a clean run
+            "teardown_races": teardown_races,
             "wall_seconds": round(time.time() - t0, 1),
         }
         art_dir = env_knob("FIREBIRD_STREAMFLEET_DIR")
