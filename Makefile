@@ -12,8 +12,9 @@ ACQUIRED ?= 1982-01-01/2017-12-31
 .PHONY: install lint test bench obs-smoke pipeline-smoke chaos-smoke \
         fleet-smoke elastic-smoke serve-smoke pyramid-smoke serve-fleet \
         compact-smoke postmortem-smoke alert-smoke streamfleet-smoke \
-        telemetry-smoke wire-smoke fuse-smoke fuse-repro image db-up \
-        db-schema db-test db-down changedetection classification clean
+        telemetry-smoke slo-smoke wire-smoke fuse-smoke fuse-repro \
+        image db-up db-schema db-test db-down changedetection \
+        classification clean
 
 install:
 	pip install -e . --no-build-isolation
@@ -38,6 +39,7 @@ test: lint
 	$(MAKE) alert-smoke
 	$(MAKE) streamfleet-smoke
 	$(MAKE) telemetry-smoke
+	$(MAKE) slo-smoke
 	$(MAKE) elastic-smoke
 
 bench:
@@ -189,6 +191,13 @@ streamfleet-smoke:
 # proves disarmed telemetry writes nothing (artifact folded by bench.py).
 telemetry-smoke:
 	python tools/telemetry_smoke.py
+
+# Error-budget plane drill (docs/OBSERVABILITY.md "Error budgets"):
+# fleet + black-box canary prober; injected serve brownout + watcher
+# stall must trip the multi-window burn verdict durably, and metric
+# history must survive a SIGKILLed serving process + a prober restart.
+slo-smoke:
+	python tools/slo_smoke.py
 
 image:
 	docker build -f deploy/Dockerfile -t firebird .

@@ -324,6 +324,16 @@ def _telemetry_fold() -> dict:
                           "telemetry_smoke.json")
 
 
+def _slo_fold() -> dict:
+    """`make slo-smoke` evidence (tools/slo_smoke.py): the black-box
+    canary catching an injected serve brownout and watcher stall, the
+    multi-window burn verdict tripping inside its deadline, the durable
+    budget-event transitions, and metric history surviving a SIGKILLed
+    serving process plus a prober restart."""
+    return _artifact_fold("slo_smoke", "FIREBIRD_SLO_DIR",
+                          "slo_smoke.json")
+
+
 def _acquisition_freshness_block() -> dict:
     """``acquisition_to_alert_p95`` promoted NEXT TO the e2e block: the
     read-side headline is pixels/sec including transfer; the streaming
@@ -1040,6 +1050,10 @@ def measure(cpu_only: bool) -> None:
             # worker's spool; critical-path breakdown vs measured
             # acquisition_to_alert agreement).
             **_telemetry_fold(),
+            # Last slo-smoke evidence (black-box canary vs injected
+            # serve brownout + watcher stall; burn verdict trip time,
+            # durable budget events, history through SIGKILL/restart).
+            **_slo_fold(),
             "streaming_pixels_per_sec": round(stream_rate, 1),
             **s2_detail,
             **hard_detail,

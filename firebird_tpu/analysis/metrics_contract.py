@@ -375,3 +375,112 @@ def check_spans(ctx: LintContext) -> None:
             ctx.emit("span-doc-stale", rel, line,
                      f"docs span table declares {pat!r} but "
                      "SPAN_NAMES has no such span")
+
+
+# ---------------------------------------------------------------------------
+# SLO objectives: obs/slo.py OBJECTIVES + default specs vs live metrics
+# ---------------------------------------------------------------------------
+
+SLO_MODULE = "firebird_tpu/obs/slo.py"
+
+_SPEC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*")
+
+
+def slo_objectives(ctx: LintContext) -> dict[str, tuple[str, list, int]]:
+    """The ``OBJECTIVES`` literal parsed from obs/slo.py source:
+    objective name -> (kind, [metric names], line).  A tuple metric
+    field (a histogram fallback chain, or a ratio's numerator/
+    denominator pair) contributes every member."""
+    src = ctx.source(SLO_MODULE)
+    if src is None:
+        return {}
+    out: dict[str, tuple[str, list, int]] = {}
+    for node in src.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "OBJECTIVES"
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                continue
+            if not (isinstance(v, ast.Tuple) and len(v.elts) >= 2):
+                continue
+            kind = v.elts[0].value \
+                if isinstance(v.elts[0], ast.Constant) else ""
+            met = v.elts[1]
+            if isinstance(met, ast.Constant) and isinstance(met.value,
+                                                            str):
+                names = [met.value]
+            elif isinstance(met, (ast.Tuple, ast.List)):
+                names = [e.value for e in met.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str)]
+            else:
+                names = []
+            out[k.value] = (str(kind), names, k.lineno)
+    return out
+
+
+def _spec_literal(ctx: LintContext, var: str) -> tuple[str, int] | None:
+    """A module-level string-constant assignment in obs/slo.py
+    (implicit concatenation folds to one Constant): (value, line)."""
+    src = ctx.source(SLO_MODULE)
+    if src is None:
+        return None
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == var \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            return node.value.value, node.lineno
+    return None
+
+
+@rule("metrics-contract", {
+    "slo-metric-unknown":
+        "SLO objective reads a metric no call site registers",
+    "slo-spec-unknown":
+        "SLO spec names an objective missing from OBJECTIVES",
+})
+def check_slo_objectives(ctx: LintContext) -> None:
+    """The SLO layer's names agree with the metric registry: every
+    OBJECTIVES metric (histogram/gauge/ratio kinds; watchdog fields are
+    report-block keys, not registry instruments) must have a live
+    registration site or a METRIC_HELP entry — a typo'd objective
+    metric silently evaluates as no-data forever, which the no-data-is-
+    zero-burn budget rule would hide indefinitely.  And every objective
+    name the default specs (DEFAULT_SPEC, DEFAULT_BUDGET_SPEC) mention
+    must exist in OBJECTIVES."""
+    objectives = slo_objectives(ctx)
+    if not objectives:
+        return  # fixture repos without the SLO module don't enforce
+    live = [s.name for s in collect_sites(ctx)]
+    catalog = help_catalog(ctx)
+    for name, (kind, metric_names, line) in sorted(objectives.items()):
+        if kind == "watchdog":
+            continue
+        for m in metric_names:
+            if not any(_pattern_match(p, m) for p in live) \
+                    and not any(_pattern_match(p, m) for p in catalog):
+                ctx.emit("slo-metric-unknown", SLO_MODULE, line,
+                         f"objective {name!r} reads metric {m!r} but "
+                         "no call site registers it and METRIC_HELP "
+                         "has no entry — it would evaluate as no-data "
+                         "forever")
+    for var in ("DEFAULT_SPEC", "DEFAULT_BUDGET_SPEC"):
+        lit = _spec_literal(ctx, var)
+        if lit is None:
+            continue
+        spec, line = lit
+        for entry in spec.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            m = _SPEC_NAME_RE.match(entry)
+            if m is None or m.group(0) not in objectives:
+                ctx.emit("slo-spec-unknown", SLO_MODULE, line,
+                         f"{var} entry {entry!r} names no OBJECTIVES "
+                         "key")

@@ -777,6 +777,37 @@ def status(x, y):
             out["serving"] = serving
     except Exception as e:
         out["serving"] = {"error": f"{type(e).__name__}: {e}"}
+    # Error-budget view (docs/OBSERVABILITY.md "Error budgets"): the
+    # multi-window burn verdict over the durable metric series next to
+    # the telemetry spools — read-only here (no event recording; that
+    # belongs to `firebird slo` and the ops endpoint), and guarded like
+    # every other section.
+    try:
+        from firebird_tpu.obs import series as _series
+        from firebird_tpu.obs import slo as _slo
+
+        sstore = _series.open_store(cfg)
+        if sstore is not None:
+            try:
+                sstore.ingest_spools()
+                v = _slo.evaluate_budgets(
+                    sstore.dir, cfg.slo_budget or None,
+                    fast_sec=cfg.slo_fast_sec, slow_sec=cfg.slo_slow_sec,
+                    burn_threshold=cfg.slo_burn)
+            finally:
+                sstore.close()
+            out["budgets"] = {
+                "ok": v["ok"], "violations": v["violations"],
+                "budgets": {b["name"]: {
+                    "ok": b["ok"], "budget_spent": b["budget_spent"],
+                    "exhausted": b["exhausted"], "burning": b["burning"],
+                    "fast_burn": b["fast_burn"],
+                    "slow_burn": b["slow_burn"],
+                    "empty_windows": b["empty_windows"],
+                } for b in v["budgets"]},
+            }
+    except Exception as e:
+        out["budgets"] = {"error": f"{type(e).__name__}: {e}"}
     if x is not None:
         tile = grid.tile(x, y)
         cids = [tuple(int(v) for v in c) for c in grid.chips(tile)]
@@ -1140,7 +1171,62 @@ def _top_frame(cfg) -> dict:
             }
         except Exception as e:
             frame["telemetry"] = {"error": f"{type(e).__name__}: {e}"}
+    # Durable history (obs/series.py): ingest the spools into the
+    # series store (reader-side ingestion — the monitored processes
+    # never pay for history) and pull the busiest metrics' last ~30
+    # fine-resolution buckets for sparklines.  Off (no section) when
+    # telemetry or the series store is disabled.
+    try:
+        import time as _time
+
+        from firebird_tpu.obs import series as obs_series
+
+        sstore = obs_series.open_store(cfg)
+        if sstore is not None:
+            try:
+                sstore.ingest_spools()
+                res = sstore.resolutions[0]
+                now = _time.time()
+                pts = sstore.points(res, now - 30 * res, now)
+            finally:
+                sstore.close()
+            names: dict = {}
+            for p in pts:
+                m = p.get("m") or {}
+                for n in (m.get("counters") or {}):
+                    names.setdefault(n, "counter")
+                for n in (m.get("histograms") or {}):
+                    names.setdefault(n, "histogram")
+            spark = {}
+            for n, kind in names.items():
+                vals = [v for _, v in
+                        obs_series.bucket_series(pts, n, kind, res)]
+                if any(v > 0 for v in vals):
+                    spark[n] = {"kind": kind, "values": vals}
+            frame["series"] = {
+                "res_sec": res,
+                "sparklines": dict(sorted(
+                    spark.items(),
+                    key=lambda kv: -sum(kv[1]["values"]))[:8]),
+            }
+    except Exception as e:
+        frame["series"] = {"error": f"{type(e).__name__}: {e}"}
     return frame
+
+
+_SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values) -> str:
+    """Unicode block sparkline, scaled to the window's max (pure)."""
+    if not values:
+        return ""
+    hi = max(values)
+    if hi <= 0:
+        return _SPARK_GLYPHS[0] * len(values)
+    top = len(_SPARK_GLYPHS) - 1
+    return "".join(
+        _SPARK_GLYPHS[min(int(v / hi * top + 0.5), top)] for v in values)
 
 
 def _render_top(frame: dict) -> list[str]:
@@ -1191,6 +1277,15 @@ def _render_top(frame: dict) -> list[str]:
                 lines.append(
                     f"  {n:<40} n={h['count']} p50={h['p50']:.3g}s "
                     f"p95={h['p95']:.3g}s max={h['max']:.3g}s")
+    sr = frame.get("series") or {}
+    if "error" in sr:
+        lines.append(f"history: unavailable ({sr['error']})")
+    elif sr.get("sparklines"):
+        lines.append(f"history ({sr['res_sec']:g}s buckets, "
+                     "rate per bucket):")
+        for n, s in sorted(sr["sparklines"].items()):
+            lines.append(f"  {n:<40} {_sparkline(s['values'])} "
+                         f"max={max(s['values']):g}")
     if len(lines) == 1:
         lines.append("(no fleet, alert, or telemetry state found)")
     return lines
@@ -1206,8 +1301,10 @@ def top(interval, iterations):
     """Live fleet console: one merged view of the queue (depth, leases,
     supervisor), the alert log (depth, subscriber lag), and the
     telemetry plane (per-process spool freshness plus fleet-merged
-    counters and histogram percentiles re-derived from bucket counts).
-    Reads only on-disk state — run it anywhere the store is visible."""
+    counters and histogram percentiles re-derived from bucket counts,
+    with sparkline history from the durable series store).  Reads only
+    the fleet's on-disk state — run it anywhere the store is visible
+    (the series store it refreshes lives next to the spools)."""
     import time as _time
 
     from firebird_tpu.config import Config
@@ -1224,6 +1321,133 @@ def top(interval, iterations):
         except KeyboardInterrupt:
             break
         click.echo("")
+
+
+@entrypoint.command()
+@click.option("--budget", "-b", default=None,
+              help="objective spec override ('name[<thr]@target/window;"
+                   "...'); default FIREBIRD_SLO_BUDGET, else the "
+                   "built-in spec; '0' disables")
+@click.option("--fast", default=None, type=float,
+              help="fast burn window seconds (default "
+                   "FIREBIRD_SLO_FAST_SEC)")
+@click.option("--slow", default=None, type=float,
+              help="slow burn window seconds (default "
+                   "FIREBIRD_SLO_SLOW_SEC)")
+@click.option("--burn", default=None, type=float,
+              help="paging burn-rate threshold (default "
+                   "FIREBIRD_SLO_BURN)")
+@click.option("--record/--no-record", default=True,
+              help="append budget state transitions to the durable "
+                   "event log (slo_events.jsonl); --no-record is a "
+                   "pure read")
+def slo(budget, fast, slow, burn, record):
+    """Evaluate the error budgets over the durable metric series.
+
+    Ingests every telemetry spool under the spool home into the series
+    store, evaluates each budget objective's multi-window burn rate
+    (fast AND slow window over threshold pages; cumulative bad over
+    the full window exhausts), records state transitions durably, and
+    prints the verdict as JSON.  Exit status is CI-able: 0 = every
+    budget ok (or no data yet), 1 = a budget burning or exhausted,
+    2 = the series store is disabled.  Fleet verdicts come from the
+    merged per-host series — never one host's view
+    (docs/OBSERVABILITY.md "Error budgets")."""
+    import json as _json
+
+    from firebird_tpu.config import Config
+    from firebird_tpu.obs import series as obs_series
+    from firebird_tpu.obs import slo as obs_slo
+
+    cfg = Config.from_env()
+    store = obs_series.open_store(cfg)
+    if store is None:
+        click.echo(_json.dumps(
+            {"disabled": True,
+             "reason": "series store off (FIREBIRD_TELEMETRY / "
+                       "FIREBIRD_SERIES / no spool home)"}))
+        raise SystemExit(2)
+    try:
+        store.ingest_spools()
+        kw = dict(
+            fast_sec=fast if fast is not None else cfg.slo_fast_sec,
+            slow_sec=slow if slow is not None else cfg.slo_slow_sec,
+            burn_threshold=burn if burn is not None else cfg.slo_burn)
+        spec = budget if budget is not None else (cfg.slo_budget or None)
+        verdict = obs_slo.evaluate_and_record(store.dir, spec, **kw) \
+            if record else obs_slo.evaluate_budgets(store.dir, spec, **kw)
+    finally:
+        store.close()
+    click.echo(_json.dumps(verdict, indent=1))
+    if not verdict.get("ok", True):
+        raise SystemExit(1)
+
+
+@entrypoint.command()
+@click.option("--serve-url", default=None,
+              help="serve base URL to probe from outside "
+                   "(e.g. http://127.0.0.1:8080)")
+@click.option("--landing", default=None,
+              help="FileSource landing zone directory — arms the "
+                   "end-to-end alert probe (synthetic scenes through "
+                   "the real watcher/fleet/alert path)")
+@click.option("--x", "-x", default=None, type=float,
+              help="watched tile x (required with --landing)")
+@click.option("--y", "-y", default=None, type=float,
+              help="watched tile y (required with --landing)")
+@click.option("--chip-offset", default=8, type=int,
+              help="first probe chip's index in the tile chip list — "
+                   "reserve probe chips INSIDE the watcher's -n window "
+                   "but past the production chips")
+@click.option("--chips", default=24, type=int,
+              help="probe-chip reserve (each end-to-end alert probe "
+                   "consumes one; the prober stops attempting when "
+                   "spent)")
+@click.option("--interval", "-i", default=None, type=float,
+              help="seconds between probe cycles (default "
+                   "FIREBIRD_PROBE_SEC)")
+@click.option("--timeout", default=None, type=float,
+              help="per-request timeout seconds (default "
+                   "FIREBIRD_PROBE_TIMEOUT)")
+@click.option("--cycles", "-n", default=0, type=int,
+              help="probe cycles before exiting (0 = until "
+                   "SIGTERM/ctrl-c)")
+@click.option("--pyramid-product", default="ccd",
+              help="product name for the pyramid tile probe")
+def probe(serve_url, landing, x, y, chip_offset, chips, interval,
+          timeout, cycles, pyramid_product):
+    """Black-box canary prober (docs/OBSERVABILITY.md "The canary").
+
+    A standalone process that exercises the REAL surfaces from outside
+    — /v1 GETs with ETag revalidation, synthetic scenes through the
+    watcher to SSE alerts, webhook round-trips through the deliverer —
+    and spools probe_* metrics the error budgets read like any other
+    host's.  Outage detection stops depending on the sick process
+    reporting itself."""
+    import json as _json
+    import signal
+    import threading as _threading
+
+    from firebird_tpu.config import Config
+    from firebird_tpu.obs import prober as obs_prober
+
+    cfg = Config.from_env()
+    try:
+        p = obs_prober.CanaryProber(
+            cfg, serve_url=serve_url, landing=landing, x=x, y=y,
+            chip_offset=chip_offset, chips=chips, interval=interval,
+            timeout=timeout, pyramid_product=pyramid_product)
+    except ValueError as e:
+        raise click.BadParameter(str(e))
+    stop = _threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    p.arm()
+    try:
+        p.run(stop=stop, cycles=cycles or None)
+    finally:
+        p.close()
+        click.echo(_json.dumps(p.status()))
 
 
 @entrypoint.command(context_settings=dict(

@@ -141,6 +141,36 @@ KNOBS = (
          help="auto device-profile window seconds at first batch (0 off)"),
     Knob(name="FIREBIRD_SLO", field="slo",
          help="SLO spec name=target;... (empty = defaults, 0 disables)"),
+    Knob(name="FIREBIRD_SLO_BUDGET", field="slo_budget",
+         help="error-budget spec name[<threshold]@target/window;... "
+              "(empty = defaults, 0 disables; obs/slo.py)"),
+    Knob(name="FIREBIRD_SLO_FAST_SEC", field="slo_fast_sec",
+         default="300",
+         help="fast burn-rate window seconds (multi-window paging "
+              "pair's short leg)"),
+    Knob(name="FIREBIRD_SLO_SLOW_SEC", field="slo_slow_sec",
+         default="3600",
+         help="slow burn-rate window seconds (filters one-batch blips)"),
+    Knob(name="FIREBIRD_SLO_BURN", field="slo_burn", default="14.4",
+         help="burn-rate threshold: page when BOTH windows burn this "
+              "many times the budget rate"),
+    Knob(name="FIREBIRD_SERIES", field="series", default="512",
+         help="metric-history ring: points per segment file per "
+              "resolution (0 disables the series store)"),
+    Knob(name="FIREBIRD_SERIES_SEGMENTS", field="series_segments",
+         default="4",
+         help="metric-history segment files per resolution (bounded "
+              "ring)"),
+    Knob(name="FIREBIRD_SERIES_DIR", field="series_dir",
+         help="metric-history directory (default: series/ inside the "
+              "telemetry spool dir)"),
+    Knob(name="FIREBIRD_PROBE_SEC", field="probe_sec", default="10",
+         help="black-box canary probe interval seconds (firebird "
+              "probe; 0 refuses to arm)"),
+    Knob(name="FIREBIRD_PROBE_TIMEOUT", field="probe_timeout",
+         default="30",
+         help="per-probe deadline seconds (request timeout / SSE alert "
+              "wait)"),
     Knob(name="FIREBIRD_FLIGHTREC", field="flightrec", default="128",
          help="crash flight-recorder ring size per thread (0 off)"),
     Knob(name="FIREBIRD_TELEMETRY", field="telemetry", default="4096",
@@ -300,6 +330,8 @@ KNOBS = (
          help="stream-fleet-soak artifact directory"),
     Knob(name="FIREBIRD_TELEMETRY_SMOKE_DIR", default="/tmp/fb_telemetry",
          help="telemetry-smoke artifact directory"),
+    Knob(name="FIREBIRD_SLO_DIR", default="/tmp/fb_slo",
+         help="slo-smoke artifact directory"),
     Knob(name="FIREBIRD_WIRE_DIR", default="/tmp/fb_wire",
          help="wire-smoke artifact directory"),
     Knob(name="FIREBIRD_PYRAMID_DIR", default="/tmp/fb_pyramid",
@@ -482,6 +514,34 @@ class Config:
     # "0" disables evaluation).  Known objectives: batch_p95, serve_p99,
     # freshness.
     slo: str = ""
+
+    # Error budgets over the durable series store (obs/slo.py):
+    # "name[<threshold]@target/window;..." — e.g.
+    # "alert_freshness<60@99.9/28d" budgets 0.1% of 28 days' alert
+    # observations over 60s.  "" = the default budgets, "0" disables.
+    # The fast/slow burn-window pair pages only when BOTH windows burn
+    # >= slo_burn times the budget rate (the multi-window rule: fast
+    # catches cliffs, slow filters blips).
+    slo_budget: str = ""
+    slo_fast_sec: float = 300.0
+    slo_slow_sec: float = 3600.0
+    slo_burn: float = 14.4
+
+    # Durable metric history (obs/series.py): spool snapshots
+    # downsampled into fixed-resolution segment rings that survive
+    # process death.  FIREBIRD_SERIES is the points-per-segment bound
+    # per resolution (0 disables — no series files anywhere);
+    # FIREBIRD_SERIES_SEGMENTS the ring's file count; FIREBIRD_SERIES_DIR
+    # overrides the series/ placement inside the telemetry spool dir.
+    series: int = 512
+    series_segments: int = 4
+    series_dir: str = ""
+
+    # Black-box canary prober (obs/prober.py; `firebird probe`):
+    # interval between probe cycles and the per-probe deadline (request
+    # timeout and the scene-drop -> SSE-alert wait).
+    probe_sec: float = 10.0
+    probe_timeout: float = 30.0
 
     # Crash flight recorder (obs/flightrec.py): per-thread ring size of
     # recent spans/logs/progress marks dumped to postmortem.json on
@@ -729,6 +789,41 @@ class Config:
             from firebird_tpu.obs import slo as _slo
 
             _slo.parse_spec(self.slo)
+        # Same fail-fast for the budget grammar: a typo'd budget
+        # objective silently evaluating as no-data forever is the
+        # exact failure mode the lint rule + this parse close off.
+        if self.slo_budget and self.slo_budget != "0":
+            from firebird_tpu.obs import slo as _slo
+
+            _slo.parse_budget_spec(self.slo_budget)
+        if self.slo_fast_sec <= 0 or self.slo_slow_sec <= 0:
+            raise ValueError(
+                "FIREBIRD_SLO_FAST_SEC / FIREBIRD_SLO_SLOW_SEC must be "
+                f"> 0 seconds, got {self.slo_fast_sec} / "
+                f"{self.slo_slow_sec}")
+        if self.slo_fast_sec >= self.slo_slow_sec:
+            raise ValueError(
+                "FIREBIRD_SLO_FAST_SEC must be shorter than "
+                "FIREBIRD_SLO_SLOW_SEC (the multi-window pair needs "
+                f"two scales), got {self.slo_fast_sec} >= "
+                f"{self.slo_slow_sec}")
+        if self.slo_burn <= 0:
+            raise ValueError("FIREBIRD_SLO_BURN must be > 0, got "
+                             f"{self.slo_burn}")
+        if self.series < 0:
+            raise ValueError("FIREBIRD_SERIES must be >= 0 "
+                             f"(0 = disabled), got {self.series}")
+        if self.series_segments < 2:
+            raise ValueError("FIREBIRD_SERIES_SEGMENTS must be >= 2 "
+                             "(one live + one sealed segment), got "
+                             f"{self.series_segments}")
+        if self.probe_sec < 0:
+            raise ValueError("FIREBIRD_PROBE_SEC must be >= 0 seconds "
+                             f"(0 = prober refuses to arm), got "
+                             f"{self.probe_sec}")
+        if self.probe_timeout <= 0:
+            raise ValueError("FIREBIRD_PROBE_TIMEOUT must be > 0 "
+                             f"seconds, got {self.probe_timeout}")
         if self.stream_statestore not in ("packed", "npz"):
             raise ValueError(
                 "FIREBIRD_STREAM_STATESTORE must be 'packed' or 'npz', "
@@ -844,6 +939,19 @@ class Config:
                                           cls.obs_merge_timeout)),
             profile=float(e.get("FIREBIRD_PROFILE", cls.profile)),
             slo=e.get("FIREBIRD_SLO", cls.slo),
+            slo_budget=e.get("FIREBIRD_SLO_BUDGET", cls.slo_budget),
+            slo_fast_sec=float(e.get("FIREBIRD_SLO_FAST_SEC",
+                                     cls.slo_fast_sec)),
+            slo_slow_sec=float(e.get("FIREBIRD_SLO_SLOW_SEC",
+                                     cls.slo_slow_sec)),
+            slo_burn=float(e.get("FIREBIRD_SLO_BURN", cls.slo_burn)),
+            series=int(e.get("FIREBIRD_SERIES", cls.series)),
+            series_segments=int(e.get("FIREBIRD_SERIES_SEGMENTS",
+                                      cls.series_segments)),
+            series_dir=e.get("FIREBIRD_SERIES_DIR", cls.series_dir),
+            probe_sec=float(e.get("FIREBIRD_PROBE_SEC", cls.probe_sec)),
+            probe_timeout=float(e.get("FIREBIRD_PROBE_TIMEOUT",
+                                      cls.probe_timeout)),
             flightrec=int(e.get("FIREBIRD_FLIGHTREC", cls.flightrec)),
             telemetry=int(e.get("FIREBIRD_TELEMETRY", cls.telemetry)),
             telemetry_segments=int(e.get("FIREBIRD_TELEMETRY_SEGMENTS",

@@ -26,6 +26,15 @@ scope target            what the injector wraps
                         injected failure drops the beat, so the lease ages
                         toward expiry — ``lease:p=1`` models a worker
                         partitioned from the queue (a zombie)
+``serve``               ``/v1`` request handling (serve/api.py): an injected
+                        failure answers 503 — ``serve:after=K,brownout=M``
+                        models a serving brownout the black-box prober
+                        (obs/prober.py) must detect from outside
+``watch``               ``watcher.poll_once`` (streamops/watcher.py): an
+                        injected failure aborts the poll before any scene
+                        is mapped, so the landing zone backs up — a stalled
+                        watcher the prober's alert probe sees as missed
+                        end-to-end deadlines
 ======================  =====================================================
 
 ======================  =====================================================
@@ -60,7 +69,7 @@ import zlib
 
 from firebird_tpu.obs import metrics as obs_metrics
 
-TARGETS = ("ingest", "aux", "store", "writer", "lease")
+TARGETS = ("ingest", "aux", "store", "writer", "lease", "serve", "watch")
 _KINDS = ("ioerror", "timeout", "conn")
 
 

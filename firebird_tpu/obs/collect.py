@@ -219,6 +219,15 @@ def critical_paths(events: list[dict]) -> list[dict]:
     return out
 
 
+def snap_events(directory: str) -> list[dict]:
+    """Just the metric snapshots under ``directory`` (role/pid
+    attributed, torn lines skipped) — the series store's ingest feed
+    (obs/series.py): history wants every stamped snapshot, not only
+    the newest per process like :func:`latest_snapshots`."""
+    return [ev for ev in read_events(directory)
+            if ev.get("kind") == "snap" and ev.get("pid") is not None]
+
+
 def latest_snapshots(events: list[dict]) -> dict:
     """The newest metric snapshot per process:
     ``{"<role>:<pid>": {"t": ..., "metrics": {...}}}``."""

@@ -293,6 +293,20 @@ METRIC_HELP = {
         "operations rejected for a stale fencing token",
     "fleet_lease_age_seconds": "age of this worker's current fleet lease",
     "fleet_job_seconds_*": "fleet job execution wall time by job type",
+    "probe_attempts": "black-box probes resolved (all surfaces)",
+    "probe_attempts_*": "black-box probes resolved, by surface",
+    "probe_failures":
+        "black-box probes failed (timeout, transport error, or 5xx)",
+    "probe_failures_*": "black-box probe failures, by surface",
+    "probe_etag_304":
+        "probe conditional GETs answered 304 (ETag revalidation "
+        "worked end to end)",
+    "probe_serve_seconds":
+        "black-box serve GET seconds (the outside view of /v1 latency)",
+    "probe_alert_seconds":
+        "black-box scene drop -> SSE alert visibility seconds",
+    "probe_webhook_seconds":
+        "black-box scene drop -> webhook delivery seconds",
 }
 
 

@@ -51,6 +51,7 @@ SPAN_NAMES = (
     "first_dispatch",
     "fleet_job",
     "pack",
+    "probe_cycle",
     "profile",
     "publish",
     "stage",

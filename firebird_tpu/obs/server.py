@@ -328,6 +328,31 @@ def mark_mesh_up() -> None:
         st.mark_mesh_up()
 
 
+def _budget_block() -> dict:
+    """The /slo budgets block: ingest fresh spool snapshots into the
+    series rings, then evaluate + durably record the error budgets for
+    the ambient config.  Raises when the store cannot open — the /slo
+    route degrades that to an error string."""
+    from firebird_tpu.config import Config
+    from firebird_tpu.obs import series as series_mod
+    from firebird_tpu.obs import slo as slomod
+
+    cfg = Config.from_env()
+    store = series_mod.open_store(cfg)
+    if store is None:
+        return {"disabled": True,
+                "reason": "no series store (FIREBIRD_SERIES=0 / "
+                          "FIREBIRD_TELEMETRY=0 / memory backend)"}
+    try:
+        store.ingest_spools()
+        return slomod.evaluate_and_record(
+            store.dir, cfg.slo_budget or None,
+            fast_sec=cfg.slo_fast_sec, slow_sec=cfg.slo_slow_sec,
+            burn_threshold=cfg.slo_burn)
+    finally:
+        store.close()
+
+
 class _OpsHandler(httpd.JsonHandler):
     server_version = "firebird-ops/1"
 
@@ -370,12 +395,24 @@ class _OpsHandler(httpd.JsonHandler):
                               else None)))
         elif path == "/slo":
             from firebird_tpu.obs import slo as slomod
-            self._send_json(200, slomod.evaluate_snapshot(
+            doc = slomod.evaluate_snapshot(
                 obs_metrics.get_registry().snapshot(),
                 watchdog=(st.watchdog.snapshot()
                           if st is not None and st.watchdog is not None
                           else None),
-                spec=st.slo_spec if st is not None else None))
+                spec=st.slo_spec if st is not None else None)
+            # Durable error budgets ride along whenever a series store
+            # exists for this config; a broken store degrades to an
+            # error string, never a dead endpoint (the status-section
+            # rule).  ?budgets=0 skips the disk walk.
+            if (query.get("budgets") or ["1"])[0] not in ("0", "false"):
+                try:
+                    doc["budgets"] = _budget_block()
+                except Exception as e:
+                    doc["budgets"] = {"error": f"{type(e).__name__}: {e}"}
+            self._send_json(200, doc)
+        elif path == "/metrics/history":
+            self._history(query)
         elif path == "/profile":
             # GET reports the windows captured so far (POST starts one).
             from firebird_tpu.obs import profiling
@@ -391,8 +428,55 @@ class _OpsHandler(httpd.JsonHandler):
         else:
             self._send_json(404, {"error": f"unknown path {path!r}",
                                   "paths": ["/healthz", "/readyz", "/metrics",
-                                            "/progress", "/report", "/slo",
-                                            "/profile"]})
+                                            "/metrics/history", "/progress",
+                                            "/report", "/slo", "/profile"]})
+
+    def _history(self, query: dict) -> None:
+        """``/metrics/history?res=&window=&metric=``: windowed points
+        from the durable series rings (obs/series.py) — spools are
+        re-ingested first, so the answer includes snapshots from
+        processes that died since the last read."""
+        import time as _time
+
+        from firebird_tpu.config import Config
+        from firebird_tpu.obs import series as series_mod
+
+        try:
+            res = int((query.get("res") or ["10"])[0])
+            window = float((query.get("window") or ["600"])[0])
+        except ValueError:
+            self._send_json(400, {"error": "res/window must be numbers"})
+            return
+        metric = (query.get("metric") or [None])[0]
+        store = series_mod.open_store(Config.from_env())
+        if store is None:
+            self._send_json(503, {
+                "error": "metric history disabled (FIREBIRD_SERIES=0 / "
+                         "FIREBIRD_TELEMETRY=0) or homeless (memory "
+                         "backend, no FIREBIRD_SERIES_DIR)"})
+            return
+        try:
+            if res not in store.resolutions:
+                self._send_json(400, {
+                    "error": f"unknown resolution {res}s",
+                    "resolutions": list(store.resolutions)})
+                return
+            store.ingest_spools()
+            now = _time.time()
+            pts = store.points(res, now - window, now)
+        finally:
+            store.close()
+        if metric:
+            pts = [dict(p, m={k: {metric: (p.get("m") or {})[k][metric]}
+                              if metric in ((p.get("m") or {}).get(k) or {})
+                              else {}
+                              for k in ("counters", "gauges",
+                                        "histograms")})
+                   for p in pts]
+        self._send_json(200, {
+            "schema": "firebird-metric-history/1", "res_sec": res,
+            "window_sec": window, "t1": now, "metric": metric,
+            "sources": series_mod.sources(pts), "points": pts})
 
     def _route_post(self, path: str, query: dict) -> None:
         from firebird_tpu.obs import profiling

@@ -51,7 +51,8 @@ SPOOL_GLOB = "spool.*.jsonl"
 
 # Fleet roles that arm by default (cli.py): the standing multi-process
 # fleet whose telemetry would otherwise die with each process.
-FLEET_ROLES = ("watcher", "worker", "supervisor", "deliverer", "serve")
+FLEET_ROLES = ("watcher", "worker", "supervisor", "deliverer", "serve",
+               "prober")
 
 
 def spool_dir(cfg) -> str | None:

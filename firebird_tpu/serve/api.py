@@ -163,6 +163,14 @@ class ServeService:
         # owned by the caller (start/stop lifecycles belong to the serve
         # command); mounted here for /healthz context and status.
         self.changefeed = changefeed
+        # Fault seam (faults.py ``serve`` scope): when the plan names
+        # this scope, every /v1 request fires the injector before
+        # admission — an injected failure answers 503, the serving
+        # brownout the black-box prober (obs/prober.py) exists to see.
+        from firebird_tpu import faults
+        plan = faults.FaultPlan.from_config(cfg)
+        self.fault_injector = plan.injector("serve") \
+            if plan is not None else None
         # One tile-model class-order lookup per tile, shared across
         # requests; invalidated wholesale when the tile table changes.
         self._classes: dict = {}
@@ -605,6 +613,8 @@ class _ServeHandler(httpd.JsonHandler):
                     # The deadline starts at ARRIVAL: queue wait +
                     # compute share one budget, so the documented worst
                     # case holds.
+                    if svc.fault_injector is not None:
+                        svc.fault_injector.fire()
                     deadline = Deadline(svc.admission.deadline_sec)
                     with svc.admission.admit(deadline):
                         self._dispatch(svc, path, query, deadline)
@@ -631,6 +641,13 @@ class _ServeHandler(httpd.JsonHandler):
                 except NotFound as e:
                     status = "not_found"
                     self._send_json(404, {"error": str(e)})
+                except OSError as e:
+                    # The injected-fault seam (and any raw transport
+                    # error the layers below didn't classify): an
+                    # outside client sees a 503 — precisely what the
+                    # prober's serve surface must count as a failure.
+                    status = "fault"
+                    self._send_json(503, {"error": str(e)})
             # Observed INSIDE the activation: the latency histogram's
             # exemplars carry this request's trace id.
             obs_metrics.histogram(

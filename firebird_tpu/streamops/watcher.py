@@ -236,6 +236,14 @@ class AcquisitionWatcher:
             sstore_mod.open_statestore(cfg)
         self.cursor = cursor if cursor is not None else \
             SceneCursor(watch_db_path(cfg))
+        # Fault seam (faults.py ``watch`` scope): an injected failure
+        # aborts the poll before any scene is mapped — run() logs and
+        # retries, so a brownout window models a stalled watcher the
+        # prober's end-to-end alert deadline catches from outside.
+        from firebird_tpu import faults
+        plan = faults.FaultPlan.from_config(cfg)
+        self.fault_injector = plan.injector("watch") \
+            if plan is not None else None
         self._clock = clock
         self.tallies = {k: 0 for k in
                         ("polls", "scenes_seen", "scenes_enqueued",
@@ -412,6 +420,8 @@ class AcquisitionWatcher:
         """One manifest poll: list, dedupe, map, enqueue, record.
         Returns a summary dict (also the unit the soak asserts on)."""
         self.tallies["polls"] += 1
+        if self.fault_injector is not None:
+            self.fault_injector.fire()
         since = max(self.cursor.cursor() - LOOKBACK_SEC, 0.0)
         with tracing.span("watch_poll", since=round(since, 3)):
             scenes = sorted(self.source.list_acquisitions(since=since),

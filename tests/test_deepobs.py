@@ -507,6 +507,7 @@ def test_throughput_drop_events_surface_in_degraded_block(fresh_metrics):
 # End-to-end propagation: one batch id across four threads (satellite)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # ~30s (full small changedetection run); telemetry-smoke proves trace propagation across real processes in `make test`
 def test_driver_trace_propagation_end_to_end(tmp_path):
     """A real (small) changedetection run: every pipeline span in
     fetch→pack→stage→dispatch→drain→d2h→store_write carries the SAME

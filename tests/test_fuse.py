@@ -119,6 +119,7 @@ def test_fused_golden_compact_off():
     _assert_identical(_run(True, False), _run(False, False))
 
 
+@pytest.mark.slow  # ~65s (two more full kernel shapes); `make test` / fuse-smoke still dispatch the fused-vs-core comparison on every verify run
 def test_fused_golden_compact_on():
     """Same golden under active-lane compaction: the fused kernel rides
     the dense-prefix permutation and the per-block skip guards without

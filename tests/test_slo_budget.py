@@ -1,0 +1,727 @@
+"""Error-budget plane: the durable metric series store (obs/series.py),
+multi-window burn-rate budgets (obs/slo.py), the black-box canary
+prober's units (obs/prober.py), and their CLI/endpoint surfaces.  The
+cross-PROCESS end-to-end drill — a live fleet with an injected serve
+brownout and a stalled watcher — is `make slo-smoke`
+(tools/slo_smoke.py); these tests pin the unit contracts the smoke
+builds on."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from firebird_tpu.config import Config
+from firebird_tpu.obs import metrics as obs_metrics
+from firebird_tpu.obs import series as obs_series
+from firebird_tpu.obs import slo as slomod
+
+
+@pytest.fixture
+def fresh_metrics():
+    obs_metrics.reset_registry()
+    yield
+    obs_metrics.reset_registry()
+
+
+# A fixed "now" far from the test host's clock: every bucket assertion
+# below only holds if ingestion keys on the EMITTER's stamps.
+T0 = 1_700_000_000.0
+
+
+def _snap(t, role="worker", pid=7, counters=None, gauges=None,
+          hists=None):
+    return {"kind": "snap", "t": t, "role": role, "pid": pid,
+            "metrics": {"counters": counters or {},
+                        "gauges": gauges or {},
+                        "histograms": hists or {}}}
+
+
+def _hist(count, s, bounds, counts):
+    return {"count": count, "sum": s, "bucket_bounds": list(bounds),
+            "bucket_counts": list(counts)}
+
+
+def _write_spool(directory, role, pid, snaps):
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"spool.{role}.{pid}.0.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "header", "role": role, "pid": pid,
+                            "run_id": f"run-{role}", "segment": 0,
+                            "t": 0.0}) + "\n")
+        for doc in snaps:
+            f.write(json.dumps(doc) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# Series store: ring files, idempotency, the emitter-clock rule
+# ---------------------------------------------------------------------------
+
+def test_series_buckets_key_on_emitter_stamps_never_reader_clock(tmp_path):
+    """Regression for the clock-domain rule: a snap line's bucket comes
+    from the wall-clock the EMITTING process stamped, so two hosts with
+    skewed clocks land in their own stamps' buckets and a reader
+    re-ingesting years-old spools reproduces the original timeline —
+    nothing keys on time.time() in the ingesting process."""
+    _write_spool(str(tmp_path), "worker", 42,
+                 [_snap(T0 + 5.0, counters={"c": 1.0})])
+    # a second host, 12h skewed
+    _write_spool(str(tmp_path), "serve", 43,
+                 [_snap(T0 + 43_200.0, counters={"c": 9.0})])
+    store = obs_series.SeriesStore(str(tmp_path / "series"))
+    assert store.ingest_spools(str(tmp_path)) > 0
+    pts = obs_series.read_points(str(tmp_path / "series"), 10)
+    by_src = {p["src"]: p for p in pts}
+    assert by_src["worker:42"]["b"] == int((T0 + 5.0) // 10)
+    assert by_src["serve:43"]["b"] == int((T0 + 43_200.0) // 10)
+    # and none of them anywhere near the reader's own clock
+    assert all(abs(p["b"] * 10 - time.time()) > 86_400 * 365 for p in pts)
+    store.close()
+
+
+def test_series_reingest_is_idempotent_across_restart(tmp_path):
+    events = [_snap(T0 + i * 20.0, counters={"c": float(i)})
+              for i in range(5)]
+    store = obs_series.SeriesStore(str(tmp_path))
+    assert store.ingest_events(events) > 0
+    assert store.ingest_events(events) == 0          # same process
+    store.close()
+    store2 = obs_series.SeriesStore(str(tmp_path))   # restarted reader
+    assert store2.ingest_events(events) == 0         # state from disk
+    store2.close()
+
+
+def test_series_live_bucket_refresh_is_throttled(tmp_path):
+    store = obs_series.SeriesStore(str(tmp_path), resolutions=(80,))
+    assert store.ingest_events([_snap(T0, counters={"c": 1.0})]) == 1
+    # same bucket, under res/8 later: throttled
+    assert store.ingest_events(
+        [_snap(T0 + 5.0, counters={"c": 2.0})]) == 0
+    # same bucket, past the throttle: refreshed
+    assert store.ingest_events(
+        [_snap(T0 + 11.0, counters={"c": 3.0})]) == 1
+    # an older bucket arriving later is immutable past: dropped
+    assert store.ingest_events(
+        [_snap(T0 - 500.0, counters={"c": 0.5})]) == 0
+    store.close()
+
+
+def test_series_segment_ring_is_bounded(tmp_path):
+    store = obs_series.SeriesStore(str(tmp_path), points_per_segment=4,
+                                   segments=2, resolutions=(10,))
+    for i in range(40):
+        store.ingest_events(
+            [_snap(T0 + i * 10.0, counters={"c": float(i)})])
+    store.close()
+    segs = sorted(p.name for p in tmp_path.iterdir())
+    pid = os.getpid()
+    assert segs == [f"series.10.{pid}.{s}.jsonl" for s in (0, 1)]
+    # the ring retains the newest points, oldest truncated away
+    pts = obs_series.read_points(str(tmp_path), 10)
+    assert pts and pts[-1]["b"] == int((T0 + 390.0) // 10)
+    assert len(pts) <= 8
+
+
+def test_read_points_dedupes_and_windows(tmp_path):
+    store = obs_series.SeriesStore(str(tmp_path), resolutions=(10,))
+    store.ingest_events([_snap(T0 + 1.0, counters={"c": 1.0}),
+                         _snap(T0 + 9.0, counters={"c": 2.0}),
+                         _snap(T0 + 21.0, counters={"c": 3.0})])
+    store.close()
+    pts = obs_series.read_points(str(tmp_path), 10)
+    # same bucket collapses keep-latest (batch pre-group)
+    assert [p["m"]["counters"]["c"] for p in pts] == [2.0, 3.0]
+    # (t0, t1] window edges
+    assert obs_series.read_points(str(tmp_path), 10, T0 + 9.0) == pts[1:]
+    assert obs_series.read_points(str(tmp_path), 10, None, T0 + 9.0) \
+        == pts[:1]
+    assert obs_series.sources(pts) == ["worker:7"]
+
+
+def test_counter_window_sums_per_source_deltas():
+    pts = []
+    for t, src, v in ((T0 + 10, "worker:1", 10.0),
+                      (T0 + 100, "worker:1", 30.0),
+                      (T0 + 110, "worker:2", 5.0)):
+        pts.append({"kind": "pt", "res": 10, "b": int(t // 10), "t": t,
+                    "src": src,
+                    "m": {"counters": {"c": v}, "gauges": {},
+                          "histograms": {}}})
+    # worker:1 delta 20 (baseline point at t<=t0), worker:2 born inside
+    # the window baselines at zero: its full cumulative 5 counts
+    assert obs_series.counter_window(pts, "c", T0 + 50, T0 + 200) == 25.0
+    # empty window is no data, never zero activity
+    assert obs_series.counter_window(pts, "c", T0 + 500, T0 + 900) is None
+    assert obs_series.counter_window(pts, "other", T0, T0 + 200) == 0.0
+
+
+def test_hist_window_merges_deltas_and_over_threshold():
+    def pt(t, src, h):
+        return {"kind": "pt", "res": 10, "b": int(t // 10), "t": t,
+                "src": src, "m": {"counters": {}, "gauges": {},
+                                  "histograms": {"h_seconds": h}}}
+
+    pts = [pt(T0 + 10, "a:1", _hist(4, 2.0, (1.0, 5.0), (4, 0, 0))),
+           pt(T0 + 100, "a:1", _hist(10, 20.0, (1.0, 5.0), (6, 2, 2))),
+           pt(T0 + 100, "b:2", _hist(3, 9.0, (1.0, 5.0), (0, 3, 0)))]
+    win = obs_series.hist_window(pts, "h_seconds", T0 + 50, T0 + 200)
+    # a:1 delta (6, [2,2,2]) + b:2 born-inside (3, [0,3,0])
+    assert win["count"] == 9.0
+    assert win["bucket_counts"] == [2.0, 5.0, 2.0]
+    # over 1.0s: everything past the first bucket
+    assert obs_series.hist_over_threshold(win, 1.0) == 7.0
+    assert obs_series.hist_over_threshold(win, 5.0) == 2.0
+    assert obs_series.hist_window(pts, "h_seconds", T0 + 500,
+                                  T0 + 900) is None
+
+
+def test_bucket_series_per_kind():
+    def pt(t, c, g):
+        return {"kind": "pt", "res": 10, "b": int(t // 10), "t": t,
+                "src": "w:1",
+                "m": {"counters": {"c": c}, "gauges": {"g": g},
+                      "histograms": {}}}
+
+    pts = [pt(T0 + 5, 10.0, 1.0), pt(T0 + 15, 25.0, 2.0),
+           pt(T0 + 25, 25.0, 3.0)]
+    # counters render as per-bucket activity deltas
+    assert obs_series.bucket_series(pts, "c", "counter", 10) == [
+        (int(T0 // 10), 10.0), (int(T0 // 10) + 1, 15.0),
+        (int(T0 // 10) + 2, 0.0)]
+    # gauges as the merged in-bucket sample
+    assert [v for _, v in
+            obs_series.bucket_series(pts, "g", "gauge", 10)] == \
+        [1.0, 2.0, 3.0]
+
+
+def test_open_store_zero_cost_paths(tmp_path):
+    assert obs_series.open_store(Config(telemetry=0)) is None
+    assert obs_series.open_store(
+        Config(series=0, series_dir=str(tmp_path))) is None
+    # memory backend without an explicit dir: homeless, disabled
+    assert obs_series.open_store(Config(store_backend="memory")) is None
+    store = obs_series.open_store(Config(series_dir=str(tmp_path)))
+    assert store is not None and store.dir == str(tmp_path)
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# Budget grammar + config fail-fast
+# ---------------------------------------------------------------------------
+
+def test_budget_spec_grammar():
+    (b,) = slomod.parse_budget_spec("alert_freshness<60@99.9/28d")
+    assert b["name"] == "alert_freshness" and b["threshold"] == 60.0
+    assert b["target_pct"] == 99.9 and b["window_sec"] == 28 * 86400.0
+    (r,) = slomod.parse_budget_spec("probe_errors@99/1d")
+    assert r["kind"] == "ratio" and r["threshold"] is None
+    assert slomod.parse_budget_spec("") == []
+    with pytest.raises(ValueError, match="unknown budget objective"):
+        slomod.parse_budget_spec("bogus<1@99/1d")
+    with pytest.raises(ValueError, match="watchdog-kind"):
+        slomod.parse_budget_spec("freshness<600@99/1d")
+    with pytest.raises(ValueError, match="takes no"):
+        slomod.parse_budget_spec("probe_errors<1@99/1d")
+    with pytest.raises(ValueError, match="needs a <threshold"):
+        slomod.parse_budget_spec("serve_p99@99/1d")
+    with pytest.raises(ValueError, match="missing its /window"):
+        slomod.parse_budget_spec("serve_p99<2@99")
+    with pytest.raises(ValueError, match="not\\s+<number>"):
+        slomod.parse_budget_spec("serve_p99<2@99/soon")
+    with pytest.raises(ValueError, match="percentage"):
+        slomod.parse_budget_spec("serve_p99<2@100/1d")
+    # the default spec must parse (the knob's fallback path)
+    assert slomod.parse_budget_spec(slomod.DEFAULT_BUDGET_SPEC)
+
+
+def test_budget_config_fail_fast():
+    Config(slo_budget="serve_p99<2@99/7d")               # valid
+    Config(slo_budget="0")                               # disabled
+    with pytest.raises(ValueError):
+        Config(slo_budget="nope<1@99/1d")
+    with pytest.raises(ValueError, match="two scales"):
+        Config(slo_fast_sec=600.0, slo_slow_sec=600.0)
+    with pytest.raises(ValueError):
+        Config(slo_burn=0.0)
+    with pytest.raises(ValueError):
+        Config(series=-1)
+    with pytest.raises(ValueError):
+        Config(series_segments=1)
+
+
+# ---------------------------------------------------------------------------
+# Budget evaluation: no-data semantics, burn, exhaustion, durable events
+# ---------------------------------------------------------------------------
+
+def test_budget_no_data_contributes_zero_burn(tmp_path):
+    """Satellite contract: an objective whose metric never reported is
+    ok=null with ZERO burn — never a violation, never banked credit —
+    and names its empty windows."""
+    v = slomod.evaluate_budgets(str(tmp_path), "probe_errors@99/1d",
+                                now=T0)
+    assert v["ok"] is True and v["violations"] == 0
+    (b,) = v["budgets"]
+    assert b["ok"] is None and not b["exhausted"] and not b["burning"]
+    assert b["empty_windows"] == ["window", "fast", "slow"]
+    assert b["fast_burn"] is None and b["budget_spent"] is None
+
+
+def test_budget_partial_data_names_empty_windows(tmp_path):
+    """Data old enough to miss the fast window must not page: burning
+    needs BOTH burn windows non-empty, and the report says which window
+    was blind."""
+    store = obs_series.SeriesStore(str(tmp_path))
+    bad = _hist(10, 100.0, (2.0,), (0, 10))    # all observations > 2s
+    store.ingest_events([
+        _snap(T0 - 2000.0, role="serve", pid=9,
+              hists={"serve_request_seconds": _hist(0, 0.0, (2.0,),
+                                                    (0, 0))}),
+        _snap(T0 - 1000.0, role="serve", pid=9,
+              hists={"serve_request_seconds": bad})])
+    store.close()
+    v = slomod.evaluate_budgets(str(tmp_path), "serve_p99<2@99/7d",
+                                now=T0)
+    (b,) = v["budgets"]
+    assert b["empty_windows"] == ["fast"]
+    assert b["burning"] is False               # fast window is blind
+    assert b["exhausted"] is True              # 10 bad of 10 >> 1%
+    assert b["ok"] is False and v["ok"] is False
+
+
+def test_budget_burning_and_exhaustion_from_ratio_counters(tmp_path):
+    """A failing canary: both burn windows over threshold pages, and
+    cumulative bad over the full window exhausts the budget."""
+    store = obs_series.SeriesStore(str(tmp_path))
+    store.ingest_events([
+        _snap(T0 - 3000.0, role="prober", pid=5,
+              counters={"probe_attempts": 10.0, "probe_failures": 0.0}),
+        # the fast-window baseline must sit inside the evaluator's
+        # 2-bucket lookback before the window edge (T0-320 at res 10)
+        _snap(T0 - 310.0, role="prober", pid=5,
+              counters={"probe_attempts": 90.0, "probe_failures": 40.0}),
+        _snap(T0 - 100.0, role="prober", pid=5,
+              counters={"probe_attempts": 100.0,
+                        "probe_failures": 50.0})])
+    store.close()
+    v = slomod.evaluate_budgets(str(tmp_path), "probe_errors@99/1d",
+                                now=T0)
+    assert v["sources"] == ["prober:5"]
+    (b,) = v["budgets"]
+    # fast window: 10 of 10 attempts failed -> burn 100x; slow: 50/100
+    assert b["fast_burn"] == pytest.approx(100.0)
+    assert b["slow_burn"] == pytest.approx(50.0)
+    assert b["burning"] is True
+    assert b["total"] == 100.0 and b["bad"] == 50.0
+    assert b["exhausted"] is True and b["budget_spent"] == 50.0
+    assert b["ok"] is False and v["violations"] == 1
+
+    # a healthy canary over the same shape: no page, budget intact
+    for f in tmp_path.iterdir():
+        f.unlink()
+    store = obs_series.SeriesStore(str(tmp_path))
+    store.ingest_events([
+        _snap(T0 - 3000.0, role="prober", pid=5,
+              counters={"probe_attempts": 10.0, "probe_failures": 0.0}),
+        _snap(T0 - 100.0, role="prober", pid=5,
+              counters={"probe_attempts": 500.0,
+                        "probe_failures": 0.0})])
+    store.close()
+    v = slomod.evaluate_budgets(str(tmp_path), "probe_errors@99/1d",
+                                now=T0)
+    (b,) = v["budgets"]
+    assert b["ok"] is True and b["fast_burn"] == 0.0
+
+
+def test_budget_events_record_transitions_only(tmp_path):
+    def verdict(state):
+        return {"budgets": [{
+            "name": "probe_errors", "bad": 5.0, "total": 10.0,
+            "allowed_bad": 0.1, "window_sec": 300.0,
+            "fast_burn": 50.0, "slow_burn": 50.0,
+            "exhausted": state == "exhausted",
+            "burning": state in ("burning", "exhausted"),
+            "ok": None if state == "no_data" else state == "ok"}]}
+
+    d = str(tmp_path)
+    # ok with no prior trouble: not an incident, nothing recorded
+    assert slomod.record_budget_events(d, verdict("ok"), now=T0) == []
+    (ev,) = slomod.record_budget_events(d, verdict("burning"), now=T0)
+    assert ev["state"] == "burning" and ev["prev"] is None
+    # steady state repeats are not re-recorded
+    assert slomod.record_budget_events(d, verdict("burning"),
+                                       now=T0 + 1) == []
+    (ev2,) = slomod.record_budget_events(d, verdict("exhausted"),
+                                         now=T0 + 2)
+    assert ev2["state"] == "exhausted" and ev2["prev"] == "burning"
+    (ev3,) = slomod.record_budget_events(d, verdict("ok"), now=T0 + 3)
+    assert ev3["state"] == "ok" and ev3["prev"] == "exhausted"
+    # ok <-> no_data flaps are not an incident timeline
+    assert slomod.record_budget_events(d, verdict("no_data"),
+                                       now=T0 + 4) == []
+    states = [e["state"] for e in slomod.read_budget_events(d)]
+    assert states == ["burning", "exhausted", "ok"]
+    # and the log survives a torn tail line
+    with open(slomod.budget_events_path(d), "a") as f:
+        f.write('{"name": "torn')
+    assert [e["state"] for e in slomod.read_budget_events(d)] == states
+
+
+def test_evaluate_and_record_appends_durably(tmp_path):
+    store = obs_series.SeriesStore(str(tmp_path))
+    store.ingest_events([
+        _snap(T0 - 700.0, role="prober", pid=5,
+              counters={"probe_attempts": 0.0, "probe_failures": 0.0}),
+        _snap(T0 - 100.0, role="prober", pid=5,
+              counters={"probe_attempts": 10.0,
+                        "probe_failures": 10.0})])
+    store.close()
+    v = slomod.evaluate_and_record(str(tmp_path), "probe_errors@99/1d",
+                                   now=T0)
+    assert v["ok"] is False
+    assert [e["state"] for e in v["events_appended"]] in \
+        (["burning"], ["exhausted"])
+    assert slomod.read_budget_events(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# Gauge-kind SLO inputs through the snapshot-rebuilt exposition
+# ---------------------------------------------------------------------------
+
+def test_prometheus_from_snapshot_gauge_byte_identity(fresh_metrics):
+    """The changefeed_lag budget leg reads gauges from spool snapshots:
+    the rebuilt exposition must be byte-identical to the scrape the
+    live process would have served, including gauge float formatting."""
+    obs_metrics.gauge("serve_changefeed_lag_seconds").set(0.25)
+    obs_metrics.gauge("queue_drain_eta_seconds").set(1234.5)
+    obs_metrics.gauge("stream_chips").set(0)
+    snap = obs_metrics.get_registry().snapshot()
+    text = obs_metrics.prometheus_from_snapshot(snap)
+    assert text == obs_metrics.get_registry().prometheus()
+    assert "firebird_serve_changefeed_lag_seconds 0.25" in text
+    for line in text.splitlines():
+        assert obs_metrics.PROM_LINE_RE.match(line), line
+
+
+# ---------------------------------------------------------------------------
+# Ops endpoints: /metrics/history and the /slo budgets block
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def ops_env(tmp_path, monkeypatch):
+    """A file-backed telemetry home for Config.from_env(): one spool
+    with historic snapshots plus the series dir next to it."""
+    _write_spool(str(tmp_path), "worker", 42, [
+        _snap(T0 + 5.0, counters={"scenes_seen": 3.0}),
+        _snap(T0 + 25.0, counters={"scenes_seen": 8.0})])
+    monkeypatch.setenv("FIREBIRD_SERIES_DIR", str(tmp_path / "series"))
+    monkeypatch.setenv("FIREBIRD_TELEMETRY_DIR", str(tmp_path))
+    return tmp_path
+
+
+def _get(port, path):
+    r = urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                               timeout=5)
+    return json.loads(r.read())
+
+
+def test_history_and_slo_budget_endpoints(ops_env, fresh_metrics):
+    from firebird_tpu.obs import server as obs_server
+
+    status = obs_server.set_status(obs_server.RunStatus(
+        "r", "test", slo_spec="batch_p95=30"))
+    try:
+        srv = obs_server.start_ops_server(0, status, host="127.0.0.1")
+        try:
+            big = int(time.time() - T0 + 3600)
+            doc = _get(srv.port, f"/metrics/history?window={big}")
+            assert doc["schema"] == "firebird-metric-history/1"
+            assert doc["sources"] == ["worker:42"]
+            assert [p["b"] for p in doc["points"]] == \
+                [int((T0 + 5.0) // 10), int((T0 + 25.0) // 10)]
+            # ?metric= filters the payload to one instrument
+            doc = _get(srv.port,
+                       f"/metrics/history?window={big}"
+                       "&metric=scenes_seen")
+            assert all(list(p["m"]["counters"]) == ["scenes_seen"]
+                       for p in doc["points"])
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.port, "/metrics/history?res=7")
+            assert ei.value.code == 400
+            # /slo carries the budget block (stamps are historic: every
+            # budget is no_data, which is ok=True, not a violation)
+            doc = _get(srv.port, "/slo")
+            assert doc["ok"] is True and doc["budgets"]["ok"] is True
+            assert {b["ok"] for b in doc["budgets"]["budgets"]} == {None}
+            # ?budgets=0 skips the disk walk
+            assert "budgets" not in _get(srv.port, "/slo?budgets=0")
+        finally:
+            srv.close()
+    finally:
+        obs_server.clear_status()
+    # the endpoint's ingestion persisted: a later reader sees the points
+    assert obs_series.read_points(str(ops_env / "series"), 10)
+
+
+def test_history_endpoint_disabled_without_series(tmp_path, monkeypatch,
+                                                  fresh_metrics):
+    from firebird_tpu.obs import server as obs_server
+
+    monkeypatch.setenv("FIREBIRD_SERIES_DIR", str(tmp_path / "series"))
+    monkeypatch.setenv("FIREBIRD_SERIES", "0")
+    status = obs_server.set_status(obs_server.RunStatus("r", "test"))
+    try:
+        srv = obs_server.start_ops_server(0, status, host="127.0.0.1")
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.port, "/metrics/history")
+            assert ei.value.code == 503
+        finally:
+            srv.close()
+    finally:
+        obs_server.clear_status()
+    assert not (tmp_path / "series").exists()
+
+
+# ---------------------------------------------------------------------------
+# firebird slo: CI-able exit codes
+# ---------------------------------------------------------------------------
+
+def test_slo_cli_exit_codes(tmp_path):
+    from click.testing import CliRunner
+
+    from firebird_tpu import cli
+
+    env = {"FIREBIRD_SERIES_DIR": str(tmp_path / "series"),
+           "FIREBIRD_TELEMETRY_DIR": str(tmp_path)}
+    # disabled store: exit 2
+    res = CliRunner().invoke(cli.entrypoint, ["slo"],
+                             env=dict(env, FIREBIRD_TELEMETRY="0"))
+    assert res.exit_code == 2 and json.loads(res.output)["disabled"]
+    # no data: ok (exit 0), every budget no_data
+    res = CliRunner().invoke(cli.entrypoint, ["slo"], env=env)
+    assert res.exit_code == 0, res.output
+    doc = json.loads(res.output)
+    assert doc["ok"] is True and {b["ok"] for b in doc["budgets"]} == \
+        {None}
+    # a burning canary in fresh (reader-clock-now) spools: exit 1, and
+    # the transition lands in the durable event log
+    now = time.time()
+    _write_spool(str(tmp_path), "prober", 5, [
+        _snap(now - 60.0, role="prober", pid=5,
+              counters={"probe_attempts": 1.0, "probe_failures": 1.0}),
+        _snap(now - 11.0, role="prober", pid=5,
+              counters={"probe_attempts": 20.0,
+                        "probe_failures": 20.0})])
+    res = CliRunner().invoke(
+        cli.entrypoint,
+        ["slo", "-b", "probe_errors@99/5m", "--fast", "45",
+         "--slow", "90"], env=env)
+    assert res.exit_code == 1, res.output
+    doc = json.loads(res.output)
+    (b,) = doc["budgets"]
+    assert b["ok"] is False and (b["burning"] or b["exhausted"])
+    assert doc["events_appended"]
+    assert slomod.read_budget_events(str(tmp_path / "series"))
+    # --no-record is a pure read: same verdict, no new events
+    n = len(slomod.read_budget_events(str(tmp_path / "series")))
+    res = CliRunner().invoke(
+        cli.entrypoint,
+        ["slo", "-b", "probe_errors@99/5m", "--fast", "45",
+         "--slow", "90", "--no-record"], env=env)
+    assert res.exit_code == 1
+    assert len(slomod.read_budget_events(str(tmp_path / "series"))) == n
+
+
+# ---------------------------------------------------------------------------
+# The canary prober's units
+# ---------------------------------------------------------------------------
+
+def test_sparkline_rendering():
+    from firebird_tpu.cli import _SPARK_GLYPHS, _sparkline
+
+    assert _sparkline([]) == ""
+    assert _sparkline([0.0, 0.0]) == _SPARK_GLYPHS[0] * 2
+    s = _sparkline([0.0, 4.0, 8.0])
+    assert s[0] == _SPARK_GLYPHS[0] and s[-1] == _SPARK_GLYPHS[-1]
+    assert len(_sparkline(range(30))) == 30
+
+
+def test_prober_refuses_bad_configs(tmp_path):
+    from firebird_tpu.obs import prober as obs_prober
+
+    with pytest.raises(ValueError, match="at least one surface"):
+        obs_prober.CanaryProber(Config())
+    with pytest.raises(ValueError, match="-x/-y"):
+        obs_prober.CanaryProber(Config(), landing=str(tmp_path))
+    with pytest.raises(ValueError, match="refuses to arm"):
+        obs_prober.CanaryProber(Config(probe_sec=0),
+                                serve_url="http://127.0.0.1:1")
+    # an explicit interval overrides the knob-off default
+    p = obs_prober.CanaryProber(Config(probe_sec=0),
+                                serve_url="http://127.0.0.1:1",
+                                interval=5.0)
+    assert p.interval == 5.0
+
+
+def test_webhook_sink_records_first_receipt():
+    from firebird_tpu.obs import prober as obs_prober
+
+    sink = obs_prober._WebhookSink()
+    try:
+        body = json.dumps({"schema": "firebird-alert-webhook/1",
+                           "cursor": 3,
+                           "alerts": [{"cx": 100, "cy": 200}]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{sink.port}/probe", data=body,
+            method="POST")
+        t0 = time.time()
+        assert urllib.request.urlopen(req, timeout=5).status == 200
+        t = sink.first_receipt((100, 200), after=t0 - 1.0)
+        assert t is not None and t >= t0 - 1.0
+        # only receipts after the probe's append count
+        assert sink.first_receipt((100, 200), time.time() + 60) is None
+        assert sink.first_receipt((1, 2), 0.0) is None
+        # a second delivery of the same chip keeps the FIRST receipt
+        urllib.request.urlopen(req, timeout=5)
+        assert sink.first_receipt((100, 200), after=0.0) == t
+    finally:
+        sink.close()
+
+
+def test_sse_watcher_parses_alert_events():
+    from firebird_tpu.obs import prober as obs_prober
+
+    w = obs_prober._SSEWatcher("http://127.0.0.1:1", timeout=1.0)
+    frames = [b": keepalive\n", b"\n",
+              b"event: alert\n",
+              b'data: {"cx": 100, "cy": 200, "date": 730000}\n',
+              b"id: 17\n", b"\n",
+              b"event: other\n", b'data: {"cx": 1, "cy": 2}\n', b"\n",
+              b"event: alert\n", b"data: not-json\n", b"\n"]
+    w._consume(iter(frames))
+    assert w.first_seen((100, 200), after=0.0) is not None
+    assert w.first_seen((1, 2), after=0.0) is None     # non-alert event
+    assert w.cursor == 17                              # reconnect point
+
+
+def test_resolve_pending_times_out_as_failure(fresh_metrics):
+    from firebird_tpu.obs import prober as obs_prober
+
+    p = obs_prober.CanaryProber(Config(), serve_url="http://127.0.0.1:1")
+    p.pending.append({"kind": "alert", "cid": (1, 2),
+                      "t_appended": time.time() - 999.0,
+                      "deadline": 10.0})
+    p.pending.append({"kind": "alert", "cid": (3, 4),
+                      "t_appended": time.time(), "deadline": 999.0})
+    p._resolve_pending()
+    assert obs_metrics.counter("probe_failures_alert").value == 1
+    assert obs_metrics.counter("probe_attempts_alert").value == 1
+    assert len(p.pending) == 1             # the fresh one still waits
+
+
+def test_alert_conveyor_confirms_on_sixth_scene(tmp_path):
+    """The staged conveyor: one scene per tick per in-flight chip, the
+    SCENES_TO_CONFIRM-th append is the end-to-end attempt, scenes are
+    bbox'd strictly inside their chip's cell."""
+    from firebird_tpu.ingest.sources import FileSource
+    from firebird_tpu.obs import prober as obs_prober
+
+    c = obs_prober._AlertConveyor(str(tmp_path), 100.0, 200.0,
+                                  chip_offset=0, chips=1)
+    (cid,) = c.reserve
+    sx, sy = c.span
+    x0, y0, x1, y1 = c._bbox(cid)
+    assert cid[0] < x0 < x1 < cid[0] + sx
+    assert cid[1] - sy < y0 < y1 < cid[1]
+    confirmed = []
+    for _ in range(obs_prober.SCENES_TO_CONFIRM):
+        assert not c.exhausted()
+        confirmed += c.tick()
+    assert [a["cid"] for a in confirmed] == [cid]
+    assert confirmed[0]["scene_id"] == \
+        f"PROBE_{cid[0]}_{cid[1]}_{obs_prober.SCENES_TO_CONFIRM - 1}"
+    assert c.exhausted() and c.tick() == []
+    # the landing zone carries one scene per stage, each bbox'd
+    scenes = FileSource(str(tmp_path)).list_acquisitions()
+    probe = [s for s in scenes
+             if s["scene_id"].startswith("PROBE_")]
+    assert len(probe) == obs_prober.SCENES_TO_CONFIRM
+    assert all(s.get("bbox") for s in probe)
+
+
+# ---------------------------------------------------------------------------
+# firebird-lint: SLO objective specs vs the metric registry
+# ---------------------------------------------------------------------------
+
+SLO_LINT_BASE = """
+    OBJECTIVES = {
+        "good_p95": ("histogram", "thing_seconds", "p95", "fine"),
+        "pair": ("ratio", ("thing_bad", "thing_seconds"), None, "r"),
+        "live": ("watchdog", "last_beat_age_sec", None, "skipped"),
+    }
+    DEFAULT_SPEC = "good_p95=30"
+    DEFAULT_BUDGET_SPEC = "good_p95<30@99/7d"
+"""
+
+SLO_LINT_SITE = """
+    from firebird_tpu.obs.metrics import histogram
+
+    def f():
+        histogram("thing_seconds", help="h").observe(1.0)
+"""
+
+
+def test_lint_slo_objectives_clean(tmp_path):
+    from tests.test_lint import build_repo, rules_hit
+
+    from firebird_tpu.analysis import run_lint
+
+    root = build_repo(tmp_path, {
+        "firebird_tpu/obs/slo.py": SLO_LINT_BASE.replace(
+            '"thing_bad", ', '"thing_seconds", '),
+        "firebird_tpu/work.py": SLO_LINT_SITE})
+    res = run_lint(root)
+    assert "slo-metric-unknown" not in rules_hit(res)
+    assert "slo-spec-unknown" not in rules_hit(res)
+
+
+def test_lint_slo_metric_and_spec_unknown(tmp_path):
+    from tests.test_lint import build_repo, by_rule
+
+    from firebird_tpu.analysis import run_lint
+
+    root = build_repo(tmp_path, {
+        "firebird_tpu/obs/slo.py": SLO_LINT_BASE.replace(
+            'DEFAULT_SPEC = "good_p95=30"',
+            'DEFAULT_SPEC = "ghost_p99=30"'),
+        "firebird_tpu/work.py": SLO_LINT_SITE})
+    res = run_lint(root)
+    # the ratio's numerator has no registration site anywhere
+    unknown = by_rule(res, "slo-metric-unknown")
+    assert len(unknown) == 1 and "thing_bad" in unknown[0].message
+    spec = by_rule(res, "slo-spec-unknown")
+    assert len(spec) == 1 and "ghost_p99" in spec[0].message
+    # the watchdog objective is exempt: its field is a report-block
+    # key, not a registry instrument
+    assert not any("last_beat_age_sec" in f.message for f in unknown)
+
+
+def test_lint_slo_metric_known_via_catalog(tmp_path):
+    """A metric with no live call site but a METRIC_HELP entry is still
+    known — catalog names are registry names (dynamic call sites)."""
+    from tests.test_lint import build_repo, rules_hit
+
+    from firebird_tpu.analysis import run_lint
+
+    root = build_repo(tmp_path, {
+        "firebird_tpu/obs/slo.py": SLO_LINT_BASE,
+        "firebird_tpu/obs/metrics.py": """
+            METRIC_HELP = {
+                "thing_bad": "bad things",
+            }
+        """,
+        "firebird_tpu/work.py": SLO_LINT_SITE})
+    res = run_lint(root)
+    assert "slo-metric-unknown" not in rules_hit(res)

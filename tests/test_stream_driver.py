@@ -175,6 +175,7 @@ def test_checkpoint_roundtrip(tmp_path):
     assert float(side2["horizon"]) == 7.0 and int(side2["curqa"][0]) == 24
 
 
+@pytest.mark.slow  # ~52s (multi-chip bootstrap through the 8-device virtual mesh, twice); streamfleet-smoke drains a sharded multi-chip stream end-to-end in `make test`
 def test_sharded_bootstrap_multi_chip(tmp_path):
     """VERDICT round-1 weak #6: the stream driver composes with the batch
     driver's device sharding — a multi-chip bootstrap batch runs through
