@@ -25,7 +25,13 @@ Design points (the spool's discipline, re-applied to the read side):
   resolution per ingesting pid (``series.<res>.<pid>.<seg>.jsonl``),
   ``flush()`` per line, OSError degrades to a drop counter.  A full
   segment truncate-reopens the oldest; a torn tail line is skipped by
-  readers.
+  readers.  A (re)opened store RESUMES its newest on-disk segment in
+  append mode — truncation only ever happens when the ring genuinely
+  wraps, so reopening never destroys a prior incarnation's durable
+  points.  Ring files whose entire content has aged past their
+  resolution's retention are garbage-collected at open, so dead
+  incarnations (cron runs, killed fleets) do not grow the directory
+  without bound.
 - **Idempotent.**  Re-ingesting the same spools is a no-op: a bucket
   already holding a point at the same or newer snapshot time is
   skipped, and live-bucket refreshes are throttled to ``res/8`` so the
@@ -106,10 +112,15 @@ class SeriesStore:
         self.points_per_segment = int(points_per_segment)
         self.segments = int(segments)
         self.resolutions = tuple(int(r) for r in resolutions)
+        # Lock order: _ingest_lock (whole-batch atomicity for
+        # concurrent ingesters sharing one instance) outside _lock
+        # (rings + dedup state).
+        self._ingest_lock = threading.Lock()
         self._lock = threading.Lock()
         self._rings: dict = {}      # guarded-by: _lock  res -> {seg,n,f}
         self._state: dict = {}      # guarded-by: _lock  (res,src) -> (b,t)
         self._dropped = 0           # guarded-by: _lock
+        self._gc_removed = 0        # guarded-by: _lock
         os.makedirs(directory, exist_ok=True)
         self._load_state()
 
@@ -119,18 +130,56 @@ class SeriesStore:
         return os.path.join(
             self.dir, f"series.{int(res)}.{self.pid}.{seg}.jsonl")
 
-    def _open_segment(self, res: int, seg: int):
+    def _open_segment(self, res: int, seg: int, *, append: bool = False,
+                      n: int = 0):
         # guarded-by: _lock (callers hold it)
         ring = self._rings.setdefault(res, {"seg": 0, "n": 0, "f": None})
         if ring["f"] is not None:
             ring["f"].close()
-        ring["seg"], ring["n"] = seg, 0
-        ring["f"] = open(self.segment_path(res, seg), "w")
-        header = {"kind": "header", "schema": SERIES_SCHEMA,
-                  "pid": self.pid, "res": int(res), "segment": seg}
-        ring["f"].write(json.dumps(header, separators=(",", ":")) + "\n")
-        ring["f"].flush()
+        ring["seg"], ring["n"] = seg, n
+        ring["f"] = open(self.segment_path(res, seg),
+                         "a" if append else "w")
+        if not append:
+            header = {"kind": "header", "schema": SERIES_SCHEMA,
+                      "pid": self.pid, "res": int(res), "segment": seg}
+            ring["f"].write(json.dumps(header, separators=(",", ":"))
+                            + "\n")
+            ring["f"].flush()
         return ring
+
+    def _resume_point(self, res: int) -> tuple | None:
+        """Where this pid's ring resumes after a (re)open: the newest
+        existing segment (by last point time, then mtime) and its
+        occupied line count, or None when no segment exists yet.
+        Resuming in APPEND mode is what keeps a re-opened store — same
+        process, same pid — from truncating a prior incarnation's
+        durable points; a segment is only ever truncated when the ring
+        genuinely wraps onto it."""
+        # guarded-by: _lock (callers hold it)
+        best_key, best = None, None
+        for seg in range(self.segments):
+            path = self.segment_path(res, seg)
+            try:
+                mtime = os.path.getmtime(path)
+                n, last_t = 0, float("-inf")
+                with open(path) as f:
+                    for line in f:
+                        try:
+                            doc = json.loads(line)
+                        except ValueError:
+                            n += 1      # a torn line still fills a slot
+                            continue
+                        if isinstance(doc, dict) \
+                                and doc.get("kind") == "pt":
+                            n += 1
+                            last_t = max(last_t,
+                                         float(doc.get("t", 0.0)))
+            except OSError:
+                continue
+            key = (last_t, mtime, seg)
+            if best_key is None or key > best_key:
+                best_key, best = key, (seg, n)
+        return best
 
     def _write(self, res: int, doc: dict) -> None:
         line = json.dumps(doc, separators=(",", ":"), default=str)
@@ -138,7 +187,18 @@ class SeriesStore:
             try:
                 ring = self._rings.get(res)
                 if ring is None or ring["f"] is None:
-                    ring = self._open_segment(res, 0)
+                    resume = self._resume_point(res)
+                    if resume is None:
+                        ring = self._open_segment(res, 0)
+                    elif resume[1] >= self.points_per_segment:
+                        # The resumed segment is already full: a
+                        # genuine ring wrap, the one case where
+                        # truncating the next slot is correct.
+                        ring = self._open_segment(
+                            res, (resume[0] + 1) % self.segments)
+                    else:
+                        ring = self._open_segment(
+                            res, resume[0], append=True, n=resume[1])
                 elif ring["n"] >= self.points_per_segment:
                     ring = self._open_segment(
                         res, (ring["seg"] + 1) % self.segments)
@@ -151,16 +211,56 @@ class SeriesStore:
                 self._dropped += 1
 
     def _load_state(self) -> None:
-        """Rebuild the dedup state from EVERY pid's rings on disk, so a
-        restarted ingester (or a second one) never re-appends points an
-        earlier incarnation already durably wrote."""
+        """Rebuild the dedup state from EVERY pid's rings on disk (so a
+        restarted ingester — or a second one — never re-appends points
+        an earlier incarnation already durably wrote), then
+        garbage-collect ring files whose whole content has aged out of
+        their resolution's retention."""
         with self._lock:
-            for pt in _read_raw(self.dir):
-                key = (pt["res"], pt["src"])
-                cur = self._state.get(key)
-                cand = (pt["b"], pt["t"])
-                if cur is None or cand > cur:
-                    self._state[key] = cand
+            files = _scan_files(self.dir)
+            for pts in files.values():
+                for pt in pts:
+                    key = (pt["res"], pt["src"])
+                    cur = self._state.get(key)
+                    cand = (pt["b"], pt["t"])
+                    if cur is None or cand > cur:
+                        self._state[key] = cand
+            self._gc_locked(files)
+
+    def _gc_locked(self, files: dict) -> None:
+        """Reclaim dead incarnations' ring files.  Each ingesting pid
+        (every cron ``firebird slo`` run, every killed fleet) leaves up
+        to resolutions x segments files behind; without collection the
+        directory — and every ``_read_raw`` walk over it — grows
+        without bound.  A file whose NEWEST point predates its
+        resolution's whole-ring retention (``points_per_segment x
+        segments x res`` seconds) can no longer serve any window the
+        ring itself would have retained, so it is unlinked.  Staleness
+        is judged against the newest point at the same resolution —
+        the emitters' clock domain, never this reader's wall clock
+        (historic spools must stay replayable) — and never touches
+        this pid's own files (they may be live open handles)."""
+        # guarded-by: _lock (called at open, before any ring opens)
+        res_newest: dict = {}
+        stamped: dict = {}
+        for path, pts in files.items():
+            name = _parse_ring_name(path)
+            if name is None or not pts:
+                continue        # foreign file / header-only segment
+            newest = max(float(p.get("t", 0.0)) for p in pts)
+            stamped[path] = (name, newest)
+            res = name[0]
+            res_newest[res] = max(res_newest.get(res, newest), newest)
+        for path, ((res, pid, _seg), newest) in stamped.items():
+            if pid == self.pid:
+                continue
+            horizon = self.points_per_segment * self.segments * res
+            if newest < res_newest[res] - horizon:
+                try:
+                    os.remove(path)
+                    self._gc_removed += 1
+                except OSError:
+                    pass
 
     # -- ingestion ---------------------------------------------------------
 
@@ -168,7 +268,15 @@ class SeriesStore:
         """Downsample spool snap events into the rings.  Buckets key on
         each snap line's own wall-clock ``t`` — the emitting process's
         clock, NEVER this reader's (clock-domain rule, module
-        docstring).  Returns the number of points written."""
+        docstring).  Returns the number of points written.  The whole
+        batch runs under the ingest lock, so concurrent ingesters
+        sharing one instance (the threaded ops endpoint) cannot
+        interleave their dedup checks and double-write points."""
+        with self._ingest_lock:
+            return self._ingest_events_locked(events)
+
+    def _ingest_events_locked(self, events: list) -> int:
+        # guarded-by: _ingest_lock
         # Batch pre-group: per (res, src, bucket) keep only the
         # newest-t snapshot, then walk buckets in order so a closed
         # bucket lands exactly one line (its final cumulative state).
@@ -222,7 +330,8 @@ class SeriesStore:
             return {"dir": self.dir, "pid": self.pid,
                     "resolutions": list(self.resolutions),
                     "sources": sorted({s for _, s in self._state}),
-                    "dropped": self._dropped}
+                    "dropped": self._dropped,
+                    "gc_removed": self._gc_removed}
 
     def close(self) -> None:
         with self._lock:
@@ -249,11 +358,26 @@ def open_store(cfg) -> SeriesStore | None:
 # Read side: any process can query the rings without a writer instance
 # ---------------------------------------------------------------------------
 
-def _read_raw(directory: str) -> list:
-    """Every parseable point line under ``directory`` (all pids, all
-    segments); torn tail lines skipped, not fatal."""
-    out = []
+def _parse_ring_name(path: str) -> tuple | None:
+    """``(res, pid, seg)`` from a ring file name
+    (``series.<res>.<pid>.<seg>.jsonl``), or None for anything else
+    the glob happened to match."""
+    parts = os.path.basename(path).split(".")
+    if len(parts) != 5 or parts[0] != "series" or parts[4] != "jsonl":
+        return None
+    try:
+        return int(parts[1]), int(parts[2]), int(parts[3])
+    except ValueError:
+        return None
+
+
+def _scan_files(directory: str) -> dict:
+    """path -> parseable point lines for every ring file under
+    ``directory`` (all pids, all segments); torn tail lines skipped,
+    not fatal."""
+    out: dict = {}
     for path in sorted(glob.glob(os.path.join(directory, SERIES_GLOB))):
+        pts: list = []
         try:
             with open(path) as f:
                 for line in f:
@@ -264,9 +388,18 @@ def _read_raw(directory: str) -> list:
                     if not isinstance(doc, dict) \
                             or doc.get("kind") != "pt":
                         continue
-                    out.append(doc)
+                    pts.append(doc)
         except OSError:
             continue
+        out[path] = pts
+    return out
+
+
+def _read_raw(directory: str) -> list:
+    """Every parseable point line under ``directory``."""
+    out: list = []
+    for pts in _scan_files(directory).values():
+        out.extend(pts)
     return out
 
 

@@ -328,29 +328,52 @@ def mark_mesh_up() -> None:
         st.mark_mesh_up()
 
 
+# One process-wide SeriesStore for the threaded handlers: per-request
+# instances share this pid, so two concurrent /slo or /metrics/history
+# requests would append to (or resume over) the same segment files from
+# two uncoordinated writers — SeriesStore is thread-safe only within
+# one instance.  Re-keyed when the ambient config changes (knob flips,
+# tests); _budget_lock additionally serializes evaluate+record so two
+# requests cannot race the durable event log's read-then-append.
+_series_lock = threading.Lock()
+_series_cache: dict = {"key": None, "store": None}
+_budget_lock = threading.Lock()
+
+
+def _shared_store(cfg):
+    from firebird_tpu.obs import series as series_mod
+
+    key = (series_mod.series_dir(cfg), getattr(cfg, "series", 0),
+           getattr(cfg, "series_segments", 0), cfg.telemetry)
+    with _series_lock:
+        if _series_cache["key"] != key:
+            if _series_cache["store"] is not None:
+                _series_cache["store"].close()
+            _series_cache["store"] = series_mod.open_store(cfg)
+            _series_cache["key"] = key
+        return _series_cache["store"]
+
+
 def _budget_block() -> dict:
     """The /slo budgets block: ingest fresh spool snapshots into the
     series rings, then evaluate + durably record the error budgets for
     the ambient config.  Raises when the store cannot open — the /slo
     route degrades that to an error string."""
     from firebird_tpu.config import Config
-    from firebird_tpu.obs import series as series_mod
     from firebird_tpu.obs import slo as slomod
 
     cfg = Config.from_env()
-    store = series_mod.open_store(cfg)
+    store = _shared_store(cfg)
     if store is None:
         return {"disabled": True,
                 "reason": "no series store (FIREBIRD_SERIES=0 / "
                           "FIREBIRD_TELEMETRY=0 / memory backend)"}
-    try:
+    with _budget_lock:
         store.ingest_spools()
         return slomod.evaluate_and_record(
             store.dir, cfg.slo_budget or None,
             fast_sec=cfg.slo_fast_sec, slow_sec=cfg.slo_slow_sec,
             burn_threshold=cfg.slo_burn)
-    finally:
-        store.close()
 
 
 class _OpsHandler(httpd.JsonHandler):
@@ -448,24 +471,21 @@ class _OpsHandler(httpd.JsonHandler):
             self._send_json(400, {"error": "res/window must be numbers"})
             return
         metric = (query.get("metric") or [None])[0]
-        store = series_mod.open_store(Config.from_env())
+        store = _shared_store(Config.from_env())
         if store is None:
             self._send_json(503, {
                 "error": "metric history disabled (FIREBIRD_SERIES=0 / "
                          "FIREBIRD_TELEMETRY=0) or homeless (memory "
                          "backend, no FIREBIRD_SERIES_DIR)"})
             return
-        try:
-            if res not in store.resolutions:
-                self._send_json(400, {
-                    "error": f"unknown resolution {res}s",
-                    "resolutions": list(store.resolutions)})
-                return
-            store.ingest_spools()
-            now = _time.time()
-            pts = store.points(res, now - window, now)
-        finally:
-            store.close()
+        if res not in store.resolutions:
+            self._send_json(400, {
+                "error": f"unknown resolution {res}s",
+                "resolutions": list(store.resolutions)})
+            return
+        store.ingest_spools()
+        now = _time.time()
+        pts = store.points(res, now - window, now)
         if metric:
             pts = [dict(p, m={k: {metric: (p.get("m") or {})[k][metric]}
                               if metric in ((p.get("m") or {}).get(k) or {})

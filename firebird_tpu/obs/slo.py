@@ -409,6 +409,7 @@ def evaluate_budgets(directory: str, spec: str | None = None, *,
     srcs: set = set()
     for b in parse_budget_spec(spec):
         windows: dict = {}
+        burn_raw: dict = {}
         for wname, wsec in (("window", b["window_sec"]),
                             ("fast", fast_sec), ("slow", slow_sec)):
             res = _pick_resolution(wsec, resolutions)
@@ -420,18 +421,23 @@ def evaluate_budgets(directory: str, spec: str | None = None, *,
             w = _window_stats(points, b, now - wsec, now)
             w["resolution_sec"] = res
             if w["error_ratio"] is None:
+                burn_raw[wname] = None
                 w["burn_rate"] = None
             else:
-                w["burn_rate"] = round(
-                    w["error_ratio"] / max(1.0 - b["target"], 1e-9), 3)
+                # The paging decision below compares the UNROUNDED
+                # ratio; rounding is display-only (a window burning at
+                # 14.3996x must not page a 14.4 threshold).
+                burn_raw[wname] = (w["error_ratio"]
+                                   / max(1.0 - b["target"], 1e-9))
+                w["burn_rate"] = round(burn_raw[wname], 3)
             windows[wname] = w
         full = windows["window"]
         allowed = (1.0 - b["target"]) * full["total"]
         exhausted = (not full["empty"]) and full["bad"] > allowed
         burning = (not windows["fast"]["empty"]
                    and not windows["slow"]["empty"]
-                   and windows["fast"]["burn_rate"] >= burn_threshold
-                   and windows["slow"]["burn_rate"] >= burn_threshold)
+                   and burn_raw["fast"] >= burn_threshold
+                   and burn_raw["slow"] >= burn_threshold)
         empty_names = [n for n in ("window", "fast", "slow")
                        if windows[n]["empty"]]
         ok = None if len(empty_names) == 3 else \

@@ -93,6 +93,82 @@ def test_series_reingest_is_idempotent_across_restart(tmp_path):
     store2.close()
 
 
+def test_series_history_survives_reopen_then_write(tmp_path):
+    """Regression: the first post-reopen write used to truncate
+    segment 0 for this pid (mode 'w'), destroying every point a prior
+    same-process incarnation durably wrote — while the restored dedup
+    state kept the destroyed points from ever re-ingesting.  A
+    reopened store must RESUME its ring in append mode."""
+    store = obs_series.SeriesStore(str(tmp_path), resolutions=(10,))
+    events = [_snap(T0 + i * 20.0, counters={"c": float(i)})
+              for i in range(10)]
+    assert store.ingest_events(events) == 10
+    store.close()
+    store2 = obs_series.SeriesStore(str(tmp_path), resolutions=(10,))
+    assert store2.ingest_events(
+        [_snap(T0 + 500.0, counters={"c": 99.0})]) == 1
+    store2.close()
+    pts = obs_series.read_points(str(tmp_path), 10)
+    assert [p["m"]["counters"]["c"] for p in pts] == \
+        [float(i) for i in range(10)] + [99.0]
+
+
+def test_series_reopen_resumes_ring_position(tmp_path):
+    """A reopened store resumes its NEWEST segment and rotates onward
+    from there — truncation only happens when the ring genuinely wraps
+    onto a segment."""
+    store = obs_series.SeriesStore(str(tmp_path), points_per_segment=2,
+                                   segments=3, resolutions=(10,))
+    for i in range(3):
+        store.ingest_events(
+            [_snap(T0 + i * 20.0, counters={"c": float(i)})])
+    store.close()
+    # seg 0 is full (2 points), seg 1 holds 1 — resume appends to seg 1
+    store2 = obs_series.SeriesStore(str(tmp_path), points_per_segment=2,
+                                    segments=3, resolutions=(10,))
+    assert store2.ingest_events(
+        [_snap(T0 + 60.0, counters={"c": 3.0})]) == 1
+    store2.close()
+    pid = os.getpid()
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert names == [f"series.10.{pid}.{s}.jsonl" for s in (0, 1)]
+    pts = obs_series.read_points(str(tmp_path), 10)
+    assert [p["m"]["counters"]["c"] for p in pts] == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_series_gc_reclaims_stale_dead_incarnation_files(tmp_path):
+    """Ring files whose whole content has aged past their resolution's
+    ring retention are unlinked at open (dead cron/CI incarnations must
+    not grow the directory without bound); a dead-but-FRESH file
+    survives — a SIGKILL'd process's recent history is the point of the
+    store — and staleness is judged in the emitters' clock domain
+    (newest same-resolution point), never the reader's wall clock."""
+
+    def _pt(t, src):
+        return json.dumps(
+            {"kind": "pt", "res": 10, "b": int(t // 10), "t": t,
+             "src": src, "m": {"counters": {}, "gauges": {},
+                               "histograms": {}}}) + "\n"
+
+    store = obs_series.SeriesStore(str(tmp_path), points_per_segment=4,
+                                   segments=2, resolutions=(10,))
+    store.ingest_events([_snap(T0, counters={"c": 1.0})])
+    store.close()
+    # retention horizon is 4 x 2 x 10s = 80s behind the newest point
+    stale = tmp_path / "series.10.999999.0.jsonl"
+    stale.write_text(_pt(T0 - 900.0, "worker:1"))
+    fresh = tmp_path / "series.10.999998.0.jsonl"
+    fresh.write_text(_pt(T0 - 30.0, "worker:2"))
+    store2 = obs_series.SeriesStore(str(tmp_path), points_per_segment=4,
+                                    segments=2, resolutions=(10,))
+    assert store2.status()["gc_removed"] == 1
+    store2.close()
+    names = {p.name for p in tmp_path.iterdir()}
+    assert stale.name not in names and fresh.name in names
+    srcs = obs_series.sources(obs_series.read_points(str(tmp_path), 10))
+    assert srcs == ["worker:2", "worker:7"]
+
+
 def test_series_live_bucket_refresh_is_throttled(tmp_path):
     store = obs_series.SeriesStore(str(tmp_path), resolutions=(80,))
     assert store.ingest_events([_snap(T0, counters={"c": 1.0})]) == 1
@@ -334,6 +410,37 @@ def test_budget_burning_and_exhaustion_from_ratio_counters(tmp_path):
     assert b["ok"] is True and b["fast_burn"] == 0.0
 
 
+def test_budget_burn_decision_uses_unrounded_ratio(tmp_path):
+    """Display rounding must not leak into paging: a window burning at
+    14.3996x REPORTS 14.4 (3-decimal rounding) but must not page a
+    14.4 threshold — and a threshold just under the true ratio must."""
+    store = obs_series.SeriesStore(str(tmp_path))
+    store.ingest_events([
+        # a long clean history keeps the full 1d window unexhausted
+        _snap(T0 - 4000.0, role="prober", pid=5,
+              counters={"probe_attempts": 10_000_000.0,
+                        "probe_failures": 0.0}),
+        # fast-window baseline inside the 2-bucket lookback (res 10)
+        _snap(T0 - 310.0, role="prober", pid=5,
+              counters={"probe_attempts": 10_000_000.0,
+                        "probe_failures": 0.0}),
+        # 35_999 / 250_000 = 0.143996 -> burn 14.3996x at 99% target
+        _snap(T0 - 100.0, role="prober", pid=5,
+              counters={"probe_attempts": 10_250_000.0,
+                        "probe_failures": 35_999.0})])
+    store.close()
+    v = slomod.evaluate_budgets(str(tmp_path), "probe_errors@99/1d",
+                                now=T0, burn_threshold=14.4)
+    (b,) = v["budgets"]
+    assert b["fast_burn"] == 14.4 and b["slow_burn"] == 14.4
+    assert b["burning"] is False and b["exhausted"] is False
+    assert b["ok"] is True and v["ok"] is True
+    # the true (unrounded) ratio still pages a threshold it exceeds
+    v = slomod.evaluate_budgets(str(tmp_path), "probe_errors@99/1d",
+                                now=T0, burn_threshold=14.39)
+    assert v["budgets"][0]["burning"] is True
+
+
 def test_budget_events_record_transitions_only(tmp_path):
     def verdict(state):
         return {"budgets": [{
@@ -462,6 +569,23 @@ def test_history_and_slo_budget_endpoints(ops_env, fresh_metrics):
         obs_server.clear_status()
     # the endpoint's ingestion persisted: a later reader sees the points
     assert obs_series.read_points(str(ops_env / "series"), 10)
+
+
+def test_ops_server_shares_one_series_store(tmp_path, monkeypatch):
+    """The threaded handlers use ONE process-wide SeriesStore:
+    per-request instances share this pid, so two concurrent /slo or
+    /metrics/history requests would append to the same segment files
+    from two uncoordinated writers.  The cache re-keys (closing the
+    old store) when the ambient config changes."""
+    from firebird_tpu.obs import server as obs_server
+
+    monkeypatch.setenv("FIREBIRD_SERIES_DIR", str(tmp_path / "series"))
+    monkeypatch.setenv("FIREBIRD_TELEMETRY_DIR", str(tmp_path))
+    s1 = obs_server._shared_store(Config.from_env())
+    s2 = obs_server._shared_store(Config.from_env())
+    assert s1 is not None and s1 is s2
+    monkeypatch.setenv("FIREBIRD_SERIES", "0")
+    assert obs_server._shared_store(Config.from_env()) is None
 
 
 def test_history_endpoint_disabled_without_series(tmp_path, monkeypatch,
