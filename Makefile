@@ -13,6 +13,7 @@ ACQUIRED ?= 1982-01-01/2017-12-31
         fleet-smoke elastic-smoke serve-smoke pyramid-smoke serve-fleet \
         compact-smoke postmortem-smoke alert-smoke streamfleet-smoke \
         telemetry-smoke slo-smoke wire-smoke fuse-smoke fuse-repro \
+        precision-smoke \
         image db-up db-schema db-test db-down changedetection \
         classification clean
 
@@ -36,6 +37,7 @@ test: lint
 	python -m pytest tests/ -x -q
 	$(MAKE) pyramid-smoke
 	$(MAKE) fuse-smoke
+	$(MAKE) precision-smoke
 	$(MAKE) alert-smoke
 	$(MAKE) streamfleet-smoke
 	$(MAKE) telemetry-smoke
@@ -157,6 +159,13 @@ fuse-smoke:
 # interpret-only caveat.
 fuse-repro:
 	python tools/fuse_repro.py
+
+# Mixed-precision envelope check (docs/ROOFLINE.md "Precision"): mixed
+# vs f32 dispatches decision-identical (break days/QA/segment counts/
+# curve ranks), coef/rmse drift inside the pinned scaled-ulp budget,
+# and the mixed trace counter moving; artifact folded by bench.py.
+precision-smoke:
+	python tools/precision_smoke.py
 
 # Alerting end-to-end drill (docs/ALERTS.md): a streaming run over a
 # step-change archive with injected ingest faults and a SIGKILL

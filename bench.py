@@ -17,6 +17,7 @@ attempt dies or stalls, a reduced CPU-platform run still produces a valid
 
 import functools
 import json
+import os
 import re
 import subprocess
 import sys
@@ -176,6 +177,61 @@ def autotune_pick(rates, errors, decision_exact):
     # baseline config errored while the baseline itself ran and won
     # (there the errors dict already tells the whole story).
     return (max(eligible, key=lambda k: rates[k]), [], "0" in errors)
+
+
+def repro_block_seeds() -> dict:
+    """fuse_repro.json's smallest COMPILING block per pairing — consumed
+    as the FIREBIRD_MEGA_BLOCK_P seed for the mega/mon rungs (the
+    artifact stops being advisory).  Empty when the tool never ran on a
+    Mosaic-reachable host."""
+    from firebird_tpu.config import env_knob as _ek
+
+    try:
+        with open(os.path.join(_ek("FIREBIRD_FUSE_DIR"),
+                               "fuse_repro.json")) as f:
+            rep = json.load(f)
+        if not rep.get("mosaic_reachable"):
+            return {}
+        return {k: v["smallest_ok_block"]
+                for k, v in rep.get("probes", {}).items()
+                if v.get("smallest_ok_block")}
+    except (OSError, ValueError, KeyError):
+        return {}
+
+
+def apply_tune_flag(flag: str, repro_blocks: dict | None = None) -> None:
+    """One autotune rung -> the env it means: a '+mixed' suffix (or bare
+    'mixed') arms FIREBIRD_MIXED_PRECISION; 'fused' / 'fused+<components>'
+    arms FIREBIRD_FUSED_FIT=1 and 'mon' / 'mon+<components>' the
+    whole-round fusion (FIREBIRD_FUSED_FIT=mon), each with FIREBIRD_PALLAS
+    set to the (possibly empty) component list; anything else is a plain
+    FIREBIRD_PALLAS value with both knobs off.  The mega/mon rungs also
+    seed FIREBIRD_MEGA_BLOCK_P from ``repro_blocks`` (repro_block_seeds),
+    the smallest compiling block for their pairing.  Shared by the probes
+    and the final pick so the timed run executes exactly the raced
+    configuration."""
+    repro_blocks = repro_blocks or {}
+    mixed_f = flag == "mixed" or flag.endswith("+mixed")
+    base = flag[:-len("+mixed")] if flag.endswith("+mixed") \
+        else ("0" if flag == "mixed" else flag)
+    os.environ["FIREBIRD_MIXED_PRECISION"] = "1" if mixed_f else "0"
+    if base == "fused" or base.startswith("fused+"):
+        tier = "1"
+        os.environ["FIREBIRD_PALLAS"] = base[len("fused+"):] or "0"
+    elif base == "mon" or base.startswith("mon+"):
+        tier = "mon"
+        os.environ["FIREBIRD_PALLAS"] = base[len("mon+"):] or "0"
+    else:
+        tier = "0"
+        os.environ["FIREBIRD_PALLAS"] = base
+    os.environ["FIREBIRD_FUSED_FIT"] = tier
+    fam = ("mon" if tier == "mon"
+           else "mega" if "mega" in base
+           else "fused" if tier == "1"
+           else None)
+    bp = repro_blocks.get(f"{fam}+mixed" if mixed_f else fam) \
+        if fam else None
+    os.environ["FIREBIRD_MEGA_BLOCK_P"] = str(bp or 0)
 
 
 def _fleet_obs_fold() -> dict:
@@ -372,6 +428,15 @@ def _fuse_fold() -> dict:
     return out
 
 
+def _precision_fold() -> dict:
+    """`make precision-smoke` evidence: mixed-vs-f32 store decision
+    identity, the scale-anchored coef/rmse ulp-drift histogram against
+    params.MIXED_ULP_BUDGET, and the mixed trace counters moving
+    (docs/ROOFLINE.md "Precision")."""
+    return _artifact_fold("precision_smoke", "FIREBIRD_PRECISION_DIR",
+                          "precision_smoke.json")
+
+
 def previous_round_e2e(here: str) -> dict | None:
     """The newest committed TPU evidence artifact's end-to-end figure —
     the denominator of the headline regression gate.  Scans
@@ -462,19 +527,8 @@ def measure(cpu_only: bool) -> None:
 
         probe_outs = {}
 
-        def _apply_tune_flag(flag: str) -> None:
-            """One autotune rung -> the env it means: 'fused' /
-            'fused+<components>' arms FIREBIRD_FUSED_FIT with
-            FIREBIRD_PALLAS set to the (possibly empty) component list;
-            anything else is a plain FIREBIRD_PALLAS value with the
-            fused knob off.  Shared by the probes and the final pick so
-            the timed run executes exactly the raced configuration."""
-            if flag == "fused" or flag.startswith("fused+"):
-                _os.environ["FIREBIRD_FUSED_FIT"] = "1"
-                _os.environ["FIREBIRD_PALLAS"] = flag[len("fused+"):] or "0"
-            else:
-                _os.environ["FIREBIRD_FUSED_FIT"] = "0"
-                _os.environ["FIREBIRD_PALLAS"] = flag
+        _apply_tune_flag = _ft.partial(apply_tune_flag,
+                                       repro_blocks=repro_block_seeds())
 
         def probe_rate(flag: str) -> float:
             _apply_tune_flag(flag)
@@ -585,6 +639,22 @@ def measure(cpu_only: bool) -> None:
         fw = ",".join(sorted(set(winners) | {"fit"}))
         if f"fused+{fw}" not in rates:
             safe_rate(f"fused+{fw}")
+        # Whole-round fusion (FIREBIRD_FUSED_FIT=mon): monitor+fit+close
+        # in ONE VMEM residency per round.  Raced bare and composed with
+        # the Pallas fit prologue like the fused rungs above.
+        safe_rate("mon")
+        safe_rate("mon+fit")
+        # Mixed-precision rungs (FIREBIRD_MIXED_PRECISION): bf16
+        # split-dot gram + int32 counts inside the Pallas fit routes
+        # with the f32 decision envelope.  Raced on the strongest
+        # Pallas-fit families only (mixed is a no-op on XLA routes);
+        # any decision flip is caught by autotune_parity below and the
+        # config demoted by autotune_pick.
+        safe_rate("fit+mixed")
+        safe_rate("mega+mixed")
+        safe_rate("mon+fit+mixed")
+        if f"fused+{fw}+mixed" not in rates:
+            safe_rate(f"fused+{fw}+mixed")
         parity, decision_exact = autotune_parity(probe_outs)
         pick, demoted, parity_unavailable = autotune_pick(
             rates, errors, decision_exact)
@@ -797,7 +867,7 @@ def measure(cpu_only: bool) -> None:
              if kernel.use_pallas(c)
              and (c != "mega" or _mega_fits_shape(packed, wcap, seg))]
             + (["fused"] if kernel.use_fused_fit() else [])),
-        wire_bytes=2)
+        wire_bytes=2, mixed=kernel.use_mixed_precision())
 
     # ---- rebalance: straggler-idle model + what the ring moved ----
     # Per-device round counts bound the idle a perfect balancer could
@@ -1032,6 +1102,9 @@ def measure(cpu_only: bool) -> None:
             # identity, forced-ragged rebalance leg, classified
             # compiler-crash probe records) when one ran on this host.
             **_fuse_fold(),
+            # Last precision-smoke evidence (mixed-vs-f32 decision
+            # identity + scaled-ulp drift histogram) when one ran here.
+            **_precision_fold(),
             # Last `make lint` summary (contract-checker clean flag +
             # per-rule counts) when the linter ran on this host.
             **_lint_fold(),

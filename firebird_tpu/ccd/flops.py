@@ -84,7 +84,8 @@ def _sort_flops(rows: float, n: int) -> float:
     return 3.0 * rows * n * stages
 
 
-def round_flops(P: int, T: int, W: int, sensor=LANDSAT_ARD) -> dict:
+def round_flops(P: int, T: int, W: int, sensor=LANDSAT_ARD,
+                mixed: bool = False) -> dict:
     """FLOPs of one event-horizon round over P pixels (kernel.body).
 
     Terms are grouped by the cond gate that executes them (kernel
@@ -92,6 +93,13 @@ def round_flops(P: int, T: int, W: int, sensor=LANDSAT_ARD) -> dict:
     initializing pixel, ``close`` on rounds closing a segment, ``refit``
     on rounds fitting a model, ``monitor`` every round.  ``total`` is
     the ungated (every-round) sum — the pre-gating upper bound.
+
+    ``mixed`` (FIREBIRD_MIXED_PRECISION) adds a ``mixed`` sub-dict
+    modeling the bf16 split-dot gram (pallas_ops._gram_cd_core): the
+    useful arithmetic is UNCHANGED (total stays comparable across
+    rungs), but the Gram/corr dots execute 2 and 3 bf16 MXU passes
+    instead of f32-"highest"'s 6, with bf16 (half-width) operands —
+    bench_detail turns that into the mixed compute ceiling.
     """
     B = sensor.n_bands
     D = len(sensor.detection_bands)
@@ -113,10 +121,30 @@ def round_flops(P: int, T: int, W: int, sensor=LANDSAT_ARD) -> dict:
              + _sort_flops(P * B, params.PEEK_SIZE))         # mags median
     refit = _lasso_fit_flops(P, T, B, with_rmse=True)       # cfull
     init = init_fit + init_resid + tmask + onehot_w
-    return {"init_fit": init_fit, "init_resid": init_resid,
-            "tmask": tmask, "onehot": onehot_w, "monitor": monitor,
-            "close": close, "refit": refit, "init": init,
-            "total": init + monitor + close + refit}
+    out = {"init_fit": init_fit, "init_resid": init_resid,
+           "tmask": tmask, "onehot": onehot_w, "monitor": monitor,
+           "close": close, "refit": refit, "init": init,
+           "total": init + monitor + close + refit}
+    if mixed:
+        # Per firing fit (the INIT stability fit and the shared refit
+        # each contain one Gram + one corr): the useful dot FLOPs that
+        # move from the f32-"highest" MXU schedule (6 bf16 passes per
+        # dot) to the split-dot schedule (Gram 2 — 0/1 weights are
+        # bf16-exact; corr 3 — lo·lo dropped).  Everything else (CD
+        # loop, RMSE, monitor, Tmask, medians) stays f32: the decision
+        # envelope.
+        gram_dot = 2.0 * P * T * K * K
+        corr_dot = 2.0 * P * B * T * K
+        out["mixed"] = {
+            "gram_dot_flops": gram_dot, "corr_dot_flops": corr_dot,
+            "mxu_passes_f32": 6, "mxu_passes_gram": 2,
+            "mxu_passes_corr": 3,
+            "gram_operand_bytes_ratio": 0.5,    # bf16 vs f32 operands
+            "dot_stage_speedup_model": round(
+                6.0 * (gram_dot + corr_dot)
+                / (2.0 * gram_dot + 3.0 * corr_dot), 2),
+        }
+    return out
 
 
 def setup_flops(P: int, T: int, sensor=LANDSAT_ARD) -> float:
@@ -133,13 +161,14 @@ def setup_flops(P: int, T: int, sensor=LANDSAT_ARD) -> float:
 
 def detect_flops(P: int, T: int, W: int, rounds: float,
                  sensor=LANDSAT_ARD,
-                 phase_rounds: tuple | None = None) -> dict:
+                 phase_rounds: tuple | None = None,
+                 mixed: bool = False) -> dict:
     """Total kernel FLOPs for one dispatch and the per-pixel figure.
 
     ``phase_rounds`` = (init_rounds, fit_rounds, close_rounds) — the
     measured cond-gate execution counts (ChipSegments.round_counts).
     None models the ungated loop (every block every round)."""
-    r = round_flops(P, T, W, sensor)
+    r = round_flops(P, T, W, sensor, mixed=mixed)
     ir, fr, cr = phase_rounds if phase_rounds is not None \
         else (rounds, rounds, rounds)
     total = (r["monitor"] * rounds + r["init"] * ir + r["refit"] * fr
@@ -153,8 +182,17 @@ def round_bytes(P: int, T: int, W: int, S: int, dtype_bytes: int,
                 rounds: float = 1.0,
                 phase_rounds: tuple | None = None,
                 pallas: frozenset | set | tuple = (),
-                wire_bytes: int = 2) -> float:
+                wire_bytes: int = 2, mixed: bool = False) -> float:
     """Estimated HBM traffic (read+write) over the event loop.
+
+    ``mixed`` (FIREBIRD_MIXED_PRECISION): the HBM model is mixed-
+    INVARIANT on every route the knob actually reaches — the Pallas fit
+    kernels stream the wire int16 spectra either way, and the bf16 gram
+    operands live at the VMEM→MXU boundary, not in HBM (their halved
+    bytes are modeled in round_flops' ``mixed`` block and fold into
+    bench_detail's mixed compute ceiling).  The parameter is accepted so
+    call sites can pass the picked config through uniformly; it changes
+    no HBM term by design, and this docstring is the written argument.
 
     Per-phase apportionment mirrors the kernel's cond gates
     (_detect_batch_impl): the score-group spectra read, the [P,T]
@@ -408,18 +446,25 @@ def bench_detail(pixels_per_sec: float, P: int, T: int, W: int, S: int,
                  rounds: float, device_kind: str, dtype_bytes: int = 4,
                  sensor=LANDSAT_ARD, phase_rounds: tuple | None = None,
                  pallas: frozenset | set | tuple = (),
-                 wire_bytes: int = 2) -> dict:
+                 wire_bytes: int = 2, mixed: bool = False) -> dict:
     """The roofline block bench.py embeds in its detail output.
 
     ``phase_rounds`` = measured (init, fit, close) cond-gate counts
     (ChipSegments.round_counts) — makes the model reflect what the
     phase-gated loop actually executed instead of the ungated bound.
     ``pallas`` = the enabled component set (see round_bytes) so the byte
-    model reflects the picked config's actual streams."""
-    fl = detect_flops(P, T, W, rounds, sensor, phase_rounds=phase_rounds)
+    model reflects the picked config's actual streams.  ``mixed`` = the
+    picked config runs the bf16 split-dot gram: the model's MFU numbers
+    stay against the SAME useful-arithmetic count (comparable across
+    rungs), and a ``mixed`` block reports the dot-stage pass/operand
+    model plus the raised compute ceiling — with
+    ``mfu_pct_vs_bf16_peak`` the headline utilization figure for the
+    picked config, since the dots then run on the bf16 MXU path."""
+    fl = detect_flops(P, T, W, rounds, sensor, phase_rounds=phase_rounds,
+                      mixed=mixed)
     by = round_bytes(P, T, W, S, dtype_bytes, sensor, rounds=rounds,
                      phase_rounds=phase_rounds, pallas=pallas,
-                     wire_bytes=wire_bytes) / max(P, 1)
+                     wire_bytes=wire_bytes, mixed=mixed) / max(P, 1)
     achieved = pixels_per_sec * fl["per_pixel"]
     hbm_rate = pixels_per_sec * by
     out = {
@@ -446,4 +491,23 @@ def bench_detail(pixels_per_sec: float, P: int, T: int, W: int, S: int,
         out["compute_bound_pixels_per_sec"] = round(
             pk.f32_flops / fl["per_pixel"], 1)
         out["hbm_bound_pixels_per_sec"] = round(pk.hbm_bytes / max(by, 1.0), 1)
+    if mixed:
+        r = fl["per_round"]
+        md = dict(r["mixed"])
+        if pk is not None and phase_rounds is not None:
+            # Mixed compute ceiling: the Gram/corr dots fire on init +
+            # fit rounds and run pass-counted at the bf16 peak; the rest
+            # of the useful arithmetic stays at the f32 peak.  Per
+            # pixel, over the dispatch:
+            ir, frr, _ = phase_rounds
+            dots = (md["gram_dot_flops"] + md["corr_dot_flops"]) \
+                * (ir + frr) / max(P, 1)
+            rest = max(fl["per_pixel"] - dots, 0.0)
+            t_mixed = (md["mxu_passes_gram"] * md["gram_dot_flops"]
+                       + md["mxu_passes_corr"] * md["corr_dot_flops"]) \
+                * (ir + frr) / max(P, 1) / pk.bf16_flops \
+                + rest / pk.f32_flops
+            md["mixed_compute_bound_pixels_per_sec"] = round(
+                1.0 / max(t_mixed, 1e-30), 1)
+        out["mixed"] = md
     return out

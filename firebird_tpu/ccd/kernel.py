@@ -109,6 +109,40 @@ def use_fused_fit() -> bool:
     return env_knob("FIREBIRD_FUSED_FIT") not in ("", "0")
 
 
+def fused_mode():
+    """FIREBIRD_FUSED_FIT's three-way resolution: 0 (off), 1 (the fused
+    fit+close kernel, byte-identical to the unfused chain), or "mon"
+    (value "mon" or "2" — the monitor-fused round kernel
+    pallas_ops.fused_round, one VMEM residency for the whole post-INIT
+    round; decision-exact with the seg_mag f32 envelope, like the mega
+    route).  Read at trace time like use_pallas."""
+    from firebird_tpu.config import env_knob
+
+    v = env_knob("FIREBIRD_FUSED_FIT")
+    if v in ("", "0"):
+        return 0
+    if v in ("2", "mon"):
+        return "mon"
+    return 1
+
+
+def use_mixed_precision() -> bool:
+    """Whether the fit kernels accumulate the Gram/corr dots in bf16
+    split form (f32 accumulators, int32 counts) instead of the 6-pass
+    f32-"highest" emulation — pallas_ops._gram_cd_core's ``mixed``
+    path.  Decision fields stay identical to the f32 path (the split
+    exploits the int16-valued spectra and 0/1 weights; coef/rmse drift
+    is bounded by params.MIXED_ULP_BUDGET — tools/precision_smoke.py
+    enforces both).  FIREBIRD_MIXED_PRECISION, default off; read at
+    trace time like use_pallas; applies only to f32 stores (the f64
+    bit-parity path keeps full precision) and only to the Pallas fit
+    routes — the XLA reference path stays f32, it IS the oracle the
+    identity tests compare against."""
+    from firebird_tpu.config import env_knob
+
+    return env_knob("FIREBIRD_MIXED_PRECISION") not in ("", "0")
+
+
 # ---------------------------------------------------------------------------
 # Results container
 # ---------------------------------------------------------------------------
@@ -626,19 +660,22 @@ def _detect_core(X, Xt, t, valid, Y, qa, *, wcap: int | None = None,
 
 
 def _fit_chip(res, w, coefmask, with_rmse=True, *, fit_pallas, on_tpu,
-              active=None):
+              mixed=False, active=None):
     """One chip's batched Lasso fit, routed to the winning implementation
     (the fused Pallas Gram+corr+CD+RMSE kernel reads the wire-dtype
     resident spectra; the lax path reads the widened float view).
-    ``active`` is the compaction-mode skip guard: pixels outside it carry
-    all-zero windows, so dead lane blocks are skipped for the zeros they
-    would compute (see _fit_lasso_coefs)."""
+    ``mixed`` (FIREBIRD_MIXED_PRECISION) selects the bf16 split-dot
+    Gram on the Pallas route only — the XLA path stays f32 (it is the
+    oracle the decision-identity tests compare against).  ``active`` is
+    the compaction-mode skip guard: pixels outside it carry all-zero
+    windows, so dead lane blocks are skipped for the zeros they would
+    compute (see _fit_lasso_coefs)."""
     if fit_pallas:
         from firebird_tpu.ccd import pallas_ops
 
         b, r = pallas_ops.lasso_fit(res["Yt"], w, res["X"], coefmask,
-                                    with_rmse=with_rmse, active=active,
-                                    interpret=not on_tpu)
+                                    with_rmse=with_rmse, mixed=mixed,
+                                    active=active, interpret=not on_tpu)
         return (b, r) if with_rmse else b
     if with_rmse:
         return _fit_lasso(res["X"], res["Y"], w, coefmask, XX=res["XX"],
@@ -787,7 +824,8 @@ def _prologue(X, Xt, t, valid, Y, qa, *, sensor, S, fdtype, fit,
     return res, state
 
 
-def _init_block(res, st, *, sensor, W, fdtype, fit, f32_ok, guards=False):
+def _init_block(res, st, *, sensor, W, fdtype, fit, f32_ok, mixed=False,
+                guards=False):
     """One chip's INIT-phase round work: initialization-window search, the
     Tmask IRLS screen, and the stability test.  Runs under a scalar
     lax.cond — on rounds where no pixel is initializing (most of them:
@@ -814,7 +852,7 @@ def _init_block(res, st, *, sensor, W, fdtype, fit, f32_ok, guards=False):
 
         return pallas_ops.init_window(
             alive, st["cur_i"], in_init, t, X, Xt, res["Yt"],
-            res["vario"], W=W, sensor=sensor, active=act,
+            res["vario"], W=W, sensor=sensor, mixed=mixed, active=act,
             interpret=not on_tpu)
 
     Y = res["Y"]
@@ -1192,7 +1230,8 @@ def _detect_batch_core(Xs, Xts, ts, valids, Ys, qas, *,
                        wcap: int | None = None, sensor=LANDSAT_ARD,
                        max_segments: int = MAX_SEGMENTS, dtype=None,
                        compact: bool | None = None,
-                       fused: bool | None = None, rebalance=None):
+                       fused=None, mixed: bool | None = None,
+                       rebalance=None):
     """A chip batch: Xs [C,T,8], Xts [C,T,5], ts [C,T], valids [C,T],
     Ys [C,B,P,T] (wire int16 or float), qas [C,P,T] int32 → ChipSegments
     with [C, ...] leading axes.
@@ -1233,7 +1272,16 @@ def _detect_batch_core(Xs, Xts, ts, valids, Ys, qas, *,
     pair through the fused gram→CD→close Pallas kernel (None defers to
     FIREBIRD_FUSED_FIT at trace time, like ``compact``); results are
     byte-identical against the unfused Pallas-fit configuration
-    (tests/test_fuse.py golden).
+    (tests/test_fuse.py golden).  The value "mon" (or env "mon"/"2")
+    instead fuses the WHOLE post-INIT round — monitor chain + close +
+    fit — into one pallas_call (pallas_ops.fused_round); that route is
+    decision-exact with seg_mag inside the f32 envelope, like mega.
+
+    ``mixed`` (static) accumulates the fit kernels' Gram/corr dots in
+    bf16 split form with f32 accumulators and int32 counts (None defers
+    to FIREBIRD_MIXED_PRECISION at trace time) — decision fields stay
+    identical to f32, coef/rmse inside params.MIXED_ULP_BUDGET; f32
+    stores and Pallas fit routes only (see use_mixed_precision).
 
     ``rebalance`` (static; a parallel.mesh.RebalanceSpec, sharded
     dispatches only) arms the cross-device straggler rebalancing ring at
@@ -1244,12 +1292,13 @@ def _detect_batch_core(Xs, Xts, ts, valids, Ys, qas, *,
         return _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, wcap=wcap,
                                   sensor=sensor, max_segments=max_segments,
                                   dtype=dtype, compact=compact,
-                                  fused=fused, rebalance=rebalance)
+                                  fused=fused, mixed=mixed,
+                                  rebalance=rebalance)
 
 
 def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
                        max_segments, dtype, compact=None, fused=None,
-                       rebalance=None):
+                       mixed=None, rebalance=None):
     C, B, P, T = Ys.shape
     S = max_segments
     W = T if wcap is None else min(wcap, T)
@@ -1270,7 +1319,15 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
 
         mega = pallas_ops.mega_fits(T, W, B, S, Ys.dtype.itemsize)
     fit_pallas = (use_pallas("fit") or mega) and f32_ok
-    fit = functools.partial(_fit_chip, fit_pallas=fit_pallas, on_tpu=on_tpu)
+    # Mixed-precision gram (FIREBIRD_MIXED_PRECISION / explicit mixed=):
+    # bf16 split dots + int32 counts inside the Pallas fit routes, f32
+    # everywhere decisions are made.  f32 stores only — the f64
+    # bit-parity path keeps full precision — and inert on the XLA fit
+    # path, which stays the f32 oracle.
+    mixed_on = (use_mixed_precision() if mixed is None else bool(mixed)) \
+        and f32_ok and fdtype == jnp.float32
+    fit = functools.partial(_fit_chip, fit_pallas=fit_pallas,
+                            on_tpu=on_tpu, mixed=mixed_on)
     wire_only = (mega or _wire_resident_only()) and f32_ok
     # Active-lane compaction (trace-time resolution, like use_pallas).
     # The mega route already stops paying for finished pixels its own way
@@ -1281,10 +1338,34 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
     # Fused gram→CD→close round kernel (FIREBIRD_FUSED_FIT / explicit
     # fused=): each round's segment-close + shared-Lasso-fit pair runs
     # as ONE pallas_call on a single VMEM residency of the wire spectra.
-    # The mega route supersedes it (the whole loop is already one
+    # Mode "mon" widens the fusion to the whole post-INIT round —
+    # monitor chain + close + fit in one kernel (pallas_ops.fused_round).
+    # The mega route supersedes both (the whole loop is already one
     # kernel); the f64-on-TPU bit-parity path keeps the XLA pair.
-    fused_on = (use_fused_fit() if fused is None else bool(fused)) \
-        and f32_ok and not mega
+    fused_req = fused_mode() if fused is None else fused
+    if fused_req in ("mon", 2):
+        fused_req = "mon"
+    elif fused_req:
+        fused_req = 1
+    else:
+        fused_req = 0
+    fused_on = bool(fused_req) and f32_ok and not mega
+    fused_mon = fused_on and fused_req == "mon"
+
+    # Trace-time route counters (host code; a jit trace runs once per
+    # compiled shape, so these count PROGRAMS built on each route —
+    # tools/precision_smoke.py's "counters moving" check).
+    from firebird_tpu.obs import metrics as obs_metrics
+    if mixed_on:
+        obs_metrics.counter(
+            "kernel_mixed_traces",
+            help="programs traced with the bf16/int32 mixed-precision "
+                 "gram (FIREBIRD_MIXED_PRECISION)").inc()
+    if fused_mon:
+        obs_metrics.counter(
+            "kernel_fused_round_traces",
+            help="programs traced with the whole-round monitor-fused "
+                 "kernel (FIREBIRD_FUSED_FIT=mon)").inc()
 
     res, state = jax.vmap(functools.partial(
         _prologue, sensor=sensor, S=S, fdtype=fdtype, fit=fit,
@@ -1304,7 +1385,7 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
             res["vario"], W=W, S=S, sensor=sensor,
             phases=(PHASE_INIT, PHASE_MONITOR, PHASE_DONE),
             change_thr=float(change_thr), outlier_thr=float(outlier_thr),
-            interpret=not on_tpu)
+            mixed=mixed_on, interpret=not on_tpu)
         final_mask = jnp.where(
             res["is_std"][..., None], out["alive"],
             jnp.where(res["is_alt"][..., None], res["alt_mask"], False))
@@ -1317,7 +1398,7 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
 
     initf = jax.vmap(functools.partial(
         _init_block, sensor=sensor, W=W, fdtype=fdtype, fit=fit,
-        f32_ok=f32_ok, guards=compact_on))
+        f32_ok=f32_ok, mixed=mixed_on, guards=compact_on))
     monf = jax.vmap(functools.partial(
         _mon_block, sensor=sensor, change_thr=change_thr,
         outlier_thr=outlier_thr, f32_ok=f32_ok, guards=compact_on))
@@ -1327,7 +1408,24 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
                                                active=a))
     else:
         fitf = jax.vmap(lambda r, w, n: fit(r, w, _coefmask_for(n)))
-    if fused_on:
+    if fused_mon:
+        from firebird_tpu.ccd import pallas_ops
+
+        def _round_chip(r, st_c, init_c, act=None):
+            in_mon_c = st_c["phase"] == PHASE_MONITOR
+            return pallas_ops.fused_round(
+                r["Yt"], r["X"], r["t"], st_c["alive"], st_c["included"],
+                st_c["cur_k"], st_c["n_last_fit"], in_mon_c,
+                st_c["coefs"], st_c["rmse"], r["vario"],
+                init_c["init_ok"], init_c["w_stab"], init_c["n_ok"],
+                st_c["first_seg"], st_c["nseg"], st_c["bufs"], S=S,
+                sensor=sensor, change_thr=float(change_thr),
+                outlier_thr=float(outlier_thr), mixed=mixed_on,
+                active=act, interpret=not on_tpu)
+
+        roundf = jax.vmap(_round_chip) if compact_on \
+            else jax.vmap(functools.partial(_round_chip, act=None))
+    elif fused_on:
         from firebird_tpu.ccd import pallas_ops
 
         def _fused_chip(r, w, df, nf, mg, st_c, mn_c, act=None):
@@ -1337,7 +1435,7 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
                 mn_c["is_tail"], mn_c["is_brk"],
                 mn_c["pos_ev"], mn_c["n_exceed"],
                 st_c["first_seg"], st_c["nseg"], st_c["bufs"], S=S,
-                active=act, interpret=not on_tpu)
+                mixed=mixed_on, active=act, interpret=not on_tpu)
 
         fusedf = jax.vmap(_fused_chip) if compact_on \
             else jax.vmap(functools.partial(_fused_chip, act=None))
@@ -1430,16 +1528,57 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
             init = lax.cond(any_init,
                             lambda: initf(res_l, st),
                             lambda: _init_zeros(st))
-            mon = lax.cond(jnp.any(in_mon),
-                           lambda: monf(res_l, st), lambda: _mon_zeros(st))
 
-            close = mon["is_tail"] | mon["is_brk"]
-            any_close = jnp.any(close)
-            # Refit / init-ok shared fit (skipped when no pixel needs one).
-            init_ok, is_refit = init["init_ok"], mon["is_refit"]
-            do_fit = init_ok | is_refit
-            any_fit = jnp.any(do_fit)
-            n_full = jnp.where(init_ok, init["n_ok"], mon["n_rf"])
+            if fused_mon:
+                # Whole-round fusion: monitor chain + segment close +
+                # shared refit run as ONE pallas_call per chip
+                # (pallas_ops.fused_round), so the separate monf/closef/
+                # fitf conds collapse into a single any-work gate.  The
+                # INIT block stays cond-gated outside (rare after
+                # warmup) and hands its fit window into the kernel; the
+                # event flags come back in ``ev`` and feed the same
+                # next-state code as the other routes.
+                def _run_round():
+                    if compact_on:
+                        return roundf(res_l, st, init,
+                                      in_mon | init["init_ok"])
+                    return roundf(res_l, st, init)
+
+                def _skip_round():
+                    zb = jnp.zeros_like(in_mon)
+                    zi = jnp.zeros_like(st["cur_i"])
+                    ev0 = dict(is_tail=zb, is_brk=zb, is_refit=zb,
+                               pos_ev=zi, do_fit=zb, n_full=zi,
+                               included_mon=st["included"],
+                               alive_mon=st["alive"])
+                    return (st["bufs"], st["nseg"], st["coefs"],
+                            st["rmse"], ev0)
+
+                bufs, nseg, cfull, rfull, ev = lax.cond(
+                    jnp.any(in_mon) | jnp.any(init["init_ok"]),
+                    _run_round, _skip_round)
+                mon = dict(is_tail=ev["is_tail"], is_brk=ev["is_brk"],
+                           is_refit=ev["is_refit"], pos_ev=ev["pos_ev"],
+                           included_mon=ev["included_mon"],
+                           alive_mon=ev["alive_mon"])
+                close = mon["is_tail"] | mon["is_brk"]
+                any_close = jnp.any(close)
+                init_ok, is_refit = init["init_ok"], mon["is_refit"]
+                do_fit, n_full = ev["do_fit"], ev["n_full"]
+                any_fit = jnp.any(do_fit)
+            else:
+                mon = lax.cond(jnp.any(in_mon),
+                               lambda: monf(res_l, st),
+                               lambda: _mon_zeros(st))
+
+                close = mon["is_tail"] | mon["is_brk"]
+                any_close = jnp.any(close)
+                # Refit / init-ok shared fit (skipped when no pixel
+                # needs one).
+                init_ok, is_refit = init["init_ok"], mon["is_refit"]
+                do_fit = init_ok | is_refit
+                any_fit = jnp.any(do_fit)
+                n_full = jnp.where(init_ok, init["n_ok"], mon["n_rf"])
 
             def _w_full():
                 # The [C,P,T] fit-window build lives inside the branches
@@ -1447,7 +1586,9 @@ def _detect_batch_impl(Xs, Xts, ts, valids, Ys, qas, *, wcap, sensor,
                 return jnp.where(init_ok[..., None], init["w_stab"],
                                  mon["included_mon"] & is_refit[..., None])
 
-            if fused_on:
+            if fused_mon:
+                pass        # bufs/nseg/cfull/rfull merged in-kernel above
+            elif fused_on:
                 # One fused pallas_call serves the close AND the shared
                 # fit on a single VMEM residency of the wire spectra;
                 # the do_fit coefs/rmse merge happens in-kernel, so the
@@ -1710,7 +1851,7 @@ def device_designs(days, n_obs, dtype):
 def _detect_batch_wire(days_i32, n_obs_i32, Y_i16, qa_wire, *, dtype,
                        wcap=None, sensor=LANDSAT_ARD,
                        max_segments=MAX_SEGMENTS, compact=None,
-                       fused=None):
+                       fused=None, mixed=None):
     """Batch detect from the all-integer wire: spectra ride int16, QA
     uint8/uint16, and the day ordinals ride int32 — the harmonic design
     matrices, the float date grid, and the validity mask are built on
@@ -1724,11 +1865,12 @@ def _detect_batch_wire(days_i32, n_obs_i32, Y_i16, qa_wire, *, dtype,
     return _detect_batch_core(Xs, Xts, ts, valids, Y_i16,
                               qa_wire.astype(jnp.int32), wcap=wcap,
                               sensor=sensor, max_segments=max_segments,
-                              dtype=dtype, compact=compact, fused=fused)
+                              dtype=dtype, compact=compact, fused=fused,
+                              mixed=mixed)
 
 
 _WIRE_STATICS = ("dtype", "wcap", "sensor", "max_segments", "compact",
-                 "fused")
+                 "fused", "mixed")
 # Donating twin for the driver's staged steady-state dispatch: the packed
 # wire buffers (spectra + QA, the dominant HBM input term) are consumed by
 # the dispatch, so a deeper pipeline (Config.pipeline_depth) doesn't pin
@@ -2032,7 +2174,8 @@ def stage_packed(packed, dtype) -> tuple:
 
 def aot_compile(avatars, *, dtype, wcap, sensor=LANDSAT_ARD,
                 max_segments: int = MAX_SEGMENTS, donate: bool = False,
-                compact: bool | None = None, fused: bool | None = None):
+                compact: bool | None = None, fused=None,
+                mixed: bool | None = None):
     """AOT lower+compile the wire-dtype batch program for a shape WITHOUT
     running it (``avatars`` are jax.ShapeDtypeStructs in the
     ``_detect_batch_wire`` argument order: days int32 [C,T], n_obs int32
@@ -2047,7 +2190,7 @@ def aot_compile(avatars, *, dtype, wcap, sensor=LANDSAT_ARD,
     fn = _detect_batch_wire_donated if donate else _detect_batch_wire
     return fn.lower(*avatars, dtype=jnp.dtype(dtype), wcap=wcap,
                     sensor=sensor, max_segments=max_segments,
-                    compact=compact, fused=fused).compile()
+                    compact=compact, fused=fused, mixed=mixed).compile()
 
 
 def detect_packed(packed, dtype=jnp.float32,
@@ -2055,7 +2198,7 @@ def detect_packed(packed, dtype=jnp.float32,
                   check_capacity: bool = True, staged: tuple | None = None,
                   donate: bool = False,
                   compact: bool | None = None,
-                  fused: bool | None = None) -> ChipSegments:
+                  fused=None, mixed: bool | None = None) -> ChipSegments:
     """Run the kernel over a PackedChips batch -> ChipSegments with leading
     chip axis [C, P, ...].  The batch's sensor spec selects the band
     layout the kernel compiles for.
@@ -2074,18 +2217,20 @@ def detect_packed(packed, dtype=jnp.float32,
     instead of transferring here; ``donate=True`` (honored only with
     ``check_capacity=False`` — a retry would re-dispatch deleted buffers)
     frees the wire input buffers at dispatch.  ``compact`` overrides the
-    FIREBIRD_COMPACT default (params.compact_default) per call.
+    FIREBIRD_COMPACT default (params.compact_default) per call;
+    ``fused`` (False/True/"mon") and ``mixed`` likewise override
+    FIREBIRD_FUSED_FIT / FIREBIRD_MIXED_PRECISION.
     """
     ensure_x64(dtype)
     args = staged if staged is not None else stage_packed(packed, dtype)
     kw = dict(dtype=jnp.dtype(dtype), wcap=window_cap(packed),
               sensor=getattr(packed, "sensor", LANDSAT_ARD),
-              compact=compact, fused=fused)
+              compact=compact, fused=fused, mixed=mixed)
     fn = _detect_batch_wire_donated if donate and not check_capacity \
         else _detect_batch_wire
     dispatch = lambda S: record_first_call(
         ("single", packed.spectra.shape, str(kw["dtype"]), kw["wcap"],
-         kw["sensor"].name, S, compact, fused),
+         kw["sensor"].name, S, compact, fused, mixed),
         lambda: fn(*args, max_segments=S, **kw))
     if not check_capacity:
         return dispatch(max(max_segments, 1))

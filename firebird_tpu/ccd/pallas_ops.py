@@ -50,6 +50,36 @@ from firebird_tpu.ccd import params
 BLOCK_P = 512   # pixels per grid step (4 x 128 lanes, f32)
 
 
+def _env_block_p() -> int | None:
+    """FIREBIRD_MEGA_BLOCK_P: static lane-block width override for the
+    multi-phase kernels (detect_mega / fused_round), consumed when the
+    caller passes ``block_p=None``.  This is how tools/fuse_repro.py's
+    bisected smallest-compiling block shape becomes the DEFAULT instead
+    of an advisory artifact: bench.py seeds the knob from
+    fuse_repro.json before racing the mega rungs.  Read at trace time
+    (set before the first dispatch, like FIREBIRD_PALLAS); values are
+    rounded down to the 128-lane vector width, <=0/garbage means no
+    override."""
+    from firebird_tpu.config import env_knob
+
+    v = env_knob("FIREBIRD_MEGA_BLOCK_P")
+    try:
+        n = int(v) if v else 0
+    except (TypeError, ValueError):
+        n = 0
+    return (n // 128) * 128 if n >= 128 else None
+
+
+def _split_bf16(x):
+    """hi/lo bf16 split of an f32 plane: ``hi`` is x rounded to bf16,
+    ``lo`` the bf16-rounded residual — together a ~16-bit-significand
+    representation whose MXU dots accumulate in f32 (the mixed-precision
+    gram's operand form; see _gram_cd_core)."""
+    hi = x.astype(jnp.bfloat16)
+    lo = (x - hi.astype(x.dtype)).astype(jnp.bfloat16)
+    return hi, lo
+
+
 # ---------------------------------------------------------------------------
 # Per-block skip guards (active-lane compaction).  Every kernel here
 # grids over pixel-lane blocks; with the event loop's dense-prefix
@@ -200,7 +230,8 @@ def fit_block_p(T: int, B: int, y_bytes: int) -> int:
     return max(128, min(512, (budget // per_lane) // 128 * 128))
 
 
-def _gram_cd_core(XT, XXT, y_of, wb, mask, *, B, K, iters, alpha):
+def _gram_cd_core(XT, XXT, y_of, wb, mask, *, B, K, iters, alpha,
+                  mixed=False):
     """Gram + corr + CD loop on VMEM-resident planes — the exact
     kernel._fit_lasso_coefs math (same normalization, update order,
     unpenalized intercept), shared by the fused fit kernel and the
@@ -215,15 +246,52 @@ def _gram_cd_core(XT, XXT, y_of, wb, mask, *, B, K, iters, alpha):
     Mosaic's ApplyVectorLayoutPass dies on the folded sublane-slice
     pattern ("Check failed: limits[i] <= dim(i) (4 vs. 1)", real-v5e
     remote compiler, bisected r5).  Returns (beta [B,K,BP], n [1,BP]).
+
+    ``mixed`` (FIREBIRD_MIXED_PRECISION) swaps the Gram/corr dots — the
+    only MXU work here, which default_matmul_precision("highest") runs
+    as SIX bf16 passes each on TPU — for hi/lo bf16 split dots with f32
+    accumulators (preferred_element_type) and an int32 window count:
+
+      * ``wb`` is exactly 0/1, so its bf16 image is EXACT and the Gram
+        needs only the XXT split: 2 passes instead of 6.
+      * ``y*wb`` is int16-valued (the wire spectra are int16; PR 11), so
+        its hi/lo split is EXACT (hi captures the top 8 significand
+        bits, the residual is an integer < 2^8 — bf16-representable);
+        dropping only the lo·lo cross term leaves 3 passes with a
+        ~2^-17 relative error vs "highest"'s ~2^-24 — inside the pinned
+        ulp budget (params.MIXED_ULP_BUDGET) and empirically
+        decision-identical (tools/precision_smoke.py, tests/test_fuse).
+      * the count n is an exact int32 sum of 0/1 weights.
+
+    Everything downstream of the dots — diag floors, the CD loop, and
+    every consumer (RMSE predictions, monitor scores, chi2 thresholds,
+    the close-median) — stays f32: the decision envelope.
     """
     f32 = wb.dtype
-    n = jnp.maximum(jnp.sum(wb, 0, keepdims=True), 1.0)       # [1, BP]
-    G = jnp.dot(XXT, wb, preferred_element_type=f32) / n      # [K*K, BP]
+    if mixed:
+        ni = jnp.sum(wb.astype(jnp.int32), 0, keepdims=True)  # exact count
+        n = jnp.maximum(ni, 1).astype(f32)                    # [1, BP]
+        wh = wb.astype(jnp.bfloat16)                          # exact 0/1
+        xxh, xxl = _split_bf16(XXT)
+        G = (jnp.dot(xxh, wh, preferred_element_type=f32)
+             + jnp.dot(xxl, wh, preferred_element_type=f32)) / n
+    else:
+        n = jnp.maximum(jnp.sum(wb, 0, keepdims=True), 1.0)   # [1, BP]
+        G = jnp.dot(XXT, wb, preferred_element_type=f32) / n  # [K*K, BP]
     diag = jnp.maximum(
         jnp.concatenate([G[j * K + j][None] for j in range(K)], 0), 1e-12)
 
-    cs = [jnp.dot(XT, y_of(bb) * wb, preferred_element_type=f32) / n
-          for bb in range(B)]                                 # B x [K, BP]
+    if mixed:
+        th, tl = _split_bf16(XT)
+        cs = []
+        for bb in range(B):
+            yh, yl = _split_bf16(y_of(bb) * wb)               # exact split
+            cs.append((jnp.dot(th, yh, preferred_element_type=f32)
+                       + jnp.dot(th, yl, preferred_element_type=f32)
+                       + jnp.dot(tl, yh, preferred_element_type=f32)) / n)
+    else:
+        cs = [jnp.dot(XT, y_of(bb) * wb, preferred_element_type=f32) / n
+              for bb in range(B)]                             # B x [K, BP]
 
     # Mosaic legality (real-v5e remote compiler, r5): any 3D [B,K,BP] op
     # whose lowering touches the tiled sublane (K) axis — vector.extract
@@ -261,7 +329,7 @@ def _gram_cd_core(XT, XXT, y_of, wb, mask, *, B, K, iters, alpha):
 
 
 def _fit_block(x_ref, xt_ref, xxt_ref, y_ref, w_ref, mask_ref, *refs,
-               B, K, iters, alpha, with_rmse, guarded=False):
+               B, K, iters, alpha, with_rmse, mixed=False, guarded=False):
     """One pixel block: Gram/corr builds, the full CD loop, and the
     weighted-window RMSE, all in VMEM.
 
@@ -282,7 +350,7 @@ def _fit_block(x_ref, xt_ref, xxt_ref, y_ref, w_ref, mask_ref, *refs,
         y_of = lambda bb: y_ref[bb].astype(f32)
         beta, n = _gram_cd_core(xt_ref[...], xxt_ref[...], y_of, wb,
                                 mask_ref[...], B=B, K=K, iters=iters,
-                                alpha=alpha)
+                                alpha=alpha, mixed=mixed)
         b_ref[...] = beta
 
         if with_rmse:
@@ -302,9 +370,10 @@ def _fit_block(x_ref, xt_ref, xxt_ref, y_ref, w_ref, mask_ref, *refs,
     _when_active(cnt_ref, compute, lambda: _zero_refs(b_ref, r_ref))
 
 
-@functools.partial(jax.jit, static_argnames=("with_rmse", "interpret"))
-def lasso_fit(Yt, w, X, coefmask, *, with_rmse=True, active=None,
-              interpret=False):
+@functools.partial(jax.jit, static_argnames=("with_rmse", "mixed",
+                                             "interpret"))
+def lasso_fit(Yt, w, X, coefmask, *, with_rmse=True, mixed=False,
+              active=None, interpret=False):
     """Fused Pallas twin of kernel._fit_lasso / _fit_lasso_coefs.
 
     Under plain XLA the fit path materializes the [P,B,T] ``Y*w`` product
@@ -318,6 +387,9 @@ def lasso_fit(Yt, w, X, coefmask, *, with_rmse=True, active=None,
         w: [P, T] 0/1 fit-window weights (float).
         X: [T, K] design (chip-shared).
         coefmask: [P, K] allowed coefficients.
+        mixed: FIREBIRD_MIXED_PRECISION — bf16 split-dot Gram/corr with
+            f32 accumulation + int32 counts (see _gram_cd_core); the CD
+            loop and RMSE stay f32.
         active: optional [P] bool skip guard — inactive lanes must carry
             all-zero windows (see module note).
     Returns:
@@ -351,7 +423,7 @@ def lasso_fit(Yt, w, X, coefmask, *, with_rmse=True, active=None,
     kern = functools.partial(_fit_block, B=B, K=K,
                              iters=int(params.LASSO_ITERS),
                              alpha=float(params.LASSO_ALPHA),
-                             with_rmse=bool(with_rmse),
+                             with_rmse=bool(with_rmse), mixed=bool(mixed),
                              guarded=active is not None)
     beta, rmse = pl.pallas_call(
         kern,
@@ -719,7 +791,7 @@ def _first_ge(mask, ti, T):
 def _init_logic(alive, cur_i, in_init, t_col, X, Xtr, XTK, XXT, y_of,
                 vario, *, T, W, B, K, NT, n_pow, det, tmb, cd_iters,
                 alpha, tm_iters, huber_k, tmask_const, meow, init_days,
-                stab_factor):
+                stab_factor, mixed=False):
     """The INIT-phase round work on VMEM-resident planes — shared by the
     standalone init_window kernel and the whole-loop mega kernel.
 
@@ -806,7 +878,8 @@ def _init_logic(alive, cur_i, in_init, t_col, X, Xtr, XTK, XXT, y_of,
         1.0, 0.0).astype(f32)
     c4, _ = _gram_cd_core(XTK, XXT, lambda b: Yf[b],
                           jnp.where(w_stab, 1.0, 0.0).astype(f32), cm4,
-                          B=B, K=K, iters=cd_iters, alpha=alpha)
+                          B=B, K=K, iters=cd_iters, alpha=alpha,
+                          mixed=mixed)
     stab_w = valid_w & ~bad_w
     stab_f = jnp.where(stab_w, 1.0, 0.0).astype(f32)
     n4 = jnp.maximum(jnp.sum(stab_f, 0, keepdims=True), 1.0)
@@ -898,9 +971,10 @@ def _init_window_block(alive_ref, curi_ref, inin_ref, t_ref, x_ref, xtr_ref,
     _when_active(cnt_ref, compute, skip)
 
 
-@functools.partial(jax.jit, static_argnames=("W", "sensor", "interpret"))
+@functools.partial(jax.jit, static_argnames=("W", "sensor", "mixed",
+                                             "interpret"))
 def init_window(alive, cur_i, in_init, t, X, Xt, Yt, vario, *, W, sensor,
-                active=None, interpret=False):
+                mixed=False, active=None, interpret=False):
     """Fused Pallas twin of kernel._init_block (same output contract).
 
     Args:
@@ -940,7 +1014,7 @@ def init_window(alive, cur_i, in_init, t, X, Xt, Yt, vario, *, W, sensor,
         huber_k=float(params.HUBER_K),
         tmask_const=float(params.TMASK_CONST),
         meow=int(params.MEOW_SIZE), init_days=float(params.INIT_DAYS),
-        stab_factor=float(params.STABILITY_FACTOR),
+        stab_factor=float(params.STABILITY_FACTOR), mixed=bool(mixed),
         guarded=active is not None)
     pspec = pl.BlockSpec((T, BP), lambda i: (0, i))
     vspec = pl.BlockSpec((1, BP), lambda i: (0, i))
@@ -1221,7 +1295,7 @@ def _fused_fit_close_block(x_ref, xtk_ref, xxt_ref, t_ref, y_ref,
                            mags0_ref, coefs0_ref, *refs, T, B, K, S, peek,
                            qa_start, qa_inside, qa_end,
                            cd_iters, alpha, num_obs_factor, mid_coefs,
-                           guarded=False):
+                           mixed=False, guarded=False):
     """One pixel block's fit round ACROSS the gram→CD→close boundary:
     the segment-close row write against the closing model and the shared
     Lasso refit (_gram_cd_core + RMSE) run back to back on one VMEM
@@ -1312,7 +1386,8 @@ def _fused_fit_close_block(x_ref, xtk_ref, xxt_ref, t_ref, y_ref,
             lax.broadcasted_iota(i32, (K,) + n_full.shape[1:], 0) < nc,
             1.0, 0.0).astype(f32)
         beta, n = _gram_cd_core(xtk_ref[...], xxt_ref[...], y_of, wf, cm,
-                                B=B, K=K, iters=cd_iters, alpha=alpha)
+                                B=B, K=K, iters=cd_iters, alpha=alpha,
+                                mixed=mixed)
         rs = []
         for b in range(B):
             pred = jnp.dot(X, beta[b], preferred_element_type=f32)
@@ -1346,11 +1421,12 @@ def _fused_fit_close_block(x_ref, xtk_ref, xxt_ref, t_ref, y_ref,
     _when_active(cnt_ref, compute, skip)
 
 
-@functools.partial(jax.jit, static_argnames=("S", "block_p", "interpret"))
+@functools.partial(jax.jit, static_argnames=("S", "mixed", "block_p",
+                                             "interpret"))
 def fused_fit_close(Yt, X, t, w_fit, do_fit, n_full, included_mon,
                     coefs, rmse, mags, is_tail, is_brk, pos_ev,
-                    n_exceed, first_seg, nseg, bufs, *, S, active=None,
-                    block_p=None, interpret=False):
+                    n_exceed, first_seg, nseg, bufs, *, S, mixed=False,
+                    active=None, block_p=None, interpret=False):
     """Fused Pallas twin of one round's close + shared-fit pair
     (kernel._close_block + the refit's fit), reading the wire-dtype
     resident spectra ONCE per pixel block.
@@ -1430,7 +1506,8 @@ def fused_fit_close(Yt, X, t, w_fit, do_fit, n_full, included_mon,
         qa_end=int(params.CURVE_QA_END),
         cd_iters=int(params.LASSO_ITERS), alpha=float(params.LASSO_ALPHA),
         num_obs_factor=int(params.NUM_OBS_FACTOR),
-        mid_coefs=int(params.MID_COEFS), guarded=active is not None)
+        mid_coefs=int(params.MID_COEFS), mixed=bool(mixed),
+        guarded=active is not None)
     outs = pl.pallas_call(
         kern,
         grid=(Pp // BP,),
@@ -1453,6 +1530,327 @@ def fused_fit_close(Yt, X, t, w_fit, do_fit, n_full, included_mon,
               unflat(coefsb_n, B * K))
     return (bufs_n, nseg_n[0, :P], co[..., :P].transpose(2, 0, 1),
             ro[:, :P].T)
+
+
+# ---------------------------------------------------------------------------
+# Monitor-fused round kernel (FIREBIRD_FUSED_FIT=mon): monitor → close →
+# fit — the ENTIRE post-INIT round — in one pallas_call / one VMEM
+# residency of the wire spectra.
+# ---------------------------------------------------------------------------
+
+def fused_round_block_p(T: int, B: int, S: int, y_bytes: int) -> int:
+    """Lane-block width for the monitor-fused round kernel: the fused
+    fit+close footprint (fused_block_p) plus the monitor chain's ~12
+    live [T,BP] scan planes (score, rank, run-length / refit-ladder
+    shift scans) — hence the 20-plane T term."""
+    budget = 10 * 2 ** 20
+    per_lane = (max(T, 1) * (B * y_bytes + 20 * 4)
+                + 2 * max(S, 1) * (6 + 2 * B + B * params.MAX_COEFS) * 4
+                + params.PEEK_SIZE * (params.MAX_COEFS + B + 4) * 4)
+    return max(128, min(512, (budget // per_lane) // 128 * 128))
+
+
+def _fused_round_block(x_ref, xtk_ref, xxt_ref, t_ref, y_ref,
+                       alive_ref, inc_ref, curk_ref, nlast_ref, inmon_ref,
+                       coefs_ref, rmse_ref, vario_ref,
+                       initok_ref, wstab_ref, nok_ref,
+                       first_ref, nseg_ref,
+                       meta0_ref, rmses0_ref, mags0_ref, coefs0_ref,
+                       *refs, T, B, K, S, det, peek, n_pow_peek,
+                       change_thr, outlier_thr, refit_factor,
+                       qa_start, qa_inside, qa_end, cd_iters, alpha,
+                       num_obs_factor, mid_coefs, mixed, guarded=False):
+    """One pixel block's ENTIRE post-INIT round — monitor scoring/event
+    chain, segment close (including the break-magnitude median, in-VMEM
+    like the mega kernel), and the shared Lasso refit — on one VMEM
+    residency of the wire spectra.  Composes the same shared cores as
+    the per-component kernels (_mon_scored_logic, _close_logic,
+    _gram_cd_core) with the mega block's cond gates, so a round with no
+    monitoring / closing / fitting lane skips that phase's work
+    entirely.  The contract is the mega route's decision-exact-with-
+    envelope, NOT fused_fit_close's byte identity: the break magnitudes
+    are computed here from the in-VMEM PEEK run rather than arriving
+    from kernel._close_mags (seg_mag sits inside the pinned ulp
+    envelope; every decision field is an exact select/integer).
+    """
+    cnt_ref, (meta_ref, rmseso_ref, magso_ref, coefsbo_ref, nsego_ref,
+              co_ref, ro_ref, tail_ref, brk_ref, refit_ref, pos_ref,
+              dofit_ref, nfull_ref, incmon_ref, alivemon_ref) = (
+                  (refs[0], refs[1:]) if guarded else (None, refs))
+
+    def compute():
+        X = x_ref[...]
+        t_col = t_ref[...]
+        f32 = X.dtype
+        i32 = jnp.int32
+        one = i32(1)
+        as_i = lambda v: jnp.where(v, one, 0)
+        det_l = list(det)
+        nb = len(det_l)
+        y_of = lambda b: y_ref[b].astype(f32)
+        alive = alive_ref[...] > 0
+        included = inc_ref[...] > 0
+        in_mon = inmon_ref[...] > 0
+        coefs = coefs_ref[...]
+        rmse = rmse_ref[...]
+        vario = vario_ref[...]
+        first_seg = first_ref[...] > 0
+        nseg0 = nseg_ref[...]
+        BP = rmse.shape[-1]
+
+        # ---- MONITOR (skipped when no lane of the block monitors) ----
+        any_mon = jnp.any(in_mon)
+        dden = jnp.concatenate(
+            [jnp.maximum(rmse[b], vario[b])[None] for b in det_l], 0)
+        coefs_d = jnp.concatenate([coefs[b][None] for b in det_l], 0)
+
+        def run_mon():
+            outs = _mon_scored_logic(
+                lambda b: y_ref[det_l[b]], coefs_d, dden, X, alive,
+                included, curk_ref[...], nlast_ref[...], in_mon,
+                change_thr=change_thr, outlier_thr=outlier_thr,
+                peek=peek, refit_factor=refit_factor, T=T, nb=nb)
+            # .astype(i32): x64 promotes integer sums to i64, which
+            # would mismatch the skip branch's i32 zeros.
+            return tuple(v.astype(i32) for v in outs)
+
+        def zero_mon():
+            zv = jnp.zeros((1, BP), i32)
+            zp = jnp.zeros((T, BP), i32)
+            return (zv, zv, zv, zv, zv, zv, zv, zv, zp, zp)
+
+        (m, is_tail_i, is_brk_i, is_refit_i, ev_rank, pos_ev, n_exceed,
+         n_rf, inc_q_i, rem_q_i) = lax.cond(any_mon, run_mon, zero_mon)
+        is_tail = is_tail_i > 0
+        is_brk = is_brk_i > 0
+        is_refit = is_refit_i > 0
+        included_mon = included | ((inc_q_i > 0) & in_mon)
+        alive_mon = alive & ~((rem_q_i > 0) & in_mon)
+
+        # ---- CLOSE (in-VMEM magnitudes; the mega route's math) ----
+        close = is_tail | is_brk
+        any_close = jnp.any(close)
+
+        def run_close():
+            return _close_logic(
+                y_of, X, t_col, coefs, rmse, alive, included_mon, m,
+                is_tail, is_brk, ev_rank, pos_ev, n_exceed, first_seg,
+                nseg0, meta0_ref[...], rmses0_ref[...], mags0_ref[...],
+                coefs0_ref[...], T=T, B=B, K=K, S=S, peek=peek,
+                n_pow_peek=n_pow_peek, qa_start=qa_start,
+                qa_inside=qa_inside, qa_end=qa_end)
+
+        def keep_close():
+            return (meta0_ref[...], rmses0_ref[...], mags0_ref[...],
+                    coefs0_ref[...], nseg0)
+
+        meta_n, rmses_n, mags_n, coefs_bn, nseg_n = lax.cond(
+            any_close, run_close, keep_close)
+
+        # ---- shared Lasso fit (init-ok + refit; mega's run_fit) ----
+        init_ok = initok_ref[...] > 0
+        do_fit = init_ok | is_refit
+        any_fit = jnp.any(do_fit)
+        n_full = jnp.where(init_ok, nok_ref[...], n_rf)        # [1,BP]
+
+        def run_fit():
+            # f32-valued selects, not bool ones: an i1-result select_n
+            # lowers to an i8->i1 trunci Mosaic rejects (r5).
+            wf = jnp.where(init_ok,
+                           jnp.where(wstab_ref[...] > 0, 1.0, 0.0),
+                           jnp.where(included_mon & is_refit, 1.0, 0.0)
+                           ).astype(f32)
+            nc = jnp.where(
+                n_full >= K * num_obs_factor, K,
+                jnp.where(n_full >= mid_coefs * num_obs_factor,
+                          mid_coefs, 4))
+            cm = jnp.where(
+                lax.broadcasted_iota(i32, (K, BP), 0) < nc,
+                1.0, 0.0).astype(f32)
+            beta, n = _gram_cd_core(xtk_ref[...], xxt_ref[...], y_of, wf,
+                                    cm, B=B, K=K, iters=cd_iters,
+                                    alpha=alpha, mixed=mixed)
+            rs = []
+            for b in range(B):
+                pred = jnp.dot(X, beta[b], preferred_element_type=f32)
+                r = y_of(b) - pred
+                rs.append(jnp.sqrt(jnp.maximum(
+                    jnp.sum(r * r * wf, 0, keepdims=True) / n, 0.0)))
+            return beta, jnp.concatenate(rs, 0)
+
+        def keep_fit():
+            return coefs, rmse
+
+        cfull, rfull = lax.cond(any_fit, run_fit, keep_fit)
+
+        meta_ref[...] = meta_n
+        rmseso_ref[...] = rmses_n
+        magso_ref[...] = mags_n
+        coefsbo_ref[...] = coefs_bn
+        nsego_ref[...] = nseg_n.astype(nsego_ref.dtype)
+        co_ref[...] = jnp.where(do_fit[None], cfull, coefs)
+        ro_ref[...] = jnp.where(do_fit, rfull, rmse)
+        tail_ref[...] = as_i(is_tail)
+        brk_ref[...] = as_i(is_brk)
+        refit_ref[...] = as_i(is_refit)
+        pos_ref[...] = pos_ev.astype(pos_ref.dtype)
+        dofit_ref[...] = as_i(do_fit)
+        nfull_ref[...] = n_full.astype(nfull_ref.dtype)
+        incmon_ref[...] = as_i(included_mon)
+        alivemon_ref[...] = as_i(alive_mon)
+
+    def skip():
+        # A block with no monitoring and no initializing lane is a pure
+        # pass-through — exactly kernel._mon_zeros + keep-old-model:
+        # every event flag is False (zero), included/alive pass through
+        # unchanged, the close mask selects nothing, and the do_fit
+        # merge keeps the old coefs/rmse.  Copying the inputs IS the
+        # computed value (the skip-guard contract).
+        meta_ref[...] = meta0_ref[...]
+        rmseso_ref[...] = rmses0_ref[...]
+        magso_ref[...] = mags0_ref[...]
+        coefsbo_ref[...] = coefs0_ref[...]
+        nsego_ref[...] = nseg_ref[...].astype(nsego_ref.dtype)
+        co_ref[...] = coefs_ref[...]
+        ro_ref[...] = rmse_ref[...]
+        _zero_refs(tail_ref, brk_ref, refit_ref, pos_ref, dofit_ref,
+                   nfull_ref)
+        incmon_ref[...] = inc_ref[...].astype(incmon_ref.dtype)
+        alivemon_ref[...] = alive_ref[...].astype(alivemon_ref.dtype)
+
+    _when_active(cnt_ref, compute, skip)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "S", "sensor", "change_thr", "outlier_thr", "mixed", "block_p",
+    "interpret"))
+def fused_round(Yt, X, t, alive, included, cur_k, n_last_fit, in_mon,
+                coefs, rmse, vario, init_ok, w_stab, n_ok, first_seg,
+                nseg, bufs, *, S, sensor, change_thr, outlier_thr,
+                mixed=False, active=None, block_p=None, interpret=False):
+    """The whole post-INIT round — monitor chain, segment close, shared
+    Lasso refit — as ONE pallas_call (FIREBIRD_FUSED_FIT=mon): one VMEM
+    residency of the wire spectra per round instead of the three
+    separate score/close/fit streams of the per-component kernels, with
+    the INIT block still cond-gated outside (its outputs arrive as
+    ``init_ok``/``w_stab``/``n_ok``).
+
+    Args:
+        Yt: [B, T, P] resident spectra (wire int16 or float32).
+        X: [T, K] design (chip-shared); t: [T] float ordinal days.
+        alive, included: [P, T] bool state planes.
+        cur_k, n_last_fit: [P] int; in_mon: [P] bool.
+        coefs: [P, B, K]; rmse: [P, B] — the CURRENT model; vario [P, B].
+        init_ok: [P] bool; w_stab: [P, T] 0/1; n_ok: [P] int — the INIT
+            block's fit handoff (zeros when no lane initialized).
+        first_seg: [P] bool; nseg: [P] int32; bufs: the four FLAT result
+            buffers (meta [P,S*6], rmse [P,S*B], mag [P,S*B],
+            coef [P,S*B*K]).
+        active: optional [P] bool per-block skip guard — normally
+            in_mon | init_ok; skipped blocks pass state through and
+            zero the event flags (kernel._mon_zeros' contract, exact).
+        block_p: static lane-width override (fuse_repro's ladder /
+            FIREBIRD_MEGA_BLOCK_P); None sizes from the VMEM budget.
+    Returns:
+        (bufs', nseg' [P], coefs' [P,B,K], rmse' [P,B], ev) where ev is
+        a dict of the event outputs the outer next-state needs:
+        is_tail/is_brk/is_refit/do_fit [P] bool, pos_ev/n_full [P] i32,
+        included_mon/alive_mon [P,T] bool.
+    """
+    B, T, P = Yt.shape
+    K = X.shape[-1]
+    f32 = X.dtype
+    i32 = jnp.int32
+    det = tuple(sensor.detection_bands)
+    peek = int(params.PEEK_SIZE)
+    BP = (block_p or _env_block_p()
+          or fused_round_block_p(T, B, S, Yt.dtype.itemsize))
+    Pp = -BP * (-P // BP)
+    pad = Pp - P
+    plane, vec = _pad_helpers(pad)
+
+    meta0, rmse0, mag0, coef0 = bufs
+    XT = X.T                                                  # [K, T]
+    XXT = (X[:, :, None] * X[:, None, :]).reshape(T, K * K).T  # [K*K, T]
+    padb = lambda a: jnp.pad(a, ((0, 0), (0, 0), (0, pad)))
+    padr = lambda a, cv=0.0: jnp.pad(a.T, ((0, 0), (0, pad)),
+                                     constant_values=cv)
+    args = [X, XT.astype(f32), XXT.astype(f32), t.astype(f32)[:, None],
+            padb(Yt),
+            plane(alive.astype(i32)), plane(included.astype(i32)),
+            vec(cur_k.astype(i32)), vec(n_last_fit.astype(i32), 1),
+            vec(in_mon.astype(i32)),
+            padb(coefs.transpose(1, 2, 0)), padr(rmse, 1.0),
+            padr(vario, 1.0),
+            vec(init_ok.astype(i32)), plane(w_stab.astype(i32)),
+            vec(n_ok.astype(i32)),
+            vec(first_seg.astype(i32)), vec(nseg.astype(i32)),
+            padb(meta0.reshape(P, S, 6).transpose(1, 2, 0)),
+            padb(rmse0.reshape(P, S, B).transpose(1, 2, 0)),
+            padb(mag0.reshape(P, S, B).transpose(1, 2, 0)),
+            padb(coef0.reshape(P, S, B * K).transpose(1, 2, 0))]
+
+    full = lambda shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+    pspec = pl.BlockSpec((T, BP), lambda i: (0, i))
+    vspec = pl.BlockSpec((1, BP), lambda i: (0, i))
+    bspec = pl.BlockSpec((B, BP), lambda i: (0, i))
+    b3 = lambda lead: pl.BlockSpec((lead[0], lead[1], BP),
+                                   lambda i: (0, 0, i))
+    in_specs = [full((T, K)), full((K, T)), full((K * K, T)), full((T, 1)),
+                b3((B, T)),
+                pspec, pspec, vspec, vspec, vspec,
+                b3((B, K)), bspec, bspec,
+                vspec, pspec, vspec,
+                vspec, vspec,
+                b3((S, 6)), b3((S, B)), b3((S, B)), b3((S, B * K))]
+    if active is not None:
+        args.append(_block_counts(active, BP, Pp))
+        in_specs.append(_CNT_SPEC)
+
+    kern = functools.partial(
+        _fused_round_block, T=T, B=B, K=K, S=S, det=det, peek=peek,
+        n_pow_peek=1 << max(1, (peek - 1).bit_length()),
+        change_thr=float(change_thr), outlier_thr=float(outlier_thr),
+        refit_factor=float(params.REFIT_FACTOR),
+        qa_start=int(params.CURVE_QA_START),
+        qa_inside=int(params.CURVE_QA_INSIDE),
+        qa_end=int(params.CURVE_QA_END),
+        cd_iters=int(params.LASSO_ITERS), alpha=float(params.LASSO_ALPHA),
+        num_obs_factor=int(params.NUM_OBS_FACTOR),
+        mid_coefs=int(params.MID_COEFS), mixed=bool(mixed),
+        guarded=active is not None)
+    outs = pl.pallas_call(
+        kern,
+        grid=(Pp // BP,),
+        in_specs=in_specs,
+        out_specs=[b3((S, 6)), b3((S, B)), b3((S, B)), b3((S, B * K)),
+                   vspec, b3((B, K)), bspec,
+                   vspec, vspec, vspec, vspec, vspec, vspec,
+                   pspec, pspec],
+        out_shape=[jax.ShapeDtypeStruct((S, 6, Pp), f32),
+                   jax.ShapeDtypeStruct((S, B, Pp), f32),
+                   jax.ShapeDtypeStruct((S, B, Pp), f32),
+                   jax.ShapeDtypeStruct((S, B * K, Pp), f32),
+                   jax.ShapeDtypeStruct((1, Pp), i32),
+                   jax.ShapeDtypeStruct((B, K, Pp), f32),
+                   jax.ShapeDtypeStruct((B, Pp), f32)]
+        + [jax.ShapeDtypeStruct((1, Pp), i32)] * 6
+        + [jax.ShapeDtypeStruct((T, Pp), i32)] * 2,
+        interpret=interpret,
+    )(*args)
+    (meta_n, rmses_n, mags_n, coefsb_n, nseg_n, co, ro,
+     tail, brk, refit, pos, dofit, nfull, incmon, alivemon) = outs
+    unflat = lambda a, k: a[..., :P].transpose(2, 0, 1).reshape(P, S * k)
+    bufs_n = (unflat(meta_n, 6), unflat(rmses_n, B), unflat(mags_n, B),
+              unflat(coefsb_n, B * K))
+    cut = lambda x: x[0, :P]
+    cutb = lambda x: x[0, :P] > 0
+    ev = dict(is_tail=cutb(tail), is_brk=cutb(brk), is_refit=cutb(refit),
+              pos_ev=cut(pos), do_fit=cutb(dofit), n_full=cut(nfull),
+              included_mon=(incmon[:, :P] > 0).T,
+              alive_mon=(alivemon[:, :P] > 0).T)
+    return (bufs_n, nseg_n[0, :P], co[..., :P].transpose(2, 0, 1),
+            ro[:, :P].T, ev)
 
 
 # ---------------------------------------------------------------------------
@@ -1657,7 +2055,7 @@ def _detect_mega_block(phase0_ref, curi0_ref, nseg0_ref, alive0_ref,
                        meow, init_days, stab_factor, peek, refit_factor,
                        num_obs_factor, mid_coefs,
                        qa_start, qa_inside, qa_end,
-                       ph_init, ph_mon, ph_done):
+                       ph_init, ph_mon, ph_done, mixed=False):
     """One pixel block's ENTIRE event-horizon loop in VMEM.
 
     The [B,T,BP] wire spectra are read from HBM exactly once per pixel;
@@ -1725,7 +2123,8 @@ def _detect_mega_block(phase0_ref, curi0_ref, nseg0_ref, alive0_ref,
                             cd_iters=cd_iters, alpha=alpha,
                             tm_iters=tm_iters, huber_k=huber_k,
                             tmask_const=tmask_const, meow=meow,
-                            init_days=init_days, stab_factor=stab_factor)
+                            init_days=init_days, stab_factor=stab_factor,
+                            mixed=mixed)
             # .astype(i32): x64 mode promotes integer sums to i64, which
             # would mismatch the skip branch's i32 zeros.
             return (as_i(o["init_nowin"]), as_i(o["init_tm"]),
@@ -1814,7 +2213,8 @@ def _detect_mega_block(phase0_ref, curi0_ref, nseg0_ref, alive0_ref,
                 lax.broadcasted_iota(i32, (K, BP), 0) < nc,
                 1.0, 0.0).astype(f32)
             beta, n = _gram_cd_core(XTK, XXT, y_of, wf, cm, B=B, K=K,
-                                    iters=cd_iters, alpha=alpha)
+                                    iters=cd_iters, alpha=alpha,
+                                    mixed=mixed)
             rs = []
             for b in range(B):
                 pred = jnp.dot(X, beta[b], preferred_element_type=f32)
@@ -1879,10 +2279,10 @@ def _detect_mega_block(phase0_ref, curi0_ref, nseg0_ref, alive0_ref,
 
 @functools.partial(jax.jit, static_argnames=(
     "W", "S", "sensor", "phases", "change_thr", "outlier_thr",
-    "block_p", "interpret"))
+    "mixed", "block_p", "interpret"))
 def detect_mega(Yt, phase0, cur_i0, alive0, nseg0, bufs0, t, X, Xt, vario,
                 *, W, S, sensor, phases, change_thr, outlier_thr,
-                block_p=None, interpret=False):
+                mixed=False, block_p=None, interpret=False):
     """The whole event-horizon loop as ONE pallas_call (the 'mega'
     component): grid over (chip, pixel-block), each block running its own
     while_loop with the wire spectra VMEM-resident — HBM traffic for the
@@ -1911,8 +2311,11 @@ def detect_mega(Yt, phase0, cur_i0, alive0, nseg0, bufs0, t, X, Xt, vario,
     tmb = tuple(sensor.tmask_bands)
     ph_init, ph_mon, ph_done = phases
     # ``block_p`` (static) overrides the budget-derived width — the
-    # SIGABRT repro's block-shape reduction (tools/fuse_repro.py).
-    BP = block_p or mega_block_p(T, W, B, S, Yt.dtype.itemsize)
+    # SIGABRT repro's block-shape reduction (tools/fuse_repro.py); the
+    # FIREBIRD_MEGA_BLOCK_P knob (bench-seeded from fuse_repro.json's
+    # smallest compiling shape) sits between the two.
+    BP = block_p or _env_block_p() or mega_block_p(T, W, B, S,
+                                                   Yt.dtype.itemsize)
     Pp = -BP * (-P // BP)
     pad = Pp - P
     nblk = Pp // BP
@@ -1971,7 +2374,8 @@ def detect_mega(Yt, phase0, cur_i0, alive0, nseg0, bufs0, t, X, Xt, vario,
         qa_start=int(params.CURVE_QA_START),
         qa_inside=int(params.CURVE_QA_INSIDE),
         qa_end=int(params.CURVE_QA_END),
-        ph_init=int(ph_init), ph_mon=int(ph_mon), ph_done=int(ph_done))
+        ph_init=int(ph_init), ph_mon=int(ph_mon), ph_done=int(ph_done),
+        mixed=bool(mixed))
 
     outs = pl.pallas_call(
         kern,

@@ -331,7 +331,7 @@ def detect_sharded(packed, mesh: Mesh, dtype=None,
                    max_segments: int | None = None,
                    staged: tuple | None = None, donate: bool = False,
                    compact: bool | None = None,
-                   fused: bool | None = None):
+                   fused=None, mixed: bool | None = None):
     """Run the CCD kernel with the chip batch sharded over the mesh.
 
     This is the multi-device production path: same math as
@@ -349,7 +349,8 @@ def detect_sharded(packed, mesh: Mesh, dtype=None,
     overrides FIREBIRD_COMPACT per call (kernel._detect_batch_core;
     compaction is per-shard — each shard permutes its own chips' lanes,
     so no cross-shard dependence is introduced and the zero-collective
-    property holds).  ``fused`` overrides FIREBIRD_FUSED_FIT likewise.
+    property holds).  ``fused`` (False/True/"mon") and ``mixed``
+    override FIREBIRD_FUSED_FIT / FIREBIRD_MIXED_PRECISION likewise.
 
     The one deliberate exception to zero-collectives is the straggler
     rebalancing ring (FIREBIRD_REBALANCE, default off): three
@@ -375,11 +376,11 @@ def detect_sharded(packed, mesh: Mesh, dtype=None,
         fn = sharded_detect_fn(mesh, jnp.dtype(dtype), wcap,
                                packed.sensor, max_segments=S,
                                donate=do_donate, compact=compact,
-                               fused=fused, rebalance=rb)
+                               fused=fused, mixed=mixed, rebalance=rb)
         return record_first_call(
             ("sharded", packed.spectra.shape, str(jnp.dtype(dtype)), wcap,
              packed.sensor.name, S, len(mesh.devices.flat), compact,
-             fused, rb),
+             fused, mixed, rb),
             lambda: fn(*args))
 
     def read_worst(seg):
@@ -401,7 +402,7 @@ def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor,
                       max_segments: int | None = None,
                       donate: bool = False,
                       compact: bool | None = None,
-                      fused: bool | None = None,
+                      fused=None, mixed: bool | None = None,
                       rebalance: RebalanceSpec | None = None):
     """The jitted shard_map program, cached per (mesh, dtype, wcap, sensor,
     capacity) — rebuilding the jit wrapper per batch would retrace every
@@ -417,7 +418,7 @@ def sharded_detect_fn(mesh: Mesh, dtype, wcap: int, sensor,
     core = functools.partial(_detect_batch_core, wcap=wcap, sensor=sensor,
                              max_segments=max_segments or MAX_SEGMENTS,
                              dtype=dtype, compact=compact, fused=fused,
-                             rebalance=rebalance)
+                             mixed=mixed, rebalance=rebalance)
 
     def local_batch(days, n_obs, Y_i16, qa_wire):
         # All-integer wire: each shard builds its own chips' float
@@ -458,7 +459,7 @@ def aot_compile_sharded(mesh: Mesh, dtype, wcap: int, sensor, shapes,
                         max_segments: int | None = None,
                         donate: bool = False,
                         compact: bool | None = None,
-                        fused: bool | None = None):
+                        fused=None, mixed: bool | None = None):
     """AOT lower+compile the sharded batch program for a shape without
     running it (``shapes``: the 4 global array shapes in shard_packed's
     argument order — days [C,T], n_obs [C], spectra [C,B,P,T], QA
@@ -472,7 +473,7 @@ def aot_compile_sharded(mesh: Mesh, dtype, wcap: int, sensor, shapes,
 
     fn = sharded_detect_fn(mesh, jnp.dtype(dtype), wcap, sensor,
                            max_segments=max_segments, donate=donate,
-                           compact=compact, fused=fused,
+                           compact=compact, fused=fused, mixed=mixed,
                            rebalance=rebalance_spec(mesh))
     sh = chip_sharding(mesh)
     dts = (jnp.int32, jnp.int32, jnp.int16, wire_qa_dtype())

@@ -301,3 +301,70 @@ def test_probe_timeout_reports_hung_tunnel(monkeypatch):
                  "reason": "timeout after 5s (tunnel hung)",
                  "attempts": [{"ok": False, "rc": None, "backend": None,
                                "reason": "timeout after 5s (tunnel hung)"}]}
+
+
+def test_repro_block_seeds_parsing(tmp_path, monkeypatch):
+    """The fuse_repro.json -> block-seed contract: absent file and
+    unreachable-Mosaic artifacts yield no seeds; a reachable artifact
+    yields only the pairings whose ladder actually found a compiling
+    block (null smallest_ok_block rows drop out)."""
+    import json as _json
+
+    from bench import repro_block_seeds
+
+    monkeypatch.setenv("FIREBIRD_FUSE_DIR", str(tmp_path))
+    assert repro_block_seeds() == {}                  # no artifact yet
+    art = tmp_path / "fuse_repro.json"
+    art.write_text(_json.dumps({
+        "mosaic_reachable": False,
+        "probes": {"mega": {"smallest_ok_block": 256}}}))
+    assert repro_block_seeds() == {}                  # advisory-only host
+    art.write_text(_json.dumps({
+        "mosaic_reachable": True,
+        "probes": {"mega": {"smallest_ok_block": 256},
+                   "mon+mixed": {"smallest_ok_block": 128},
+                   "fused": {"smallest_ok_block": None}}}))
+    assert repro_block_seeds() == {"mega": 256, "mon+mixed": 128}
+    art.write_text("not json")
+    assert repro_block_seeds() == {}                  # corrupt artifact
+
+
+def test_apply_tune_flag_env_grammar():
+    """Every rung shape the autotune races maps to exactly one env
+    combination (FIREBIRD_FUSED_FIT tier, FIREBIRD_PALLAS components,
+    FIREBIRD_MIXED_PRECISION, FIREBIRD_MEGA_BLOCK_P seed)."""
+    from bench import apply_tune_flag
+
+    # apply_tune_flag writes os.environ directly, and monkeypatch.delenv
+    # on an ABSENT key registers no undo — snapshot/restore by hand or
+    # the last case's fused/mixed env leaks into the whole suite.
+    keys = ("FIREBIRD_FUSED_FIT", "FIREBIRD_PALLAS",
+            "FIREBIRD_MIXED_PRECISION", "FIREBIRD_MEGA_BLOCK_P")
+    saved = {k: os.environ.get(k) for k in keys}
+    seeds = {"mega": 256, "mega+mixed": 384, "mon": 128,
+             "mon+mixed": 512, "fused": 640}
+    cases = {
+        # flag -> (FUSED_FIT, PALLAS, MIXED, BLOCK_P)
+        "0": ("0", "0", "0", "0"),
+        "fit,init": ("0", "fit,init", "0", "0"),
+        "mega": ("0", "mega", "0", "256"),
+        "mega+mixed": ("0", "mega", "1", "384"),
+        "mixed": ("0", "0", "1", "0"),
+        "fused": ("1", "0", "0", "640"),
+        "fused+fit,init": ("1", "fit,init", "0", "640"),
+        "fused+fit,init+mixed": ("1", "fit,init", "1", "0"),
+        "mon": ("mon", "0", "0", "128"),
+        "mon+fit": ("mon", "fit", "0", "128"),
+        "mon+fit+mixed": ("mon", "fit", "1", "512"),
+    }
+    try:
+        for flag, (ff, pal, mx, bp) in cases.items():
+            apply_tune_flag(flag, seeds)
+            got = tuple(os.environ[k] for k in keys)
+            assert got == (ff, pal, mx, bp), flag
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

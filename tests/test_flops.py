@@ -78,3 +78,35 @@ def test_bench_detail_shapes():
                            S=8, rounds=40.0, device_kind="cpu")
     assert "mfu_pct_vs_f32_peak" not in c
     assert c["model_flops_per_pixel"] == d["model_flops_per_pixel"]
+
+
+def test_mixed_block_models_pass_counts_not_new_flops():
+    """FIREBIRD_MIXED_PRECISION changes the MXU schedule, not the useful
+    arithmetic: every shared term (and total) is identical with and
+    without mixed=True, and the mixed sub-dict models exactly the
+    dot-stage pass trade (gram 6->2, corr 6->3, bf16 operands)."""
+    f32 = flops.round_flops(1000, 400, 120)
+    mx = flops.round_flops(1000, 400, 120, mixed=True)
+    assert {k: v for k, v in mx.items() if k != "mixed"} == f32
+    md = mx["mixed"]
+    assert (md["mxu_passes_f32"], md["mxu_passes_gram"],
+            md["mxu_passes_corr"]) == (6, 2, 3)
+    assert md["gram_operand_bytes_ratio"] == 0.5
+    g, c = md["gram_dot_flops"], md["corr_dot_flops"]
+    assert g > 0 and c > 0
+    assert md["dot_stage_speedup_model"] == round(
+        6.0 * (g + c) / (2.0 * g + 3.0 * c), 2)
+    # the schedule trade is strictly a win and bounded by the pass ratios
+    assert 2.0 < md["dot_stage_speedup_model"] < 3.0
+
+
+def test_round_bytes_is_mixed_invariant():
+    """The HBM model must NOT move under mixed: the wire spectra stream
+    int16 either way and the bf16 operands live at the VMEM->MXU
+    boundary (round_bytes' docstring is the written argument)."""
+    for pallas in ((), ("fit",), ("fit", "init", "score")):
+        a = flops.round_bytes(1000, 400, 120, 4, 4, rounds=12.0,
+                              pallas=pallas)
+        b = flops.round_bytes(1000, 400, 120, 4, 4, rounds=12.0,
+                              pallas=pallas, mixed=True)
+        assert a == b

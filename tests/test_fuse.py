@@ -127,6 +127,40 @@ def test_fused_golden_compact_on():
     _assert_identical(_run(True, True), _run(False, True))
 
 
+def _assert_mon_golden(mon, base):
+    """The whole-round fusion's contract (FIREBIRD_FUSED_FIT=mon): every
+    decision field AND the coef/rmse payload byte-identical to the
+    unfused chain (same _mon_scored_logic/_close_logic/_gram_cd_core
+    programs), with seg_mag alone on the mega-style envelope — the
+    break-magnitude median is computed from the in-VMEM PEEK run instead
+    of arriving from kernel._close_mags, and a last-ulp input difference
+    can flip which element the median selects (measured 1.2e-4 here)."""
+    for f in STORE_FIELDS:
+        if f == "seg_mag":
+            continue
+        np.testing.assert_array_equal(np.asarray(getattr(mon, f)),
+                                      np.asarray(getattr(base, f)),
+                                      err_msg=f)
+    np.testing.assert_allclose(np.asarray(mon.seg_mag),
+                               np.asarray(base.seg_mag),
+                               rtol=5e-3, atol=1e-2)
+
+
+@pytest.mark.slow  # ~40s (one extra full kernel shape on the shared baseline); `make test` / precision-smoke still dispatch the mon route every verify run
+def test_fused_mon_golden_compact_off():
+    """Monitor+fit+close as ONE pallas_call vs the unfused chain,
+    compaction off — pure kernel equality, no permutation in play."""
+    _assert_mon_golden(_run("mon", False), _run(False, False))
+
+
+@pytest.mark.slow  # ~65s (one extra full kernel shape incl. the cascade); tier-1 keeps the fused_round skip-guard + knob rungs below
+def test_fused_mon_golden_compact_on():
+    """Same golden under active-lane compaction: the whole-round kernel
+    rides the dense-prefix permutation and the per-block skip guards."""
+    _assert_mon_golden(_run("mon", True), _run(False, True))
+
+
+@pytest.mark.slow  # ~93s in tier-1 (the compact-ON fused run is uncached there with the goldens deselected); `make test` shares the golden's cached run and `make fuse-smoke` asserts the same occupancy-counters-moving contract every verify run
 def test_fused_occupancy_still_captured():
     """The fused route must not blind the occupancy telemetry the
     roofline model feeds on."""
@@ -180,6 +214,57 @@ def test_fused_guard_skip_is_pass_through():
     for b_in, b_out in zip(bufs, got[0]):
         np.testing.assert_array_equal(np.asarray(b_in)[BP:],
                                       np.asarray(b_out)[BP:])
+
+
+@pytest.mark.slow  # ~13s interpret trace; the mon goldens above ride the same guard path under compaction and `make precision-smoke`'s mon leg dispatches it every verify run
+def test_fused_round_guard_skip_is_pass_through():
+    """Skip-guard exactness for the whole-round kernel: a block with no
+    monitoring and no initializing lane must pass buffers, nseg, coefs
+    and rmse through BIT-identically and zero the event flags — the
+    outer loop's _skip_round contract.  Inactive lanes are a compute
+    no-op, so the guarded call equals the unguarded call everywhere."""
+    from firebird_tpu.ccd import pallas_ops
+    from firebird_tpu.ccd.sensor import LANDSAT_ARD
+
+    rng = np.random.default_rng(9)
+    B, T, K, S, P, BP = 7, 24, 8, 3, 16, 8
+    Yt = jnp.asarray(rng.integers(100, 3000, (B, T, P)), jnp.int16)
+    X = jnp.asarray(rng.standard_normal((T, K)), jnp.float32)
+    t = jnp.asarray(np.sort(rng.integers(724000, 725000, T)), jnp.float32)
+    act = np.zeros(P, bool)
+    act[:BP] = True
+    in_mon = act.copy()
+    in_mon[0] = False
+    init_ok = np.zeros(P, bool)
+    init_ok[0] = True                 # lane 0: the INIT handoff path
+    w_stab = np.zeros((P, T), np.int32)
+    w_stab[0, ::2] = 1
+    bufs = tuple(jnp.asarray(rng.standard_normal((P, S * k)), jnp.float32)
+                 for k in (6, B, B, B * K))
+    args = (Yt, X, t,
+            jnp.ones((P, T), bool),
+            jnp.asarray(rng.integers(0, 2, (P, T)).astype(bool)),
+            jnp.full(P, T // 2, jnp.int32), jnp.full(P, 12, jnp.int32),
+            jnp.asarray(in_mon),
+            jnp.asarray(rng.standard_normal((P, B, K)), jnp.float32),
+            jnp.ones((P, B), jnp.float32), jnp.ones((P, B), jnp.float32),
+            jnp.asarray(init_ok), jnp.asarray(w_stab),
+            jnp.full(P, 20, jnp.int32), jnp.ones(P, bool),
+            jnp.zeros(P, jnp.int32), bufs)
+    kw = dict(S=S, sensor=LANDSAT_ARD, change_thr=35.9, outlier_thr=31.7,
+              block_p=BP, interpret=True)
+    out_u = pallas_ops.fused_round(*args, **kw)
+    out_g = pallas_ops.fused_round(*args, active=jnp.asarray(act), **kw)
+    for r, g in zip(jax_leaves(out_u), jax_leaves(out_g)):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+    # and the dead block really passed its buffers through untouched
+    for b_in, b_out in zip(bufs, out_g[0]):
+        np.testing.assert_array_equal(np.asarray(b_in)[BP:],
+                                      np.asarray(b_out)[BP:])
+    # event flags on the dead block are the _skip_round zeros
+    ev = out_g[4]
+    for f in ("is_tail", "is_brk", "is_refit", "do_fit"):
+        assert not np.asarray(ev[f])[BP:].any(), f
 
 
 def jax_leaves(tree):
@@ -302,3 +387,34 @@ def test_fused_knob_resolution(monkeypatch):
     assert kernel.use_fused_fit() is True
     monkeypatch.setenv("FIREBIRD_FUSED_FIT", "0")
     assert kernel.use_fused_fit() is False
+
+
+def test_fused_mode_tristate(monkeypatch):
+    """fused_mode's tri-state: off ('', '0') -> 0, whole-round ('mon' or
+    '2') -> 'mon', any other truthy value -> 1 — and use_fused_fit stays
+    truthy for BOTH fused tiers (the roofline's fused modeling keys on
+    it)."""
+    monkeypatch.delenv("FIREBIRD_FUSED_FIT", raising=False)
+    assert kernel.fused_mode() == 0
+    for v, want in (("0", 0), ("1", 1), ("mon", "mon"), ("2", "mon")):
+        monkeypatch.setenv("FIREBIRD_FUSED_FIT", v)
+        assert kernel.fused_mode() == want, v
+        assert kernel.use_fused_fit() is bool(want)
+
+
+def test_mega_block_p_env_override(monkeypatch):
+    """FIREBIRD_MEGA_BLOCK_P (the bench autotune's fuse_repro seed) is a
+    trace-time multiple-of-128 override; 0/unset defers to the VMEM
+    budget sizing."""
+    from firebird_tpu.ccd import pallas_ops
+
+    monkeypatch.delenv("FIREBIRD_MEGA_BLOCK_P", raising=False)
+    assert pallas_ops._env_block_p() is None
+    monkeypatch.setenv("FIREBIRD_MEGA_BLOCK_P", "256")
+    assert pallas_ops._env_block_p() == 256
+    monkeypatch.setenv("FIREBIRD_MEGA_BLOCK_P", "300")
+    assert pallas_ops._env_block_p() == 256     # floored to the lane width
+    monkeypatch.setenv("FIREBIRD_MEGA_BLOCK_P", "100")   # below one vector
+    assert pallas_ops._env_block_p() is None
+    monkeypatch.setenv("FIREBIRD_MEGA_BLOCK_P", "junk")
+    assert pallas_ops._env_block_p() is None

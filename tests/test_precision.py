@@ -1,0 +1,163 @@
+"""Mixed-precision envelope (FIREBIRD_MIXED_PRECISION).
+
+The bf16 split-dot gram (pallas_ops._gram_cd_core mixed=True) trades
+MXU passes for ~2^-17 relative error in the normal equations — but the
+decision plane (break days, curve QA, segment counts, ranks, masks,
+procedures) is computed behind the f32 envelope and must be IDENTICAL
+to the full-f32 route, with the continuous coef/rmse payload pinned to
+``params.MIXED_ULP_BUDGET`` scale-anchored ulps (see the params.py
+rationale).  The fuzz golden here seeds lanes whose change score sits
+AT the chi2 detection threshold — the exact surface where leaked gram
+error would flip a break decision.
+"""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from firebird_tpu.ccd import kernel, params, synthetic
+from firebird_tpu.ingest.packer import PackedChips
+
+P_TEST = 32
+EPS32 = 2.0 ** -23
+DECISION_META_COLS = [0, 1, 2, 4, 5]   # sday, eday, bday, curqa, rank
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _precision_env():
+    """Mixed only changes arithmetic inside the Pallas fit routes; the
+    module baseline is the Pallas fit kernel (test_fuse's precedent)."""
+    old = os.environ.get("FIREBIRD_PALLAS")
+    os.environ["FIREBIRD_PALLAS"] = "fit"
+    yield
+    if old is None:
+        os.environ.pop("FIREBIRD_PALLAS", None)
+    else:
+        os.environ["FIREBIRD_PALLAS"] = old
+
+
+def _threshold_fuzz_pixels(seed=11):
+    """Breaks, spikes, and a ladder of marginal steps bracketing the
+    detection threshold (standardized score ~ CHANGE_THRESHOLD, where
+    ~2^-17 gram error flips the verdict if it escapes the envelope),
+    plus starved/cloud/fill lanes."""
+    rng = np.random.default_rng(seed)
+    t = synthetic.acquisition_dates("1995-01-01", "1997-06-01", 16)
+    T = t.shape[0]
+    px = []
+    for i in range(8):
+        Y = synthetic.harmonic_series(t, rng)
+        if i % 2 == 0:
+            Y[:, T // 2:] += 800.0            # clean break + re-init
+        if i % 3 == 0:
+            Y[:, rng.integers(0, T)] += 2500  # spike (outlier path)
+        px.append((Y, np.full(T, synthetic.QA_CLEAR, np.uint16)))
+    for i in range(8):
+        Y = synthetic.harmonic_series(t, rng)
+        Y[:, T // 2:] += 85.0 + 6.0 * i       # marginal step ladder
+        px.append((Y, np.full(T, synthetic.QA_CLEAR, np.uint16)))
+    qs = np.full(T, synthetic.QA_CLOUD, np.uint16)
+    qs[:: max(T // 5, 1)] = synthetic.QA_CLEAR
+    px.append((synthetic.harmonic_series(t, rng), qs))   # init-starved
+    while len(px) < P_TEST:
+        px.append((np.full((7, T), params.FILL_VALUE, np.float64),
+                   np.full(T, synthetic.QA_FILL, np.uint16)))
+    order = rng.permutation(P_TEST)
+    return t, [px[i] for i in order]
+
+
+def _pack(t, pixels):
+    Ys, qas = zip(*pixels)
+    spectra = np.stack([np.asarray(Y, np.int16) for Y in Ys])
+    return PackedChips(
+        cids=np.stack([np.full(2, 0, np.int64)]),
+        dates=t[None].astype(np.int32),
+        spectra=spectra.transpose(1, 0, 2)[None],
+        qas=np.stack(qas)[None],
+        n_obs=np.array([t.shape[0]], np.int32))
+
+
+def _scaled_ulps(mixed, f32, vector_axis=None):
+    """params.MIXED_ULP_BUDGET's metric: |mixed - f32| / (eps32 * scale),
+    scale anchored at the coefficient vector's max magnitude (coefs) or
+    the element's own (rmse) — never below 1."""
+    mixed = np.asarray(mixed, np.float64)
+    f32 = np.asarray(f32, np.float64)
+    if vector_axis is not None:
+        scale = np.maximum(np.abs(f32).max(axis=vector_axis,
+                                           keepdims=True), 1.0)
+    else:
+        scale = np.maximum(np.abs(f32), 1.0)
+    return np.abs(mixed - f32) / (EPS32 * scale)
+
+
+@pytest.mark.slow  # ~45s (two full kernel shapes); `make test` / precision-smoke dispatch the same mixed-vs-f32 comparison every verify run
+def test_mixed_decision_identity_and_ulp_budget():
+    """The headline contract: mixed vs f32 on the threshold-fuzz chip —
+    every decision field byte-identical, coef/rmse inside the pinned
+    scaled-ulp budget, seg_mag (a median of residual norms downstream
+    of the mixed fit) on a loose envelope."""
+    t, px = _threshold_fuzz_pixels()
+    pk = _pack(t, px)
+    f32 = kernel.detect_packed(pk, dtype=jnp.float32, compact=True,
+                               fused=False, mixed=False)
+    mx = kernel.detect_packed(pk, dtype=jnp.float32, compact=True,
+                              fused=False, mixed=True)
+    for f in ("n_segments", "mask", "procedure", "rounds"):
+        np.testing.assert_array_equal(np.asarray(getattr(mx, f)),
+                                      np.asarray(getattr(f32, f)),
+                                      err_msg=f)
+    np.testing.assert_array_equal(
+        np.asarray(mx.seg_meta)[..., DECISION_META_COLS],
+        np.asarray(f32.seg_meta)[..., DECISION_META_COLS])
+    budget = params.MIXED_ULP_BUDGET
+    coef_u = _scaled_ulps(mx.seg_coef, f32.seg_coef, vector_axis=-1)
+    rmse_u = _scaled_ulps(mx.seg_rmse, f32.seg_rmse)
+    assert float(coef_u.max()) <= budget, float(coef_u.max())
+    assert float(rmse_u.max()) <= budget, float(rmse_u.max())
+    # measured on this fixture: ~2.7e-4 max relative (median selection
+    # can jump by an inter-element gap, so looser than coef/rmse)
+    np.testing.assert_allclose(np.asarray(mx.seg_mag),
+                               np.asarray(f32.seg_mag),
+                               rtol=1e-2, atol=0.5)
+
+
+@pytest.mark.slow  # ~13s interpret trace; `make precision-smoke` holds the same mixed-vs-f32 envelope at the full-kernel level every verify run
+def test_mixed_lasso_fit_matches_f32_closely():
+    """The fit kernel pair at the pallas_ops layer: mixed=True lands
+    within the scaled-ulp budget of the f32 kernel on int-valued wire
+    spectra (the y hi/lo split is exact; only the gram carries bf16
+    error), and the zero pattern of masked coefficients is identical."""
+    from firebird_tpu.ccd import harmonic, pallas_ops
+
+    rng = np.random.default_rng(3)
+    B, T, P, K = 7, 48, 8, 8
+    Yt = jnp.asarray(rng.integers(100, 9000, (B, T, P)), jnp.int16)
+    w = jnp.asarray(rng.integers(0, 2, (P, T)), jnp.float32)
+    t = np.sort(rng.integers(724000, 725000, T)).astype(np.float64)
+    X = jnp.asarray(harmonic.design_matrix(t, float(t[0]), K), jnp.float32)
+    cm = jnp.ones((P, K), jnp.float32)
+    c_f, r_f = pallas_ops.lasso_fit(Yt, w, X, cm, interpret=True)
+    c_m, r_m = pallas_ops.lasso_fit(Yt, w, X, cm, mixed=True,
+                                    interpret=True)
+    budget = params.MIXED_ULP_BUDGET
+    cu = _scaled_ulps(c_m, c_f, vector_axis=-1)
+    ru = _scaled_ulps(r_m, r_f)
+    assert float(cu.max()) <= budget, float(cu.max())
+    assert float(ru.max()) <= budget, float(ru.max())
+    # the fit genuinely differs (bf16 gram ran) but masked coefs stay 0
+    assert float(np.abs(np.asarray(c_m) - np.asarray(c_f)).max()) > 0
+
+
+def test_mixed_knob_resolution(monkeypatch):
+    """use_mixed_precision reads the registered knob; explicit mixed=
+    wins at the dispatch layer regardless of env (the fused/compact
+    precedent)."""
+    monkeypatch.delenv("FIREBIRD_MIXED_PRECISION", raising=False)
+    assert kernel.use_mixed_precision() is False
+    monkeypatch.setenv("FIREBIRD_MIXED_PRECISION", "1")
+    assert kernel.use_mixed_precision() is True
+    monkeypatch.setenv("FIREBIRD_MIXED_PRECISION", "0")
+    assert kernel.use_mixed_precision() is False

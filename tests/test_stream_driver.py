@@ -73,6 +73,7 @@ def runs(tmp_path_factory):
     return cfg, s1, s2, s3, mk_store()
 
 
+@pytest.mark.slow  # the shared `runs` fixture is ~90s (three full stream() passes over a 100x100 chip); streamfleet-smoke drives the same bootstrap->update->publish loop end-to-end in `make test`
 def test_bootstrap_then_update_then_noop(runs):
     cfg, s1, s2, s3, _ = runs
     assert s1["bootstrapped"] == 1 and s1["updated"] == 0
@@ -87,6 +88,7 @@ def test_bootstrap_then_update_then_noop(runs):
     assert _state_chips(cfg)
 
 
+@pytest.mark.slow  # shares the ~90s `runs` fixture; streamfleet-smoke asserts published rows from a drained stream in `make test`
 def test_published_rows_reflect_stream(runs):
     _, _, _, _, store = runs
     seg = store.read("segment")
@@ -106,6 +108,7 @@ def test_published_rows_reflect_stream(runs):
     assert (bday[broke] <= "1999-07-01").all()
 
 
+@pytest.mark.slow  # shares the ~90s `runs` fixture; alert-smoke runs the alert-emission drill end-to-end in `make test`
 def test_alerts_emitted_exactly_once_and_repair_scheduled(runs):
     """The alerting loop over the same runs: the update pass that
     confirmed the step change must emit one durable alert per broken

@@ -37,7 +37,8 @@ from firebird_tpu.config import env_knob  # noqa: E402
 # Ladder of explicit lane-block widths, smallest first: the first
 # failure IS the minimized repro (everything below it compiles).
 BLOCKS = (128, 256, 512)
-PAIRINGS = ("fused", "mega")
+PAIRINGS = ("fused", "mega", "mon", "fused+mixed", "mega+mixed",
+            "mon+mixed")
 PROBE_TIMEOUT = float(env_knob("FIREBIRD_BENCH_BUDGET")) / 6
 
 
@@ -57,7 +58,28 @@ def _probe(pairing: str, block_p: int) -> None:
     Yt = jnp.asarray(rng.integers(100, 3000, (B, T, P)), jnp.int16)
     X = jnp.asarray(rng.standard_normal((T, K)), jnp.float32)
     t = jnp.asarray(np.sort(rng.integers(724000, 727000, T)), jnp.float32)
-    if pairing == "fused":
+    pairing, _, suffix = pairing.partition("+")
+    mixed = suffix == "mixed"
+    if pairing == "mon":
+        out = pallas_ops.fused_round(
+            Yt, X, t,
+            jnp.asarray(rng.integers(0, 2, (P, T)).astype(bool)),
+            jnp.asarray(rng.integers(0, 2, (P, T)).astype(bool)),
+            jnp.full(P, T // 2, jnp.int32), jnp.full(P, 24, jnp.int32),
+            jnp.ones(P, bool),
+            jnp.asarray(rng.standard_normal((P, B, K)), jnp.float32),
+            jnp.ones((P, B), jnp.float32), jnp.ones((P, B), jnp.float32),
+            jnp.zeros(P, bool), jnp.zeros((P, T), jnp.float32),
+            jnp.zeros(P, jnp.int32),
+            jnp.ones(P, bool), jnp.zeros(P, jnp.int32),
+            (jnp.zeros((P, S * 6), jnp.float32),
+             jnp.zeros((P, S * B), jnp.float32),
+             jnp.zeros((P, S * B), jnp.float32),
+             jnp.zeros((P, S * B * K), jnp.float32)),
+            S=S, sensor=LANDSAT_ARD, change_thr=35.9, outlier_thr=31.7,
+            mixed=mixed, block_p=block_p, interpret=not on_tpu)
+        jax.block_until_ready(out)
+    elif pairing == "fused":
         out = pallas_ops.fused_fit_close(
             Yt, X, t,
             jnp.asarray(rng.integers(0, 2, (P, T)), jnp.float32),
@@ -73,7 +95,7 @@ def _probe(pairing: str, block_p: int) -> None:
              jnp.zeros((P, S * B), jnp.float32),
              jnp.zeros((P, S * B), jnp.float32),
              jnp.zeros((P, S * B * K), jnp.float32)),
-            S=S, block_p=block_p, interpret=not on_tpu)
+            S=S, mixed=mixed, block_p=block_p, interpret=not on_tpu)
         jax.block_until_ready(out)
     else:  # mega
         C, W = 1, 16
@@ -90,7 +112,7 @@ def _probe(pairing: str, block_p: int) -> None:
             t[None], X[None], Xt, jnp.ones((C, P, B), jnp.float32),
             W=W, S=S, sensor=LANDSAT_ARD, phases=(0, 1, 2),
             change_thr=35.9, outlier_thr=31.7,
-            block_p=block_p, interpret=not on_tpu)
+            mixed=mixed, block_p=block_p, interpret=not on_tpu)
         jax.block_until_ready(out)
 
 
@@ -126,7 +148,7 @@ def main() -> int:
     results = {}
     for pairing in PAIRINGS:
         ladder = []
-        smallest_failing = None
+        smallest_failing = smallest_ok = None
         for bp in BLOCKS:
             try:
                 proc = subprocess.run(
@@ -148,8 +170,14 @@ def main() -> int:
                   file=sys.stderr, flush=True)
             if rec["kind"] != "ok" and smallest_failing is None:
                 smallest_failing = bp
+            if rec["kind"] == "ok" and smallest_ok is None:
+                smallest_ok = bp
+        # smallest_ok_block is what bench consumes: the mega/mon autotune
+        # rungs seed FIREBIRD_MEGA_BLOCK_P with the smallest block the
+        # real toolchain compiled, instead of the VMEM-budget guess.
         results[pairing] = {"ladder": ladder,
-                            "smallest_failing_block": smallest_failing}
+                            "smallest_failing_block": smallest_failing,
+                            "smallest_ok_block": smallest_ok}
 
     report = {
         "schema": "firebird-fuse-repro/1",

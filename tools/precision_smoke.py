@@ -1,0 +1,193 @@
+"""Mixed-precision smoke (``make precision-smoke``): bf16 gram buys
+speed, never decisions.
+
+Two detect_packed runs over one adversarial chip (breaks, spikes,
+near-threshold step lanes, starved/cloud/fill lanes) on the Pallas fit
+route — FIREBIRD_MIXED_PRECISION semantics ON (bf16 split-dot gram +
+int32 counts, mixed=True) vs OFF (full-f32 gram) — asserting:
+
+1. **Store decision identity** — every discrete field that reaches the
+   store is byte-identical: segment counts, seg_meta break/start/end
+   days + curve QA + rank (columns 0,1,2,4,5), the per-pixel processing
+   mask and procedure codes.  A single flipped break day fails the run.
+2. **Continuous payload inside the pinned budget** — seg_coef/seg_rmse
+   drift no more than ``params.MIXED_ULP_BUDGET`` scale-anchored ulps
+   (|mixed - f32| / (eps32 * scale); coefs anchor at their coefficient
+   vector's max |coef| per (pixel, band, segment), rmse at max(|f32|,1)
+   — see the params.py rationale).  A log2 drift histogram lands in the
+   artifact so a slow precision regression is visible before it trips
+   the budget.
+3. **The mixed path actually ran** — ``kernel_mixed_traces`` > 0 in the
+   metrics registry; a smoke whose mixed leg silently fell back to f32
+   (wrong dtype, non-Pallas route) proves nothing.
+
+Both legs repeat under the whole-round fusion (FIREBIRD_FUSED_FIT=mon)
+so the mega-fused kernel's mixed gram is held to the same bar.
+
+Writes ``precision_smoke.json`` (FIREBIRD_PRECISION_DIR, default
+/tmp/fb_precision; folded into bench artifacts by
+bench._precision_fold) and exits non-zero on any violation.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Mixed only changes arithmetic inside the Pallas fit routes
+# (interpret-mode on CPU); the XLA fallback is the f32 oracle either way.
+os.environ["FIREBIRD_PALLAS"] = "fit"
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, HERE)
+
+P_LANES = 32
+DECISION_META_COLS = (0, 1, 2, 4, 5)  # sday, eday, bday, curqa, rank
+EPS32 = 2.0 ** -23
+
+
+def _adversarial_pixels(np, synthetic, params, t, rng):
+    """Breaks, spikes, a near-threshold step (bf16 rounding of the gram
+    lands the change score AT the chi2 boundary), starved/cloud/fill
+    lanes — the fuzz surface where a precision bug flips a decision."""
+    T = t.shape[0]
+    px = []
+    for i in range(10):
+        Y = synthetic.harmonic_series(t, rng)
+        if i % 2 == 0:
+            Y[:, T // 2:] += 800.0            # clean break + re-init
+        if i % 3 == 0:
+            Y[:, rng.integers(0, T)] += 2500  # spike (Tmask/outlier path)
+        px.append((Y, np.full(T, synthetic.QA_CLEAR, np.uint16)))
+    for i in range(6):
+        # Marginal steps bracketing the detection threshold: scaled so
+        # the standardized change score sits near CHANGE_THRESHOLD and
+        # ~2^-17 gram error would flip it if it leaked past the f32
+        # decision envelope.
+        Y = synthetic.harmonic_series(t, rng)
+        Y[:, T // 2:] += 90.0 + 8.0 * i
+        px.append((Y, np.full(T, synthetic.QA_CLEAR, np.uint16)))
+    qs = np.full(T, synthetic.QA_CLOUD, np.uint16)
+    qs[:: max(T // 5, 1)] = synthetic.QA_CLEAR
+    px.append((synthetic.harmonic_series(t, rng), qs))  # init-starved
+    px.append((synthetic.harmonic_series(t, rng),
+               np.full(T, synthetic.QA_CLOUD, np.uint16)))
+    while len(px) < P_LANES:
+        px.append((np.full((7, T), params.FILL_VALUE, np.float64),
+                   np.full(T, synthetic.QA_FILL, np.uint16)))
+    order = rng.permutation(P_LANES)
+    return [px[i] for i in order]
+
+
+def _pack(np, PackedChips, t, pixels):
+    Ys, qas = zip(*pixels)
+    spectra = np.stack([np.asarray(Y, np.int16) for Y in Ys])
+    return PackedChips(
+        cids=np.stack([np.full(2, 0, np.int64)]),
+        dates=t[None].astype(np.int32),
+        spectra=spectra.transpose(1, 0, 2)[None],
+        qas=np.stack(qas)[None],
+        n_obs=np.array([t.shape[0]], np.int32))
+
+
+def _scaled_ulps(np, mixed, f32, vector_axis=None):
+    """Scale-anchored ulp distance per params.MIXED_ULP_BUDGET: the
+    error is measured against the magnitude it propagates from, not the
+    (lasso-thresholded, often ~0) element it happens to land on."""
+    mixed, f32 = np.asarray(mixed, np.float64), np.asarray(f32, np.float64)
+    if vector_axis is not None:
+        scale = np.maximum(np.abs(f32).max(axis=vector_axis,
+                                           keepdims=True), 1.0)
+    else:
+        scale = np.maximum(np.abs(f32), 1.0)
+    return np.abs(mixed - f32) / (EPS32 * scale)
+
+
+def _hist(np, ulps) -> dict:
+    """log2 histogram of nonzero scaled-ulp drift (bucket k counts
+    drift in [2^k, 2^(k+1)))."""
+    flat = np.asarray(ulps).ravel()
+    nz = flat[flat > 0]
+    if nz.size == 0:
+        return {"max": 0.0, "nonzero": 0, "log2_buckets": {}}
+    k = np.floor(np.log2(nz)).astype(int)
+    return {"max": round(float(flat.max()), 1),
+            "nonzero": int(nz.size),
+            "log2_buckets": {str(b): int(c) for b, c in
+                             zip(*np.unique(k, return_counts=True))}}
+
+
+def main() -> int:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from firebird_tpu.ccd import kernel, params, synthetic
+    from firebird_tpu.ingest.packer import PackedChips
+    from firebird_tpu.obs import metrics as obs_metrics
+
+    rng = np.random.default_rng(11)
+    t = synthetic.acquisition_dates("1995-01-01", "1997-06-01", 16)
+    pk = _pack(np, PackedChips, t,
+               _adversarial_pixels(np, synthetic, params, t, rng))
+
+    budget = params.MIXED_ULP_BUDGET
+    report = {"schema": "firebird-precision-smoke/1",
+              "ulp_budget": budget, "legs": {}}
+    for leg, fused in (("fit", False), ("mon", "mon")):
+        f32 = kernel.detect_packed(pk, dtype=jnp.float32, compact=True,
+                                   fused=fused, mixed=False)
+        mx = kernel.detect_packed(pk, dtype=jnp.float32, compact=True,
+                                  fused=fused, mixed=True)
+        bad = [f for f, a, b in (
+            ("n_segments", mx.n_segments, f32.n_segments),
+            ("seg_meta_decisions",
+             np.asarray(mx.seg_meta)[..., DECISION_META_COLS],
+             np.asarray(f32.seg_meta)[..., DECISION_META_COLS]),
+            ("mask", mx.mask, f32.mask),
+            ("procedure", mx.procedure, f32.procedure),
+        ) if not np.array_equal(np.asarray(a), np.asarray(b))]
+        if bad:
+            print(f"precision-smoke[{leg}]: mixed flipped decisions in "
+                  f"{bad}", file=sys.stderr)
+            return 1
+        coef_u = _scaled_ulps(np, mx.seg_coef, f32.seg_coef,
+                              vector_axis=-1)
+        rmse_u = _scaled_ulps(np, mx.seg_rmse, f32.seg_rmse)
+        for name, u in (("coef", coef_u), ("rmse", rmse_u)):
+            if float(u.max()) > budget:
+                print(f"precision-smoke[{leg}]: {name} drift "
+                      f"{float(u.max()):.0f} scaled ulps exceeds the "
+                      f"budget {budget}", file=sys.stderr)
+                return 1
+        report["legs"][leg] = {
+            "decisions_identical": True,
+            "coef_ulps": _hist(np, coef_u),
+            "rmse_ulps": _hist(np, rmse_u),
+        }
+
+    counters = obs_metrics.get_registry().snapshot()["counters"]
+    if counters.get("kernel_mixed_traces", 0) <= 0:
+        print("precision-smoke: kernel_mixed_traces never moved — the "
+              f"mixed path did not run ({counters})", file=sys.stderr)
+        return 1
+    report["counters"] = {
+        k: counters.get(k, 0)
+        for k in ("kernel_mixed_traces", "kernel_fused_round_traces")}
+
+    art_dir = os.environ.get("FIREBIRD_PRECISION_DIR", "/tmp/fb_precision")
+    os.makedirs(art_dir, exist_ok=True)
+    art = os.path.join(art_dir, "precision_smoke.json")
+    with open(art, "w") as f:
+        json.dump(report, f, indent=1)
+    worst = max(report["legs"][leg][k]["max"]
+                for leg in report["legs"] for k in ("coef_ulps",
+                                                    "rmse_ulps"))
+    print(f"precision-smoke OK: decisions identical on both legs, worst "
+          f"drift {worst:.0f}/{budget} scaled ulps, "
+          f"{report['counters']['kernel_mixed_traces']} mixed trace(s); "
+          f"artifact {art}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
