@@ -13,7 +13,7 @@ ACQUIRED ?= 1982-01-01/2017-12-31
         fleet-smoke elastic-smoke serve-smoke pyramid-smoke serve-fleet \
         compact-smoke postmortem-smoke alert-smoke streamfleet-smoke \
         telemetry-smoke slo-smoke wire-smoke fuse-smoke fuse-repro \
-        precision-smoke \
+        precision-smoke objectstore-smoke \
         image db-up db-schema db-test db-down changedetection \
         classification clean
 
@@ -42,6 +42,7 @@ test: lint
 	$(MAKE) streamfleet-smoke
 	$(MAKE) telemetry-smoke
 	$(MAKE) slo-smoke
+	$(MAKE) objectstore-smoke
 	$(MAKE) elastic-smoke
 
 bench:
@@ -200,6 +201,17 @@ streamfleet-smoke:
 # proves disarmed telemetry writes nothing (artifact folded by bench.py).
 telemetry-smoke:
 	python tools/telemetry_smoke.py
+
+# Object-tier chaos drill (docs/ROBUSTNESS.md "Object tier"): the
+# chunked conditional-put protocol, 3-way store parity (plain sqlite /
+# env-armed mirror / pure object backend row-identical), stale object
+# fences rejected 100% with a durable census, torn uploads (truncated
+# chunk, dropped manifest) recovered by generation fallback, a SIGKILL
+# between chunk upload and manifest commit leaving no visible partial
+# object, and the orphan scrubber reclaiming the debris; statestore and
+# pyramid object legs ride along (artifact folded by bench.py).
+objectstore-smoke:
+	python tools/objectstore_chaos.py
 
 # Error-budget plane drill (docs/OBSERVABILITY.md "Error budgets"):
 # fleet + black-box canary prober; injected serve brownout + watcher

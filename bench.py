@@ -390,6 +390,16 @@ def _slo_fold() -> dict:
                           "slo_smoke.json")
 
 
+def _objectstore_fold() -> dict:
+    """`make objectstore-smoke` evidence (tools/objectstore_chaos.py):
+    the chunked conditional-put protocol, 3-way store parity, stale
+    object fences rejected with a durable census, torn-upload recovery,
+    and the SIGKILL-mid-upload / orphan-scrub legs
+    (docs/ROBUSTNESS.md "Object tier")."""
+    return _artifact_fold("objectstore_chaos", "FIREBIRD_OBJECTSTORE_DIR",
+                          "objectstore_chaos.json")
+
+
 def _acquisition_freshness_block() -> dict:
     """``acquisition_to_alert_p95`` promoted NEXT TO the e2e block: the
     read-side headline is pixels/sec including transfer; the streaming
@@ -1127,6 +1137,11 @@ def measure(cpu_only: bool) -> None:
             # serve brownout + watcher stall; burn verdict trip time,
             # durable budget events, history through SIGKILL/restart).
             **_slo_fold(),
+            # Last objectstore-smoke evidence (chunked-publish protocol,
+            # 3-way store parity, durable stale-fence census, torn
+            # uploads recovered, SIGKILL-mid-upload invisibility +
+            # orphan scrub).
+            **_objectstore_fold(),
             "streaming_pixels_per_sec": round(stream_rate, 1),
             **s2_detail,
             **hard_detail,

@@ -71,6 +71,20 @@ KNOBS = (
          help="results store backend: sqlite | parquet | memory"),
     Knob(name="FIREBIRD_STORE_PATH", field="store_path",
          help="results store path"),
+    Knob(name="FIREBIRD_OBJECT_ROOT", field="object_root",
+         help="object-tier root directory (store/objectstore.py): when "
+              "set, every durable write (store shards, stream "
+              "checkpoints, pyramid tiles) also publishes to the object "
+              "store, object-first — and 'object' becomes a valid "
+              "FIREBIRD_STORE_BACKEND"),
+    Knob(name="FIREBIRD_OBJECT_CHUNK_KB", field="object_chunk_kb",
+         help="object-tier chunk size (KiB) for content-addressed "
+              "multi-chunk uploads"),
+    Knob(name="FIREBIRD_OBJECT_SCRUB_GRACE_SEC",
+         field="object_scrub_grace_sec",
+         help="minimum orphaned-chunk age (seconds) before `firebird "
+              "objectstore scrub` reclaims it — the guard against "
+              "scrubbing a live writer's not-yet-committed upload"),
     Knob(name="FIREBIRD_SOURCE", field="source_backend",
          help="ingest source: chipmunk | synthetic | file"),
     Knob(name="FIREBIRD_SOURCE_PATH", field="source_path",
@@ -333,6 +347,13 @@ KNOBS = (
          help="postmortem-smoke artifact directory"),
     Knob(name="FIREBIRD_FLEET_DIR", default="/tmp/fb_fleet",
          help="fleet-chaos artifact directory"),
+    Knob(name="FIREBIRD_OBJECTSTORE_DIR", default="/tmp/fb_objectstore",
+         help="objectstore-chaos artifact directory"),
+    Knob(name="FIREBIRD_OBJECT_COMMIT_HOLD_SEC", default="0",
+         internal=True,
+         help="chaos hook: seconds to sleep between the last chunk "
+              "upload and the manifest commit (widens the torn-upload "
+              "SIGKILL window for tools/objectstore_chaos.py)"),
     Knob(name="FIREBIRD_ELASTIC_DIR", default="/tmp/fb_elastic",
          help="elastic-soak artifact directory"),
     Knob(name="FIREBIRD_ALERT_DIR", default="/tmp/fb_alerts",
@@ -387,9 +408,17 @@ class Config:
     ard_url: str = "http://localhost:5656"
     aux_url: str = "http://localhost:5656"
 
-    # Results store. backend: 'sqlite' | 'parquet' | 'memory'
+    # Results store. backend: 'sqlite' | 'parquet' | 'memory' | 'object'
     store_backend: str = "sqlite"
     store_path: str = "firebird.db"
+
+    # Object tier (store/objectstore.py).  object_root "" = off; when
+    # set, durable writes mirror to the object store (object-first, so
+    # stale fenced writes reject before any local byte lands) and
+    # store_backend='object' serves reads from it natively.
+    object_root: str = ""
+    object_chunk_kb: int = 256
+    object_scrub_grace_sec: float = 60.0
 
     # Ingest source: 'chipmunk' (HTTP, ard_url/aux_url) | 'synthetic' | 'file'
     source_backend: str = "chipmunk"
@@ -897,6 +926,16 @@ class Config:
         if self.serve_feed_poll_sec <= 0:
             raise ValueError("FIREBIRD_SERVE_FEED_POLL must be > 0 "
                              f"seconds, got {self.serve_feed_poll_sec}")
+        if self.object_chunk_kb <= 0:
+            raise ValueError("FIREBIRD_OBJECT_CHUNK_KB must be > 0 KiB, "
+                             f"got {self.object_chunk_kb}")
+        if self.object_scrub_grace_sec < 0:
+            raise ValueError("FIREBIRD_OBJECT_SCRUB_GRACE_SEC must be >= "
+                             f"0 seconds, got {self.object_scrub_grace_sec}")
+        if self.store_backend == "object" and not self.object_root:
+            raise ValueError(
+                "FIREBIRD_STORE_BACKEND=object needs FIREBIRD_OBJECT_ROOT "
+                "set to the object-tier root directory")
 
     @classmethod
     def from_env(cls, env: dict | None = None, **overrides) -> "Config":
@@ -912,6 +951,12 @@ class Config:
             aux_url=e.get("AUX_CHIPMUNK", cls.aux_url),
             store_backend=e.get("FIREBIRD_STORE_BACKEND", cls.store_backend),
             store_path=e.get("FIREBIRD_STORE_PATH", cls.store_path),
+            object_root=e.get("FIREBIRD_OBJECT_ROOT", cls.object_root),
+            object_chunk_kb=int(e.get("FIREBIRD_OBJECT_CHUNK_KB",
+                                      cls.object_chunk_kb)),
+            object_scrub_grace_sec=float(
+                e.get("FIREBIRD_OBJECT_SCRUB_GRACE_SEC",
+                      cls.object_scrub_grace_sec)),
             source_backend=e.get("FIREBIRD_SOURCE", cls.source_backend),
             source_path=e.get("FIREBIRD_SOURCE_PATH", cls.source_path),
             synth_sensor=e.get("FIREBIRD_SYNTH_SENSOR", cls.synth_sensor),

@@ -35,6 +35,12 @@ scope target            what the injector wraps
                         is mapped, so the landing zone backs up — a stalled
                         watcher the prober's alert probe sees as missed
                         end-to-end deadlines
+``object``              every object-tier operation
+                        (store/objectstore.py): puts/gets/heads/lists
+                        fail per the schedule; the ``torn`` kind (puts
+                        only) additionally leaves a *torn upload* behind
+                        — see below.  ``chip=`` is rejected here: object
+                        ops carry no chip identity
 ======================  =====================================================
 
 ======================  =====================================================
@@ -52,6 +58,15 @@ option                  meaning
 ``timeout``             raise :class:`InjectedTimeout` (TimeoutError)
 ``conn``                raise :class:`InjectedConnError` (ConnectionError)
 ``ioerror``             raise :class:`InjectedFault` (OSError) — the default
+``torn``                object scope only: raise :class:`TornUpload` AND
+                        leave a genuinely torn upload on disk — occurrences
+                        alternate deterministically between committing a
+                        truncated chunk (the manifest promises bytes that
+                        are not there) and dropping the manifest write (the
+                        chunks upload, the object never becomes visible).
+                        NonRetryable by design: the damage must persist for
+                        the reader-side recovery drills, not be healed by
+                        the retry wrapper
 ======================  =====================================================
 
 With ``FIREBIRD_FAULTS`` unset, :func:`wrap_source` / :func:`wrap_store` /
@@ -67,10 +82,12 @@ import random
 import threading
 import zlib
 
+from firebird_tpu import retry as retrylib
 from firebird_tpu.obs import metrics as obs_metrics
 
-TARGETS = ("ingest", "aux", "store", "writer", "lease", "serve", "watch")
-_KINDS = ("ioerror", "timeout", "conn")
+TARGETS = ("ingest", "aux", "store", "writer", "lease", "serve", "watch",
+           "object")
+_KINDS = ("ioerror", "timeout", "conn", "torn")
 
 
 class InjectedFault(OSError):
@@ -85,8 +102,18 @@ class InjectedConnError(ConnectionError):
     """A fault-plan-injected connection failure."""
 
 
+class TornUpload(OSError, retrylib.NonRetryable):
+    """A fault-plan-injected torn object upload (``object`` scope).
+
+    NonRetryable on purpose: the proxy has already left real damage on
+    disk (a truncated chunk under a committed manifest, or uploaded
+    chunks with the manifest write dropped), and the drill is the
+    *reader's* recovery path — a retry wrapper silently re-putting would
+    erase the very state under test."""
+
+
 _ERRORS = {"ioerror": InjectedFault, "timeout": InjectedTimeout,
-           "conn": InjectedConnError}
+           "conn": InjectedConnError, "torn": TornUpload}
 
 
 class FaultSpec:
@@ -109,6 +136,13 @@ class FaultSpec:
         if kind not in _KINDS:
             raise ValueError(f"fault kind must be one of {_KINDS}, got "
                              f"{kind!r}")
+        if kind == "torn" and target != "object":
+            # A torn upload is an object-tier phenomenon (chunks vs
+            # manifest); on any other scope it would be a misspelled
+            # ioerror that silently changed semantics.
+            raise ValueError(
+                f"fault kind 'torn' only applies to the object scope, "
+                f"not {target!r}")
         if chips and target not in ("ingest", "aux"):
             # store/writer ops carry no chip identity, so chip= there
             # would validate yet never fire — the silent-no-op chaos run
@@ -325,6 +359,62 @@ class FaultyWriter:
         return getattr(self._inner, name)
 
 
+class FaultyObjectStore:
+    """Object-store proxy (store/objectstore.py protocol).
+
+    ``put`` always rides the injector; the read-side ops (get/head/list/
+    delete) ride it only for the transient kinds — a ``torn`` schedule
+    is about *uploads*, and firing it on reads would raise TornUpload
+    from operations that cannot tear anything.
+
+    On a TornUpload the proxy first performs the damaged put for real —
+    alternating deterministically between a truncated final chunk
+    (``_torn="chunk"``: manifest commits over missing bytes) and a
+    dropped manifest (``_torn="manifest"``: chunks land, the object
+    never becomes visible) — then re-raises, so the on-disk state
+    matches what a crashed uploader leaves behind."""
+
+    def __init__(self, inner, injector: FaultInjector):
+        self._inner = inner
+        self._inj = injector
+        self._torn_lock = threading.Lock()
+        self._torn_count = 0
+
+    def _fire_transient(self):
+        if self._inj.spec.kind != "torn":
+            self._inj.fire()
+
+    def put(self, key, data, **kw):
+        try:
+            self._inj.fire()
+        except TornUpload:
+            with self._torn_lock:
+                mode = "chunk" if self._torn_count % 2 == 0 else "manifest"
+                self._torn_count += 1
+            self._inner.put(key, data, **{**kw, "_torn": mode})
+            raise
+        return self._inner.put(key, data, **kw)
+
+    def get(self, key):
+        self._fire_transient()
+        return self._inner.get(key)
+
+    def head(self, key):
+        self._fire_transient()
+        return self._inner.head(key)
+
+    def list(self, prefix=""):
+        self._fire_transient()
+        return self._inner.list(prefix)
+
+    def delete(self, key):
+        self._fire_transient()
+        return self._inner.delete(key)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 def wrap_source(source, plan: FaultPlan | None, scope: str = "ingest"):
     """Source under the plan's ``scope`` injector; the source itself
     (zero indirection) when no plan covers either the scope or ``aux``
@@ -350,3 +440,10 @@ def wrap_writer(writer, plan: FaultPlan | None):
         return writer
     inj = plan.injector("writer")
     return writer if inj is None else FaultyWriter(writer, inj)
+
+
+def wrap_objectstore(store, plan: FaultPlan | None):
+    if plan is None:
+        return store
+    inj = plan.injector("object")
+    return store if inj is None else FaultyObjectStore(store, inj)

@@ -948,6 +948,14 @@ class FencedStore:
         self._inner = inner
         self._queue = queue
         self._lease = lease
+        # Object-backed (and mirrored) stores also reject stale fences
+        # durably at the object layer via conditional-put generation
+        # preconditions — stamp the lease's token on them so a zombie's
+        # write is refused even if this process dies before the queue's
+        # own fence_valid check can run.
+        bind = getattr(inner, "bind_fence", None)
+        if bind is not None:
+            bind(lease.fence)
 
     def write(self, table: str, frame: dict) -> int:
         if not self._queue.fence_valid(self._lease.job_id,

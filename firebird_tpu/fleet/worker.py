@@ -51,7 +51,7 @@ from firebird_tpu.obs import metrics as obs_metrics
 from firebird_tpu.obs import server as obs_server
 from firebird_tpu.obs import spool as obs_spool
 from firebird_tpu.obs import tracing
-from firebird_tpu.store import AsyncWriter, open_store
+from firebird_tpu.store import AsyncWriter, StaleObjectFence, open_store
 
 
 # `fleet work`/`fleet supervise` exit status for a WEDGED queue
@@ -276,7 +276,7 @@ class FleetWorker:
             obs_spool.mark("job_acked", trace=ctx.batch_id,
                            job=lease.job_id, type=lease.job_type)
             self.log.info("acked job %d (%.2fs)", lease.job_id, tm.elapsed)
-        except (StaleFence, LeaseLost) as e:
+        except (StaleFence, StaleObjectFence, LeaseLost) as e:
             # The job is a successor's now: abandon it quietly — no
             # fail() (our token could not record one anyway), no
             # quarantine records, just the loss accounting.
@@ -295,7 +295,7 @@ class FleetWorker:
             stop_heartbeat()
             try:
                 state = self.queue.fail(lease, e)
-            except StaleFence:
+            except (StaleFence, StaleObjectFence):
                 self.tallies["lost"] += 1
                 flightrec.mark("fleet_lease_lost", job=lease.job_id,
                                fence=lease.fence, error=type(e).__name__)
@@ -518,7 +518,8 @@ class FleetWorker:
         try:
             pyr = pyrlib.TilePyramid(
                 root, pyrlib.store_read_chip(
-                    fenced, compute=bool(payload.get("compute", True))))
+                    fenced, compute=bool(payload.get("compute", True))),
+                storage=pyrlib.pyramid_storage(self.cfg, root))
             summary = pyr.build_area(
                 list(payload["products"]),
                 list(payload["product_dates"]),

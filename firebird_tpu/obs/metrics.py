@@ -267,6 +267,19 @@ METRIC_HELP = {
     "store_write_errors": "store writes that exhausted their retries",
     "store_write_retries": "store writes retried after transient errors",
     "store_queue_depth": "frames queued to the async writer",
+    "objectstore_puts": "objects published (manifest commits)",
+    "objectstore_gets": "object reads served",
+    "objectstore_conflicts":
+        "conditional puts that lost the generation race",
+    "objectstore_torn_recoveries":
+        "reads that fell back a generation past a torn newest object",
+    "objectstore_scrubbed_chunks":
+        "orphaned chunks reclaimed by the scrubber",
+    "objectstore_retries":
+        "transient object-store operation failures retried under the "
+        "shared budget",
+    "object_fence_rejected_total":
+        "stale-fence conditional puts rejected at the object layer",
     "watchdog_stall_total": "stall episodes declared by the watchdog",
     "watchdog_recovered_total": "stalls cleared by a later batch beat",
     "watchdog_throughput_drop_total":

@@ -323,6 +323,27 @@ class RetryPolicy:
                    counter_help=("transient store-write failures retried "
                                  "by the async writer"))
 
+    @classmethod
+    def for_object(cls, cfg, *, budget: RetryBudget | None = None,
+                   breaker: CircuitBreaker | None = None,
+                   sleep=None) -> "RetryPolicy":
+        """Object-tier operations (store/objectstore.py): same attempt
+        count and budget semantics as store writes; NonRetryable losses
+        (PreconditionFailed, StaleObjectFence, TornUpload) re-raise
+        without spending budget."""
+        # Pre-register so the series exposes at zero from the first
+        # scrape rather than appearing only after the first retry.
+        obs_metrics.counter("objectstore_retries",
+                            help=("transient object-store operation "
+                                  "failures retried under the shared "
+                                  "budget"))
+        return cls(cfg.fetch_retries, budget=budget, breaker=breaker,
+                   sleep=sleep,
+                   counter_name="objectstore_retries",
+                   counter_help=("transient object-store operation "
+                                 "failures retried under the shared "
+                                 "budget"))
+
 
 def make_breaker(cfg) -> CircuitBreaker | None:
     """The run's ingest breaker per config; None when disabled

@@ -34,13 +34,16 @@ from firebird_tpu.serve.changefeed import (ChangefeedConsumer, ProductWrites,
                                            changefeed_db_path)
 from firebird_tpu.serve.flight import (AdmissionControl, DeadlineExceeded,
                                        Overload, SingleFlight, StoreDegraded)
-from firebird_tpu.serve.pyramid import TilePyramid, pyramid_root
+from firebird_tpu.serve.pyramid import (LocalTileStorage, ObjectTileStorage,
+                                        TilePyramid, pyramid_root,
+                                        pyramid_storage)
 
 __all__ = [
     "ServeServer", "ServeService", "start_serve_server",
     "LRUCache", "StoreGenerations", "watch_store",
     "ChangefeedConsumer", "ProductWrites", "changefeed_db_path",
-    "TilePyramid", "pyramid_root",
+    "TilePyramid", "pyramid_root", "pyramid_storage",
+    "LocalTileStorage", "ObjectTileStorage",
     "AdmissionControl", "DeadlineExceeded", "Overload", "SingleFlight",
     "StoreDegraded",
 ]

@@ -234,32 +234,17 @@ def downsample2x(cells: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(cells[::2, ::2])
 
 
-class TilePyramid:
-    """The versioned static-tile tree under ``root``.
+class LocalTileStorage:
+    """The classic on-disk tile tree: ``<root>/<product>/<date>/<z>/
+    <x>/<y>.npy`` + ``<y>.json`` meta + ``<y>.stale`` marker, all
+    atomic-replace writes.  This is the storage seam's reference
+    implementation — :class:`TilePyramid` defaults to it, and
+    :class:`ObjectTileStorage` implements the same interface over the
+    object tier (store/objectstore.py)."""
 
-    ``read_chip(name, date, cx, cy) -> flat cells | None`` renders base
-    tiles; ``flight`` (a serve/flight.SingleFlight, optional) coalesces
-    concurrent builds of one tile.  Thread-safe; cross-process build
-    races resolve by atomic last-writer-wins replaces.
-    """
-
-    def __init__(self, root: str, read_chip=None, *, flight=None,
-                 max_miss_depth: int = MAX_MISS_DEPTH):
+    def __init__(self, root: str):
         self.root = root
-        self.read_chip = read_chip
-        self.flight = flight
-        self.max_miss_depth = int(max_miss_depth)
-        self._lock = threading.Lock()
-        # mtime-validated meta cache: the conditional-request (304) hot
-        # path peeks a tile's meta on EVERY revalidation; an os.stat
-        # against the cached mtime replaces the open+json.loads, and
-        # invalidation stamps / rebuilds rewrite the file (new mtime),
-        # so a hit can never serve a stamp that already landed.
-        self._meta_cache: dict = {}  # guarded-by: _meta_lock
-        self._meta_lock = threading.Lock()
         os.makedirs(root, exist_ok=True)
-
-    # -- paths --------------------------------------------------------------
 
     def _tile_dir(self, name: str, date: str, z: int, x: int) -> str:
         return os.path.join(self.root, name, date, str(z), str(x))
@@ -269,18 +254,265 @@ class TilePyramid:
         d = self._tile_dir(name, date, z, x)
         return os.path.join(d, f"{y}.npy"), os.path.join(d, f"{y}.json")
 
-    def _marker_path(self, name: str, date: str, z: int, x: int,
-                     y: int) -> str:
+    def marker_path(self, name: str, date: str, z: int, x: int,
+                    y: int) -> str:
         """The stale MARKER sidecar.  Invalidation touches this file
         instead of rewriting the meta: a consumer's stamp can therefore
         never clobber a build that persisted concurrently in another
         process (the meta — and its version counter — has exactly one
-        writer, ``_persist``).  Staleness = marker mtime >= meta mtime;
+        writer, ``persist``).  Staleness = marker mtime >= meta mtime;
         a rebuild's fresh meta outdates the marker, and a marker
         touched while a build races lands >= and forces one extra
         rebuild — over-invalidation, never under."""
         return os.path.join(self._tile_dir(name, date, z, x),
                             f"{y}.stale")
+
+    def meta_ident(self, name, date, z, x, y):
+        """A cheap identity token for the persisted meta (None when the
+        tile does not exist): any stamp/rebuild changes it, so a cached
+        meta validated against it can never go stale silently."""
+        _, mpath = self.tile_paths(name, date, z, x, y)
+        try:
+            st = os.stat(mpath)
+        except OSError:
+            return None
+        return (st.st_mtime_ns, st.st_ino)
+
+    def load_meta(self, name, date, z, x, y) -> dict | None:
+        _, mpath = self.tile_paths(name, date, z, x, y)
+        try:
+            with open(mpath) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def is_stale(self, name, date, z, x, y, ident) -> bool:
+        try:
+            mst = os.stat(self.marker_path(name, date, z, x, y))
+        except OSError:
+            return False
+        return mst.st_mtime_ns >= ident[0]
+
+    def load_cells(self, name, date, z, x, y):
+        npy, _ = self.tile_paths(name, date, z, x, y)
+        try:
+            return np.load(npy)
+        except (OSError, ValueError):
+            return None
+
+    def persist(self, name, date, z, x, y, cells, meta: dict) -> None:
+        npy, mpath = self.tile_paths(name, date, z, x, y)
+        os.makedirs(os.path.dirname(npy), exist_ok=True)
+        tmp = f"{npy}.tmp.{os.getpid()}.npy"
+        np.save(tmp, np.asarray(cells, np.int32))
+        os.replace(tmp, npy)
+        _atomic_json(mpath, meta)
+
+    def stamp(self, name, date, z, x, y) -> bool:
+        marker = self.marker_path(name, date, z, x, y)
+        try:
+            with open(marker, "a"):
+                pass
+            os.utime(marker, None)
+        except OSError:
+            return False
+        return True
+
+    def product_dates(self) -> list[tuple[str, str]]:
+        out = []
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return out
+        for n in names:
+            d = os.path.join(self.root, n)
+            if not os.path.isdir(d):
+                continue
+            try:
+                out.extend((n, dt) for dt in sorted(os.listdir(d)))
+            except OSError:
+                continue
+        return out
+
+    def tiles_by_level(self) -> dict:
+        """Tile counts by level (+ stale counts) — a directory walk, no
+        tile loads."""
+        by_level: dict[str, dict] = {}
+        for name, date in self.product_dates():
+            droot = os.path.join(self.root, name, date)
+            try:
+                zs = sorted(os.listdir(droot))
+            except OSError:
+                continue
+            for z in zs:
+                zdir = os.path.join(droot, z)
+                if not os.path.isdir(zdir):
+                    continue
+                lv = by_level.setdefault(z, {"tiles": 0, "stale": 0})
+                for xdir in os.listdir(zdir):
+                    xd = os.path.join(zdir, xdir)
+                    if not os.path.isdir(xd):
+                        continue
+                    for fn in os.listdir(xd):
+                        if fn.endswith(".json"):
+                            mpath = os.path.join(xd, fn)
+                            try:
+                                mt = os.stat(mpath).st_mtime_ns
+                            except OSError:
+                                continue
+                            lv["tiles"] += 1
+                            try:
+                                stale = os.stat(
+                                    mpath[:-len(".json")] + ".stale"
+                                ).st_mtime_ns >= mt
+                            except OSError:
+                                stale = False
+                            lv["stale"] += stale
+        return by_level
+
+    def describe(self) -> str:
+        return self.root
+
+
+class ObjectTileStorage:
+    """Tiles + ``.stale`` markers as objects (store/objectstore.py).
+
+    One object per tile — the ``.npy`` bytes as the body, the tile meta
+    dict riding the manifest user metadata, so the 304-revalidation
+    probe (``meta_ident`` + ``load_meta``) is a pure ``head`` and the
+    ETag contract (``meta["version"]``, monotonic under ``persist``'s
+    read-increment-write) is unchanged.  The stale marker is a tiny
+    sibling object whose ``updated`` plays the marker-mtime role:
+    stale when ``marker.updated >= tile.updated``, and a rebuild's
+    fresh manifest outdates the marker — the exact over-invalidation
+    (never under-) semantics of the local marker files."""
+
+    def __init__(self, objstore, scope: str):
+        self._obj = objstore
+        self.scope = scope
+
+    def _tkey(self, name, date, z, x, y) -> str:
+        return f"{self.scope}/pyramid/{name}/{date}/{z}/{x}/{y}"
+
+    def _mkey(self, name, date, z, x, y) -> str:
+        return self._tkey(name, date, z, x, y) + ".stale"
+
+    def meta_ident(self, name, date, z, x, y):
+        h = self._obj.head(self._tkey(name, date, z, x, y))
+        return None if h is None else (h.generation, h.updated)
+
+    def load_meta(self, name, date, z, x, y) -> dict | None:
+        h = self._obj.head(self._tkey(name, date, z, x, y))
+        return None if h is None else dict(h.meta)
+
+    def is_stale(self, name, date, z, x, y, ident) -> bool:
+        m = self._obj.head(self._mkey(name, date, z, x, y))
+        return m is not None and m.updated >= ident[1]
+
+    def load_cells(self, name, date, z, x, y):
+        import io
+
+        try:
+            data, _ = self._obj.get(self._tkey(name, date, z, x, y))
+        except (KeyError, OSError):
+            return None
+        try:
+            return np.load(io.BytesIO(data), allow_pickle=False)
+        except ValueError:
+            return None
+
+    def persist(self, name, date, z, x, y, cells, meta: dict) -> None:
+        import io
+
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(cells, np.int32))
+        self._obj.put(self._tkey(name, date, z, x, y), buf.getvalue(),
+                      meta=meta)
+
+    def stamp(self, name, date, z, x, y) -> bool:
+        try:
+            self._obj.put(self._mkey(name, date, z, x, y), b"")
+        except OSError:
+            return False
+        return True
+
+    def _tile_keys(self):
+        prefix = f"{self.scope}/pyramid/"
+        for key in self._obj.list(prefix):
+            parts = key[len(prefix):].split("/")
+            if len(parts) == 5 and not parts[4].endswith(".stale"):
+                yield parts  # name, date, z, x, y
+
+    def product_dates(self) -> list[tuple[str, str]]:
+        return sorted({(p[0], p[1]) for p in self._tile_keys()})
+
+    def tiles_by_level(self) -> dict:
+        by_level: dict[str, dict] = {}
+        for name, date, z, x, y in self._tile_keys():
+            lv = by_level.setdefault(z, {"tiles": 0, "stale": 0})
+            lv["tiles"] += 1
+            ident = self.meta_ident(name, date, int(z), int(x), int(y))
+            if ident is not None and self.is_stale(
+                    name, date, int(z), int(x), int(y), ident):
+                lv["stale"] += 1
+        return by_level
+
+    def describe(self) -> str:
+        return f"object:{self.scope}/pyramid"
+
+
+def pyramid_storage(cfg, root: str):
+    """The config's tile storage for ``root``: ObjectTileStorage when
+    the deployment is object-native (``FIREBIRD_STORE_BACKEND=object``
+    with an object root), else None — TilePyramid then defaults to
+    LocalTileStorage, including under the mirror mode, where local
+    files stay read-authoritative."""
+    if getattr(cfg, "store_backend", "") != "object" or \
+            not getattr(cfg, "object_root", ""):
+        return None
+    from firebird_tpu.store import objectstore as objlib
+
+    return ObjectTileStorage(objlib.open_object_root(cfg=cfg),
+                             objlib.scope_for_path(root))
+
+
+class TilePyramid:
+    """The versioned static-tile tree under ``root``.
+
+    ``read_chip(name, date, cx, cy) -> flat cells | None`` renders base
+    tiles; ``flight`` (a serve/flight.SingleFlight, optional) coalesces
+    concurrent builds of one tile.  Thread-safe; cross-process build
+    races resolve by atomic last-writer-wins replaces.  ``storage``
+    picks the durable layer (default :class:`LocalTileStorage`;
+    :class:`ObjectTileStorage` for object-native deployments — see
+    :func:`pyramid_storage`).
+    """
+
+    def __init__(self, root: str, read_chip=None, *, flight=None,
+                 max_miss_depth: int = MAX_MISS_DEPTH, storage=None):
+        self.root = root
+        self.read_chip = read_chip
+        self.flight = flight
+        self.max_miss_depth = int(max_miss_depth)
+        self.storage = storage if storage is not None \
+            else LocalTileStorage(root)
+        self._lock = threading.Lock()
+        # ident-validated meta cache: the conditional-request (304) hot
+        # path peeks a tile's meta on EVERY revalidation; a storage
+        # meta_ident probe (an os.stat / object head) against the cached
+        # identity replaces the full meta load, and invalidation stamps
+        # / rebuilds change the identity, so a hit can never serve a
+        # stamp that already landed.
+        self._meta_cache: dict = {}  # guarded-by: _meta_lock
+        self._meta_lock = threading.Lock()
+
+    # -- paths --------------------------------------------------------------
+
+    def tile_paths(self, name: str, date: str, z: int, x: int,
+                   y: int) -> tuple[str, str]:
+        """Local tile file paths — the byte-compare hook smoke tools
+        use; only meaningful for LocalTileStorage."""
+        return self.storage.tile_paths(name, date, z, x, y)
 
     # -- serving ------------------------------------------------------------
 
@@ -288,24 +520,19 @@ class TilePyramid:
                   y: int) -> dict | None:
         """The persisted tile meta, or None — the cheap freshness probe
         the conditional-request (304) path uses before touching cells.
-        Validated against the file's (mtime_ns, inode): every stamp and
-        rebuild is an atomic replace, so a changed file never matches
-        the cached identity."""
-        _, mpath = self.tile_paths(name, date, z, x, y)
+        Validated against the storage identity (file (mtime_ns, inode)
+        / object (generation, updated)): every stamp and rebuild
+        changes it, so a cached meta never matches a changed tile."""
         key = (name, date, z, x, y)
-        try:
-            st = os.stat(mpath)
-        except OSError:
+        ident = self.storage.meta_ident(name, date, z, x, y)
+        if ident is None:
             return None
-        ident = (st.st_mtime_ns, st.st_ino)
         with self._meta_lock:
             hit = self._meta_cache.get(key)
             meta = hit[1] if hit is not None and hit[0] == ident else None
         if meta is None:
-            try:
-                with open(mpath) as f:
-                    meta = json.load(f)
-            except (OSError, ValueError):
+            meta = self.storage.load_meta(name, date, z, x, y)
+            if meta is None:
                 return None
             with self._meta_lock:
                 if len(self._meta_cache) > 4096:
@@ -313,13 +540,9 @@ class TilePyramid:
                 self._meta_cache[key] = (ident, meta)  # one hot pass
         # Marker staleness is evaluated per call (never cached): the
         # marker is what another process's invalidation touches.
-        try:
-            mst = os.stat(self._marker_path(name, date, z, x, y))
-            if mst.st_mtime_ns >= st.st_mtime_ns and \
-                    not meta.get("stale"):
-                meta = {**meta, "stale": True}
-        except OSError:
-            pass
+        if not meta.get("stale") and self.storage.is_stale(
+                name, date, z, x, y, ident):
+            meta = {**meta, "stale": True}
         return meta
 
     def tile(self, name: str, date: str, z: int, x: int, y: int,
@@ -358,13 +581,11 @@ class TilePyramid:
         return self.flight.do(key, build, deadline=deadline)
 
     def _load_fresh(self, name, date, z, x, y):
-        npy, _ = self.tile_paths(name, date, z, x, y)
         meta = self.peek_meta(name, date, z, x, y)
         if meta is None or meta.get("stale"):
             return None
-        try:
-            cells = np.load(npy)
-        except (OSError, ValueError):
+        cells = self.storage.load_cells(name, date, z, x, y)
+        if cells is None:
             return None
         return np.asarray(cells, np.int32), meta
 
@@ -415,8 +636,6 @@ class TilePyramid:
         return out
 
     def _persist(self, name, date, z, x, y, cells) -> dict:
-        npy, mpath = self.tile_paths(name, date, z, x, y)
-        os.makedirs(os.path.dirname(npy), exist_ok=True)
         prev = self.peek_meta(name, date, z, x, y)
         meta = {
             "schema": TILE_SCHEMA,
@@ -428,34 +647,18 @@ class TilePyramid:
             "fill": FILL_VALUE,
             "extent": tile_extent(z, x, y),
         }
-        tmp = f"{npy}.tmp.{os.getpid()}.npy"
-        np.save(tmp, np.asarray(cells, np.int32))
-        os.replace(tmp, npy)
-        _atomic_json(mpath, meta)
+        self.storage.persist(name, date, z, x, y, cells, meta)
         return meta
 
     # -- invalidation (the changefeed consumer's hook) ----------------------
 
     def _product_dates(self) -> list[tuple[str, str]]:
-        out = []
-        try:
-            names = sorted(os.listdir(self.root))
-        except OSError:
-            return out
-        for n in names:
-            d = os.path.join(self.root, n)
-            if not os.path.isdir(d):
-                continue
-            try:
-                out.extend((n, dt) for dt in sorted(os.listdir(d)))
-            except OSError:
-                continue
-        return out
+        return self.storage.product_dates()
 
     def invalidate_chip(self, cx: float, cy: float) -> int:
         """Mark the base tile of chip (cx, cy) and every ancestor stale
         across all persisted (product, date) combos, by TOUCHING each
-        tile's stale marker (see ``_marker_path`` — the meta and its
+        tile's stale marker (``storage.stamp`` — the meta and its
         version counter have exactly one writer, so a stamp can never
         roll back a concurrent rebuild's version, and the rebuilt
         tile's ETag can never collide with the stale one's).  Returns
@@ -471,12 +674,7 @@ class TilePyramid:
                     meta = self.peek_meta(name, date, z, x, y)
                     if meta is None or meta.get("stale"):
                         continue
-                    marker = self._marker_path(name, date, z, x, y)
-                    try:
-                        with open(marker, "a"):
-                            pass
-                        os.utime(marker, None)
-                    except OSError:
+                    if not self.storage.stamp(name, date, z, x, y):
                         continue
                     dirtied += 1
         if dirtied:
@@ -538,39 +736,9 @@ class TilePyramid:
 
     def status(self) -> dict:
         """Tile counts by level (+ stale counts) for ``firebird status``
-        and the loadtest artifact — a directory walk, no tile loads."""
-        by_level: dict[str, dict] = {}
-        for name, date in self._product_dates():
-            droot = os.path.join(self.root, name, date)
-            try:
-                zs = sorted(os.listdir(droot))
-            except OSError:
-                continue
-            for z in zs:
-                zdir = os.path.join(droot, z)
-                if not os.path.isdir(zdir):
-                    continue
-                lv = by_level.setdefault(z, {"tiles": 0, "stale": 0})
-                for xdir in os.listdir(zdir):
-                    xd = os.path.join(zdir, xdir)
-                    if not os.path.isdir(xd):
-                        continue
-                    for fn in os.listdir(xd):
-                        if fn.endswith(".json"):
-                            mpath = os.path.join(xd, fn)
-                            try:
-                                mt = os.stat(mpath).st_mtime_ns
-                            except OSError:
-                                continue
-                            lv["tiles"] += 1
-                            try:
-                                stale = os.stat(
-                                    mpath[:-len(".json")] + ".stale"
-                                ).st_mtime_ns >= mt
-                            except OSError:
-                                stale = False
-                            lv["stale"] += stale
-        return {"root": self.root,
+        and the loadtest artifact — a storage census, no tile loads."""
+        by_level = self.storage.tiles_by_level()
+        return {"root": self.storage.describe(),
                 "products": sorted({n for n, _ in self._product_dates()}),
                 "tiles_by_level": dict(sorted(by_level.items(),
                                               key=lambda kv: int(kv[0])))}

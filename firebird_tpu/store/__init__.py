@@ -20,8 +20,17 @@ from firebird_tpu.store.schema import TABLES, primary_key
 from firebird_tpu.store.backends import (CassandraStore, MemoryStore,
                                          ParquetStore, SqliteStore,
                                          cassandra_ddl, open_store)
+from firebird_tpu.store.objectstore import (LocalObjectStore,
+                                            MirroredStore,
+                                            ObjectBackedStore,
+                                            ObjectStoreError,
+                                            PreconditionFailed,
+                                            StaleObjectFence,
+                                            open_object_root)
 from firebird_tpu.store.writer import AsyncWriter
 
 __all__ = ["TABLES", "primary_key", "CassandraStore", "MemoryStore",
            "SqliteStore", "ParquetStore", "cassandra_ddl", "open_store",
-           "AsyncWriter"]
+           "LocalObjectStore", "ObjectBackedStore", "MirroredStore",
+           "ObjectStoreError", "PreconditionFailed", "StaleObjectFence",
+           "open_object_root", "AsyncWriter"]

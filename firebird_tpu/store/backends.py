@@ -555,12 +555,21 @@ def open_store(backend: str, path: str, keyspace: str,
     CASSANDRA_PASS, CASSANDRA_OUTPUT_CONCURRENT_WRITES — credentials stay
     in the environment, not in Config.
     """
-    if read_only and backend != "sqlite":
+    if read_only and backend not in ("sqlite", "object"):
         raise ValueError(
             f"read_only is a sqlite replica mode; backend {backend!r} "
             "has no writer lock for replicas to avoid")
+    if backend == "object":
+        # Object-native: shards, manifests, and fencing all live in the
+        # object tier (FIREBIRD_OBJECT_ROOT); ``path`` only scopes the
+        # key prefix so distinct logical stores share one root safely.
+        from firebird_tpu.store import objectstore as objlib
+        return objlib.ObjectBackedStore(
+            objlib.open_object_root(), objlib.scope_for_path(path),
+            keyspace, read_only=read_only)
     if backend == "sqlite":
-        return SqliteStore(path, keyspace, read_only=read_only)
+        store = SqliteStore(path, keyspace, read_only=read_only)
+        return _maybe_mirror(store, path, keyspace, read_only)
     if backend == "cassandra":
         hosts = os.environ.get("CASSANDRA", "127.0.0.1").split(",")
         return CassandraStore(
@@ -572,7 +581,29 @@ def open_store(backend: str, path: str, keyspace: str,
             concurrent_writes=int(
                 os.environ.get("CASSANDRA_OUTPUT_CONCURRENT_WRITES", "2")))
     if backend == "memory":
-        return MemoryStore(keyspace)
+        return _maybe_mirror(MemoryStore(keyspace), path, keyspace, False)
     if backend == "parquet":
-        return ParquetStore(path, keyspace)
+        return _maybe_mirror(ParquetStore(path, keyspace), path, keyspace,
+                             False)
     raise ValueError(f"unknown store backend: {backend!r}")
+
+
+def _maybe_mirror(store, path: str, keyspace: str, read_only: bool):
+    """Wrap a local-file store in the object-tier write-through mirror
+    when FIREBIRD_OBJECT_ROOT is set (store/objectstore.MirroredStore).
+
+    Env-driven on purpose: every existing open_store call site — driver,
+    fleet workers, CLI — inherits the mirror just by running with the
+    knob exported, which is how `make fleet-smoke` reruns UNCHANGED
+    against the object backend.  Local files stay read-authoritative;
+    writes publish to the object tier FIRST so a zombie's stale-fence
+    write is rejected at the object layer before any local byte lands.
+    Replica (read-only) handles never write, so they skip the wrap.
+    """
+    from firebird_tpu.config import env_knob
+    if read_only or not env_knob("FIREBIRD_OBJECT_ROOT"):
+        return store
+    from firebird_tpu.store import objectstore as objlib
+    mirror = objlib.ObjectBackedStore(
+        objlib.open_object_root(), objlib.scope_for_path(path), keyspace)
+    return objlib.MirroredStore(store, mirror)

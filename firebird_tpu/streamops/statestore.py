@@ -679,6 +679,141 @@ class TileStateStore:
             self._geom.clear()
 
 
+# ---------------------------------------------------------------------------
+# The object-tier statestore (store/objectstore.py; ROADMAP item 4)
+# ---------------------------------------------------------------------------
+
+class ObjectStateStore:
+    """Stream checkpoints as versioned objects: one object per chip.
+
+    The double-bank CRC slot protocol maps onto the object tier's
+    retained generations — a publish is a new manifest generation, and a
+    torn newest (truncated chunk, dropped manifest) falls back one
+    generation inside ``objectstore.get`` exactly as the ``.fbss`` path
+    falls back one bank.  The payload is the same canonical
+    ``serialize_state`` byte layout, so object and packed checkpoints
+    are byte-comparable; geometry and the scheduling horizon ride the
+    manifest user metadata so ``exists``/``peek_horizon`` are head-only.
+    JAX-free except ``load`` (the ``_wrap_state`` contract).
+    """
+
+    backend = "object"
+
+    def __init__(self, objstore, scope: str):
+        self._obj = objstore
+        self.scope = scope
+        self.tallies = {"saves": 0, "loads": 0}
+
+    def _key(self, cid) -> str:
+        return f"{self.scope}/state/chip_{int(cid[0])}_{int(cid[1])}"
+
+    def save(self, cid, st, side: dict) -> None:
+        self.save_arrays(cid, None, st=st, side=side)
+
+    def save_arrays(self, cid, arrays: dict | None, *, st=None,
+                    side=None) -> None:
+        if arrays is not None:
+            coefs = np.asarray(arrays["coefs"])
+            P, B, K = coefs.shape
+            payload = b"".join(
+                _canonical(n, arrays[n], d, s).tobytes()
+                for n, d, s in _layout(P, B, K))
+        else:
+            payload = serialize_state(st, side)
+            P, B, K = np.asarray(st.coefs).shape
+        horizon = struct.unpack("<d", payload[-8:])[0]
+        self._obj.put(self._key(cid), payload,
+                      meta={"geom": [int(P), int(B), int(K)],
+                            "horizon": float(horizon)})
+        self.tallies["saves"] += 1
+
+    def _load_arrays(self, cid) -> dict:
+        try:
+            payload, meta = self._obj.get(self._key(cid))
+        except KeyError:
+            raise KeyError(f"no object state for chip "
+                           f"({int(cid[0])},{int(cid[1])})") from None
+        self.tallies["loads"] += 1
+        return deserialize_state(payload, *meta.meta["geom"])
+
+    def peek_arrays(self, cid) -> dict:
+        return self._load_arrays(cid)
+
+    def load(self, cid):
+        return _wrap_state(self._load_arrays(cid))
+
+    def exists(self, cid) -> bool:
+        return self._obj.head(self._key(cid)) is not None
+
+    def peek_horizon(self, cid) -> float | None:
+        h = self._obj.head(self._key(cid))
+        if h is None or "horizon" not in h.meta:
+            return None
+        return float(h.meta["horizon"])
+
+    def chips(self) -> list:
+        import re
+
+        out = []
+        for key in self._obj.list(f"{self.scope}/state/chip_"):
+            m = re.fullmatch(r"chip_(-?\d+)_(-?\d+)",
+                             key.rsplit("/", 1)[-1])
+            if m:
+                out.append((int(m.group(1)), int(m.group(2))))
+        return sorted(out)
+
+    def void(self, cid) -> None:
+        self._obj.delete(self._key(cid))
+
+    def status(self) -> dict:
+        return {"backend": self.backend, "scope": self.scope,
+                "chips": len(self.chips()), **self.tallies}
+
+    def close(self) -> None:
+        close = getattr(self._obj, "close", None)
+        if close is not None:
+            close()
+
+
+class MirroredStateStore:
+    """Write-through mirror: the local packed store stays
+    read-authoritative, every checkpoint publish ALSO lands in the
+    object tier (local first here — checkpoints carry no fencing
+    precondition, and the stream driver re-reads its own writes
+    locally on the hot path)."""
+
+    backend = "packed+object"
+
+    def __init__(self, local, mirror: ObjectStateStore):
+        self._local = local
+        self._mirror = mirror
+
+    def save(self, cid, st, side: dict) -> None:
+        self._local.save(cid, st, side)
+        self._mirror.save(cid, st, side)
+
+    def save_arrays(self, cid, arrays, *, st=None, side=None) -> None:
+        self._local.save_arrays(cid, arrays, st=st, side=side)
+        self._mirror.save_arrays(cid, arrays, st=st, side=side)
+
+    def void(self, cid) -> None:
+        self._local.void(cid)
+        self._mirror.void(cid)
+
+    def status(self) -> dict:
+        return {**self._local.status(), "backend": self.backend,
+                "object_scope": self._mirror.scope}
+
+    def close(self) -> None:
+        try:
+            self._mirror.close()
+        finally:
+            self._local.close()
+
+    def __getattr__(self, name):
+        return getattr(self._local, name)
+
+
 def open_statestore(cfg, root: str | None = None):
     """The config's stream checkpoint store: packed (default) or the
     legacy per-chip npz layout (``FIREBIRD_STREAM_STATESTORE=npz``).
@@ -686,9 +821,21 @@ def open_statestore(cfg, root: str | None = None):
     A ``FIREBIRD_DTYPE=float64`` config routes to the npz layout
     automatically: f64 state does not fit the packed canonical-f32
     slots losslessly, and a supported dtype must not crash at its
-    first checkpoint save just because the layout default changed."""
+    first checkpoint save just because the layout default changed.
+
+    With ``FIREBIRD_OBJECT_ROOT`` set, the packed store is wrapped in
+    the object-tier write-through mirror (npz mode is not: its f64
+    escape-hatch payloads are exactly what the canonical object layout
+    refuses to round)."""
     root = root or state_dir(cfg)
     mode = getattr(cfg, "stream_statestore", "packed")
     if mode == "npz" or getattr(cfg, "dtype", "float32") == "float64":
         return LegacyNpzStore(root)
-    return TileStateStore(root)
+    store = TileStateStore(root)
+    if getattr(cfg, "object_root", ""):
+        from firebird_tpu.store import objectstore as objlib
+        mirror = ObjectStateStore(
+            objlib.open_object_root(cfg=cfg),
+            objlib.scope_for_path(root))
+        return MirroredStateStore(store, mirror)
+    return store

@@ -1,0 +1,494 @@
+"""Object-tier tests (store/objectstore.py, docs/ROBUSTNESS.md "Object
+tier"): the chunked conditional-put protocol, torn-upload generation
+fallback, the orphan scrubber, the Store/statestore/pyramid refactors
+behind it, and the two nastiest windows — a SIGKILL between the last
+chunk upload and the manifest commit, and a zombie's stale-fence
+conditional put racing its successor."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from firebird_tpu import faults as faultlib
+from firebird_tpu.config import Config
+from firebird_tpu.obs import metrics as obs_metrics
+from firebird_tpu.store import open_store
+from firebird_tpu.store.objectstore import (KEEP_GENERATIONS,
+                                            LocalObjectStore,
+                                            MirroredStore,
+                                            ObjectBackedStore,
+                                            PreconditionFailed,
+                                            RetryingObjectStore,
+                                            StaleObjectFence, cas_update,
+                                            open_object_root,
+                                            scope_for_path)
+
+
+def seg_frame(cx=1, cy=2, px=3, py=4, sday="1999-01-01", chprob=1.0):
+    f = {"cx": [cx], "cy": [cy], "px": [px], "py": [py],
+         "sday": [sday], "eday": ["2000-01-01"], "bday": [sday],
+         "chprob": [chprob], "curqa": [8], "rfrawp": [None]}
+    for p in ("bl", "gr", "re", "ni", "s1", "s2", "th"):
+        f[f"{p}mag"] = [1.5]
+        f[f"{p}rmse"] = [0.5]
+        f[f"{p}coef"] = [[0.1, 0.2, 0.3]]
+        f[f"{p}int"] = [7.0]
+    return f
+
+
+# ---------------------------------------------------------------------------
+# Protocol
+# ---------------------------------------------------------------------------
+
+def test_chunked_roundtrip_and_meta(tmp_path):
+    s = LocalObjectStore(str(tmp_path), chunk_size=64)
+    body = bytes(range(256))                     # 4 distinct chunks
+    m = s.put("a/b", body, meta={"rows": 3})
+    assert m.generation == 1 and len(m.chunks) == 4 and m.size == 256
+    got, meta = s.get("a/b")
+    assert got == body and meta.meta == {"rows": 3}
+    h = s.head("a/b")
+    assert h is not None and h.generation == 1 and h.meta == {"rows": 3}
+
+
+def test_conditional_put_and_generation_pruning(tmp_path):
+    s = LocalObjectStore(str(tmp_path))
+    s.put("k", b"one")
+    s.put("k", b"two", if_generation=1)
+    with pytest.raises(PreconditionFailed) as ei:
+        s.put("k", b"late", if_generation=1)
+    assert ei.value.current == 2
+    assert s.get("k")[0] == b"two"
+    # if_generation=0 means "must not exist"
+    with pytest.raises(PreconditionFailed):
+        s.put("k", b"new", if_generation=0)
+    s.put("fresh", b"x", if_generation=0)
+    # only KEEP_GENERATIONS manifests are retained
+    for i in range(5):
+        s.put("k", f"v{i}".encode())
+    kdir = s._kdir("k")
+    manifests = [n for n in os.listdir(kdir) if n.endswith(".json")]
+    assert len(manifests) == KEEP_GENERATIONS
+
+
+def test_list_delete_and_key_quoting(tmp_path):
+    s = LocalObjectStore(str(tmp_path))
+    keys = ["a/b c", "a/d%2F", "z/1"]
+    for k in keys:
+        s.put(k, k.encode())
+    assert s.list("a/") == sorted(keys[:2])
+    assert s.list() == sorted(keys)
+    for k in keys:                               # quoting round-trips
+        assert s.get(k)[0] == k.encode()
+    s.delete("a/b c")
+    assert s.head("a/b c") is None
+    assert s.list("a/") == ["a/d%2F"]
+    s.delete("a/b c")                            # idempotent
+
+
+def test_torn_chunk_falls_back_one_generation(tmp_path):
+    obs_metrics.reset_registry()
+    s = LocalObjectStore(str(tmp_path), chunk_size=32)
+    good = bytes(range(100))
+    s.put("k", good)
+    s.put("k", bytes(reversed(range(100))), _torn="chunk")
+    got, meta = s.get("k")
+    assert got == good and meta.generation == 1
+    assert obs_metrics.counter("objectstore_torn_recoveries").value >= 1
+    # head still reports the (torn) newest committed generation — the
+    # conditional-put expectation readers must NOT take from get()
+    assert s.head("k").generation == 2
+
+
+def test_torn_manifest_is_invisible_and_scrubbed(tmp_path):
+    s = LocalObjectStore(str(tmp_path), chunk_size=32)
+    s.put("k", b"\x01" * 100, _torn="manifest")
+    assert s.head("k") is None
+    assert s.list() == []
+    c = s.census()
+    assert c["orphan_chunks"] >= 1 and c["keys"] == 0
+    # inside the grace window the orphans are a live writer's chunks
+    rep = s.scrub(grace_sec=3600)
+    assert rep["removed"] == 0 and rep["kept_young"] >= 1
+    rep = s.scrub(grace_sec=0.0, dry_run=True)
+    assert rep["removed"] >= 1 and s.census()["orphan_chunks"] >= 1
+    rep = s.scrub(grace_sec=0.0)
+    assert rep["removed"] >= 1 and s.census()["orphan_chunks"] == 0
+
+
+def test_scrub_keeps_referenced_chunks(tmp_path):
+    s = LocalObjectStore(str(tmp_path), chunk_size=32)
+    body = bytes(range(100))
+    s.put("live", body)
+    s.put("gone", b"\x02" * 100, _torn="manifest")
+    s.scrub(grace_sec=0.0)
+    assert s.get("live")[0] == body
+
+
+def test_census_tolerates_junk(tmp_path):
+    s = LocalObjectStore(str(tmp_path))
+    s.put("k", b"x")
+    kdir = s._kdir("k")
+    with open(os.path.join(kdir, "g2.json"), "w") as f:
+        f.write("{not json")
+    os.makedirs(os.path.join(str(tmp_path), "keys", "stray"),
+                exist_ok=True)
+    c = s.census()
+    assert c["keys"] == 1 and c["junk"] >= 1
+    assert s.get("k")[0] == b"x"                 # junk newest falls back
+
+
+def test_cas_update_contends_past_torn_newest(tmp_path):
+    s = LocalObjectStore(str(tmp_path))
+    cas_update(s, "ctr", lambda old: b"1" if old is None else
+               str(int(old) + 1).encode())
+    cas_update(s, "ctr", lambda old: str(int(old) + 1).encode())
+    assert s.get("ctr")[0] == b"2"
+    # a torn newest must not livelock the RMW loop: head says gen 3,
+    # get falls back to gen 2 — the expectation must come from head
+    s.put("ctr", b"9", _torn="chunk")
+    cas_update(s, "ctr", lambda old: str(int(old) + 1).encode())
+    assert s.get("ctr")[0] == b"3"
+
+
+def test_retry_and_fault_layering(tmp_path, monkeypatch):
+    """open_object_root wires Local -> Faulty -> Retrying: transient
+    injected faults are retried away; torn faults pass through
+    NonRetryable with the damage preserved."""
+    from firebird_tpu import retry as retrylib
+
+    monkeypatch.setattr(retrylib.time, "sleep", lambda s: None)
+    obs_metrics.reset_registry()
+    root = str(tmp_path / "objects")
+    cfg = Config.from_env(env=dict(
+        os.environ, FIREBIRD_OBJECT_ROOT=root,
+        FIREBIRD_FAULTS="object:p=0.4,seed=3", FIREBIRD_RETRIES="8"))
+    s = open_object_root(cfg=cfg)
+    assert isinstance(s, RetryingObjectStore)
+    for i in range(10):
+        s.put(f"k{i}", b"v")
+    assert sorted(s.list()) == sorted(f"k{i}" for i in range(10))
+    assert all(s.get(f"k{i}")[0] == b"v" for i in range(10))
+    assert obs_metrics.counter("objectstore_retries").value >= 1
+
+    torn_cfg = Config.from_env(env=dict(
+        os.environ, FIREBIRD_OBJECT_ROOT=root,
+        FIREBIRD_FAULTS="object:p=1,torn"))
+    t = open_object_root(cfg=torn_cfg)
+    with pytest.raises(faultlib.TornUpload):
+        t.put("k0", b"replacement")
+    assert s.get("k0")[0] == b"v"                # fallback, not retry-put
+
+
+def test_faults_grammar_object_scope():
+    plan = faultlib.FaultPlan.parse("object:p=0.5,torn")
+    assert plan.injector("object") is not None
+    with pytest.raises(ValueError):              # torn is object-only
+        faultlib.FaultPlan.parse("store:p=1,torn")
+    with pytest.raises(ValueError):              # chip= never fires here
+        faultlib.FaultPlan.parse("object:chip=1:2")
+
+
+# ---------------------------------------------------------------------------
+# Nasty window 1: SIGKILL between the last chunk upload and the commit
+# ---------------------------------------------------------------------------
+
+CHILD_SRC = """\
+import os, sys
+sys.path.insert(0, os.environ["FB_REPO"])
+from firebird_tpu.store.objectstore import LocalObjectStore
+s = LocalObjectStore(os.environ["FIREBIRD_OBJECT_ROOT"], chunk_size=64)
+s.put("w/key", b"".join(bytes([c]) * 64 for c in range(4)))
+"""
+
+
+def test_sigkill_between_chunks_and_manifest(tmp_path):
+    root = str(tmp_path / "objects")
+    env = dict(os.environ, FB_REPO=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir),
+        FIREBIRD_OBJECT_ROOT=root,
+        FIREBIRD_OBJECT_COMMIT_HOLD_SEC="60")
+    child = subprocess.Popen([sys.executable, "-c", CHILD_SRC], env=env,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+    try:
+        chunk_dir = os.path.join(root, "chunks")
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                n = len([x for x in os.listdir(chunk_dir)
+                         if not x.endswith(".tmp")])
+            except OSError:
+                n = 0
+            if n >= 4:
+                break
+            assert child.poll() is None, \
+                f"writer finished despite hold: {child.stdout.read()}"
+            time.sleep(0.02)
+        else:
+            pytest.fail("chunks never appeared")
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+        child.stdout.close()
+    s = LocalObjectStore(root, chunk_size=64)
+    assert s.head("w/key") is None               # no visible partial
+    assert s.list() == []
+    assert s.census()["orphan_chunks"] == 4
+    assert s.scrub(grace_sec=0.0)["removed"] == 4
+    m = s.put("w/key", b"clean")                 # successor recovers
+    assert m.generation == 1 and s.get("w/key")[0] == b"clean"
+
+
+# ---------------------------------------------------------------------------
+# Nasty window 2: the zombie's stale-fence conditional put
+# ---------------------------------------------------------------------------
+
+def test_stale_object_fence_rejected_durably(tmp_path):
+    obs_metrics.reset_registry()
+    root = str(tmp_path / "objects")
+
+    def make():
+        return ObjectBackedStore(open_object_root(
+            root=root, cfg=Config.from_env(env=dict(
+                os.environ, FIREBIRD_OBJECT_ROOT=root))),
+            "scope", "ks")
+
+    zombie, successor = make(), make()
+    zombie.bind_fence(3)
+    successor.bind_fence(5)
+    zombie.write("segment", seg_frame(chprob=0.1))   # pre-reclaim: lands
+    successor.write("segment", seg_frame(chprob=0.9))
+    with pytest.raises(StaleObjectFence):
+        zombie.write("segment", seg_frame(chprob=0.2))
+    assert successor.read("segment")["chprob"] == [0.9]
+    assert successor.fence_rejects() == 1
+    assert obs_metrics.counter("object_fence_rejected_total").value == 1
+    zombie.close()
+    successor.close()
+    assert make().fence_rejects() == 1           # durable across opens
+
+
+def test_fenced_store_stamps_object_fence(tmp_path):
+    """fleet.FencedStore binds the lease fence onto a mirror/object
+    store, so the object layer rejects a zombie even when the queue's
+    own fence_valid check cannot run."""
+    from firebird_tpu.fleet.queue import FencedStore, FleetQueue
+
+    q = FleetQueue(str(tmp_path / "q.db"), lease_sec=30)
+    q.enqueue("detect", {"n": 1})
+    lease = q.claim("w:1")
+    root = str(tmp_path / "objects")
+    inner = ObjectBackedStore(open_object_root(
+        root=root, cfg=Config.from_env(env=dict(
+            os.environ, FIREBIRD_OBJECT_ROOT=root))), "scope", "ks")
+    FencedStore(inner, q, lease)
+    assert inner._fence == lease.fence
+    inner.close()
+    q.close()
+
+
+# ---------------------------------------------------------------------------
+# The Store refactor: pure object backend + the write-through mirror
+# ---------------------------------------------------------------------------
+
+def fixture_rows(store):
+    store.write("chip", {"cx": [10], "cy": [20],
+                         "dates": [["1999-01-01", "1999-02-01"]]})
+    store.write("pixel", {"cx": [10], "cy": [20], "px": [10], "py": [20],
+                          "mask": [[1, 0]]})
+    store.write("segment", seg_frame(cx=10, cy=20, chprob=0.25))
+    store.write("segment", seg_frame(cx=10, cy=20, chprob=0.75))
+    store.write("tile", {"tx": [1], "ty": [2], "name": ["rf"],
+                         "model": ["BLOB"], "updated": ["2020-01-01"]})
+
+
+def canon(store) -> dict:
+    out = {}
+    for t in ("chip", "pixel", "segment", "tile"):
+        frame = store.read(t)
+        cols = sorted(frame)
+        n = len(frame[cols[0]]) if cols else 0
+        out[t] = sorted(
+            json.dumps([(c, frame[c][i]) for c in cols], sort_keys=True)
+            for i in range(n))
+    return out
+
+
+def test_object_backend_parity_with_sqlite(tmp_path, monkeypatch):
+    monkeypatch.delenv("FIREBIRD_OBJECT_ROOT", raising=False)
+    sq = open_store("sqlite", str(tmp_path / "s.db"), "ks")
+    fixture_rows(sq)
+    want = canon(sq)
+    counts = {t: sq.count(t) for t in want}
+    sq.close()
+    monkeypatch.setenv("FIREBIRD_OBJECT_ROOT", str(tmp_path / "objects"))
+    ob = open_store("object", str(tmp_path / "scope"), "ks")
+    fixture_rows(ob)
+    assert canon(ob) == want
+    assert {t: ob.count(t) for t in want} == counts  # head-only counts
+    assert ob.chip_ids("segment") == {(10, 20)}
+    assert ob.read("segment", {"cx": 10, "cy": 20})["chprob"] == [0.75]
+    empty = ob.read("segment", {"cx": 99})
+    assert all(v == [] for v in empty.values())
+    ob.close()
+
+
+def test_open_store_mirror_is_env_driven(tmp_path, monkeypatch):
+    monkeypatch.setenv("FIREBIRD_OBJECT_ROOT", str(tmp_path / "objects"))
+    path = str(tmp_path / "m.db")
+    st = open_store("sqlite", path, "ks")
+    assert isinstance(st, MirroredStore)
+    fixture_rows(st)
+    want = canon(st)                             # reads are local
+    st.close()
+    # the object side alone carries identical rows
+    ob = ObjectBackedStore(
+        open_object_root(root=str(tmp_path / "objects")),
+        scope_for_path(path), "ks")
+    assert canon(ob) == want
+    ob.close()
+    # read-only replicas skip the wrap (they never write)
+    ro = open_store("sqlite", path, "ks", read_only=True)
+    assert not isinstance(ro, MirroredStore)
+    ro.close()
+    monkeypatch.delenv("FIREBIRD_OBJECT_ROOT")
+    st = open_store("sqlite", str(tmp_path / "m2.db"), "ks")
+    assert not isinstance(st, MirroredStore)
+    st.close()
+
+
+def test_config_validates_object_knobs():
+    with pytest.raises(ValueError):
+        Config.from_env(env={"FIREBIRD_STORE_BACKEND": "object"})
+    with pytest.raises(ValueError):
+        Config.from_env(env={"FIREBIRD_OBJECT_ROOT": "/tmp/o",
+                             "FIREBIRD_OBJECT_CHUNK_KB": "0"})
+    cfg = Config.from_env(env={"FIREBIRD_STORE_BACKEND": "object",
+                               "FIREBIRD_OBJECT_ROOT": "/tmp/o"})
+    assert cfg.object_chunk_kb == 256
+
+
+# ---------------------------------------------------------------------------
+# Statestore + pyramid seams
+# ---------------------------------------------------------------------------
+
+def _chip():
+    from firebird_tpu import grid
+
+    return tuple(int(v) for v in
+                 next(iter(grid.chips(grid.tile(x=100.0, y=200.0)))))
+
+
+def _arrays(P=4, B=2, K=3):
+    from firebird_tpu.streamops.statestore import _layout
+
+    out = {}
+    for i, (name, dtype, shape) in enumerate(_layout(P, B, K)):
+        n = max(int(np.prod(shape)), 1)
+        out[name] = ((np.arange(n) + i) % 5).astype(dtype).reshape(shape)
+    return out
+
+
+def test_object_statestore_parity(tmp_path):
+    from firebird_tpu.streamops.statestore import (ObjectStateStore,
+                                                   TileStateStore)
+
+    cid = _chip()
+    arrays = _arrays()
+    packed = TileStateStore(str(tmp_path / "packed"))
+    objst = ObjectStateStore(
+        open_object_root(root=str(tmp_path / "objects")), "sc")
+    packed.save_arrays(cid, arrays)
+    objst.save_arrays(cid, arrays)
+    a, b = packed.peek_arrays(cid), objst.peek_arrays(cid)
+    for k in arrays:
+        assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), k
+    assert objst.peek_horizon(cid) == packed.peek_horizon(cid)
+    assert objst.exists(cid) and objst.chips() == [cid]
+    objst.void(cid)
+    assert not objst.exists(cid)
+    packed.close()
+    objst.close()
+
+
+def test_open_statestore_mirrors_under_object_root(tmp_path):
+    from firebird_tpu.streamops.statestore import (MirroredStateStore,
+                                                   open_statestore)
+
+    cfg = Config.from_env(env={
+        "FIREBIRD_STORE_PATH": str(tmp_path / "s.db"),
+        "FIREBIRD_STREAM_DIR": str(tmp_path / "stream"),
+        "FIREBIRD_OBJECT_ROOT": str(tmp_path / "objects")})
+    st = open_statestore(cfg)
+    assert isinstance(st, MirroredStateStore)
+    cid = _chip()
+    st.save_arrays(cid, _arrays())
+    assert st.exists(cid)                        # local read-authoritative
+    assert st._mirror.exists(cid)                # mirrored
+    assert st.status()["backend"] == "packed+object"
+    st.close()
+    # npz/f64 escape hatch is NOT mirrored (lossy payloads)
+    cfg64 = Config.from_env(env={
+        "FIREBIRD_STORE_PATH": str(tmp_path / "s.db"),
+        "FIREBIRD_STREAM_DIR": str(tmp_path / "stream64"),
+        "FIREBIRD_DTYPE": "float64",
+        "FIREBIRD_OBJECT_ROOT": str(tmp_path / "objects")})
+    st = open_statestore(cfg64)
+    assert not isinstance(st, MirroredStateStore)
+    st.close()
+
+
+def test_object_tile_storage_contract(tmp_path):
+    from firebird_tpu.serve import pyramid as pyrlib
+
+    fills = {"v": 7}
+
+    def read_chip(name, date, cx, cy):
+        return np.full(pyrlib.TILE_SIDE * pyrlib.TILE_SIDE, fills["v"],
+                       np.int32)
+
+    objstore = open_object_root(root=str(tmp_path / "objects"))
+    storage = pyrlib.ObjectTileStorage(objstore, "sc")
+    pyr = pyrlib.TilePyramid("obj", read_chip, storage=storage)
+    z, x, y = pyrlib.Z_BASE, 512, 512
+    cells, meta = pyr.tile("curveqa", "2020-01-01", z, x, y)
+    assert int(cells.ravel()[0]) == 7 and meta["version"] == 1
+    ident1 = storage.meta_ident("curveqa", "2020-01-01", z, x, y)
+    cx, cy = pyrlib.chips_of_tile(z, x, y)[0]
+    assert pyr.invalidate_chip(cx, cy) >= 1
+    peek = pyr.peek_meta("curveqa", "2020-01-01", z, x, y)
+    assert peek and peek["stale"]
+    fills["v"] = 9
+    cells, meta = pyr.tile("curveqa", "2020-01-01", z, x, y)
+    assert int(cells.ravel()[0]) == 9 and meta["version"] == 2
+    assert storage.meta_ident("curveqa", "2020-01-01", z, x, y) != ident1
+    peek = pyr.peek_meta("curveqa", "2020-01-01", z, x, y)
+    assert peek and not peek["stale"]
+    st = pyr.status()
+    assert st["root"].startswith("object:")
+    assert st["tiles_by_level"][str(z)]["tiles"] == 1
+    objstore.close()
+
+
+def test_pyramid_storage_selector(tmp_path):
+    from firebird_tpu.serve import pyramid as pyrlib
+
+    mirror_cfg = Config.from_env(env={
+        "FIREBIRD_STORE_PATH": str(tmp_path / "s.db"),
+        "FIREBIRD_OBJECT_ROOT": str(tmp_path / "objects")})
+    assert pyrlib.pyramid_storage(mirror_cfg, str(tmp_path)) is None
+    pure_cfg = Config.from_env(env={
+        "FIREBIRD_STORE_BACKEND": "object",
+        "FIREBIRD_STORE_PATH": str(tmp_path / "scope"),
+        "FIREBIRD_OBJECT_ROOT": str(tmp_path / "objects")})
+    storage = pyrlib.pyramid_storage(pure_cfg, str(tmp_path))
+    assert isinstance(storage, pyrlib.ObjectTileStorage)

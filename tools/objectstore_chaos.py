@@ -1,0 +1,499 @@
+"""Object-tier chaos (``make objectstore-smoke``): torn uploads, stale
+fences, and mid-upload SIGKILLs are boring.
+
+The end-to-end proof behind docs/ROBUSTNESS.md "Object tier".  Seven
+legs over one throwaway object root per leg (numpy only — no JAX):
+
+protocol
+    The chunked-publish contract: a multi-chunk put round-trips,
+    conditional put (``if_generation``) loses to a concurrent bump with
+    ``PreconditionFailed`` carrying the current generation, and delete /
+    list / head agree with what was published.
+parity
+    The same synthetic frames through plain sqlite, the env-driven
+    sqlite+object mirror, and the pure ``object`` backend read
+    **row-for-row identically** — and the mirror's object side read
+    alone matches too (the replication bus really carries the rows).
+fence
+    A zombie's stale fence is rejected 100% at the object layer
+    (:class:`StaleObjectFence` via conditional-put generation
+    preconditions), the rejection census survives process death
+    (re-opened store still reports it), and the successor's row is the
+    one that lands.
+torn
+    A ``FIREBIRD_FAULTS=object:p=1,torn`` plan: a torn final chunk
+    falls back ONE generation on read (``objectstore_torn_recoveries``
+    moves), a dropped manifest leaves the key invisible, and scrub
+    reclaims the debris.
+sigkill
+    A writer SIGKILLed between chunk upload and manifest commit
+    (``FIREBIRD_OBJECT_COMMIT_HOLD_SEC`` widens the window) leaves NO
+    visible partial object; scrub reclaims the orphaned chunks; a clean
+    writer then publishes the same key normally.
+statestore
+    ``ObjectStateStore`` checkpoints are field-for-field equal to the
+    packed ``TileStateStore`` for the same arrays (same canonical
+    payload), with head-only horizon peeks agreeing.
+pyramid
+    ``ObjectTileStorage`` behind the unchanged ETag contract: metas are
+    version-monotonic, ``invalidate_chip`` stamps go stale, and a
+    rebuild outdates the marker and flips the identity.
+
+Writes ``objectstore_chaos.json`` under FIREBIRD_OBJECTSTORE_DIR
+(folded into bench artifacts by bench.py) and exits non-zero on any
+violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, HERE)
+
+from firebird_tpu.config import env_knob  # noqa: E402
+
+ARTIFACT_SCHEMA = "firebird-objectstore-chaos/1"
+DEADLINE = 120.0          # sigkill-leg child wait budget (seconds)
+
+
+def seg_frame(cx=1, cy=2, px=3, py=4, sday="1999-01-01", chprob=1.0):
+    f = {"cx": [cx], "cy": [cy], "px": [px], "py": [py],
+         "sday": [sday], "eday": ["2000-01-01"], "bday": [sday],
+         "chprob": [chprob], "curqa": [8], "rfrawp": [None]}
+    for p in ("bl", "gr", "re", "ni", "s1", "s2", "th"):
+        f[f"{p}mag"] = [1.5]
+        f[f"{p}rmse"] = [0.5]
+        f[f"{p}coef"] = [[0.1, 0.2, 0.3]]
+        f[f"{p}int"] = [7.0]
+    return f
+
+
+def write_fixture(store) -> None:
+    """The parity workload: all four tables, multiple rows, one upsert
+    overwrite (the idempotence case a replication bug would double)."""
+    store.write("chip", {"cx": [10, 11], "cy": [20, 20],
+                         "dates": [["1999-01-01", "1999-02-01"],
+                                   ["1999-03-01"]]})
+    store.write("pixel", {"cx": [10], "cy": [20], "px": [10], "py": [20],
+                          "mask": [[1, 0, 1]]})
+    store.write("segment", seg_frame(cx=10, cy=20, chprob=0.25))
+    store.write("segment", seg_frame(cx=10, cy=20, chprob=0.75))  # upsert
+    store.write("segment", seg_frame(cx=11, cy=20, sday="2001-06-01"))
+    store.write("tile", {"tx": [1], "ty": [2], "name": ["rf"],
+                         "model": ["BLOB"], "updated": ["2020-01-01"]})
+
+
+def store_rows(store) -> dict:
+    """Canonical row-set per table (the fleet_chaos.py comparison rule)."""
+    out = {}
+    for table in ("chip", "pixel", "segment", "tile"):
+        frame = store.read(table)
+        cols = sorted(frame)
+        n = len(frame[cols[0]]) if cols else 0
+        out[table] = sorted(
+            json.dumps([(c, frame[c][i]) for c in cols], sort_keys=True)
+            for i in range(n))
+    return out
+
+
+# The sigkill-leg child: publish one multi-chunk object with the commit
+# hold armed, so the parent can SIGKILL it inside the chunks-uploaded /
+# manifest-pending window deterministically.
+CHILD_SRC = """\
+import os, sys
+sys.path.insert(0, os.environ["FB_HERE"])
+from firebird_tpu.store.objectstore import LocalObjectStore
+s = LocalObjectStore(os.environ["FIREBIRD_OBJECT_ROOT"], chunk_size=1024)
+print("child: putting", flush=True)
+# 5 DISTINCT 1 KiB chunks — identical chunks dedup to one content
+# address, and the parent waits for all five to land before the kill.
+s.put("victim/key", b"".join(bytes([c]) * 1024 for c in range(5)))
+print("child: committed", flush=True)
+"""
+
+
+def leg_protocol(tmp: str, report: dict, failures: list) -> None:
+    from firebird_tpu.store.objectstore import (LocalObjectStore,
+                                                PreconditionFailed)
+
+    s = LocalObjectStore(os.path.join(tmp, "protocol"), chunk_size=1024)
+    body = bytes(range(256)) * 13                    # 3328 B -> 4 chunks
+    m1 = s.put("a/b c", body, meta={"tag": "one"})
+    got, meta = s.get("a/b c")
+    if got != body or meta.meta.get("tag") != "one":
+        failures.append("protocol: chunked put/get round trip broken")
+    if len(m1.chunks) < 3:
+        failures.append(f"protocol: expected a multi-chunk publish, got "
+                        f"{len(m1.chunks)} chunks")
+    s.put("a/b c", b"v2", if_generation=m1.generation)
+    try:
+        s.put("a/b c", b"late", if_generation=m1.generation)
+        failures.append("protocol: conditional put on a stale generation "
+                        "was accepted")
+    except PreconditionFailed as e:
+        if e.current != m1.generation + 1:
+            failures.append(f"protocol: PreconditionFailed.current = "
+                            f"{e.current}, want {m1.generation + 1}")
+    if s.get("a/b c")[0] != b"v2":
+        failures.append("protocol: losing conditional put changed the "
+                        "visible bytes")
+    if s.list("a/") != ["a/b c"] or s.head("a/b c") is None:
+        failures.append("protocol: list/head disagree with the publish")
+    s.delete("a/b c")
+    if s.head("a/b c") is not None or s.list():
+        failures.append("protocol: delete left the key visible")
+    s.close()
+    report["protocol"] = {"chunks": len(m1.chunks), "ok": True}
+
+
+def leg_parity(tmp: str, report: dict, failures: list) -> None:
+    from firebird_tpu.store import open_store
+    from firebird_tpu.store.objectstore import (ObjectBackedStore,
+                                                open_object_root,
+                                                scope_for_path)
+
+    oroot = os.path.join(tmp, "parity_objects")
+    legs = {}
+    # plain sqlite: the reference rows (no object root exported)
+    os.environ.pop("FIREBIRD_OBJECT_ROOT", None)
+    plain = open_store("sqlite", os.path.join(tmp, "plain.db"), "ks")
+    write_fixture(plain)
+    legs["plain"] = store_rows(plain)
+    counts = {t: plain.count(t)
+              for t in ("chip", "pixel", "segment", "tile")}
+    plain.close()
+    # mirror: the SAME open_store call, only the env knob differs — this
+    # is exactly how the fleet/stream soaks rerun unchanged.
+    os.environ["FIREBIRD_OBJECT_ROOT"] = oroot
+    try:
+        mpath = os.path.join(tmp, "mirror.db")
+        mirror = open_store("sqlite", mpath, "ks")
+        if not hasattr(mirror, "object_mirror"):
+            failures.append("parity: FIREBIRD_OBJECT_ROOT did not arm "
+                            "the mirror through open_store")
+        write_fixture(mirror)
+        legs["mirror"] = store_rows(mirror)
+        mirror.close()
+        # the mirror's OBJECT side alone — the replication proof
+        oside = ObjectBackedStore(open_object_root(root=oroot),
+                                  scope_for_path(mpath), "ks")
+        legs["mirror_objects"] = store_rows(oside)
+        oside.close()
+        # pure object backend
+        ppath = os.path.join(tmp, "pure_scope")
+        pure = open_store("object", ppath, "ks")
+        write_fixture(pure)
+        legs["object"] = store_rows(pure)
+        pcounts = {t: pure.count(t)
+                   for t in ("chip", "pixel", "segment", "tile")}
+        pure.close()
+    finally:
+        os.environ.pop("FIREBIRD_OBJECT_ROOT", None)
+    for name, rows in legs.items():
+        if rows != legs["plain"]:
+            diff = {t: (len(legs["plain"][t]), len(rows[t]))
+                    for t in rows if rows[t] != legs["plain"][t]}
+            failures.append(f"parity: {name} rows differ from plain "
+                            f"sqlite (plain vs {name} lengths: {diff})")
+    if pcounts != counts:
+        failures.append(f"parity: object-backend head-only counts "
+                        f"{pcounts} != sqlite counts {counts}")
+    report["parity"] = {"legs": sorted(legs),
+                        "rows": {t: len(legs["plain"][t])
+                                 for t in legs["plain"]},
+                        "identical": True}
+
+
+def leg_fence(tmp: str, report: dict, failures: list) -> None:
+    from firebird_tpu.store.objectstore import (ObjectBackedStore,
+                                                StaleObjectFence,
+                                                open_object_root)
+
+    oroot = os.path.join(tmp, "fence_objects")
+
+    def make():
+        return ObjectBackedStore(open_object_root(root=oroot), "fenced",
+                                 "ks")
+
+    successor = make()
+    successor.bind_fence(5)
+    successor.write("segment", seg_frame(chprob=0.9))
+    zombie = make()
+    zombie.bind_fence(3)
+    tried = accepted = 0
+    for chprob in (0.1, 0.2):
+        tried += 1
+        try:
+            zombie.write("segment", seg_frame(chprob=chprob))
+            accepted += 1
+        except StaleObjectFence:
+            pass
+    rows = successor.read("segment")
+    if accepted or rows["chprob"] != [0.9]:
+        failures.append(f"fence: {accepted}/{tried} stale writes "
+                        f"accepted (chprob={rows['chprob']})")
+    live = successor.fence_rejects()
+    zombie.close()
+    successor.close()
+    reopened = make()                 # fresh handles: durability check
+    durable = reopened.fence_rejects()
+    reopened.close()
+    if live < tried or durable != live:
+        failures.append(f"fence: reject census not durable ({live} live "
+                        f"vs {durable} after reopen, want >= {tried})")
+    report["fence"] = {"stale_writes_tried": tried,
+                       "stale_writes_accepted": accepted,
+                       "fence_rejects": durable}
+
+
+def leg_torn(tmp: str, report: dict, failures: list) -> None:
+    from firebird_tpu.config import Config
+    from firebird_tpu.faults import TornUpload
+    from firebird_tpu.obs import metrics as obs_metrics
+    from firebird_tpu.store.objectstore import open_object_root
+
+    oroot = os.path.join(tmp, "torn_objects")
+    base = dict(os.environ, FIREBIRD_OBJECT_ROOT=oroot,
+                FIREBIRD_OBJECT_CHUNK_KB="1")
+    clean = open_object_root(cfg=Config.from_env(env=base))
+    faulty = open_object_root(cfg=Config.from_env(env=dict(
+        base, FIREBIRD_FAULTS="object:p=1,torn")))
+    good = bytes(range(256)) * 10
+    clean.put("t/a", good)                       # the fallback generation
+    before = obs_metrics.counter("objectstore_torn_recoveries").value
+    torn = 0
+    for key, body in (("t/a", b"\xff" * 4096),   # chunk-mode damage
+                      ("t/b", b"\xee" * 4096)):  # manifest-mode damage
+        try:
+            faulty.put(key, body)
+            failures.append(f"torn: faulted put of {key!r} did not raise")
+        except TornUpload:
+            torn += 1
+    got, _ = clean.get("t/a")
+    if got != good:
+        failures.append("torn: reader did not fall back past the torn "
+                        "newest generation")
+    recoveries = \
+        obs_metrics.counter("objectstore_torn_recoveries").value - before
+    if recoveries < 1:
+        failures.append("torn: objectstore_torn_recoveries never moved")
+    if clean.head("t/b") is not None:
+        failures.append("torn: dropped-manifest upload is VISIBLE")
+    census = clean.census()
+    if census["orphan_chunks"] < 1:
+        failures.append(f"torn: no orphan chunks after a dropped "
+                        f"manifest ({census})")
+    scrub = clean.scrub(grace_sec=0.0)
+    if scrub["removed"] < census["orphan_chunks"]:
+        failures.append(f"torn: scrub reclaimed {scrub['removed']} of "
+                        f"{census['orphan_chunks']} orphans")
+    if clean.get("t/a")[0] != good:
+        failures.append("torn: scrub damaged a live object")
+    clean.close()
+    faulty.close()
+    report["torn"] = {"torn_puts": torn, "recoveries": int(recoveries),
+                      "orphans_scrubbed": scrub["removed"]}
+
+
+def leg_sigkill(tmp: str, report: dict, failures: list) -> None:
+    from firebird_tpu.store.objectstore import LocalObjectStore
+
+    oroot = os.path.join(tmp, "sigkill_objects")
+    env = dict(os.environ, FB_HERE=HERE, FIREBIRD_OBJECT_ROOT=oroot,
+               FIREBIRD_OBJECT_COMMIT_HOLD_SEC="60",
+               PYTHONPATH=HERE + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    child = subprocess.Popen([sys.executable, "-c", CHILD_SRC], env=env,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+    chunk_dir = os.path.join(oroot, "chunks")
+    deadline = time.time() + DEADLINE
+    uploaded = 0
+    try:
+        while time.time() < deadline:
+            try:
+                uploaded = len([n for n in os.listdir(chunk_dir)
+                                if not n.endswith(".tmp")])
+            except OSError:
+                uploaded = 0
+            if uploaded >= 5:        # all chunks up, commit held
+                break
+            if child.poll() is not None:
+                failures.append("sigkill: child exited before the "
+                                f"commit hold ({child.stdout.read()})")
+                report["sigkill"] = {"ok": False}
+                return
+            time.sleep(0.05)
+        else:
+            failures.append("sigkill: chunks never appeared")
+            report["sigkill"] = {"ok": False}
+            return
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+        child.stdout.close()
+    s = LocalObjectStore(oroot, chunk_size=1024)
+    if s.head("victim/key") is not None:
+        failures.append("sigkill: a partial object is VISIBLE after a "
+                        "kill between chunk upload and manifest commit")
+    census = s.census()
+    if census["orphan_chunks"] < 5 or census["keys"]:
+        failures.append(f"sigkill: unexpected debris census {census}")
+    scrub = s.scrub(grace_sec=0.0)
+    if scrub["removed"] < 5:
+        failures.append(f"sigkill: scrub reclaimed {scrub['removed']} "
+                        "orphans, want >= 5")
+    # the clean writer recovers the key as if nothing happened
+    body = b"".join(bytes([c]) * 1024 for c in range(5))
+    s.put("victim/key", body)
+    if s.get("victim/key")[0] != body:
+        failures.append("sigkill: clean re-publish after scrub failed")
+    s.close()
+    report["sigkill"] = {"chunks_uploaded": uploaded,
+                         "visible_partial": False,
+                         "orphans_scrubbed": scrub["removed"]}
+
+
+def leg_statestore(tmp: str, report: dict, failures: list) -> None:
+    import numpy as np
+
+    from firebird_tpu import grid
+    from firebird_tpu.store.objectstore import open_object_root
+    from firebird_tpu.streamops.statestore import (ObjectStateStore,
+                                                   TileStateStore,
+                                                   _layout)
+
+    P, B, K = 6, 2, 4
+    arrays = {}
+    for i, (name, dtype, shape) in enumerate(_layout(P, B, K)):
+        n = max(int(np.prod(shape)), 1)
+        arrays[name] = ((np.arange(n) + i) % 5).astype(dtype) \
+            .reshape(shape)
+    packed = TileStateStore(os.path.join(tmp, "packed_state"))
+    objst = ObjectStateStore(
+        open_object_root(root=os.path.join(tmp, "state_objects")),
+        "stateleg")
+    cid = tuple(int(v) for v in
+                next(iter(grid.chips(grid.tile(x=100.0, y=200.0)))))
+    packed.save_arrays(cid, arrays)
+    objst.save_arrays(cid, arrays)
+    a, b = packed.peek_arrays(cid), objst.peek_arrays(cid)
+    bad = [k for k in arrays
+           if not np.array_equal(np.asarray(a[k]), np.asarray(b[k]))]
+    if bad:
+        failures.append(f"statestore: object checkpoint differs from "
+                        f"packed on {bad}")
+    if packed.peek_horizon(cid) != objst.peek_horizon(cid) \
+            or objst.peek_horizon(cid) is None:
+        failures.append("statestore: head-only horizon peek disagrees "
+                        f"(packed {packed.peek_horizon(cid)} vs object "
+                        f"{objst.peek_horizon(cid)})")
+    if objst.chips() != [cid] or not objst.exists(cid):
+        failures.append("statestore: object chip census broken")
+    objst.void(cid)
+    if objst.exists(cid):
+        failures.append("statestore: void left the checkpoint visible")
+    packed.close()
+    objst.close()
+    report["statestore"] = {"fields": len(arrays), "byte_parity": not bad}
+
+
+def leg_pyramid(tmp: str, report: dict, failures: list) -> None:
+    import numpy as np
+
+    from firebird_tpu.serve import pyramid as pyrlib
+    from firebird_tpu.store.objectstore import open_object_root
+
+    fills = {"v": 7}
+
+    def read_chip(name, date, cx, cy):
+        return np.full(pyrlib.TILE_SIDE * pyrlib.TILE_SIDE, fills["v"],
+                       np.int32)
+
+    objstore = open_object_root(root=os.path.join(tmp, "pyr_objects"))
+    storage = pyrlib.ObjectTileStorage(objstore, "pyrleg")
+    pyr = pyrlib.TilePyramid("obj-pyramid", read_chip, storage=storage)
+    z, x, y = pyrlib.Z_BASE, 512, 512
+    cx, cy = pyrlib.chips_of_tile(z, x, y)[0]
+    name, date = "curveqa", "2020-01-01"
+    cells, meta = pyr.tile(name, date, z, x, y)
+    if int(cells.ravel()[0]) != 7 or meta["version"] != 1:
+        failures.append(f"pyramid: first object-tile build wrong "
+                        f"(v{meta.get('version')})")
+    ident1 = storage.meta_ident(name, date, z, x, y)
+    stamped = pyr.invalidate_chip(cx, cy)
+    peek = pyr.peek_meta(name, date, z, x, y)
+    if stamped < 1 or not (peek and peek.get("stale")):
+        failures.append(f"pyramid: invalidation stamp did not go stale "
+                        f"(stamped {stamped}, peek {peek})")
+    fills["v"] = 9
+    cells, meta = pyr.tile(name, date, z, x, y)   # stale -> rebuild
+    peek = pyr.peek_meta(name, date, z, x, y)
+    ident2 = storage.meta_ident(name, date, z, x, y)
+    if int(cells.ravel()[0]) != 9 or meta["version"] != 2 \
+            or (peek and peek.get("stale")):
+        failures.append(f"pyramid: rebuild did not outdate the marker "
+                        f"(v{meta.get('version')}, peek {peek})")
+    if ident2 == ident1:
+        failures.append("pyramid: rebuild kept the same identity — the "
+                        "ETag would never flip")
+    st = pyr.status()
+    if st["tiles_by_level"].get(str(z), {}).get("tiles", 0) < 1 \
+            or not st["root"].startswith("object:"):
+        failures.append(f"pyramid: object-storage status census broken "
+                        f"({st})")
+    objstore.close()
+    report["pyramid"] = {"versions": [1, meta["version"]],
+                        "stamped": stamped, "etag_flips": True}
+
+
+def main() -> int:
+    from firebird_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.reset_registry()
+    t0 = time.time()
+    report: dict = {"schema": ARTIFACT_SCHEMA}
+    failures: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="fb_objchaos_") as tmp:
+        for leg in (leg_protocol, leg_parity, leg_fence, leg_torn,
+                    leg_sigkill, leg_statestore, leg_pyramid):
+            try:
+                leg(tmp, report, failures)
+            except Exception as e:
+                failures.append(f"{leg.__name__}: crashed "
+                                f"{type(e).__name__}: {e}")
+    report["ok"] = not failures
+    report["failures"] = failures
+    report["wall_seconds"] = round(time.time() - t0, 1)
+    art_dir = env_knob("FIREBIRD_OBJECTSTORE_DIR")
+    os.makedirs(art_dir, exist_ok=True)
+    art = os.path.join(art_dir, "objectstore_chaos.json")
+    with open(art, "w") as f:
+        json.dump(report, f, indent=1)
+    if failures:
+        for f_ in failures:
+            print(f"objectstore-smoke: {f_}", file=sys.stderr)
+        return 1
+    print(f"objectstore-smoke OK: {len(report) - 4} legs — chunked "
+          f"protocol, 3-way store parity, "
+          f"{report['fence']['fence_rejects']} stale fences rejected "
+          f"(0 accepted), torn uploads recovered, SIGKILL left no "
+          f"visible partial ({report['sigkill']['orphans_scrubbed']} "
+          f"orphans scrubbed), statestore + pyramid parity, in "
+          f"{report['wall_seconds']}s; artifact {art}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

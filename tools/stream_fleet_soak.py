@@ -93,16 +93,20 @@ def tail(path: str, n: int = 4000) -> str:
         return "<no log>"
 
 
-# jax 0.4.37's CPU PJRT client can SIGABRT during interpreter teardown
-# (a C++ "terminate called" out of the XLA thread-pool destructor) AFTER
-# the run finished: the driver has already logged "stream complete" and
-# flushed the store/statestore/alert log, so the work product is whole —
-# only the exit status is corrupted.  Classify exactly that signature
-# (nonzero rc + completion marker in the log + an abort fingerprint) as
+# jax 0.4.37's CPU PJRT client can crash during interpreter teardown
+# (a C++ "terminate called" SIGABRT out of the XLA thread-pool
+# destructor, or a SIGSEGV in the same destructor region — the
+# faulthandler dump shows "<no Python frame>") AFTER the run finished:
+# the driver has already logged "stream complete" and flushed the
+# store/statestore/alert log, so the work product is whole — only the
+# exit status is corrupted (and the rowset-identity checks below still
+# gate correctness).  Classify exactly that signature (nonzero rc +
+# completion marker in the log + an abort fingerprint) as
 # success-with-a-warning, preserving the rc and log evidence in the
 # artifact; ANY other nonzero rc stays fatal.
 TEARDOWN_SIGNATURES = ("terminate called", "SIGABRT",
-                       "Fatal Python error: Aborted")
+                       "Fatal Python error: Aborted",
+                       "Fatal Python error: Segmentation fault")
 
 
 def stream_rc_ok(rc: int, log_path: str, step: str, warnings: list) -> bool:
@@ -111,8 +115,8 @@ def stream_rc_ok(rc: int, log_path: str, step: str, warnings: list) -> bool:
     if rc == 0:
         return True
     logtxt = tail(log_path, 8000)
-    aborted = rc in (-6, 134) or any(s in logtxt
-                                     for s in TEARDOWN_SIGNATURES)
+    aborted = rc in (-6, 134, -11, 139) or any(s in logtxt
+                                               for s in TEARDOWN_SIGNATURES)
     if "stream complete" in logtxt and aborted:
         warnings.append({
             "step": step,
