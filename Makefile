@@ -13,7 +13,7 @@ ACQUIRED ?= 1982-01-01/2017-12-31
         fleet-smoke elastic-smoke serve-smoke pyramid-smoke serve-fleet \
         compact-smoke postmortem-smoke alert-smoke streamfleet-smoke \
         telemetry-smoke slo-smoke wire-smoke fuse-smoke fuse-repro \
-        precision-smoke objectstore-smoke \
+        precision-smoke objectstore-smoke fanout-smoke fanout-proof \
         image db-up db-schema db-test db-down changedetection \
         classification clean
 
@@ -43,6 +43,7 @@ test: lint
 	$(MAKE) telemetry-smoke
 	$(MAKE) slo-smoke
 	$(MAKE) objectstore-smoke
+	$(MAKE) fanout-smoke
 	$(MAKE) elastic-smoke
 
 bench:
@@ -212,6 +213,22 @@ telemetry-smoke:
 # pyramid object legs ride along (artifact folded by bench.py).
 objectstore-smoke:
 	python tools/objectstore_chaos.py
+
+# Fanout-plane drill (docs/ALERTS.md "Fanout plane"): quadkey-sharded
+# subscription index + fleet-powered delivery at a scaled-down tier —
+# audience resolution must stay flat across subscriber milestones
+# (index vs brute-force scan), a 10k-pair burst must land exactly-once
+# through a fanout worker SIGKILLed mid-drain (0 duplicate re-POSTs by
+# record id), digest/batch policies must flush, and shard-job
+# completion p99 must beat the fanout_p99 budget leg; artifact folded
+# by bench.py.  `fanout-proof` is the full 1M-subscriber / 10k-alert
+# headline run (several minutes — not part of `make test`).
+fanout-smoke:
+	python tools/fanout_loadtest.py --subscribers 50000 --alerts 2000 \
+	  --workers 3
+
+fanout-proof:
+	python tools/fanout_loadtest.py
 
 # Error-budget plane drill (docs/OBSERVABILITY.md "Error budgets"):
 # fleet + black-box canary prober; injected serve brownout + watcher

@@ -390,6 +390,17 @@ def _slo_fold() -> dict:
                           "slo_smoke.json")
 
 
+def _fanout_fold() -> dict:
+    """`make fanout-smoke` evidence (tools/fanout_loadtest.py): the
+    fanout plane's scale proof — registration rate, audience-resolution
+    latency flat across subscriber milestones, the (subscriber, alert)
+    pair census exactly-once through a worker SIGKILL, and the
+    per-shard-job completion p50/p99 vs the fanout_p99 budget leg
+    (docs/ALERTS.md "Fanout plane")."""
+    return _artifact_fold("fanout_loadtest", "FIREBIRD_FANOUT_DIR",
+                          "fanout_loadtest.json")
+
+
 def _objectstore_fold() -> dict:
     """`make objectstore-smoke` evidence (tools/objectstore_chaos.py):
     the chunked conditional-put protocol, 3-way store parity, stale
@@ -1142,6 +1153,11 @@ def measure(cpu_only: bool) -> None:
             # uploads recovered, SIGKILL-mid-upload invisibility +
             # orphan scrub).
             **_objectstore_fold(),
+            # Last fanout-smoke evidence (quadkey audience resolution
+            # flat across subscriber milestones, exactly-once pair
+            # census through a fanout-worker SIGKILL, shard-job
+            # completion p99 vs the fanout_p99 budget leg).
+            **_fanout_fold(),
             "streaming_pixels_per_sec": round(stream_rate, 1),
             **s2_detail,
             **hard_detail,

@@ -3,11 +3,15 @@
 The loop that turns archive replay into a live land-change monitor:
 the streaming driver appends confirmed tail breaks to a durable alert
 log (alerts/log.py), the serving layer feeds them to consumers by
-cursor pull, SSE push, and webhooks (alerts/feed.py), and the flagged
+cursor pull, SSE push, and webhooks (alerts/feed.py), the fanout plane
+shards delivery over the quadkey subscription index into idempotent
+fleet jobs (alerts/subindex.py + alerts/fanout.py), and the flagged
 pixels schedule their own cold-path batch repair on the fleet queue
 (alerts/repair.py).
 """
 
+from firebird_tpu.alerts.fanout import (FanoutCoordinator, FanoutDeliverer,
+                                        rollup)
 from firebird_tpu.alerts.feed import AlertFeed, WebhookDeliverer
 from firebird_tpu.alerts.log import AlertLog, alert_db_path
 from firebird_tpu.alerts.repair import repair_chip, schedule_repairs
@@ -15,8 +19,11 @@ from firebird_tpu.alerts.repair import repair_chip, schedule_repairs
 __all__ = [
     "AlertFeed",
     "AlertLog",
+    "FanoutCoordinator",
+    "FanoutDeliverer",
     "WebhookDeliverer",
     "alert_db_path",
     "repair_chip",
+    "rollup",
     "schedule_repairs",
 ]

@@ -27,9 +27,6 @@ from __future__ import annotations
 import json
 import threading
 import time
-import urllib.error
-import urllib.request
-
 from firebird_tpu import retry as retrylib
 from firebird_tpu.alerts.log import AlertLog
 from firebird_tpu.obs import logger
@@ -52,20 +49,50 @@ def parse_bbox(raw: str):
     return tuple(float(p) for p in parts)
 
 
+# Keep-alive connection pool for webhook POSTs, one per (thread,
+# scheme, host): a delivery burst POSTs the same few endpoints
+# thousands of times, and a fresh TCP connection per request triples
+# the per-POST cost.  Thread-local because http.client connections
+# are not thread-safe; deliverers are long-lived threads/processes.
+_conn_pool = threading.local()
+
+
 def _default_post(url: str, body: bytes, timeout: float) -> int:
-    req = urllib.request.Request(
-        url, data=body, headers={"Content-Type": "application/json"},
-        method="POST")
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as r:
+    import http.client
+    import urllib.parse
+
+    u = urllib.parse.urlsplit(url)
+    key = (u.scheme, u.netloc)
+    conns = getattr(_conn_pool, "conns", None)
+    if conns is None:
+        conns = _conn_pool.conns = {}
+    path = u.path or "/"
+    if u.query:
+        path += "?" + u.query
+    for attempt in (0, 1):
+        conn = conns.get(key)
+        if conn is None:
+            cls = (http.client.HTTPSConnection if u.scheme == "https"
+                   else http.client.HTTPConnection)
+            conn = conns[key] = cls(u.netloc, timeout=timeout)
+        try:
+            conn.request("POST", path, body,
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
             r.read()
+            # A 4xx/5xx is an ANSWER, not a transport failure: return
+            # the code so the cursor-hold branch handles it instead of
+            # the retry loop hammering a permanent 404.
             return r.status
-    except urllib.error.HTTPError as e:
-        # A 4xx/5xx is an ANSWER, not a transport failure: return the
-        # code so the cursor-hold branch handles it instead of the
-        # retry loop hammering a permanent 404.
-        e.read()
-        return e.code
+        except (http.client.HTTPException, OSError):
+            # A stale kept-alive connection (server closed it between
+            # bursts) fails exactly once: retry on a fresh one, and
+            # only surface the second, genuine transport failure.
+            conn.close()
+            conns.pop(key, None)
+            if attempt:
+                raise
+    raise AssertionError("unreachable")
 
 
 class AlertFeed:
@@ -140,7 +167,19 @@ class WebhookDeliverer:
         POSTs per subscriber per sweep (the soak uses it to leave a
         deliberate backlog for a successor incarnation to catch up)."""
         delivered = 0
+        now = time.time()
         for sub in self.log.subscribers():
+            # Head-of-line guard: a subscriber parked after consecutive
+            # failures is skipped (cursor held) until its decorrelated
+            # backoff elapses — one dead endpoint costs the sweep one
+            # row check, not its retry budget every tick.
+            if sub.get("parked_until") is not None \
+                    and float(sub["parked_until"]) > now:
+                obs_metrics.counter(
+                    "alert_webhook_skipped_parked_total",
+                    help="webhook sweep subscriber visits skipped while "
+                         "parked after consecutive failures").inc()
+                continue
             sent = 0
             while max_batches is None or sent < max_batches:
                 recs = self.log.since(sub["cursor"], limit=batch)
@@ -169,24 +208,10 @@ class WebhookDeliverer:
                             lambda b=body, u=sub["url"]: self._post(
                                 u, b, self.cfg.alert_webhook_timeout))
                 except Exception as e:
-                    self.log.record_failure(sub["id"])
-                    obs_metrics.counter(
-                        "alert_webhook_failures_total",
-                        help="webhook batches abandoned after retries "
-                             "(cursor held; redelivered next sweep)").inc()
-                    log.warning(
-                        "webhook %s delivery failed (%s: %s); cursor "
-                        "held at %d", sub["url"], type(e).__name__, e,
-                        sub["cursor"])
+                    self._failed(sub, f"{type(e).__name__}: {e}")
                     break
                 if not 200 <= int(status) < 300:
-                    self.log.record_failure(sub["id"])
-                    obs_metrics.counter(
-                        "alert_webhook_failures_total",
-                        help="webhook batches abandoned after retries "
-                             "(cursor held; redelivered next sweep)").inc()
-                    log.warning("webhook %s answered %s; cursor held at "
-                                "%d", sub["url"], status, sub["cursor"])
+                    self._failed(sub, f"answered {status}")
                     break
                 cursor = recs[-1]["id"]
                 self.log.advance(sub["id"], cursor)
@@ -200,6 +225,22 @@ class WebhookDeliverer:
                     help="alert records delivered to webhook "
                          "subscribers (2xx-acknowledged)").inc(len(recs))
         return delivered
+
+    def _failed(self, sub: dict, why: str) -> None:
+        """One abandoned batch: count the failure and — once the
+        subscriber hits ``fanout_park_after`` consecutive failures —
+        park it under decorrelated backoff (the fanout plane's parking
+        knobs; a 2xx heals).  The cursor always holds."""
+        self.log.record_failure(
+            sub["id"], park_after=self.cfg.fanout_park_after,
+            base=self.cfg.fanout_park_base_sec,
+            cap=self.cfg.fanout_park_cap_sec)
+        obs_metrics.counter(
+            "alert_webhook_failures_total",
+            help="webhook batches abandoned after retries "
+                 "(cursor held; redelivered next sweep)").inc()
+        log.warning("webhook %s delivery failed (%s); cursor held at "
+                    "%d", sub["url"], why, sub["cursor"])
 
     def start(self) -> "WebhookDeliverer":
         if self._thread is None:

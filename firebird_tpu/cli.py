@@ -480,6 +480,23 @@ def serve(port, host, cache_entries, cache_dir, no_compute, read_only,
                            f"({type(e).__name__}: {e}); serving without "
                            "/v1/alerts", err=True)
                 feed = alog = None
+    # Fanout rollup coordinator (docs/ALERTS.md "Fanout plane"): poll
+    # the alert log, enqueue per-shard `fanout` fleet jobs that elastic
+    # delivery workers drain.  Needs a fleet queue location; without
+    # one (or with FIREBIRD_FANOUT=0) the serve layer degrades to the
+    # flat in-process deliverer only.
+    coordinator = None
+    if alog is not None and cfg.fanout_enabled:
+        try:
+            from firebird_tpu.alerts.fanout import FanoutCoordinator
+            from firebird_tpu.fleet.worker import make_queue
+
+            coordinator = FanoutCoordinator(
+                alog, make_queue(cfg), cfg).start()
+        except Exception as e:
+            click.echo(f"WARNING: fanout rollup unavailable "
+                       f"({type(e).__name__}: {e}); webhook delivery "
+                       "runs unsharded", err=True)
     # Quadkey tile pyramid (docs/SERVING.md): static versioned tiles
     # under the pyramid root; absent root -> /v1/pyramid answers 404.
     proot = pyrlib.pyramid_root(cfg)
@@ -523,6 +540,9 @@ def serve(port, host, cache_entries, cache_dir, no_compute, read_only,
     finally:
         obs_spool.disarm()
         srv.close()
+        if coordinator is not None:
+            coordinator.stop()
+            coordinator.queue.close()
         if consumer is not None:
             consumer.stop()
         if feed is not None:
@@ -713,6 +733,7 @@ def status(x, y):
                 al.close()
             by_type = (out.get("fleet") or {}).get("by_type") or {}
             rep = by_type.get("repair", {})
+            fan = by_type.get("fanout", {})
             out["alerts"] = {
                 "path": apath,
                 "depth": s["depth"],
@@ -720,6 +741,12 @@ def status(x, y):
                 "subscribers": s["subscribers"],
                 "open_repair_jobs": int(rep.get("pending", 0))
                 + int(rep.get("leased", 0)),
+                # Fanout plane (docs/ALERTS.md "Fanout plane"): index
+                # size, policy mix, parked endpoints, the rollup
+                # watermark, and the open shard-job count.
+                "fanout": dict(s.get("fanout") or {},
+                               open_jobs=int(fan.get("pending", 0))
+                               + int(fan.get("leased", 0))),
             }
         except Exception as e:
             out["alerts"] = {"path": apath,

@@ -234,6 +234,26 @@ KNOBS = (
     Knob(name="FIREBIRD_ALERT_WEBHOOK_TIMEOUT",
          field="alert_webhook_timeout",
          help="webhook delivery HTTP timeout (seconds)"),
+    # ---- alert fanout plane (Config-backed; docs/ALERTS.md) ----
+    Knob(name="FIREBIRD_FANOUT", field="fanout_enabled", default="1",
+         help="0 disables the fanout rollup loop in firebird serve "
+              "(subscription index + flat deliverer still run)"),
+    Knob(name="FIREBIRD_FANOUT_SHARD_PREFIX", field="fanout_shard_prefix",
+         help="fanout shard key width (quadkey prefix digits, 1-11): "
+              "4**n possible shards; changeable without restamping"),
+    Knob(name="FIREBIRD_FANOUT_MAX_CELLS", field="fanout_max_cells",
+         help="covering-cell budget per subscriber AOI in the quadkey "
+              "subscription index"),
+    Knob(name="FIREBIRD_FANOUT_PARK_AFTER", field="fanout_park_after",
+         help="consecutive delivery failures before a subscriber is "
+              "parked under decorrelated backoff"),
+    Knob(name="FIREBIRD_FANOUT_PARK_BASE", field="fanout_park_base_sec",
+         help="parked-subscriber backoff base (seconds)"),
+    Knob(name="FIREBIRD_FANOUT_PARK_CAP", field="fanout_park_cap_sec",
+         help="parked-subscriber backoff cap (seconds)"),
+    Knob(name="FIREBIRD_FANOUT_POLL", field="fanout_poll_sec",
+         help="fanout rollup poll interval (seconds) — alert-append to "
+              "shard-job-enqueued latency bound"),
     # ---- serving layer (Config-backed) ----
     Knob(name="FIREBIRD_SERVE_PORT", field="serve_port",
          help="firebird serve listen port"),
@@ -358,6 +378,8 @@ KNOBS = (
          help="elastic-soak artifact directory"),
     Knob(name="FIREBIRD_ALERT_DIR", default="/tmp/fb_alerts",
          help="alert-soak artifact directory"),
+    Knob(name="FIREBIRD_FANOUT_DIR", default="/tmp/fb_fanout",
+         help="fanout-loadtest artifact directory"),
     Knob(name="FIREBIRD_STREAMFLEET_DIR", default="/tmp/fb_streamfleet",
          help="stream-fleet-soak artifact directory"),
     Knob(name="FIREBIRD_TELEMETRY_SMOKE_DIR", default="/tmp/fb_telemetry",
@@ -703,6 +725,41 @@ class Config:
     # (FIREBIRD_ALERT_WEBHOOK_TIMEOUT).
     alert_webhook_timeout: float = 10.0
 
+    # ---- alert fanout plane (firebird_tpu.alerts.fanout;
+    # docs/ALERTS.md "Fanout plane") ----
+    # Fanout rollup (FIREBIRD_FANOUT, default on): `firebird serve`
+    # runs the coordinator loop that groups new quadkey-stamped alerts
+    # by shard and enqueues `fanout` fleet jobs.  Off, the subscription
+    # index still maintains itself and the flat webhook deliverer still
+    # sweeps — only the sharded fleet delivery path goes dark.
+    fanout_enabled: bool = True
+
+    # Shard key width in quadkey digits (FIREBIRD_FANOUT_SHARD_PREFIX,
+    # 1-11): 4**n possible shards.  Alerts are stamped with their FULL
+    # base quadkey and sharded by substr() at rollup, so this can
+    # change on a live log without restamping.
+    fanout_shard_prefix: int = 2
+
+    # Covering-cell budget per subscriber AOI in the subscription index
+    # (FIREBIRD_FANOUT_MAX_CELLS): the most index rows one registration
+    # may cost; coarser coalescing past it, exactness unaffected (the
+    # exact AOI post-filter runs either way).
+    fanout_max_cells: int = 64
+
+    # Failure parking (FIREBIRD_FANOUT_PARK_AFTER / _PARK_BASE /
+    # _PARK_CAP): after this many CONSECUTIVE delivery failures a
+    # subscriber parks under decorrelated backoff between base and cap
+    # seconds, so one dead endpoint never stalls its shard (or the flat
+    # sweep).  Any 2xx heals and unparks.
+    fanout_park_after: int = 3
+    fanout_park_base_sec: float = 5.0
+    fanout_park_cap_sec: float = 300.0
+
+    # Rollup poll interval (FIREBIRD_FANOUT_POLL, seconds): the
+    # alert-append to shard-job-enqueued latency bound of the
+    # coordinator loop.
+    fanout_poll_sec: float = 2.0
+
     # ---- serving layer (firebird_tpu.serve; docs/SERVING.md) ----
     # `firebird serve` port (FIREBIRD_SERVE_PORT).  Unlike ops_port this
     # is only read by the serve command — nothing auto-binds it.
@@ -904,6 +961,28 @@ class Config:
         if self.alert_webhook_timeout <= 0:
             raise ValueError("FIREBIRD_ALERT_WEBHOOK_TIMEOUT must be > 0 "
                              f"seconds, got {self.alert_webhook_timeout}")
+        if not 1 <= self.fanout_shard_prefix <= 11:
+            raise ValueError("FIREBIRD_FANOUT_SHARD_PREFIX must be a "
+                             "quadkey depth in [1, 11], got "
+                             f"{self.fanout_shard_prefix}")
+        if self.fanout_max_cells < 4:
+            raise ValueError("FIREBIRD_FANOUT_MAX_CELLS must be >= 4 "
+                             "(a quadkey split is 4 children), got "
+                             f"{self.fanout_max_cells}")
+        if self.fanout_park_after < 1:
+            raise ValueError("FIREBIRD_FANOUT_PARK_AFTER must be >= 1, "
+                             f"got {self.fanout_park_after}")
+        if self.fanout_park_base_sec <= 0:
+            raise ValueError("FIREBIRD_FANOUT_PARK_BASE must be > 0 "
+                             f"seconds, got {self.fanout_park_base_sec}")
+        if self.fanout_park_cap_sec < self.fanout_park_base_sec:
+            raise ValueError(
+                "FIREBIRD_FANOUT_PARK_CAP must be >= FIREBIRD_FANOUT_"
+                f"PARK_BASE ({self.fanout_park_base_sec}), got "
+                f"{self.fanout_park_cap_sec}")
+        if self.fanout_poll_sec <= 0:
+            raise ValueError("FIREBIRD_FANOUT_POLL must be > 0 seconds, "
+                             f"got {self.fanout_poll_sec}")
         if not 0 < self.serve_port <= 65535:
             raise ValueError("FIREBIRD_SERVE_PORT must be a valid TCP "
                              f"port, got {self.serve_port}")
@@ -1044,6 +1123,20 @@ class Config:
             alert_webhook_timeout=float(
                 e.get("FIREBIRD_ALERT_WEBHOOK_TIMEOUT",
                       cls.alert_webhook_timeout)),
+            fanout_enabled=e.get("FIREBIRD_FANOUT", "1")
+            not in ("", "0"),
+            fanout_shard_prefix=int(e.get("FIREBIRD_FANOUT_SHARD_PREFIX",
+                                          cls.fanout_shard_prefix)),
+            fanout_max_cells=int(e.get("FIREBIRD_FANOUT_MAX_CELLS",
+                                       cls.fanout_max_cells)),
+            fanout_park_after=int(e.get("FIREBIRD_FANOUT_PARK_AFTER",
+                                        cls.fanout_park_after)),
+            fanout_park_base_sec=float(e.get("FIREBIRD_FANOUT_PARK_BASE",
+                                             cls.fanout_park_base_sec)),
+            fanout_park_cap_sec=float(e.get("FIREBIRD_FANOUT_PARK_CAP",
+                                            cls.fanout_park_cap_sec)),
+            fanout_poll_sec=float(e.get("FIREBIRD_FANOUT_POLL",
+                                        cls.fanout_poll_sec)),
             serve_port=int(e.get("FIREBIRD_SERVE_PORT", cls.serve_port)),
             serve_host=e.get("FIREBIRD_SERVE_HOST", cls.serve_host),
             serve_cache_entries=int(e.get("FIREBIRD_SERVE_CACHE_ENTRIES",

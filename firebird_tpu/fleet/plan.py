@@ -120,3 +120,47 @@ def enqueue_repairs(queue: FleetQueue, chips: dict, *, acquired: str,
             help="repair enqueues skipped because the chip already has "
                  "an open (pending/leased) repair job").inc(skipped)
     return ids
+
+
+def enqueue_fanout(queue: FleetQueue, shards, *, max_attempts: int = 3,
+                   run_id: str | None = None,
+                   rolled_at: float | None = None) -> list[int]:
+    """Enqueue one ``fanout`` job per rollup shard ({shard, since,
+    upto, count} dicts from AlertLog.shards_since) whose OPEN fanout
+    job does
+    not already cover ``upto`` — the repair-plan idempotence rule,
+    shard-keyed: the rollup poll re-reporting the same alerts (its
+    watermark advances only after enqueue) cannot flood the queue, and
+    an uncovered duplicate is harmless anyway because delivery drains
+    forward-only per-subscriber cursors.  Returns the NEW job ids."""
+    open_by_shard: dict[str, int] = {}
+    for _, payload in queue.open_payloads("fanout"):
+        s = payload.get("shard")
+        if s is not None:
+            open_by_shard[s] = max(open_by_shard.get(s, 0),
+                                   int(payload.get("upto", 0)))
+    ids: list[int] = []
+    skipped = 0
+    for sh in sorted(shards, key=lambda s: s["shard"]):
+        if open_by_shard.get(sh["shard"], -1) >= int(sh["upto"]):
+            skipped += 1
+            continue
+        ids.append(queue.enqueue(
+            "fanout",
+            {"shard": sh["shard"], "upto": int(sh["upto"]),
+             "since": int(sh.get("since", 0)),
+             "count": int(sh.get("count", 0)),
+             "rolled_at": float(rolled_at) if rolled_at is not None
+             else None, "run_id": run_id},
+            max_attempts=max_attempts))
+    if ids:
+        obs_metrics.counter(
+            "fanout_jobs_enqueued",
+            help="fanout delivery jobs enqueued on the fleet queue "
+                 "(one per quadkey shard with new alerts)").inc(len(ids))
+    if skipped:
+        obs_metrics.counter(
+            "fanout_jobs_skipped_open",
+            help="fanout enqueues skipped because an open job already "
+                 "covers the shard's rollup watermark").inc(skipped)
+    return ids

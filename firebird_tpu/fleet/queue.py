@@ -58,7 +58,7 @@ PENDING, LEASED, DONE, DEAD = "pending", "leased", "done", "dead"
 STATES = (PENDING, LEASED, DONE, DEAD)
 
 JOB_TYPES = ("detect", "stream", "classify", "product", "repair",
-             "pyramid")
+             "pyramid", "fanout")
 
 # Exception text kept in job history is for diagnosis, not a log archive
 # (the quarantine.py discipline).
@@ -654,6 +654,19 @@ class FleetQueue:
             if "cx" in p and "cy" in p:
                 out[(int(p["cx"]), int(p["cy"]))] = int(jid)
         return out
+
+    def open_payloads(self, job_type: str) -> list[tuple[int, dict]]:
+        """``[(job_id, payload)]`` of OPEN (pending or leased) jobs of
+        ``job_type``, id order — the non-chip-keyed analog of
+        :meth:`open_jobs` (fanout jobs are keyed by quadkey shard, not
+        chip; plan.enqueue_fanout consults this to skip shards whose
+        open job already covers the rollup watermark)."""
+        with self._lock:
+            rows = self._con.execute(
+                "SELECT id, payload FROM jobs WHERE job_type = ? AND "
+                "state IN ('pending', 'leased') ORDER BY id",
+                (job_type,)).fetchall()
+        return [(int(jid), json.loads(payload)) for jid, payload in rows]
 
     def job(self, job_id: int) -> dict | None:
         """One job's full record (payload + history), for inspection."""

@@ -104,6 +104,7 @@ class FleetWorker:
             "product": self._run_product,
             "repair": self._run_repair,
             "pyramid": self._run_pyramid,
+            "fanout": self._run_fanout,
         }
         self.counters = Counters()
         # Worker-local tallies: the obs registry resets when a job runs
@@ -530,6 +531,42 @@ class FleetWorker:
                           summary)
         finally:
             raw.close()
+
+    def _run_fanout(self, payload: dict, lease: Lease) -> None:
+        """Drain one quadkey shard's alert fanout (alerts/fanout.py):
+        the job's audience (cell-index probe of its alert window) plus
+        the shard's stragglers advance from their durable per-shard
+        cursors to the job's ``upto`` bound.  No FencedStore — webhook
+        POSTs are not fenceable writes; re-delivery safety is the
+        forward-only cursor + record-id contract, so a SIGKILLed
+        worker's successor (or an overlapping zombie) resumes delivery
+        without duplicating records at the receiver."""
+        from firebird_tpu.alerts import fanout as fanoutlib
+        from firebird_tpu.alerts.log import AlertLog, alert_db_path
+
+        path = alert_db_path(self.cfg)
+        if path is None:
+            raise ValueError(
+                "fanout job has no alert log: set FIREBIRD_ALERT_DB "
+                "(or a file-backed store)")
+        alog = AlertLog(path)
+        try:
+            deliverer = fanoutlib.FanoutDeliverer(alog, self.cfg)
+            delivered = deliverer.drain_shard(
+                payload["shard"], int(payload["upto"]),
+                since=int(payload.get("since", 0)))
+        finally:
+            alog.close()
+        rolled = payload.get("rolled_at")
+        if rolled is not None:
+            obs_metrics.histogram(
+                "fanout_completion_seconds",
+                help="rollup-to-drained latency of one shard fanout "
+                     "job (the fanout_p99 SLO's metric)").observe(
+                max(time.time() - float(rolled), 0.0))
+        self.log.info("fanout job %d drained shard %r to %d "
+                      "(%d records delivered)", lease.job_id,
+                      payload["shard"], int(payload["upto"]), delivered)
 
     def _restore_status(self) -> None:
         """Re-register the worker's process-global obs state after a

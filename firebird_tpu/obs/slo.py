@@ -63,7 +63,8 @@ DEFAULT_SPEC = ("batch_p95=30;serve_p99=2;freshness=600;"
 # serve replica) contribute zero burn — a batch-only deployment is
 # "no data", never "burned".
 DEFAULT_BUDGET_SPEC = ("alert_freshness<60@99.9/28d;"
-                       "serve_p99<2@99/7d;probe_errors@99/1d")
+                       "serve_p99<2@99/7d;probe_errors@99/1d;"
+                       "fanout_p99<30@99/7d")
 
 # Multi-window burn-rate defaults (FIREBIRD_SLO_FAST_SEC /
 # FIREBIRD_SLO_SLOW_SEC / FIREBIRD_SLO_BURN): page when the error rate
@@ -132,6 +133,13 @@ OBJECTIVES = {
     "probe_errors": ("ratio", ("probe_failures", "probe_attempts"), None,
                      "black-box probe failure ratio (failed probes / "
                      "attempted probes, all surfaces)"),
+    # The fanout promise (docs/ALERTS.md "Fanout plane"): a rolled-up
+    # shard of new alerts is DRAINED — every shard subscriber's cursor
+    # at the job's bound — within the target.  The histogram is
+    # observed by the fleet worker's fanout handler (rollup stamp ->
+    # drain done); deployments with no fanout jobs report no_data.
+    "fanout_p99": ("histogram", "fanout_completion_seconds", "p99",
+                   "alert rollup -> shard fanout drained seconds (p99)"),
 }
 
 

@@ -561,9 +561,13 @@ class _ServeHandler(httpd.JsonHandler):
 
     def _route_post(self, path: str, query: dict) -> None:
         """POST /v1/alerts/webhooks?url=… registers a webhook subscriber
-        (idempotent on url — re-registering keeps the durable cursor);
-        DELETE is deliberately absent: unsubscribing is an operator
-        action on the alert db, not an open endpoint."""
+        (idempotent on url — re-registering keeps the durable cursor but
+        replaces AOI and policy); ``bbox=minx,miny,maxx,maxy`` scopes it
+        to an AOI through the quadkey subscription index,
+        ``mode=immediate|digest|batch`` with ``window``/``max_n`` picks
+        the delivery policy (docs/ALERTS.md "Fanout plane").  DELETE is
+        deliberately absent: unsubscribing is an operator action on the
+        alert db, not an open endpoint."""
         svc: ServeService = self.server.service
         if path != "/v1/alerts/webhooks":
             super()._route_post(path, query)
@@ -576,7 +580,15 @@ class _ServeHandler(httpd.JsonHandler):
                     feed = svc.alert_feed()
                     url = _one(query, "url", str)
                     since = _one(query, "since", int, required=False)
-                    sid = feed.log.subscribe(url, cursor=since)
+                    aoi = self._bbox(query)
+                    mode = _one(query, "mode", str,
+                                required=False) or "immediate"
+                    window = _one(query, "window", float, required=False)
+                    max_n = _one(query, "max_n", int, required=False)
+                    sid = feed.log.subscribe(
+                        url, cursor=since, aoi=aoi, mode=mode,
+                        window_sec=window, max_n=max_n,
+                        max_cells=svc.cfg.fanout_max_cells)
                 except NotFound as e:
                     status = "not_found"
                     self._send_json(404, {"error": str(e)})
@@ -586,6 +598,7 @@ class _ServeHandler(httpd.JsonHandler):
                     self._send_json(400, {"error": str(e)})
                     return
                 self._send_json(200, {"id": sid, "url": url,
+                                      "mode": mode, "aoi": aoi,
                                       "latest": feed.log.latest_cursor()})
             finally:
                 obs_metrics.counter("serve_requests_total",

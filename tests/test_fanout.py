@@ -1,0 +1,611 @@
+"""Fanout plane: quadkey subscription index, sharded delivery, rollup.
+
+Pins the contracts docs/ALERTS.md "Fanout plane" promises: index
+audience == brute-force bbox scan (property test), per-(subscriber,
+shard) cursors compose to exactly-once across deliverer incarnations,
+delivery policies (immediate | digest | batch) shape POSTs without
+bending the cursor rules, consecutive failures park a subscriber
+instead of stalling its shard, and rollup is watermark + open-job
+idempotent.  tools/fanout_loadtest.py proves the same at 1M-subscriber
+scale; these are the fast seams.
+"""
+
+import json
+import random
+import sqlite3
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from firebird_tpu.alerts import subindex
+from firebird_tpu.alerts.fanout import FanoutDeliverer, rollup
+from firebird_tpu.alerts.feed import AlertFeed, WebhookDeliverer
+from firebird_tpu.alerts.log import AlertLog
+from firebird_tpu.config import Config
+from firebird_tpu.fleet.queue import FleetQueue
+from firebird_tpu.serve import pyramid as pyr
+
+
+def tile_pt(x, y):
+    """A projection point inside base tile (x, y) — chips == base tiles,
+    so records stamped here carry quadkey(Z_BASE, x, y)."""
+    e = pyr.tile_extent(subindex.Z_BASE, x, y)
+    return int(e["ulx"]) + 1, int(e["uly"]) - 1
+
+
+def tile_mid(x, y):
+    """The center of base tile (x, y) — inside even an inset AOI."""
+    e = pyr.tile_extent(subindex.Z_BASE, x, y)
+    return (e["ulx"] + e["lrx"]) / 2, (e["uly"] + e["lry"]) / 2
+
+
+def rec_at(x, y, day, **kw):
+    """An alert record inside base tile (x, y); unique per day."""
+    px, py = tile_pt(x, y)
+    r = {"cx": px, "cy": py, "px": px, "py": py, "break_day": float(day)}
+    r.update(kw)
+    return r
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def alog(tmp_path):
+    al = AlertLog(str(tmp_path / "alerts.db"))
+    yield al
+    al.close()
+
+
+def cfg_mem(**kw):
+    return Config(store_backend="memory", fetch_retries=1, **kw)
+
+
+# ---------------------------------------------------------------------------
+# subindex: quadkey math
+# ---------------------------------------------------------------------------
+
+def test_zbase_and_shard_helpers():
+    assert subindex.Z_BASE == pyr.Z_BASE
+    assert subindex.shard_of("01230123012", 2) == "01"
+    assert subindex.shard_of("01230123012", 0) == ""
+    assert subindex.shard_prefixes("012") == ["", "0", "01"]
+    assert subindex.shard_prefixes("") == []
+    assert subindex.aoi_contains(None, 1.0, 1.0)
+    assert subindex.aoi_contains((0, 0, 2, 2), 2.0, 0.0)
+    assert not subindex.aoi_contains((0, 0, 2, 2), 3.0, 1.0)
+
+
+def test_base_quadkey_and_point_cells():
+    px, py = tile_pt(100, 200)
+    qk = pyr.quadkey(subindex.Z_BASE, 100, 200)
+    assert subindex.base_quadkey(px, py) == qk
+    cells = subindex.point_cells(px, py)
+    assert cells == [qk[:i] for i in range(subindex.Z_BASE + 1)]
+    assert cells[0] == "" and len(cells) == subindex.Z_BASE + 1
+    # off-domain chips cannot be indexed; points degrade to root-only
+    assert subindex.base_quadkey(-1e9, 1e9) is None
+    assert subindex.point_cells(-1e9, 1e9) == [""]
+
+
+def test_cover_bbox_shapes():
+    # a chip-interior AOI costs exactly its one base cell
+    e = pyr.tile_extent(subindex.Z_BASE, 100, 200)
+    bbox = (e["ulx"] + 10, e["lry"] + 10, e["lrx"] - 10, e["uly"] - 10)
+    assert subindex.cover_bbox(bbox) == \
+        [pyr.quadkey(subindex.Z_BASE, 100, 200)]
+    # the whole domain is one root cell
+    d = subindex._extent(0, 0, 0)
+    assert subindex.cover_bbox(d) == [""]
+    # slightly inset: the root splits, but the budget bounds the cost
+    inset = (d[0] + 1, d[1] + 1, d[2] - 1, d[3] - 1)
+    cells = subindex.cover_bbox(inset, max_cells=4)
+    assert cells == sorted(pyr.quadkey(1, x, y)
+                           for x in (0, 1) for y in (0, 1))
+    cells = subindex.cover_bbox(inset, max_cells=64)
+    assert 4 <= len(cells) <= 64
+    # covering property: every in-bbox point has an ancestor cell
+    got = set(cells)
+    rng = random.Random(7)
+    for _ in range(50):
+        px = rng.uniform(inset[0], inset[2])
+        py = rng.uniform(inset[1], inset[3])
+        assert any(c in got for c in subindex.point_cells(px, py))
+    # off-domain AOIs contain no indexable point
+    assert subindex.cover_bbox((-1e9, 1e9, -1e9 + 5, 1e9 + 5)) == []
+    with pytest.raises(ValueError):
+        subindex.cover_bbox((5, 0, 0, 5))           # min > max
+    with pytest.raises(ValueError):
+        subindex.cover_bbox(bbox, max_cells=3)      # budget < one split
+
+
+def test_property_index_matches_brute_force(alog):
+    """The tentpole contract: audience through the quadkey cell index
+    == a brute-force bbox scan, over randomized AOI sizes (100 m to
+    ~2000 km half-widths) and random in-domain points."""
+    rng = random.Random(20260807)
+    dminx, dminy, dmaxx, dmaxy = subindex._extent(0, 0, 0)
+    entries = []
+    for i in range(100):
+        cx = rng.uniform(dminx, dmaxx)
+        cy = rng.uniform(dminy, dmaxy)
+        half = 10.0 ** rng.uniform(2.0, 6.3)
+        aoi = (cx - half, cy - half, cx + half, cy + half)
+        assert len(subindex.cover_bbox(aoi)) <= subindex.MAX_CELLS
+        entries.append({"url": f"http://s{i}/hook", "aoi": aoi})
+    for i in range(10):
+        entries.append({"url": f"http://g{i}/hook"})   # global
+    ids = alog.subscribe_many(entries)
+    assert len(ids) == 110
+    for _ in range(120):
+        px = rng.uniform(dminx, dmaxx)
+        py = rng.uniform(dminy, dmaxy)
+        assert alog.audience(px, py) == alog.audience_brute(px, py)
+
+
+# ---------------------------------------------------------------------------
+# AlertLog: migration, registration, shard queries
+# ---------------------------------------------------------------------------
+
+def test_migration_from_pre_fanout_schema(tmp_path):
+    path = str(tmp_path / "old.db")
+    con = sqlite3.connect(path)
+    con.execute(
+        "CREATE TABLE alerts ("
+        " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+        " cx INTEGER NOT NULL, cy INTEGER NOT NULL,"
+        " px INTEGER NOT NULL, py INTEGER NOT NULL,"
+        " break_day REAL NOT NULL, score REAL, magnitude REAL,"
+        " run_id TEXT, detected_at TEXT,"
+        " UNIQUE (px, py, break_day))")
+    con.execute(
+        "CREATE TABLE subscribers ("
+        " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+        " url TEXT NOT NULL UNIQUE,"
+        " cursor INTEGER NOT NULL DEFAULT 0,"
+        " created TEXT, last_ok TEXT,"
+        " failures INTEGER NOT NULL DEFAULT 0)")
+    con.execute("INSERT INTO subscribers (url, cursor) "
+                "VALUES ('http://old/hook', 1)")
+    con.execute("INSERT INTO alerts (cx, cy, px, py, break_day) "
+                "VALUES (100, 200, 100, 200, 1000.0)")
+    con.commit()
+    con.close()
+    al = AlertLog(path)
+    try:
+        sub = al.subscribers()[0]
+        # legacy subscribers stay global, immediate, cursor intact
+        assert sub["cursor"] == 1 and sub["aoi"] is None
+        assert sub["mode"] == "immediate" and sub["parked_until"] is None
+        px, py = tile_pt(100, 200)
+        assert al.audience(px, py) == [sub["id"]]       # root-cell backfill
+        # pre-migration rows carry no quadkey: rollup ignores them (the
+        # flat deliverer still sweeps them)
+        assert al.shards_since(0, 2) == []
+        al.append([rec_at(100, 200, 2000)])
+        shards = al.shards_since(0, 2)
+        assert len(shards) == 1 and shards[0]["count"] == 1
+        assert shards[0]["upto"] == 2
+    finally:
+        al.close()
+
+
+def test_subscribe_validation_and_replace(alog):
+    for bad in (dict(url="no-scheme"),
+                dict(url="http://x/", mode="bogus"),
+                dict(url="http://x/", mode="digest"),         # no window
+                dict(url="http://x/", mode="batch")):         # no max_n
+        with pytest.raises(ValueError):
+            alog.subscribe(**bad)
+    e = pyr.tile_extent(subindex.Z_BASE, 100, 200)
+    aoi = (e["ulx"] + 10, e["lry"] + 10, e["lrx"] - 10, e["uly"] - 10)
+    sid = alog.subscribe("http://x/hook", cursor=5, aoi=aoi)
+    assert alog.audience(*tile_mid(100, 200)) == [sid]
+    assert alog.audience(*tile_pt(500, 500)) == []
+    # idempotent on url: cursor kept, AOI/policy REPLACED
+    assert alog.subscribe("http://x/hook", mode="digest",
+                          window_sec=60.0) == sid
+    sub = alog.subscribers()[0]
+    assert sub["cursor"] == 5 and sub["mode"] == "digest"
+    assert sub["aoi"] is None and sub["window_sec"] == 60.0
+    assert alog.audience(*tile_pt(500, 500)) == [sid]     # global now
+
+
+def test_shard_queries_and_cursor_rules(alog):
+    sid = alog.subscribe("http://s/hook")
+    alog.append([rec_at(100, 200, 1000 + i) for i in range(3)])
+    alog.append([rec_at(1500, 300, 2000)])     # different first digit
+    shards = alog.shards_since(0, 2)
+    assert [s["shard"] for s in shards] == ["00", "10"]
+    assert [s["upto"] for s in shards] == [3, 4]
+    page = alog.alerts_for_shard("00", upto=3)
+    assert [a["id"] for a in page] == [1, 2, 3]
+    assert all(a["qk"].startswith("00") for a in page)
+    assert [a["id"] for a in alog.alerts_for_shard("10", upto=4)] == [4]
+    # a global (root-cell) subscriber belongs to every shard
+    for shard in ("00", "10"):
+        assert [s["id"] for s in alog.shard_subscribers(shard)] == [sid]
+    # forward-only per-shard cursors; sent_at survives cursor-only moves
+    alog.advance_fanout(sid, "00", 10, sent_at=123.0)
+    alog.advance_fanout(sid, "00", 5)
+    assert alog.fanout_cursor(sid, "00") == 10
+    assert alog.shard_subscribers("00")[0]["last_sent"] == 123.0
+    alog.advance_fanout(sid, "00", 12)
+    assert alog.fanout_cursor(sid, "00") == 12
+    assert alog.fanout_cursor(sid, "10") == 0      # shards independent
+    # the rollup watermark is forward-only too
+    alog.set_rollup_cursor(4)
+    alog.set_rollup_cursor(2)
+    assert alog.rollup_cursor() == 4
+
+
+def test_shard_drained_watermark_rules(alog):
+    """Forward-only AND contiguous: a window may only extend the
+    watermark if it starts at or below it — a newer window completing
+    ahead of an in-flight older one must not mark it covered."""
+    assert alog.shard_drained("00") == 0
+    alog.set_shard_drained("00", 0, 5)       # contiguous from empty
+    assert alog.shard_drained("00") == 5
+    alog.set_shard_drained("00", 10, 20)     # gap: older window in flight
+    assert alog.shard_drained("00") == 5
+    alog.set_shard_drained("00", 3, 12)      # overlaps from below: extends
+    assert alog.shard_drained("00") == 12
+    alog.set_shard_drained("00", 12, 8)      # never rewinds
+    assert alog.shard_drained("00") == 12
+    alog.set_shard_drained("00", 12, 20)
+    assert alog.shard_drained("00") == 20
+    # a brand-new shard cannot bootstrap from a mid-log window either
+    alog.set_shard_drained("zz", 4, 9)
+    assert alog.shard_drained("zz") == 0
+
+
+def test_status_fanout_block(alog):
+    alog.subscribe("http://a/hook")
+    alog.subscribe("http://b/hook", mode="batch", max_n=2)
+    s = alog.status()["fanout"]
+    assert s["cells"] == 2
+    assert s["by_mode"] == {"immediate": 1, "batch": 1}
+    assert s["parked"] == 0 and s["rollup_cursor"] == 0
+
+
+# ---------------------------------------------------------------------------
+# FanoutDeliverer: exactly-once, policies, parking
+# ---------------------------------------------------------------------------
+
+def test_exactly_once_across_incarnations(alog):
+    """A deliverer dying mid-shard (SIGKILL-shaped: cursor durable,
+    process gone) hands a successor exactly the undelivered remainder —
+    the sharded analog of the flat catch-up test."""
+    sid = alog.subscribe("http://sink/hook")
+    alog.append([rec_at(100, 200, 1000 + i) for i in range(10)])
+    shard = alog.shards_since(0, 2)[0]["shard"]
+    got, calls = [], {"n": 0}
+
+    def post_then_die(url, body, timeout):
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise OSError("killed")
+        got.append(json.loads(body))
+        return 200
+
+    d1 = FanoutDeliverer(alog, cfg_mem(), post=post_then_die,
+                         sleep=lambda s: None)
+    assert d1.drain_shard(shard, 10, batch=4) == 4    # partial, "dies"
+    assert alog.fanout_cursor(sid, shard) == 4        # durable
+    d2 = FanoutDeliverer(
+        alog, cfg_mem(), sleep=lambda s: None,
+        post=lambda u, b, t: got.append(json.loads(b)) or 200)
+    assert d2.drain_shard(shard, 10, batch=4) == 6    # remainder only
+    ids = [a["id"] for doc in got for a in doc["alerts"]]
+    assert ids == list(range(1, 11))                  # exactly once
+    # clean completion RETIRES the catch-up row (reads as cursor 0);
+    # the shard's drained watermark is what marks the window covered
+    assert alog.fanout_cursor(sid, shard) == 0
+    assert alog.subscribers()[0]["failures"] == 0     # 2xx healed
+    # a duplicate job over the drained window is a no-op
+    n = len(got)
+    assert d2.drain_shard(shard, 10, batch=4) == 0 and len(got) == n
+
+
+def test_aoi_filtered_subscriber_pays_nothing(alog):
+    # far: AOI in tile (101, 201) — same "00" shard but a different
+    # cell, so the audience probe never even visits it.  near: AOI in
+    # the ALERT tile (100, 200) but inset past the corner the records
+    # land on — visited as a candidate, filtered by bbox, no POST.
+    e = pyr.tile_extent(subindex.Z_BASE, 101, 201)
+    far = alog.subscribe("http://far/hook", aoi=(
+        e["ulx"] + 10, e["lry"] + 10, e["lrx"] - 10, e["uly"] - 10))
+    e = pyr.tile_extent(subindex.Z_BASE, 100, 200)
+    near = alog.subscribe("http://near/hook", aoi=(
+        e["ulx"] + 10, e["lry"] + 10, e["lrx"] - 10, e["uly"] - 10))
+    alog.append([rec_at(100, 200, 1000 + i) for i in range(3)])
+    posts = []
+    d = FanoutDeliverer(alog, cfg_mem(), sleep=lambda s: None,
+                        post=lambda u, b, t: posts.append(u) or 200)
+    assert d.drain_shard("00", 3) == 0
+    assert posts == []                                 # nothing POSTed
+    # neither holds a catch-up row: no row == caught up through the
+    # shard's drained watermark, and no per-subscriber write happened
+    assert alog.fanout_cursor(far, "00") == 0
+    assert alog.fanout_cursor(near, "00") == 0
+    # a later record inside near's AOI delivers ONLY the new record
+    px, py = tile_mid(100, 200)
+    alog.append([{"cx": px, "cy": py, "px": px, "py": py,
+                  "break_day": 5000.0}])
+    assert d.drain_shard("00", 4, since=3) == 1
+    assert posts == ["http://near/hook"]
+
+
+def test_batch_mode_chunks_posts(alog):
+    sid = alog.subscribe("http://b/hook", mode="batch", max_n=3)
+    alog.append([rec_at(100, 200, 1000 + i) for i in range(8)])
+    got = []
+    d = FanoutDeliverer(alog, cfg_mem(), sleep=lambda s: None,
+                        post=lambda u, b, t: got.append(json.loads(b))
+                        or 200)
+    assert d.drain_shard("00", 8) == 8
+    assert [doc["count"] for doc in got] == [3, 3, 2]
+    assert all(doc["schema"] == "firebird-alert-webhook/1" for doc in got)
+    # intermediate cursors are real ids; the final one is the job bound
+    assert [doc["cursor"] for doc in got] == [3, 6, 8]
+    assert alog.fanout_cursor(sid, "00") == 0          # row retired
+
+
+def test_digest_holds_window_then_flushes(alog):
+    clk = Clock(1000.0)
+    sid = alog.subscribe("http://d/hook", mode="digest", window_sec=100.0)
+    alog.append([rec_at(100, 200, 1000 + i) for i in range(3)])
+    got = []
+    d = FanoutDeliverer(alog, cfg_mem(), clock=clk, sleep=lambda s: None,
+                        post=lambda u, b, t: got.append(json.loads(b))
+                        or 200)
+    assert d.drain_shard("00", 3) == 3                 # first: no window yet
+    assert len(got) == 1 and got[0]["schema"] == "firebird-alert-digest/1"
+    assert got[0]["count"] == 3
+    alog.append([rec_at(100, 200, 2000 + i) for i in range(2)])
+    clk.t = 1050.0
+    assert d.drain_shard("00", 5) == 0                 # window open: held
+    assert len(got) == 1 and alog.fanout_cursor(sid, "00") == 3
+    clk.t = 1200.0
+    assert d.drain_shard("00", 5) == 2                 # window lapsed
+    assert got[-1]["count"] == 2
+    assert [a["id"] for a in got[-1]["alerts"]] == [4, 5]
+    assert alog.fanout_cursor(sid, "00") == 5
+
+
+def test_digest_row_survives_unmatched_window(alog):
+    """A digest subscriber's cursor row is its window clock: windows
+    whose alerts miss its AOI catch the row up CURSOR-ONLY (never
+    retire it), so last_sent keeps gating the next flush."""
+    clk = Clock(1000.0)
+    e = pyr.tile_extent(subindex.Z_BASE, 100, 200)
+    sid = alog.subscribe(
+        "http://d/hook", mode="digest", window_sec=100.0,
+        aoi=(e["ulx"] + 10, e["lry"] + 10, e["lrx"] - 10, e["uly"] - 10))
+    px, py = tile_mid(100, 200)
+    alog.append([{"cx": px, "cy": py, "px": px, "py": py,
+                  "break_day": 1000.0}])
+    got = []
+    d = FanoutDeliverer(alog, cfg_mem(), clock=clk, sleep=lambda s: None,
+                        post=lambda u, b, t: got.append(json.loads(b))
+                        or 200)
+    assert d.drain_shard("00", 1) == 1        # flushes; row persists
+    assert alog.fanout_cursor(sid, "00") == 1
+    # a window whose alert lands at the tile corner, outside the inset
+    # AOI: visited, no hit, cursor catches up, row (last_sent) survives
+    alog.append([rec_at(100, 200, 3000)])
+    clk.t = 1050.0
+    assert d.drain_shard("00", 2, since=1) == 0
+    assert alog.fanout_cursor(sid, "00") == 2
+    # matching alert inside the still-open window: held on last_sent
+    alog.append([{"cx": px, "cy": py, "px": px + 1, "py": py - 1,
+                  "break_day": 4000.0}])
+    assert d.drain_shard("00", 3, since=2) == 0
+    assert len(got) == 1
+    clk.t = 1200.0                            # window lapsed: flushes
+    assert d.drain_shard("00", 3, since=2) == 1
+    assert [a["id"] for a in got[-1]["alerts"]] == [3]
+
+
+def test_parking_backoff_and_heal(alog):
+    cfg = cfg_mem(fanout_park_after=2, fanout_park_base_sec=1.0,
+                  fanout_park_cap_sec=2.0)
+    alog.subscribe("http://dead/hook")
+    alog.append([rec_at(100, 200, 1500)])
+    calls = []
+
+    def post(url, body, timeout):
+        calls.append(url)
+        raise OSError("connection refused")
+
+    clk = Clock(1000.0)
+    d = FanoutDeliverer(alog, cfg, post=post, sleep=lambda s: None,
+                        clock=clk, rng=random.Random(0))
+    assert d.drain_shard("00", 1) == 0
+    sub = alog.subscribers()[0]
+    assert sub["failures"] == 1 and sub["parked_until"] is None
+    assert d.drain_shard("00", 1) == 0      # 2nd consecutive: parked
+    sub = alog.subscribers()[0]
+    assert sub["failures"] == 2
+    assert 1001.0 <= sub["parked_until"] <= 1002.0   # base..cap past clock
+    n = len(calls)
+    assert d.drain_shard("00", 1) == 0      # parked: not even attempted
+    assert len(calls) == n
+    clk.t = 1010.0                          # backoff elapsed; endpoint up
+    d._post = lambda u, b, t: 200
+    assert d.drain_shard("00", 1) == 1
+    sub = alog.subscribers()[0]
+    assert sub["failures"] == 0 and sub["parked_until"] is None
+
+
+def test_flat_deliverer_parks_dead_subscriber(alog):
+    """The head-of-line regression: one dead webhook must cost the
+    sweep a row check, not its retry budget every tick — the live
+    subscriber behind it delivers on the same sweep."""
+    cfg = cfg_mem(fanout_park_after=1)
+    alog.append([rec_at(100, 200, 1000 + i) for i in range(3)])
+    alog.subscribe("http://dead/hook")
+    alog.subscribe("http://live/hook")
+    calls = []
+
+    def post(url, body, timeout):
+        calls.append(url)
+        if "dead" in url:
+            raise OSError("connection refused")
+        return 200
+
+    d = WebhookDeliverer(alog, cfg, post=post, sleep=lambda s: None)
+    assert d.deliver_once() == 3            # live delivered despite dead
+    subs = {s["url"]: s for s in alog.subscribers()}
+    assert subs["http://live/hook"]["cursor"] == 3
+    assert subs["http://dead/hook"]["cursor"] == 0
+    assert subs["http://dead/hook"]["parked_until"] is not None
+    n_dead = calls.count("http://dead/hook")
+    assert d.deliver_once() == 0            # parked: dead skipped outright
+    assert calls.count("http://dead/hook") == n_dead
+
+
+# ---------------------------------------------------------------------------
+# Rollup + fleet integration
+# ---------------------------------------------------------------------------
+
+def test_rollup_watermark_and_open_job_skip(tmp_path, alog):
+    from firebird_tpu.fleet import plan
+
+    cfg = cfg_mem()
+    queue = FleetQueue(str(tmp_path / "fleet.db"), lease_sec=300.0)
+    try:
+        alog.subscribe("http://s/hook")
+        alog.append([rec_at(100, 200, 1000 + i) for i in range(3)])
+        alog.append([rec_at(1500, 300, 2000)])
+        ids = rollup(alog, queue, cfg)
+        assert len(ids) == 2
+        upto = {p["shard"]: p["upto"]
+                for _, p in queue.open_payloads("fanout")}
+        assert upto == {"00": 3, "10": 4}
+        assert alog.rollup_cursor() == 4
+        assert rollup(alog, queue, cfg) == []          # watermark holds
+        # a new alert re-rolls ONLY its shard, past the open job's bound
+        alog.append([rec_at(100, 200, 3000)])
+        ids2 = rollup(alog, queue, cfg)
+        assert len(ids2) == 1
+        assert queue.job(ids2[0])["payload"]["shard"] == "00"
+        assert queue.job(ids2[0])["payload"]["upto"] == 5
+        # re-reporting shards an open job already covers is a no-op
+        assert plan.enqueue_fanout(
+            queue, [{"shard": s, "upto": u, "count": 1}
+                    for s, u in upto.items()]) == []
+    finally:
+        queue.close()
+
+
+def test_worker_runs_fanout_job(tmp_path, monkeypatch):
+    from firebird_tpu.alerts import fanout as fanoutlib
+    from firebird_tpu.fleet.worker import FleetWorker
+
+    cfg = cfg_mem(alert_db=str(tmp_path / "alerts.db"))
+    al = AlertLog(cfg.alert_db)
+    queue = FleetQueue(str(tmp_path / "fleet.db"), lease_sec=300.0)
+    try:
+        sid = al.subscribe("http://sink/hook")
+        al.append([rec_at(100, 200, 1000 + i) for i in range(5)])
+        assert len(rollup(al, queue, cfg)) == 1
+        got = []
+        monkeypatch.setattr(
+            fanoutlib, "_default_post",
+            lambda url, body, timeout: got.append(json.loads(body)) or 200)
+        w = FleetWorker(cfg, queue, worker_id="t:1", sleep=lambda s: None)
+        summary = w.run(until_drained=True)
+        assert summary["acked"] == 1 and summary["queue"]["done"] == 1
+        assert sum(doc["count"] for doc in got) == 5
+        # retired on clean completion; the watermark covers the window
+        assert al.fanout_cursor(sid, got[0]["shard"]) == 0
+        assert al.shard_drained(got[0]["shard"]) == 5
+    finally:
+        queue.close()
+        al.close()
+
+
+# ---------------------------------------------------------------------------
+# Serve endpoint + SLO + knobs
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def served(tmp_path):
+    from firebird_tpu.serve import api as serve_api
+    from firebird_tpu.store import open_store
+
+    cfg = Config(store_backend="memory", serve_deadline_sec=5.0)
+    store = open_store("memory", "", cfg.keyspace())
+    alog = AlertLog(str(tmp_path / "alerts.db"))
+    service = serve_api.ServeService(store, cfg,
+                                     alerts=AlertFeed(alog, cfg))
+    srv = serve_api.start_serve_server(0, service, host="127.0.0.1")
+    yield f"http://127.0.0.1:{srv.port}", alog
+    srv.close()
+    alog.close()
+    store.close()
+
+
+def _post(url):
+    r = urllib.request.urlopen(
+        urllib.request.Request(url, method="POST"), timeout=10)
+    return r.status, json.loads(r.read())
+
+
+def test_webhook_registration_with_aoi_and_policy(served):
+    base, alog = served
+    e = pyr.tile_extent(subindex.Z_BASE, 100, 200)
+    bbox = f"{e['ulx'] + 10},{e['lry'] + 10},{e['lrx'] - 10},{e['uly'] - 10}"
+    code, doc = _post(base + "/v1/alerts/webhooks?url=http://h/hook"
+                      f"&bbox={bbox}&mode=batch&max_n=5")
+    assert code == 200 and doc["mode"] == "batch"
+    assert len(doc["aoi"]) == 4
+    sub = alog.subscribers()[0]
+    assert sub["mode"] == "batch" and sub["max_n"] == 5
+    assert sub["aoi"] is not None
+    assert alog.audience(*tile_mid(100, 200)) == [sub["id"]]
+    assert alog.audience(*tile_pt(500, 500)) == []
+    # policy errors are a 400, not a 500
+    for bad in ("?url=http://h2/hook&mode=bogus",
+                "?url=http://h2/hook&mode=digest"):
+        try:
+            _post(base + "/v1/alerts/webhooks" + bad)
+            assert False, f"expected 400 for {bad}"
+        except urllib.error.HTTPError as err:
+            assert err.code == 400
+
+
+def test_fanout_slo_objective_in_default_budget():
+    from firebird_tpu.obs import slo
+
+    kind, metric, stat, _ = slo.OBJECTIVES["fanout_p99"]
+    assert (kind, metric, stat) == \
+        ("histogram", "fanout_completion_seconds", "p99")
+    budgets = {b["name"]: b
+               for b in slo.parse_budget_spec(slo.DEFAULT_BUDGET_SPEC)}
+    assert budgets["fanout_p99"]["threshold"] == 30.0
+    assert budgets["fanout_p99"]["window_sec"] == 7 * 86400.0
+
+
+def test_fanout_knobs_validate_and_parse():
+    for bad in (dict(fanout_shard_prefix=0),
+                dict(fanout_shard_prefix=12),
+                dict(fanout_max_cells=3),
+                dict(fanout_park_after=0),
+                dict(fanout_park_base_sec=2.0, fanout_park_cap_sec=1.0),
+                dict(fanout_poll_sec=0.0)):
+        with pytest.raises(ValueError):
+            Config(store_backend="memory", **bad)
+    cfg = Config.from_env({"FIREBIRD_FANOUT": "0",
+                           "FIREBIRD_FANOUT_SHARD_PREFIX": "3",
+                           "FIREBIRD_FANOUT_PARK_AFTER": "5"})
+    assert cfg.fanout_enabled is False
+    assert cfg.fanout_shard_prefix == 3 and cfg.fanout_park_after == 5

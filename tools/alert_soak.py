@@ -34,6 +34,11 @@ Asserts:
 - **webhook catch-up**: a registered subscriber receives every record
   exactly once across TWO deliverer incarnations — the first delivers
   partially and dies, the second resumes from the durable cursor;
+- **sharded fanout catch-up**: the fanout plane's half of the same
+  proof — on a copy of the chaos log widened to span MULTIPLE quadkey
+  shards, a FanoutDeliverer incarnation dies mid-shard and a second
+  one re-drains every shard job; the per-(subscriber, shard) cursors
+  must compose to exactly-once records with zero duplicate POSTs;
 - **repair**: the update runs enqueued exactly one repair job per
   broken chip (idempotent across the kill + resume), a fleet worker
   drains them, the reseeded checkpoints clear needs_batch, and a
@@ -344,6 +349,14 @@ def main() -> int:  # noqa: C901 (one linear drill, read top to bottom)
             failures.append("duplicate (px, py, break_day) records "
                             "survived the resume")
 
+        # Copy the chaos log NOW — before the flat subscriber below
+        # registers — so the sharded fanout leg further down starts
+        # from the same alert rowset but a clean subscriber table.
+        import shutil
+
+        fanout_db = os.path.join(tmp, "fanout_alerts.db")
+        shutil.copyfile(chaos_db, fanout_db)
+
         # ---- webhook catch-up across deliverer incarnations ----------
         recv = Receiver()
         alog = AlertLog(chaos_db)
@@ -372,6 +385,101 @@ def main() -> int:  # noqa: C901 (one linear drill, read top to bottom)
                 "exactly once")
         if subs and (subs[0]["lag"] != 0 or subs[0]["failures"] != 0):
             failures.append(f"subscriber did not catch up: {subs[0]}")
+
+        # ---- sharded fanout catch-up across deliverer incarnations ---
+        # Same exactly-once proof through the fanout plane: the copied
+        # chaos log is widened with a burst at a far tile so the rollup
+        # spans MULTIPLE shards (the soak tile's chips share one
+        # quadkey prefix), then a FanoutDeliverer incarnation dies
+        # mid-shard and a fresh one re-drains every shard job from the
+        # durable per-(subscriber, shard) cursors.
+        from firebird_tpu.alerts import FanoutDeliverer
+        from firebird_tpu.alerts import subindex
+        from firebird_tpu.alerts.feed import _default_post
+        from firebird_tpu.serve import pyramid as pyr
+
+        ext = pyr.tile_extent(subindex.Z_BASE, 1500, 300)
+        far = [{"cx": 1500, "cy": 300, "px": ext["ulx"] + 1.0,
+                "py": ext["uly"] - 1.0, "break_day": 730000 + i}
+               for i in range(40)]
+        falog = AlertLog(fanout_db)
+        recv2 = Receiver()
+        try:
+            falog.append(far)
+            fan_sub = falog.subscribe(recv2.url)
+            shards = falog.shards_since(0, ccfg.fanout_shard_prefix)
+            con = sqlite3.connect(fanout_db)
+            try:
+                fan_ids = sorted(r[0] for r in con.execute(
+                    "SELECT id FROM alerts"))
+            finally:
+                con.close()
+            # Incarnation 1's post budget runs out mid-FIRST-shard —
+            # the stand-in for a SIGKILLed fanout worker (the loadtest
+            # kills a real one; here the parent must stay in-process).
+            # Sized one POST short of the first shard so no shard ever
+            # completes cleanly under it: every shard's cursor row is
+            # left pinned, and incarnation 2's re-drain resumes each
+            # one mid-stream instead of re-POSTing a retired shard.
+            count0 = int(shards[0]["count"]) if shards else 1
+            fan_batch = max(1, count0 // 3)
+            needed0 = -(-count0 // fan_batch)        # ceil division
+            budget = {"left": max(1, needed0 - 1)}
+
+            def dying_post(url, body, timeout):
+                if budget["left"] <= 0:
+                    raise RuntimeError("incarnation 1 died mid-shard")
+                budget["left"] -= 1
+                return _default_post(url, body, timeout)
+
+            d1 = FanoutDeliverer(falog, ccfg, post=dying_post,
+                                 sleep=lambda s: None)
+            part_fan = sum(d1.drain_shard(s["shard"], s["upto"],
+                                          batch=fan_batch)
+                           for s in shards)
+            # The durable mid-stream state incarnation 2 resumes from:
+            # a pinned cursor row part-way through the first shard.
+            mid_cursor = falog.fanout_cursor(fan_sub,
+                                             shards[0]["shard"]) \
+                if shards else 0
+            d2 = FanoutDeliverer(falog, ccfg)
+            rest_fan = sum(d2.drain_shard(s["shard"], s["upto"],
+                                          batch=fan_batch)
+                           for s in shards)
+            fan_cursors = {s["shard"]: falog.fanout_cursor(fan_sub,
+                                                           s["shard"])
+                           for s in shards}
+            fan_state = falog.shard_subscribers(shards[0]["shard"])[0]
+        finally:
+            falog.close()
+            recv2.close()
+        if len(shards) < 2:
+            failures.append(f"fanout leg rolled up {len(shards)} shard "
+                            "(expected >= 2) — nothing sharded to prove")
+        if part_fan <= 0 or part_fan >= len(fan_ids):
+            failures.append(f"first fanout incarnation delivered "
+                            f"{part_fan}/{len(fan_ids)} — no shard "
+                            "catch-up to prove")
+        if sorted(recv2.ids) != fan_ids:
+            failures.append(
+                f"fanout delivered {len(recv2.ids)} records "
+                f"({len(set(recv2.ids))} distinct), expected "
+                f"{len(fan_ids)} exactly once across incarnations")
+        if shards and not (0 < mid_cursor < int(shards[0]["upto"])):
+            failures.append(
+                f"no durable mid-shard cursor after incarnation 1 "
+                f"(got {mid_cursor}, shard upto "
+                f"{shards[0]['upto']}) — nothing resumed from")
+        # Clean completion RETIRES the catch-up row (no row reads as
+        # cursor 0): any surviving nonzero cursor means a shard never
+        # finished.
+        bad_cursors = {sh: c for sh, c in fan_cursors.items() if c}
+        if bad_cursors:
+            failures.append("fanout catch-up rows not retired after "
+                            f"the second incarnation: {bad_cursors}")
+        if fan_state["failures"] != 0 or fan_state["parked_until"]:
+            failures.append("fanout subscriber did not heal after the "
+                            f"second incarnation: {fan_state}")
 
         # ---- repair jobs: enqueued once, drained, state repaired ------
         qpath = queue_path(ccfg)
@@ -441,6 +549,11 @@ def main() -> int:  # noqa: C901 (one linear drill, read top to bottom)
                         "first_incarnation": part,
                         "batches": recv.batches,
                         "exactly_once": True},
+            "fanout": {"shards": len(shards),
+                       "delivered": len(recv2.ids),
+                       "first_incarnation": part_fan,
+                       "second_incarnation": rest_fan,
+                       "exactly_once": True},
             "repair": {"jobs": N_CHIPS,
                        "acked": acked,
                        "pixels_flagged_after": flagged},
@@ -456,7 +569,9 @@ def main() -> int:  # noqa: C901 (one linear drill, read top to bottom)
         print("alert-smoke OK: "
               f"{chaos_n} alerts exactly-once through SIGKILL at "
               f"{killed_n} + resume; webhook caught up from cursor "
-              f"({part} then {chaos_n - part}); {N_CHIPS} repair jobs "
+              f"({part} then {chaos_n - part}); fanout exactly-once "
+              f"over {len(shards)} shards across incarnations "
+              f"({part_fan} then {rest_fan}); {N_CHIPS} repair jobs "
               f"drained, 0 pixels flagged after; alert_freshness p95 "
               f"{fresh['value_sec']}s (target {fresh['target_sec']}s, "
               f"ok={fresh['ok']}) in {report['wall_seconds']}s; "

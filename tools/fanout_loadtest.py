@@ -1,0 +1,499 @@
+"""Fanout loadtest (``make fanout-smoke``): the 1M-subscriber proof.
+
+The scale half of docs/ALERTS.md "Fanout plane": a subscriber
+population the flat O(subscribers)-per-alert sweep could never serve,
+driven end to end through the real machinery — quadkey registration,
+audience resolution, rollup to ``fanout`` fleet jobs, and delivery by
+``firebird fleet work`` subprocesses — with a SIGKILL mid-burst.
+
+Legs, in order:
+
+register
+    ``--subscribers`` synthetic subscribers over mixed AOI sizes
+    (chip-sized, ~10 km, ~100 km half-widths, a few global) and mixed
+    delivery policies (immediate | batch | digest), bulk-registered
+    through AlertLog.subscribe_many.  At each milestone (10k, 100k,
+    full) the quadkey index's ``audience()`` is timed over fixed probe
+    points — the sublinearity proof — and at full scale the brute-force
+    bbox scan is timed for contrast.
+burst
+    ``--alerts`` alerts over random chips, appended in two halves.
+    The first half rolls up into shard jobs and ``--workers`` fleet
+    worker subprocesses start draining; the moment delivery begins,
+    ONE worker is SIGKILLed (its leases expire and re-deliver), then
+    the second half lands and rolls up.  A local receiver records
+    every delivered (subscriber, alert) pair.
+verify
+    Expected pairs come from ``audience()`` per alert point.  Asserts:
+    nothing missing, nothing fabricated (duplicate POSTs from the kill
+    window are allowed — forward-only cursors + record ids make them
+    exactly-once at the receiver — and counted in the artifact);
+    fanout-completion p99 (job ``updated`` − payload ``rolled_at``)
+    under the ``fanout_p99`` SLO threshold; audience resolution flat
+    from 10k to full scale.
+
+Writes ``fanout_loadtest.json`` under FIREBIRD_FANOUT_DIR (folded into
+bench artifacts by bench.py's ``_fanout_fold``) and exits non-zero on
+any violation.  Defaults are the full 1M/10k proof; the Makefile smoke
+runs a scaled-down tier (same machinery, minutes not tens of minutes).
+"""
+
+import argparse
+import json
+import os
+import random
+import signal
+import sqlite3
+import statistics
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, HERE)
+
+from firebird_tpu.config import env_knob  # noqa: E402
+
+LEASE_SEC = 3.0
+SLO_THRESHOLD_SEC = 30.0        # the fanout_p99 budget leg's threshold
+DRAIN_DEADLINE = 300.0
+
+
+def fail(msg: str) -> int:
+    print(f"fanout-smoke: {msg}", file=sys.stderr)
+    return 1
+
+
+class Receiver:
+    """A local webhook sink recording every (subscriber, alert) pair.
+
+    Subscriber URLs are ``/hook/<index>``; pairs are tallied by that
+    index so exactly-once accounting never depends on body order.  The
+    sink is a RAW keep-alive socket server (one thread per worker
+    connection) that answers a canned 200 and only BUFFERS bodies —
+    header handling is a couple of bytes ops and parsing happens in
+    :meth:`finalize_count`, called while the queue is idle, so on this
+    one-core box the sink's CPU never competes with the drain it is
+    timing (http.server's per-request parsing alone is comparable to
+    the drain's own cost at this POST rate).
+    """
+
+    _RESP = b"HTTP/1.1 200 OK\r\nContent-Length: 0\r\n\r\n"
+
+    def __init__(self):
+        import socket
+
+        self.lock = threading.Lock()
+        self.raw: list = []          # (sub index, raw body) buffer
+        self.pairs: set = set()
+        self.dups = 0
+        self.posts = 0
+        self._parsed = 0
+        self._srv = socket.create_server(("127.0.0.1", 0), backlog=64)
+        self._alive = True
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        self.url = \
+            f"http://127.0.0.1:{self._srv.getsockname()[1]}/hook"
+
+    def _accept_loop(self):
+        while self._alive:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        try:
+            buf = b""
+            while True:
+                while b"\r\n\r\n" not in buf:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                head, _, buf = buf.partition(b"\r\n\r\n")
+                # "POST /hook/<n> HTTP/1.1" — the index is the tally key
+                sub = int(head.split(b" ", 2)[1].rsplit(b"/", 1)[-1])
+                n = 0
+                lo = head.lower()
+                i = lo.find(b"content-length:")
+                if i >= 0:
+                    j = lo.find(b"\r\n", i)
+                    n = int(lo[i + 15:j if j >= 0 else len(lo)])
+                while len(buf) < n:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                body, buf = buf[:n], buf[n:]
+                with self.lock:
+                    self.posts += 1
+                    self.raw.append((sub, body))
+                conn.sendall(self._RESP)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def finalize_count(self) -> int:
+        """Fold any unparsed bodies into the pair set; returns the
+        distinct pair count (only the main thread parses)."""
+        with self.lock:
+            todo = self.raw[self._parsed:]
+            self._parsed = len(self.raw)
+        for sub, body in todo:
+            for a in json.loads(body)["alerts"]:
+                key = (sub, a["id"])
+                if key in self.pairs:
+                    self.dups += 1
+                else:
+                    self.pairs.add(key)
+        return len(self.pairs)
+
+    def close(self):
+        self._alive = False
+        self._srv.close()
+
+
+def make_entries(n: int, rng: random.Random, base_url: str, domain, *,
+                 n_global: int = 0):
+    """Mixed-AOI, mixed-policy subscriber entries.  The size mix keeps
+    the expected audience per alert in the tens — realistic regional
+    watchers, not 100k subscribers all watching the same megafire."""
+    from firebird_tpu.alerts import subindex
+    from firebird_tpu.serve import pyramid as pyr
+
+    dminx, dminy, dmaxx, dmaxy = domain
+    lim = (1 << subindex.Z_BASE) - 1
+    out = []
+    for i in range(n):
+        url = f"{base_url}/{i}"
+        if i < n_global:                             # a few global feeds
+            out.append({"url": url})
+            continue
+        r = rng.random()
+        if r < 0.90:                                 # chip-sized
+            e = pyr.tile_extent(subindex.Z_BASE, rng.randint(0, lim),
+                                rng.randint(0, lim))
+            aoi = (e["ulx"] + 5, e["lry"] + 5, e["lrx"] - 5, e["uly"] - 5)
+        else:
+            half = rng.uniform(5e3, 2e4) if r < 0.998 \
+                else rng.uniform(1e5, 2.5e5)         # regional | CONUS-ish
+            cx = rng.uniform(dminx, dmaxx)
+            cy = rng.uniform(dminy, dmaxy)
+            aoi = (cx - half, cy - half, cx + half, cy + half)
+        p = rng.random()
+        policy = {}
+        if p < 0.03:
+            policy = {"mode": "batch", "max_n": 50}
+        elif p < 0.05:
+            policy = {"mode": "digest", "window_sec": 0.5}
+        out.append({"url": url, "aoi": aoi, **policy})
+    return out
+
+
+def time_audience(alog, probes, fn=None) -> dict:
+    fn = fn or alog.audience
+    us = []
+    for px, py in probes:
+        t0 = time.perf_counter()
+        fn(px, py)
+        us.append((time.perf_counter() - t0) * 1e6)
+    return {"p50_us": round(statistics.median(us), 1),
+            "p95_us": round(sorted(us)[int(len(us) * 0.95)], 1)}
+
+
+def completion_stats(fleet_db: str) -> dict:
+    """Rollup-to-drained seconds per done fanout job, straight from the
+    queue's ``updated`` stamps — the same quantity the fleet worker
+    feeds the ``fanout_completion_seconds`` histogram."""
+    con = sqlite3.connect(fleet_db)
+    try:
+        rows = con.execute(
+            "SELECT payload, updated FROM jobs WHERE job_type = 'fanout' "
+            "AND state = 'done'").fetchall()
+    finally:
+        con.close()
+    secs = []
+    for payload, updated in rows:
+        rolled = json.loads(payload).get("rolled_at")
+        if rolled is not None and updated is not None:
+            secs.append(max(float(updated) - float(rolled), 0.0))
+    if not secs:
+        return {"jobs": 0}
+    secs.sort()
+    return {"jobs": len(secs),
+            "p50_s": round(statistics.median(secs), 3),
+            "p99_s": round(secs[min(int(len(secs) * 0.99),
+                                    len(secs) - 1)], 3),
+            "max_s": round(secs[-1], 3)}
+
+
+def main() -> int:  # noqa: C901 (one linear drill, read top to bottom)
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--subscribers", type=int, default=1_000_000)
+    ap.add_argument("--alerts", type=int, default=10_000)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=20260807)
+    args = ap.parse_args()
+
+    from firebird_tpu.alerts import subindex
+    from firebird_tpu.alerts.fanout import rollup
+    from firebird_tpu.alerts.log import AlertLog
+    from firebird_tpu.config import Config
+    from firebird_tpu.fleet import plan
+    from firebird_tpu.fleet.queue import FleetQueue
+    from firebird_tpu.serve import pyramid as pyr
+
+    t0 = time.time()
+    rng = random.Random(args.seed)
+    domain = subindex._extent(0, 0, 0)
+    lim = (1 << subindex.Z_BASE) - 1
+    report: dict = {
+        "schema": "firebird-fanout-loadtest/1",
+        "subscribers": args.subscribers, "alerts": args.alerts,
+        "workers": args.workers, "lease_sec": LEASE_SEC,
+    }
+    failures: list[str] = []
+
+    with tempfile.TemporaryDirectory(prefix="fb_fanout_") as tmp:
+        recv = Receiver()
+        alert_db = os.path.join(tmp, "alerts.db")
+        fleet_db = os.path.join(tmp, "fleet.db")
+        cfg = Config(store_backend="memory", alert_db=alert_db,
+                     fleet_db=fleet_db, fetch_retries=1,
+                     fleet_lease_sec=LEASE_SEC)
+        alog = AlertLog(alert_db)
+        queue = FleetQueue(fleet_db, lease_sec=LEASE_SEC)
+        procs: list = []
+        logs: list = []
+        try:
+            # ---- register: bulk subscriptions + audience milestones --
+            probes = [(rng.uniform(domain[0], domain[2]),
+                       rng.uniform(domain[1], domain[3]))
+                      for _ in range(25)]
+            milestones = sorted({m for m in (10_000, 100_000,
+                                             args.subscribers)
+                                 if m <= args.subscribers})
+            reg_t0 = time.time()
+            audiences = {}
+            done = 0
+            for m in milestones:
+                entries = make_entries(m - done, rng, recv.url, domain,
+                                       n_global=20 if done == 0 else 0)
+                # offset urls so indices stay unique across batches
+                for j, e in enumerate(entries):
+                    e["url"] = f"{recv.url}/{done + j}"
+                for i in range(0, len(entries), 20_000):
+                    alog.subscribe_many(entries[i:i + 20_000])
+                done = m
+                audiences[str(m)] = time_audience(alog, probes)
+            reg_sec = time.time() - reg_t0
+            brute = time_audience(alog, probes[:5],
+                                  fn=alog.audience_brute)
+            first, last = (audiences[str(milestones[0])],
+                           audiences[str(milestones[-1])])
+            ratio = last["p50_us"] / max(first["p50_us"], 1e-9)
+            report["registration"] = {
+                "seconds": round(reg_sec, 1),
+                "subs_per_sec": round(args.subscribers / reg_sec),
+            }
+            report["audience"] = {
+                "milestones": audiences,
+                "brute_full_p50_us": brute["p50_us"],
+                "sublinear_ratio_first_to_full": round(ratio, 2),
+            }
+            print(f"fanout-smoke: registered {args.subscribers} subs in "
+                  f"{reg_sec:.1f}s; audience p50 "
+                  f"{first['p50_us']}us @{milestones[0]} -> "
+                  f"{last['p50_us']}us @{milestones[-1]} "
+                  f"(brute {brute['p50_us']}us)", flush=True)
+            if len(milestones) > 1 and ratio > 10.0:
+                failures.append(
+                    f"audience resolution is not flat: p50 grew "
+                    f"{ratio:.1f}x from {milestones[0]} to "
+                    f"{milestones[-1]} subscribers")
+
+            # ---- burst, first half + workers + SIGKILL ---------------
+            recs = []
+            for i in range(args.alerts):
+                e = pyr.tile_extent(subindex.Z_BASE,
+                                    rng.randint(0, lim),
+                                    rng.randint(0, lim))
+                px, py = int(e["ulx"]) + 1, int(e["uly"]) - 1
+                recs.append({"cx": px, "cy": py, "px": px, "py": py,
+                             "break_day": 700_000.0 + i})
+            half = len(recs) // 2
+            ins, _ = alog.append(recs[:half], run_id="loadtest")
+            if ins != half:
+                failures.append(f"first half deduped: {ins}/{half}")
+            jobs1 = rollup(alog, queue, cfg)
+
+            env = dict(os.environ)
+            env.update({
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONFAULTHANDLER": "1",
+                "PYTHONPATH": HERE + os.pathsep
+                + env.get("PYTHONPATH", ""),
+                "FIREBIRD_STORE_BACKEND": "memory",
+                "FIREBIRD_ALERT_DB": alert_db,
+                "FIREBIRD_FLEET_DB": fleet_db,
+                "FIREBIRD_FLEET_LEASE_SEC": str(LEASE_SEC),
+            })
+            logs = [os.path.join(tmp, f"worker{i}.log")
+                    for i in range(args.workers)]
+            for i in range(args.workers):
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "firebird_tpu.cli", "fleet",
+                     "work", "--forever", "--poll", "0.1"],
+                    env=env, cwd=HERE, stdout=open(logs[i], "w"),
+                    stderr=subprocess.STDOUT))
+            # Kill one worker the moment delivery is demonstrably under
+            # way — mid-burst, leases live, cursors part-advanced.
+            deadline = time.time() + DRAIN_DEADLINE
+            while time.time() < deadline:
+                if recv.posts:
+                    break
+                time.sleep(0.02)
+            pairs_at_kill = recv.finalize_count()
+            procs[0].send_signal(signal.SIGKILL)
+            procs[0].wait(timeout=30)
+            if procs[0].returncode != -signal.SIGKILL:
+                failures.append(
+                    f"victim exit {procs[0].returncode}, expected -9")
+            # ---- second half lands after the kill --------------------
+            alog.append(recs[half:], run_id="loadtest")
+            jobs2 = rollup(alog, queue, cfg)
+            report["burst"] = {
+                "jobs_first_half": len(jobs1),
+                "jobs_second_half": len(jobs2),
+                "sigkill": {"victim_pid": procs[0].pid,
+                            "pairs_at_kill": pairs_at_kill},
+            }
+            print(f"fanout-smoke: SIGKILLed worker {procs[0].pid} at "
+                  f"{pairs_at_kill} delivered pairs; "
+                  f"{len(jobs1)}+{len(jobs2)} shard jobs", flush=True)
+
+            # ---- expected audience per alert (the index IS the oracle
+            # the property test pinned against brute force) ------------
+            expected = set()
+            sid_to_idx = {}
+            for s in alog.subscribers():
+                sid_to_idx[s["id"]] = int(s["url"].rsplit("/", 1)[-1])
+            appended = alog.since(0, limit=10_000)
+            while True:
+                page = alog.since(appended[-1]["id"], limit=10_000)
+                if not page:
+                    break
+                appended.extend(page)
+            for a in appended:
+                for sid in alog.audience(a["px"], a["py"]):
+                    expected.add((sid_to_idx[sid], a["id"]))
+
+            # ---- converge: flush digests, drain everything -----------
+            all_shards = alog.shards_since(0, cfg.fanout_shard_prefix)
+            deadline = time.time() + DRAIN_DEADLINE
+            while time.time() < deadline:
+                if not queue.open_payloads("fanout"):
+                    # Queue idle: safe to spend the core on parsing.
+                    if recv.finalize_count() >= len(expected):
+                        break
+                    # open-job skip makes this idempotent; it re-drains
+                    # held digest windows until they flush
+                    plan.enqueue_fanout(queue, all_shards)
+                time.sleep(0.25)
+            got = recv.finalize_count()
+            dups, posts = recv.dups, recv.posts
+            missing = len(expected - recv.pairs)
+            fabricated = len(recv.pairs - expected)
+            if missing:
+                failures.append(f"{missing}/{len(expected)} expected "
+                                "(subscriber, alert) pairs were never "
+                                "delivered")
+            if fabricated:
+                failures.append(f"{fabricated} pairs delivered outside "
+                                "the audience index")
+            if pairs_at_kill >= len(expected):
+                failures.append("SIGKILL landed after full delivery — "
+                                "the kill window proved nothing")
+            report["burst"].update({
+                "pairs_expected": len(expected),
+                "pairs_delivered": got,
+                "missing": missing,
+                "fabricated": fabricated,
+                "duplicate_posts_after_kill": dups,
+                "posts": posts,
+                "exactly_once_records": missing == 0 and fabricated == 0,
+            })
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            counts = queue.counts()
+            queue.close()
+            alog.close()
+            recv.close()
+        for i, lp in enumerate(logs):
+            if failures and os.path.exists(lp):
+                with open(lp) as f:
+                    txt = f.read()[-4000:]
+                if txt:
+                    print(f"--- worker{i}.log ---\n{txt}",
+                          file=sys.stderr)
+
+        # ---- completion SLO ------------------------------------------
+        comp = completion_stats(fleet_db)
+        comp["threshold_s"] = SLO_THRESHOLD_SEC
+        comp["fanout_p99_ok"] = bool(
+            comp.get("p99_s") is not None
+            and comp["p99_s"] < SLO_THRESHOLD_SEC)
+        report["completion"] = comp
+        report["queue"] = counts
+        if not comp.get("jobs"):
+            failures.append("no done fanout jobs with rolled_at stamps")
+        elif not comp["fanout_p99_ok"]:
+            failures.append(
+                f"fanout completion p99 {comp['p99_s']}s breaches the "
+                f"{SLO_THRESHOLD_SEC}s fanout_p99 threshold")
+        if counts.get("dead"):
+            failures.append(f"dead fanout jobs: {counts}")
+
+    report["wall_seconds"] = round(time.time() - t0, 1)
+    report["ok"] = not failures
+    art_dir = env_knob("FIREBIRD_FANOUT_DIR")
+    os.makedirs(art_dir, exist_ok=True)
+    art = os.path.join(art_dir, "fanout_loadtest.json")
+    with open(art, "w") as f:
+        json.dump(report, f, indent=1)
+    if failures:
+        for msg in failures:
+            print(f"fanout-smoke: {msg}", file=sys.stderr)
+        print(f"fanout-smoke: FAILED (artifact {art})", file=sys.stderr)
+        return 1
+    b, c = report["burst"], report["completion"]
+    print("fanout-smoke OK: "
+          f"{args.subscribers} subscribers, {b['pairs_expected']} "
+          f"(subscriber, alert) pairs exactly-once through a worker "
+          f"SIGKILL at {b['sigkill']['pairs_at_kill']} "
+          f"({b['duplicate_posts_after_kill']} duplicate re-POSTs "
+          f"deduped by record id); audience p50 "
+          f"{report['audience']['milestones'][str(args.subscribers)]['p50_us']}us "
+          f"at full scale (ratio {report['audience']['sublinear_ratio_first_to_full']}x, "
+          f"brute {report['audience']['brute_full_p50_us']}us); "
+          f"completion p99 {c['p99_s']}s over {c['jobs']} jobs "
+          f"(< {SLO_THRESHOLD_SEC}s); in {report['wall_seconds']}s; "
+          f"artifact {art}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
